@@ -1,0 +1,4 @@
+from mlcomp_tpu.db.core import Session, Column, DBModel
+from mlcomp_tpu.db.options import PaginatorOptions
+
+__all__ = ['Session', 'Column', 'DBModel', 'PaginatorOptions']

@@ -1,0 +1,313 @@
+"""DB core: keyed singleton sessions over sqlite3.
+
+Parity target: reference db/core/db.py:10-119 (SQLAlchemy `Session` with
+per-key singletons, sqlite FK pragma + threading options, auto-rollback on
+error, numpy type adaptation). SQLAlchemy is not available in this image, so
+this module provides an equivalent capability on stdlib sqlite3:
+
+- ``Session.create_session(key=...)`` returns a process-wide singleton per
+  key (reference db/core/db.py:20-47)
+- WAL journal + busy timeout so multiple worker processes on one host can
+  share the metadata store concurrently
+- a tiny declarative layer (``Column`` + ``DBModel``) that the schema
+  modules use; DDL is generated from it by the migration runner
+- automatic adaptation of numpy scalar types, datetimes and bools
+"""
+
+import datetime
+import json
+import os
+import sqlite3
+import threading
+
+import numpy as np
+
+_SQLITE_PREFIX = 'sqlite:///'
+
+
+def adapt_value(v):
+    """Convert python/numpy values to sqlite-storable primitives."""
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.ndarray):
+        return json.dumps(v.tolist())
+    if isinstance(v, datetime.datetime):
+        return v.strftime('%Y-%m-%d %H:%M:%S.%f')
+    if isinstance(v, bool):
+        return int(v)
+    from mlcomp_tpu.db.enums import OrderedEnum
+    if isinstance(v, OrderedEnum):
+        return int(v)
+    return v
+
+
+def parse_datetime(s):
+    if s is None or isinstance(s, datetime.datetime):
+        return s
+    for fmt in ('%Y-%m-%d %H:%M:%S.%f', '%Y-%m-%d %H:%M:%S'):
+        try:
+            return datetime.datetime.strptime(s, fmt)
+        except ValueError:
+            continue
+    return None
+
+
+class Column:
+    """Declarative column spec (the reference used sqlalchemy.Column)."""
+
+    _counter = 0
+
+    def __init__(self, type_='TEXT', primary_key=False, nullable=True,
+                 default=None, foreign_key=None, index=False, unique=False,
+                 dtype=None):
+        self.type = type_
+        self.primary_key = primary_key
+        self.nullable = nullable
+        self.default = default
+        self.foreign_key = foreign_key  # 'table.column'
+        self.index = index
+        self.unique = unique
+        self.dtype = dtype  # python-side type: 'datetime'|'bool'|None
+        self.name = None
+        Column._counter += 1
+        self._order = Column._counter
+
+    def ddl(self):
+        parts = [f'"{self.name}"', self.type]
+        if self.primary_key:
+            parts.append('PRIMARY KEY AUTOINCREMENT'
+                         if self.type == 'INTEGER' else 'PRIMARY KEY')
+        if not self.nullable and not self.primary_key:
+            parts.append('NOT NULL')
+        if self.unique:
+            parts.append('UNIQUE')
+        if self.foreign_key:
+            t, c = self.foreign_key.split('.')
+            parts.append(f'REFERENCES {t}({c}) ON DELETE CASCADE')
+        return ' '.join(parts)
+
+
+class _ModelMeta(type):
+    def __new__(mcs, name, bases, ns):
+        cls = super().__new__(mcs, name, bases, ns)
+        cols = {}
+        for base in reversed(cls.__mro__):
+            for k, v in vars(base).items():
+                if isinstance(v, Column):
+                    v.name = k
+                    cols[k] = v
+        cls.__columns__ = dict(
+            sorted(cols.items(), key=lambda kv: kv[1]._order))
+        return cls
+
+
+class DBModel(metaclass=_ModelMeta):
+    """Base for declarative models (reference db/models/base.py:1-4).
+
+    Instances are plain attribute bags; ``to_dict`` serializes them the way
+    the reference's sqlalchemy_serializer did (datetimes to isoformat).
+    """
+
+    __tablename__ = None
+
+    def __init__(self, **kwargs):
+        for k, col in self.__columns__.items():
+            setattr(self, k, kwargs.pop(k, col.default))
+        if kwargs:
+            raise TypeError(
+                f'{type(self).__name__}: unknown fields {sorted(kwargs)}')
+
+    @classmethod
+    def from_row(cls, row):
+        obj = cls.__new__(cls)
+        keys = row.keys()
+        for k, col in cls.__columns__.items():
+            v = row[k] if k in keys else col.default
+            if v is not None:
+                if col.dtype == 'datetime':
+                    v = parse_datetime(v)
+                elif col.dtype == 'bool':
+                    v = bool(v)
+            setattr(obj, k, v)
+        return obj
+
+    def to_dict(self):
+        out = {}
+        for k in self.__columns__:
+            v = getattr(self, k, None)
+            if isinstance(v, datetime.datetime):
+                v = v.isoformat()
+            elif isinstance(v, bool):
+                v = int(v)
+            out[k] = v
+        return out
+
+    @classmethod
+    def create_table_ddl(cls):
+        cols = ',\n  '.join(c.ddl() for c in cls.__columns__.values())
+        ddl = [f'CREATE TABLE IF NOT EXISTS {cls.__tablename__} (\n  {cols}\n)']
+        for c in cls.__columns__.values():
+            if c.index:
+                ddl.append(
+                    f'CREATE INDEX IF NOT EXISTS '
+                    f'idx_{cls.__tablename__}_{c.name} '
+                    f'ON {cls.__tablename__}("{c.name}")')
+        return ddl
+
+    def __repr__(self):
+        pk = getattr(self, 'id', None)
+        return f'<{type(self).__name__} id={pk}>'
+
+
+class _Result:
+    """Materialized statement result (rows consumed before commit)."""
+
+    def __init__(self, rows, lastrowid, rowcount):
+        self._rows = rows
+        self.lastrowid = lastrowid
+        self.rowcount = rowcount
+
+    def fetchone(self):
+        return self._rows[0] if self._rows else None
+
+    def fetchall(self):
+        return self._rows
+
+
+class Session:
+    """Keyed singleton DB session (reference db/core/db.py:20-47).
+
+    Thread-safe: a single sqlite3 connection guarded by an RLock. WAL mode
+    allows concurrent reader/writer processes on the same host; for true
+    multi-host deployments the connection string can point at a shared
+    network filesystem or a server-backed store.
+    """
+
+    __session_holder = {}
+    _lock = threading.RLock()
+
+    def __init__(self, connection_string, key):
+        self.key = key
+        self.connection_string = connection_string
+        assert connection_string.startswith(_SQLITE_PREFIX), \
+            'only sqlite:/// connection strings are supported in this build'
+        self.db_path = connection_string[len(_SQLITE_PREFIX):]
+        db_dir = os.path.dirname(self.db_path)
+        if db_dir:
+            os.makedirs(db_dir, exist_ok=True)
+        self._conn = sqlite3.connect(
+            self.db_path, check_same_thread=False, timeout=30.0)
+        self._conn.row_factory = sqlite3.Row
+        self._conn.execute('PRAGMA journal_mode=WAL')
+        self._conn.execute('PRAGMA foreign_keys=ON')
+        self._conn.execute('PRAGMA busy_timeout=30000')
+        self._conn.execute('PRAGMA synchronous=NORMAL')
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------ api
+    @classmethod
+    def create_session(cls, key='default', connection_string=None):
+        with cls._lock:
+            if key in cls.__session_holder:
+                return cls.__session_holder[key]
+            if connection_string is None:
+                import mlcomp_tpu
+                connection_string = mlcomp_tpu.SA_CONNECTION_STRING
+            s = cls(connection_string, key)
+            cls.__session_holder[key] = s
+            return s
+
+    @classmethod
+    def cleanup(cls, key=None):
+        """Drop cached sessions (reference recreates sessions on SA errors)."""
+        with cls._lock:
+            keys = [key] if key else list(cls.__session_holder)
+            for k in keys:
+                s = cls.__session_holder.pop(k, None)
+                if s is not None:
+                    try:
+                        s._conn.close()
+                    except Exception:
+                        pass
+
+    def execute(self, sql, params=()):
+        params = tuple(adapt_value(p) for p in params)
+        with self._lock:
+            try:
+                cur = self._conn.execute(sql, params)
+                # consume RETURNING rows before commit
+                rows = cur.fetchall() if cur.description else []
+                result = _Result(rows, cur.lastrowid, cur.rowcount)
+                self._conn.commit()
+                return result
+            except Exception:
+                self._conn.rollback()
+                raise
+
+    def executemany(self, sql, seq):
+        seq = [tuple(adapt_value(p) for p in row) for row in seq]
+        with self._lock:
+            try:
+                cur = self._conn.executemany(sql, seq)
+                self._conn.commit()
+                return cur
+            except Exception:
+                self._conn.rollback()
+                raise
+
+    def query(self, sql, params=()):
+        params = tuple(adapt_value(p) for p in params)
+        with self._lock:
+            return self._conn.execute(sql, params).fetchall()
+
+    def query_one(self, sql, params=()):
+        params = tuple(adapt_value(p) for p in params)
+        with self._lock:
+            return self._conn.execute(sql, params).fetchone()
+
+    # --------------------------------------------------------------- object
+    def add(self, obj, commit=True):
+        cols, vals = [], []
+        for k, col in obj.__columns__.items():
+            v = getattr(obj, k, None)
+            if col.primary_key and v is None:
+                continue
+            cols.append(f'"{k}"')
+            vals.append(adapt_value(v))
+        sql = (f'INSERT INTO {obj.__tablename__} '
+               f'({", ".join(cols)}) VALUES ({", ".join("?" * len(cols))})')
+        with self._lock:
+            try:
+                cur = self._conn.execute(sql, vals)
+                if hasattr(obj, 'id') and getattr(obj, 'id', None) is None:
+                    obj.id = cur.lastrowid
+                if commit:
+                    self._conn.commit()
+                return obj
+            except Exception:
+                self._conn.rollback()
+                raise
+
+    def add_all(self, objs):
+        for o in objs:
+            self.add(o, commit=False)
+        with self._lock:
+            self._conn.commit()
+
+    def update_obj(self, obj, fields=None):
+        pk = next(k for k, c in obj.__columns__.items() if c.primary_key)
+        fields = fields or [k for k in obj.__columns__ if k != pk]
+        sets = ', '.join(f'"{f}"=?' for f in fields)
+        vals = [adapt_value(getattr(obj, f, None)) for f in fields]
+        vals.append(adapt_value(getattr(obj, pk)))
+        self.execute(
+            f'UPDATE {obj.__tablename__} SET {sets} WHERE "{pk}"=?', vals)
+
+    def commit(self):
+        with self._lock:
+            self._conn.commit()
+
+
+__all__ = ['Session', 'Column', 'DBModel', 'adapt_value', 'parse_datetime']

@@ -1,0 +1,186 @@
+"""Versioned schema migration + seed data.
+
+Parity: reference mlcomp/migration/ (sqlalchemy-migrate `migrate()`,
+migration/manage.py:9-17; DDL versions/001_init.py; seed report layouts
+versions/002_data.py). sqlalchemy-migrate is long dead, so this is a small
+self-contained runner: a ``migration_version`` table records the applied
+version; each migration is a python function applied in order.
+"""
+
+from mlcomp_tpu.db.core import Session
+from mlcomp_tpu.utils.misc import now
+
+# --------------------------------------------------------------------------
+# Seed report layouts. TPU-flavored: the base panel tracks step time,
+# throughput (images/sec) and compile time instead of the reference's
+# catalyst timer series (reference versions/002/report_layout/base.yml).
+# --------------------------------------------------------------------------
+
+LAYOUT_BASE = """\
+metric:
+  name: loss
+  minimize: True
+
+items:
+  throughput:
+    type: series
+    key: throughput
+  step_time:
+    type: series
+    key: step_time
+  compile_time:
+    type: series
+    key: compile_time
+  lr:
+    type: series
+    key: lr
+
+layout:
+  - type: panel
+    title: base
+    expanded: False
+    parent_cols: 2
+    row_height: 400
+    items:
+      - type: series
+        source: throughput
+      - type: series
+        source: step_time
+      - type: series
+        source: compile_time
+      - type: series
+        source: lr
+"""
+
+LAYOUT_CLASSIFY = """\
+extend: base
+
+metric:
+  name: accuracy
+  minimize: False
+
+items:
+  loss:
+    type: series
+    key: loss
+  accuracy:
+    type: series
+    key: accuracy
+
+layout:
+  - type: panel
+    title: main
+    parent_cols: 2
+    row_height: 400
+    items:
+      - type: series
+        source: loss
+      - type: series
+        source: accuracy
+"""
+
+LAYOUT_IMG_CLASSIFY = """\
+extend: classify
+
+items:
+  img_classify:
+    type: img_classify
+    name: img_classify
+
+layout:
+  - type: panel
+    title: images
+    expanded: False
+    items:
+      - type: img_classify
+        source: img_classify
+"""
+
+LAYOUT_SEGMENT = """\
+extend: base
+
+metric:
+  name: dice
+  minimize: False
+
+items:
+  loss:
+    type: series
+    key: loss
+  dice:
+    type: series
+    key: dice
+  iou:
+    type: series
+    key: iou
+  img_segment:
+    type: img_segment
+    name: img_segment
+
+layout:
+  - type: panel
+    title: main
+    parent_cols: 2
+    row_height: 400
+    items:
+      - type: series
+        source: loss
+      - type: series
+        source: dice
+      - type: series
+        source: iou
+  - type: panel
+    title: images
+    expanded: False
+    items:
+      - type: img_segment
+        source: img_segment
+"""
+
+DEFAULT_LAYOUTS = {
+    'base': LAYOUT_BASE,
+    'classify': LAYOUT_CLASSIFY,
+    'img_classify': LAYOUT_IMG_CLASSIFY,
+    'segment': LAYOUT_SEGMENT,
+}
+
+
+def _v1_init(session: Session):
+    """Create all tables + indices (reference versions/001_init.py)."""
+    from mlcomp_tpu.db.models import ALL_MODELS
+    for model in ALL_MODELS:
+        for stmt in model.create_table_ddl():
+            session.execute(stmt)
+
+
+def _v2_data(session: Session):
+    """Seed default report layouts (reference versions/002_data.py:9-28)."""
+    for name, content in DEFAULT_LAYOUTS.items():
+        row = session.query_one(
+            'SELECT id FROM report_layout WHERE name=?', (name,))
+        if row is None:
+            session.execute(
+                'INSERT INTO report_layout (name, content, last_modified) '
+                'VALUES (?, ?, ?)',
+                (name, content, now()))
+
+
+MIGRATIONS = [_v1_init, _v2_data]
+
+
+def migrate(session: Session = None):
+    """Apply pending migrations (reference migration/manage.py:9-17)."""
+    session = session or Session.create_session(key='migration')
+    session.execute(
+        'CREATE TABLE IF NOT EXISTS migration_version (version INTEGER)')
+    row = session.query_one('SELECT MAX(version) AS v FROM migration_version')
+    current = row['v'] if row and row['v'] is not None else 0
+    for i, fn in enumerate(MIGRATIONS, start=1):
+        if i > current:
+            fn(session)
+            session.execute(
+                'INSERT INTO migration_version (version) VALUES (?)', (i,))
+    return len(MIGRATIONS)
+
+
+__all__ = ['migrate', 'DEFAULT_LAYOUTS']

@@ -1,0 +1,24 @@
+"""DB schema: 20 declarative models (parity: reference db/models/__init__.py:1-19)."""
+
+from mlcomp_tpu.db.models.project import Project
+from mlcomp_tpu.db.models.dag import Dag
+from mlcomp_tpu.db.models.task import Task, TaskDependence, TaskSynced
+from mlcomp_tpu.db.models.computer import Computer, ComputerUsage
+from mlcomp_tpu.db.models.docker import Docker
+from mlcomp_tpu.db.models.file import File, DagStorage, DagLibrary
+from mlcomp_tpu.db.models.log import Log
+from mlcomp_tpu.db.models.step import Step
+from mlcomp_tpu.db.models.report import (
+    Report, ReportImg, ReportSeries, ReportTasks, ReportLayout
+)
+from mlcomp_tpu.db.models.model import Model
+from mlcomp_tpu.db.models.auxiliary import Auxiliary
+from mlcomp_tpu.db.models.queue import QueueMessage
+
+ALL_MODELS = [
+    Project, Report, ReportLayout, Dag, Task, TaskDependence, TaskSynced,
+    Computer, ComputerUsage, Docker, File, DagStorage, DagLibrary, Log, Step,
+    ReportImg, ReportSeries, ReportTasks, Model, Auxiliary, QueueMessage,
+]
+
+__all__ = [m.__name__ for m in ALL_MODELS] + ['ALL_MODELS']

@@ -1,0 +1,18 @@
+"""Dag model (parity: reference db/models/dag.py:9-24)."""
+
+from mlcomp_tpu.db.core import Column, DBModel
+
+
+class Dag(DBModel):
+    __tablename__ = 'dag'
+
+    id = Column('INTEGER', primary_key=True)
+    name = Column('TEXT', nullable=False)
+    created = Column('TEXT', dtype='datetime')
+    config = Column('TEXT', nullable=False)   # full yaml config text
+    project = Column('INTEGER', foreign_key='project.id', index=True)
+    docker_img = Column('TEXT')               # runtime image/environment name
+    img_size = Column('INTEGER', default=0)
+    file_size = Column('INTEGER', default=0)
+    type = Column('INTEGER', default=0)       # DagType
+    report = Column('INTEGER')                # Report.id

@@ -1,0 +1,23 @@
+"""Queue message model — the DB-backed task transport.
+
+Replaces the reference's Celery-over-Redis dispatch (reference
+worker/app.py:10-17, worker/tasks.py:292-309). Capability preserved: named
+per-(host, runtime) queues, revoke, result/status tracking — without an
+external broker. Workers poll their queues; the supervisor enqueues.
+"""
+
+from mlcomp_tpu.db.core import Column, DBModel
+
+
+class QueueMessage(DBModel):
+    __tablename__ = 'queue_message'
+
+    id = Column('INTEGER', primary_key=True)
+    queue = Column('TEXT', nullable=False, index=True)
+    payload = Column('TEXT', nullable=False)   # json {action, task_id, ...}
+    status = Column('TEXT', default='pending', index=True)
+    # pending | claimed | done | failed | revoked
+    created = Column('TEXT', dtype='datetime')
+    claimed_at = Column('TEXT', dtype='datetime')
+    claimed_by = Column('TEXT')                # worker identity
+    result = Column('TEXT')

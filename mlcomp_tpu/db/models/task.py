@@ -1,0 +1,62 @@
+"""Task models (parity: reference db/models/task.py:9-63).
+
+TPU-first resource model: a task requests ``cores``..``cores_max`` TPU
+cores (the reference requested ``gpu``..``gpu_max`` GPU indices,
+db/models/task.py:20-22); the scheduler assigns a concrete core list into
+``cores_assigned``. The queue message id replaces the celery task id.
+"""
+
+from mlcomp_tpu.db.core import Column, DBModel
+
+
+class Task(DBModel):
+    __tablename__ = 'task'
+
+    id = Column('INTEGER', primary_key=True)
+    name = Column('TEXT', nullable=False)
+    status = Column('INTEGER', default=0, index=True)     # TaskStatus
+    started = Column('TEXT', dtype='datetime')
+    finished = Column('TEXT', dtype='datetime')
+    computer = Column('TEXT')             # pinned computer name (or None)
+    cores = Column('INTEGER', default=0)  # min TPU cores required
+    cores_max = Column('INTEGER', default=0)
+    cpu = Column('INTEGER', default=1)
+    memory = Column('REAL', default=0.1)  # GB
+    executor = Column('TEXT', nullable=False)
+    computer_assigned = Column('TEXT', index=True)
+    cores_assigned = Column('TEXT')       # json list of core indices
+    docker_assigned = Column('TEXT')
+    queue_id = Column('INTEGER')          # QueueMessage.id (was celery_id)
+    pid = Column('INTEGER')
+    worker_index = Column('INTEGER', default=-1)
+    dag = Column('INTEGER', foreign_key='dag.id', index=True)
+    parent = Column('INTEGER', index=True)  # service-task link to parent
+    report = Column('INTEGER')
+    score = Column('REAL')
+    result = Column('TEXT')               # yaml result blob
+    additional_info = Column('TEXT')      # yaml: distr_info, resume, grid_cell
+    type = Column('INTEGER', default=0)   # TaskType
+    current_step = Column('TEXT')         # dotted step path
+    last_activity = Column('TEXT', dtype='datetime')
+    debug = Column('INTEGER', default=0, dtype='bool')
+    gpu_requirement = Column('TEXT')      # raw spec string e.g. "2-4"
+    single_node = Column('INTEGER', default=1, dtype='bool')
+
+
+class TaskDependence(DBModel):
+    __tablename__ = 'task_dependence'
+
+    id = Column('INTEGER', primary_key=True)
+    task_id = Column('INTEGER', foreign_key='task.id', index=True,
+                     nullable=False)
+    depend_id = Column('INTEGER', foreign_key='task.id', index=True,
+                       nullable=False)
+
+
+class TaskSynced(DBModel):
+    __tablename__ = 'task_synced'
+
+    id = Column('INTEGER', primary_key=True)
+    computer = Column('TEXT', nullable=False, index=True)
+    task = Column('INTEGER', foreign_key='task.id', index=True,
+                  nullable=False)

@@ -1,0 +1,31 @@
+"""Data providers — one repository per entity (parity: reference db/providers/)."""
+
+from mlcomp_tpu.db.providers.base import BaseDataProvider
+from mlcomp_tpu.db.providers.project import ProjectProvider
+from mlcomp_tpu.db.providers.dag import DagProvider
+from mlcomp_tpu.db.providers.task import TaskProvider
+from mlcomp_tpu.db.providers.computer import ComputerProvider
+from mlcomp_tpu.db.providers.docker import DockerProvider
+from mlcomp_tpu.db.providers.file import (
+    FileProvider, DagStorageProvider, DagLibraryProvider
+)
+from mlcomp_tpu.db.providers.log import LogProvider
+from mlcomp_tpu.db.providers.step import StepProvider
+from mlcomp_tpu.db.providers.report import (
+    ReportProvider, ReportSeriesProvider, ReportImgProvider,
+    ReportTasksProvider, ReportLayoutProvider
+)
+from mlcomp_tpu.db.providers.model import ModelProvider
+from mlcomp_tpu.db.providers.auxiliary import AuxiliaryProvider
+from mlcomp_tpu.db.providers.task_synced import TaskSyncedProvider
+from mlcomp_tpu.db.providers.queue import QueueProvider
+
+__all__ = [
+    'BaseDataProvider', 'ProjectProvider', 'DagProvider', 'TaskProvider',
+    'ComputerProvider', 'DockerProvider', 'FileProvider',
+    'DagStorageProvider', 'DagLibraryProvider', 'LogProvider',
+    'StepProvider', 'ReportProvider', 'ReportSeriesProvider',
+    'ReportImgProvider', 'ReportTasksProvider', 'ReportLayoutProvider',
+    'ModelProvider', 'AuxiliaryProvider', 'TaskSyncedProvider',
+    'QueueProvider',
+]

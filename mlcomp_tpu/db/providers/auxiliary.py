@@ -1,0 +1,35 @@
+"""Auxiliary provider (parity: reference db/providers/auxiliary.py:6-16)."""
+
+import json
+
+from mlcomp_tpu.db.models import Auxiliary
+from mlcomp_tpu.db.providers.base import BaseDataProvider
+
+
+class AuxiliaryProvider(BaseDataProvider):
+    model = Auxiliary
+
+    def create_or_update(self, name: str, data: dict):
+        payload = json.dumps(data, default=str)
+        row = self.session.query_one(
+            'SELECT name FROM auxiliary WHERE name=?', (name,))
+        if row is None:
+            self.session.execute(
+                'INSERT INTO auxiliary (name, data) VALUES (?, ?)',
+                (name, payload))
+        else:
+            self.session.execute(
+                'UPDATE auxiliary SET data=? WHERE name=?', (payload, name))
+
+    def get(self):
+        rows = self.session.query('SELECT * FROM auxiliary')
+        out = {}
+        for r in rows:
+            try:
+                out[r['name']] = json.loads(r['data'])
+            except (ValueError, TypeError):
+                out[r['name']] = r['data']
+        return out
+
+
+__all__ = ['AuxiliaryProvider']

@@ -1,0 +1,88 @@
+"""Computer provider (parity: reference db/providers/computer.py:14-154)."""
+
+import datetime
+import json
+
+from mlcomp_tpu.db.models import Computer, ComputerUsage
+from mlcomp_tpu.db.providers.base import BaseDataProvider
+from mlcomp_tpu.utils.misc import now
+
+
+class ComputerProvider(BaseDataProvider):
+    model = Computer
+
+    def computers(self):
+        """name -> computer dict for the scheduler
+        (reference computer.py:20-24)."""
+        res = {}
+        for r in self.session.query('SELECT * FROM computer'):
+            c = Computer.from_row(r)
+            d = c.to_dict()
+            res[c.name] = d
+        return res
+
+    def by_name(self, name: str):
+        row = self.session.query_one(
+            'SELECT * FROM computer WHERE name=?', (name,))
+        return Computer.from_row(row) if row else None
+
+    def get(self, filter: dict = None, options=None):
+        data = []
+        for r in self.session.query('SELECT * FROM computer'):
+            c = Computer.from_row(r)
+            item = c.to_dict()
+            if item.get('usage'):
+                try:
+                    item['usage'] = json.loads(item['usage'])
+                except (ValueError, TypeError):
+                    pass
+            dockers = self.session.query(
+                'SELECT * FROM docker WHERE computer=?', (c.name,))
+            item['dockers'] = [dict(d) for d in dockers]
+            data.append(item)
+        return {'total': len(data), 'data': data}
+
+    def current_usage(self, name: str, usage: dict):
+        c = self.by_name(name)
+        if c is not None:
+            c.usage = json.dumps(usage)
+            self.update(c, ['usage'])
+
+    def add_usage_history(self, name: str, usage: dict, time=None):
+        self.add(ComputerUsage(
+            computer=name, usage=json.dumps(usage), time=time or now()))
+
+    def usage_history(self, computer: str, min_time=None):
+        sql = 'SELECT * FROM computer_usage WHERE computer=?'
+        params = [computer]
+        if min_time:
+            sql += ' AND time>=?'
+            params.append(min_time)
+        sql += ' ORDER BY time'
+        rows = self.session.query(sql, params)
+        mean = []
+        for r in rows:
+            try:
+                u = json.loads(r['usage'])
+            except (ValueError, TypeError):
+                continue
+            u['time'] = r['time']
+            mean.append(u)
+        return {'mean': mean}
+
+    def all_with_last_activity(self):
+        """Computers + the freshest docker heartbeat on each
+        (reference computer.py `all_with_last_activtiy`)."""
+        res = []
+        for r in self.session.query('SELECT * FROM computer'):
+            c = Computer.from_row(r)
+            row = self.session.query_one(
+                'SELECT MAX(last_activity) AS m FROM docker '
+                'WHERE computer=?', (c.name,))
+            from mlcomp_tpu.db.core import parse_datetime
+            c.last_activity = parse_datetime(row['m']) if row else None
+            res.append(c)
+        return res
+
+
+__all__ = ['ComputerProvider']

@@ -1,0 +1,33 @@
+"""Docker (runtime) provider (parity: reference db/providers/docker.py:8-23)."""
+
+import datetime
+
+from mlcomp_tpu.db.models import Docker
+from mlcomp_tpu.db.providers.base import BaseDataProvider
+from mlcomp_tpu.utils.misc import now
+
+
+class DockerProvider(BaseDataProvider):
+    model = Docker
+
+    def get(self, computer: str, name: str):
+        row = self.session.query_one(
+            'SELECT * FROM docker WHERE computer=? AND name=?',
+            (computer, name))
+        return Docker.from_row(row) if row else None
+
+    def alive(self, window_seconds: float = 15.0):
+        """Docker rows whose heartbeat is within the liveness window
+        (reference supervisor.py:47-50)."""
+        min_time = now() - datetime.timedelta(seconds=window_seconds)
+        rows = self.session.query(
+            'SELECT * FROM docker WHERE last_activity >= ?', (min_time,))
+        return [Docker.from_row(r) for r in rows]
+
+    def heartbeat(self, computer: str, name: str):
+        self.session.execute(
+            'UPDATE docker SET last_activity=? WHERE computer=? AND name=?',
+            (now(), computer, name))
+
+
+__all__ = ['DockerProvider']

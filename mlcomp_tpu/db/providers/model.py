@@ -1,0 +1,59 @@
+"""Model registry provider (parity: reference db/providers/model.py:15-135)."""
+
+from mlcomp_tpu.db.models import Model
+from mlcomp_tpu.db.providers.base import BaseDataProvider, PaginatorOptions
+from mlcomp_tpu.utils.io import yaml_load
+
+
+class ModelProvider(BaseDataProvider):
+    model = Model
+
+    def by_name(self, name: str):
+        row = self.session.query_one(
+            'SELECT * FROM model WHERE name=?', (name,))
+        return Model.from_row(row) if row else None
+
+    def get(self, filter: dict = None, options: PaginatorOptions = None):
+        filter = filter or {}
+        where, params = [], []
+        if filter.get('project'):
+            where.append('project=?')
+            params.append(filter['project'])
+        if filter.get('name'):
+            where.append('name LIKE ?')
+            params.append(f"%{filter['name']}%")
+        if filter.get('dag'):
+            where.append('dag=?')
+            params.append(filter['dag'])
+        where_sql = ' AND '.join(where)
+        models = self.query(where_sql, tuple(params), options,
+                            default_sort='created')
+        total = self.count(where_sql, tuple(params))
+        return {'total': total, 'data': [m.to_dict() for m in models]}
+
+    def model_start_begin(self, model_id: int):
+        """Payload for the 'start pipe for model' UI dialog: the pipes and
+        versioned equations available in the model's project
+        (reference db/providers/model.py:97-135)."""
+        m = self.by_id(model_id)
+        if m is None:
+            return {}
+        equations = yaml_load(m.equations) if m.equations else {}
+        pipes = []
+        row = self.session.query_one(
+            'SELECT config FROM dag WHERE project=? AND type=1 '
+            'ORDER BY id DESC LIMIT 1', (m.project,))
+        if row:
+            cfg = yaml_load(row['config'])
+            for name in (cfg.get('pipes') or {}):
+                pipes.append({'name': name})
+        return {
+            'model': m.to_dict(),
+            'pipes': pipes,
+            'versions': [
+                {'name': k, 'equations': v} for k, v in equations.items()
+            ],
+        }
+
+
+__all__ = ['ModelProvider']

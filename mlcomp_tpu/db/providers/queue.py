@@ -1,0 +1,87 @@
+"""Queue provider — DB-backed task transport.
+
+Replaces the reference's Celery-over-Redis dispatch (reference
+worker/app.py:10-17; queue naming {host}_{docker}, {host}_{docker}_{n},
+{host}_{docker}_supervisor, worker/__main__.py:130-181). Capability parity:
+named queues, at-most-once claim, revoke, result status. Claims are atomic
+via a single conditional UPDATE ... RETURNING, so any number of worker
+processes can poll the same queue safely.
+"""
+
+import json
+
+from mlcomp_tpu.db.models import QueueMessage
+from mlcomp_tpu.db.providers.base import BaseDataProvider
+from mlcomp_tpu.utils.misc import now
+
+
+class QueueProvider(BaseDataProvider):
+    model = QueueMessage
+
+    def enqueue(self, queue: str, payload: dict) -> int:
+        msg = QueueMessage(
+            queue=queue, payload=json.dumps(payload), status='pending',
+            created=now())
+        self.add(msg)
+        return msg.id
+
+    def claim(self, queues, worker: str):
+        """Atomically claim the oldest pending message on any of `queues`.
+        Returns (msg_id, payload dict) or None."""
+        if not queues:
+            return None
+        marks = ','.join('?' * len(queues))
+        cur = self.session.execute(
+            f"UPDATE queue_message SET status='claimed', claimed_by=?, "
+            f"claimed_at=? WHERE id = ("
+            f"SELECT id FROM queue_message WHERE queue IN ({marks}) "
+            f"AND status='pending' ORDER BY id LIMIT 1) "
+            f"AND status='pending' RETURNING id, payload",
+            (worker, now()) + tuple(queues))
+        row = cur.fetchone()
+        if row is None:
+            return None
+        return row['id'], json.loads(row['payload'])
+
+    def complete(self, msg_id: int, result: str = None):
+        self.session.execute(
+            "UPDATE queue_message SET status='done', result=? WHERE id=?",
+            (result, msg_id))
+
+    def fail(self, msg_id: int, result: str = None):
+        self.session.execute(
+            "UPDATE queue_message SET status='failed', result=? WHERE id=?",
+            (result, msg_id))
+
+    def revoke(self, msg_id: int) -> bool:
+        """Revoke a pending message (celery revoke parity,
+        reference worker/tasks.py:336-343). Claimed messages must be killed
+        via the worker kill path instead."""
+        cur = self.session.execute(
+            "UPDATE queue_message SET status='revoked' "
+            "WHERE id=? AND status='pending' RETURNING id", (msg_id,))
+        return cur.fetchone() is not None
+
+    def status(self, msg_id: int):
+        row = self.session.query_one(
+            'SELECT status FROM queue_message WHERE id=?', (msg_id,))
+        return row['status'] if row else None
+
+    def pending(self, queue: str):
+        rows = self.session.query(
+            "SELECT * FROM queue_message WHERE queue=? AND "
+            "status='pending' ORDER BY id", (queue,))
+        return [QueueMessage.from_row(r) for r in rows]
+
+    def purge(self, before=None):
+        if before is None:
+            self.session.execute(
+                "DELETE FROM queue_message WHERE status IN "
+                "('done', 'failed', 'revoked')")
+        else:
+            self.session.execute(
+                "DELETE FROM queue_message WHERE status IN "
+                "('done', 'failed', 'revoked') AND created < ?", (before,))
+
+
+__all__ = ['QueueProvider']

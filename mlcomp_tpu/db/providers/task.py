@@ -1,0 +1,187 @@
+"""Task provider (parity: reference db/providers/task.py:14-277).
+
+Holds the scheduler-critical queries: ``dependency_status`` (which
+dependencies of each task are in which status), ``parent_tasks_stats``
+(aggregate child statuses for distributed parent tasks), and
+``change_status`` transition bookkeeping.
+"""
+
+import json
+
+from mlcomp_tpu.db.enums import TaskStatus, TaskType
+from mlcomp_tpu.db.models import Task, TaskDependence
+from mlcomp_tpu.db.providers.base import BaseDataProvider, PaginatorOptions
+from mlcomp_tpu.utils.misc import now
+
+
+class TaskProvider(BaseDataProvider):
+    model = Task
+
+    # --------------------------------------------------------- dependencies
+    def add_dependency(self, task_id: int, depend_id: int):
+        self.add(TaskDependence(task_id=task_id, depend_id=depend_id))
+
+    def dependency_status(self, task_ids):
+        """task_id -> set of statuses of its dependencies
+        (reference db/providers/task.py:194-203)."""
+        if not task_ids:
+            return {}
+        marks = ','.join('?' * len(task_ids))
+        rows = self.session.query(
+            f'SELECT td.task_id AS task_id, t.status AS status '
+            f'FROM task_dependence td JOIN task t ON td.depend_id = t.id '
+            f'WHERE td.task_id IN ({marks})', tuple(task_ids))
+        res = {tid: set() for tid in task_ids}
+        for r in rows:
+            res[r['task_id']].add(r['status'])
+        return res
+
+    def dependencies(self, task_id: int):
+        rows = self.session.query(
+            'SELECT t.* FROM task_dependence td '
+            'JOIN task t ON td.depend_id = t.id WHERE td.task_id=?',
+            (task_id,))
+        return [Task.from_row(r) for r in rows]
+
+    def children(self, parent_id: int, statuses=None):
+        sql = 'SELECT * FROM task WHERE parent=?'
+        params = [parent_id]
+        if statuses:
+            sql += f' AND status IN ({",".join("?" * len(statuses))})'
+            params += [int(s) for s in statuses]
+        return [Task.from_row(r) for r in self.session.query(sql, params)]
+
+    def parent_tasks_stats(self):
+        """For each unfinished parent task: its children grouped by status
+        (reference db/providers/task.py:224-258). Returns a list of
+        (parent_task, started, finished, [(status, count)])."""
+        unfinished = [int(s) for s in TaskStatus.unfinished()]
+        marks = ','.join('?' * len(unfinished))
+        parents = self.session.query(
+            f'SELECT * FROM task WHERE status IN ({marks}) AND id IN '
+            f'(SELECT DISTINCT parent FROM task WHERE parent IS NOT NULL)',
+            tuple(unfinished))
+        res = []
+        for p in parents:
+            parent = Task.from_row(p)
+            rows = self.session.query(
+                'SELECT status, COUNT(*) AS c, MIN(started) AS s, '
+                'MAX(finished) AS f FROM task WHERE parent=? '
+                'GROUP BY status', (parent.id,))
+            stats = {r['status']: r['c'] for r in rows}
+            started = min((r['s'] for r in rows if r['s']), default=None)
+            finished = max((r['f'] for r in rows if r['f']), default=None)
+            res.append((parent, started, finished, stats))
+        return res
+
+    # -------------------------------------------------------------- status
+    def change_status(self, task, status: TaskStatus):
+        task.status = int(status)
+        if status == TaskStatus.InProgress:
+            task.started = now()
+        elif status in TaskStatus.finished():
+            if task.started is None:
+                task.started = now()
+            task.finished = now()
+        task.last_activity = now()
+        self.update(task, ['status', 'started', 'finished', 'last_activity'])
+
+    def by_status(self, *statuses, computer: str = None):
+        marks = ','.join('?' * len(statuses))
+        sql = f'SELECT * FROM task WHERE status IN ({marks})'
+        params = [int(s) for s in statuses]
+        if computer is not None:
+            sql += ' AND computer_assigned=?'
+            params.append(computer)
+        return [Task.from_row(r) for r in self.session.query(sql, params)]
+
+    def update_last_activity(self, task_id: int):
+        self.session.execute(
+            'UPDATE task SET last_activity=? WHERE id=?', (now(), task_id))
+
+    def stop(self, task_id: int):
+        """Mark queued/not-ran task stopped directly; in-progress tasks are
+        stopped by the worker kill path."""
+        task = self.by_id(task_id)
+        if task is None:
+            return
+        if task.status <= int(TaskStatus.Queued):
+            self.change_status(task, TaskStatus.Stopped)
+
+    # ------------------------------------------------------------ UI query
+    def get(self, filter: dict = None, options: PaginatorOptions = None):
+        filter = filter or {}
+        where, params = [], []
+        if filter.get('dag'):
+            where.append('t.dag=?')
+            params.append(filter['dag'])
+        if filter.get('name'):
+            where.append('t.name LIKE ?')
+            params.append(f"%{filter['name']}%")
+        if filter.get('status') is not None:
+            statuses = filter['status']
+            if isinstance(statuses, list) and statuses:
+                where.append(
+                    f't.status IN ({",".join("?" * len(statuses))})')
+                params += statuses
+        if filter.get('project'):
+            where.append('d.project=?')
+            params.append(filter['project'])
+        if filter.get('type') is not None:
+            types = filter['type']
+            if not isinstance(types, list):
+                types = [types]
+            where.append(f't.type IN ({",".join("?" * len(types))})')
+            params += types
+        if filter.get('id'):
+            where.append('t.id=?')
+            params.append(filter['id'])
+        if not filter.get('show_service', False):
+            where.append('t.type != ?')
+            params.append(int(TaskType.Service))
+
+        where_sql = (' WHERE ' + ' AND '.join(where)) if where else ''
+        options = options or PaginatorOptions()
+        sort = options.sort_column or 'id'
+        if sort not in Task.__columns__:
+            sort = 'id'
+        direction = 'DESC' if options.sort_descending else 'ASC'
+        offset = options.page_number * options.page_size
+        rows = self.session.query(
+            f'SELECT t.*, d.name AS dag_name FROM task t '
+            f'JOIN dag d ON t.dag = d.id{where_sql} '
+            f'ORDER BY t."{sort}" {direction} LIMIT ? OFFSET ?',
+            tuple(params) + (options.page_size, offset))
+        total = self.session.query_one(
+            f'SELECT COUNT(*) AS c FROM task t '
+            f'JOIN dag d ON t.dag = d.id{where_sql}', tuple(params))['c']
+        data = []
+        for r in rows:
+            item = Task.from_row(r).to_dict()
+            item['dag_name'] = r['dag_name']
+            if item.get('cores_assigned'):
+                try:
+                    item['cores_assigned'] = json.loads(
+                        item['cores_assigned'])
+                except (ValueError, TypeError):
+                    pass
+            data.append(item)
+        return {'total': total, 'data': data}
+
+    def by_dag(self, dag_id: int):
+        rows = self.session.query(
+            'SELECT * FROM task WHERE dag=?', (dag_id,))
+        return [Task.from_row(r) for r in rows]
+
+    def last_succeed_time(self, computer: str = None):
+        sql = 'SELECT MAX(finished) AS m FROM task WHERE status=?'
+        params = [int(TaskStatus.Success)]
+        if computer:
+            sql += ' AND computer_assigned=?'
+            params.append(computer)
+        row = self.session.query_one(sql, params)
+        from mlcomp_tpu.db.core import parse_datetime
+        return parse_datetime(row['m']) if row else None
+
+
+__all__ = ['TaskProvider']

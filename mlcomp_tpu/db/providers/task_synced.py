@@ -1,0 +1,35 @@
+"""TaskSynced provider (parity: reference db/providers/task_synced.py:10-36)."""
+
+from mlcomp_tpu.db.enums import TaskStatus
+from mlcomp_tpu.db.models import Task, TaskSynced
+from mlcomp_tpu.db.providers.base import BaseDataProvider
+
+
+class TaskSyncedProvider(BaseDataProvider):
+    model = TaskSynced
+
+    def for_computer(self, computer: str):
+        """Successful tasks that ran elsewhere and have not yet been pulled
+        to `computer` (reference task_synced.py:13-36). Returns
+        [(computer_dict, project_id, [tasks])]."""
+        rows = self.session.query(
+            'SELECT t.*, d.project AS project_id FROM task t '
+            'JOIN dag d ON t.dag = d.id '
+            'WHERE t.status=? AND t.computer_assigned IS NOT NULL '
+            'AND t.computer_assigned != ? AND t.id NOT IN '
+            '(SELECT task FROM task_synced WHERE computer=?)',
+            (int(TaskStatus.Success), computer, computer))
+        grouped = {}
+        for r in rows:
+            key = (r['computer_assigned'], r['project_id'])
+            grouped.setdefault(key, []).append(Task.from_row(r))
+        return [
+            (src, project, tasks)
+            for (src, project), tasks in grouped.items()
+        ]
+
+    def mark_synced(self, computer: str, task_id: int):
+        self.add(TaskSynced(computer=computer, task=task_id))
+
+
+__all__ = ['TaskSyncedProvider']

@@ -1,0 +1,99 @@
+"""Config utilities (parity: reference utils/config.py).
+
+``merge_dicts_smart`` replicates the reference's suffix-path deep-merge
+semantics (utils/config.py:27-64), which grid search and ``--params``
+overrides depend on: a source key like ``lr`` or ``optimizer/lr`` is matched
+against the *suffix* of flattened target paths; a unique match overwrites in
+place, an ambiguous match is an error, and an unmatched key is attached at
+the deepest known anchor ("hook") sharing its prefix.
+"""
+
+import json
+import os
+from collections import defaultdict
+
+from mlcomp_tpu.utils.io import yaml_load
+from mlcomp_tpu.utils.misc import dict_flatten, dict_unflatten
+
+
+class Config(dict):
+    """Dict wrapper with helpers (reference utils/config.py:13-24)."""
+
+    @property
+    def data_folder(self):
+        from mlcomp_tpu import DATA_FOLDER
+        return os.path.join(DATA_FOLDER, self['info']['project'])
+
+    @staticmethod
+    def from_json(config: str):
+        return Config(json.loads(config))
+
+    @staticmethod
+    def from_yaml(config: str):
+        return Config(yaml_load(config))
+
+
+def merge_dicts_smart(target: dict, source: dict, sep: str = '/') -> dict:
+    """Deep-merge ``source`` into ``target`` with suffix-path key matching."""
+    flat = dict_flatten(target, sep=sep)
+
+    # suffix -> [full target paths ending with that suffix]
+    suffix_index = defaultdict(list)
+    # partial interior path -> full prefix path (anchor for new keys)
+    anchors = {}
+    for full in flat:
+        parts = full.split(sep)
+        n = len(parts)
+        for i in range(n - 1, -1, -1):
+            suffix_index[sep.join(parts[i:])].append(full)
+            if 0 < i < n - 1:
+                anchors[sep.join(parts[i:-1])] = sep.join(parts[:i + 1])
+
+    # expand nested dict values in source into flat suffix keys
+    expanded = {}
+    for k, v in source.items():
+        if isinstance(v, dict) and v:
+            for kk, vv in dict_flatten(v, sep=sep).items():
+                expanded[f'{k}{sep}{kk}'] = vv
+        else:
+            expanded[k] = v
+
+    for k, v in expanded.items():
+        matches = suffix_index.get(k, [])
+        if not matches:
+            # new key: re-anchor under the deepest known interior path
+            parts = k.split(sep)
+            dest = k
+            for i in range(len(parts) - 1, 0, -1):
+                head = sep.join(parts[:i])
+                if head in anchors:
+                    dest = anchors[head] + sep + sep.join(parts[i:])
+                    break
+            matches = [dest]
+        if len(matches) > 1:
+            raise ValueError(
+                f'ambiguous config override {k!r}: matches {matches}')
+        flat[matches[0]] = v
+
+    return dict_unflatten(flat, sep=sep)
+
+
+def dict_from_list_str(params) -> dict:
+    """Parse CLI ``--params a/b:c`` pairs (reference utils/config.py:67-75)."""
+    out = {}
+    for p in params:
+        k, _, v = p.partition(':')
+        try:
+            out[k] = int(v)
+        except ValueError:
+            try:
+                out[k] = float(v)
+            except ValueError:
+                if v in ('True', 'False'):
+                    out[k] = v == 'True'
+                else:
+                    out[k] = v
+    return out
+
+
+__all__ = ['Config', 'merge_dicts_smart', 'dict_from_list_str']

@@ -1,0 +1,38 @@
+"""IO utilities (parity: reference utils/io.py:18-44)."""
+
+import os
+import zipfile
+
+import yaml
+
+
+def yaml_load(text=None, file: str = None):
+    if file is not None:
+        with open(file) as fh:
+            text = fh.read()
+    if text is None:
+        return {}
+    res = yaml.safe_load(text)
+    return res if res is not None else {}
+
+
+def yaml_dump(data, file: str = None) -> str:
+    text = yaml.safe_dump(data, default_flow_style=False, sort_keys=False)
+    if file is not None:
+        with open(file, 'w') as fh:
+            fh.write(text)
+    return text
+
+
+def zip_folder(folder: str, dst: str, ignore=None):
+    ignore = ignore or set()
+    with zipfile.ZipFile(dst, 'w', zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(folder):
+            dirs[:] = [d for d in dirs if d not in ignore]
+            for f in files:
+                full = os.path.join(root, f)
+                zf.write(full, os.path.relpath(full, folder))
+    return dst
+
+
+__all__ = ['yaml_load', 'yaml_dump', 'zip_folder']

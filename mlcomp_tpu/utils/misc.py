@@ -1,0 +1,97 @@
+"""Misc utilities (parity: reference utils/misc.py:19-201)."""
+
+import datetime
+import os
+import re
+import signal
+
+import numpy as np
+
+
+def now():
+    """Naive UTC now — all DB timestamps use this."""
+    return datetime.datetime.now(datetime.timezone.utc).replace(tzinfo=None)
+
+
+def set_global_seed(seed: int):
+    """Seed every RNG we control. JAX is functional — jax.random keys are
+    derived from this seed explicitly at use sites; here we seed numpy and
+    python for host-side shuffling."""
+    import random
+    random.seed(seed)
+    np.random.seed(seed % (2 ** 32))
+
+
+def to_snake(name: str) -> str:
+    s1 = re.sub('(.)([A-Z][a-z]+)', r'\1_\2', name)
+    return re.sub('([a-z0-9])([A-Z])', r'\1_\2', s1).lower()
+
+
+def duration_format(seconds) -> str:
+    if seconds is None:
+        return ''
+    seconds = int(seconds)
+    h, rem = divmod(seconds, 3600)
+    m, s = divmod(rem, 60)
+    if h:
+        return f'{h}h {m}m {s}s'
+    if m:
+        return f'{m}m {s}s'
+    return f'{s}s'
+
+
+def dict_flatten(d: dict, sep: str = '/', prefix: str = '') -> dict:
+    out = {}
+    for k, v in d.items():
+        key = f'{prefix}{sep}{k}' if prefix else str(k)
+        if isinstance(v, dict) and v:
+            out.update(dict_flatten(v, sep=sep, prefix=key))
+        else:
+            out[key] = v
+    return out
+
+
+def dict_unflatten(d: dict, sep: str = '/') -> dict:
+    out = {}
+    for k, v in d.items():
+        parts = k.split(sep)
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out
+
+
+def memory():
+    """(total, available) host memory in GB."""
+    import psutil
+    vm = psutil.virtual_memory()
+    return vm.total / 2 ** 30, vm.available / 2 ** 30
+
+
+def disk(path: str):
+    """(total, free) disk space in GB for the filesystem holding `path`."""
+    st = os.statvfs(path)
+    total = st.f_frsize * st.f_blocks / 2 ** 30
+    free = st.f_frsize * st.f_bavail / 2 ** 30
+    return total, free
+
+
+def kill_child_processes(parent_pid: int, sig=signal.SIGTERM):
+    """Terminate the whole process subtree under `parent_pid`."""
+    import psutil
+    try:
+        parent = psutil.Process(parent_pid)
+    except psutil.NoSuchProcess:
+        return
+    for child in parent.children(recursive=True):
+        try:
+            child.send_signal(sig)
+        except psutil.NoSuchProcess:
+            pass
+
+
+__all__ = [
+    'now', 'set_global_seed', 'to_snake', 'duration_format', 'dict_flatten',
+    'dict_unflatten', 'memory', 'disk', 'kill_child_processes',
+]

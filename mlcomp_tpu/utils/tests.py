@@ -1,0 +1,30 @@
+"""Test fixtures (parity: reference utils/tests.py:12-21).
+
+The reference's per-test isolation: wipe ROOT_FOLDER, reimport the package,
+migrate, yield a fresh Session. Here we keep the per-xdist-worker sandbox
+root (set up by mlcomp_tpu/__init__.py when MLCOMP_TPU_TEST or
+PYTEST_XDIST_WORKER is present) and recreate the sqlite DB per test.
+"""
+
+import os
+import shutil
+
+
+def fresh_session():
+    """Wipe the sandbox DB and return a migrated Session."""
+    import mlcomp_tpu
+    from mlcomp_tpu.db.core import Session
+    from mlcomp_tpu.db.migration import migrate
+
+    Session.cleanup()
+    shutil.rmtree(mlcomp_tpu.DB_FOLDER, ignore_errors=True)
+    os.makedirs(mlcomp_tpu.DB_FOLDER, exist_ok=True)
+    for sub in (mlcomp_tpu.TASK_FOLDER, mlcomp_tpu.TMP_FOLDER):
+        shutil.rmtree(sub, ignore_errors=True)
+        os.makedirs(sub, exist_ok=True)
+    session = Session.create_session()
+    migrate(session)
+    return session
+
+
+__all__ = ['fresh_session']

@@ -1,0 +1,22 @@
+"""Test bootstrap: CPU-emulated 8-device mesh + sandboxed framework root.
+
+Must set env vars BEFORE jax or mlcomp_tpu are imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+os.environ.setdefault('MLCOMP_TPU_TEST', '1')
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def session():
+    """Fresh migrated DB per test (parity: reference utils/tests.py:12-21)."""
+    from mlcomp_tpu.utils.tests import fresh_session
+    yield fresh_session()

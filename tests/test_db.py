@@ -1,0 +1,161 @@
+"""DB layer tests (parity model: reference db/tests/test_project.py:8-28)."""
+
+import datetime
+
+from mlcomp_tpu.db.enums import TaskStatus
+from mlcomp_tpu.db.migration import DEFAULT_LAYOUTS
+from mlcomp_tpu.db.models import Dag, Project, Task
+from mlcomp_tpu.db.providers import (
+    AuxiliaryProvider, ComputerProvider, DagProvider, ProjectProvider,
+    QueueProvider, ReportLayoutProvider, TaskProvider,
+)
+from mlcomp_tpu.utils.misc import now
+
+
+class TestProject:
+    def test_add_and_by_name(self, session):
+        provider = ProjectProvider(session)
+        provider.add_project('test_proj')
+        p = provider.by_name('test_proj')
+        assert p is not None and p.name == 'test_proj'
+        assert provider.by_name('missing') is None
+
+    def test_get_with_counts(self, session):
+        provider = ProjectProvider(session)
+        p = provider.add_project('proj2')
+        res = provider.get()
+        assert res['total'] == 1
+        assert res['data'][0]['dag_count'] == 0
+        assert p.id is not None
+
+
+class TestTask:
+    def _make_dag(self, session, name='dag1'):
+        p = ProjectProvider(session).add_project(name + '_proj')
+        dag = Dag(name=name, config='', project=p.id, created=now())
+        session.add(dag)
+        return dag
+
+    def test_dependency_status(self, session):
+        dag = self._make_dag(session)
+        tp = TaskProvider(session)
+        a = tp.add(Task(name='a', executor='x', dag=dag.id))
+        b = tp.add(Task(name='b', executor='x', dag=dag.id))
+        tp.add_dependency(b.id, a.id)
+        dep = tp.dependency_status([a.id, b.id])
+        assert dep[a.id] == set()
+        assert dep[b.id] == {int(TaskStatus.NotRan)}
+        tp.change_status(a, TaskStatus.Success)
+        dep = tp.dependency_status([b.id])
+        assert dep[b.id] == {int(TaskStatus.Success)}
+
+    def test_change_status_timestamps(self, session):
+        dag = self._make_dag(session, 'dag2')
+        tp = TaskProvider(session)
+        t = tp.add(Task(name='t', executor='x', dag=dag.id))
+        tp.change_status(t, TaskStatus.InProgress)
+        t2 = tp.by_id(t.id)
+        assert t2.status == int(TaskStatus.InProgress)
+        assert isinstance(t2.started, datetime.datetime)
+        tp.change_status(t, TaskStatus.Success)
+        t3 = tp.by_id(t.id)
+        assert t3.finished is not None
+
+    def test_parent_tasks_stats(self, session):
+        dag = self._make_dag(session, 'dag3')
+        tp = TaskProvider(session)
+        parent = tp.add(Task(name='p', executor='x', dag=dag.id,
+                             status=int(TaskStatus.Queued)))
+        c1 = tp.add(Task(name='c1', executor='x', dag=dag.id,
+                         parent=parent.id))
+        tp.add(Task(name='c2', executor='x', dag=dag.id, parent=parent.id))
+        tp.change_status(c1, TaskStatus.Success)
+        stats = tp.parent_tasks_stats()
+        assert len(stats) == 1
+        p, _, _, counts = stats[0]
+        assert p.id == parent.id
+        assert counts[int(TaskStatus.Success)] == 1
+        assert counts[int(TaskStatus.NotRan)] == 1
+
+
+class TestDagGraph:
+    def test_graph(self, session):
+        p = ProjectProvider(session).add_project('gproj')
+        dag = Dag(name='g', config='', project=p.id, created=now())
+        session.add(dag)
+        tp = TaskProvider(session)
+        a = tp.add(Task(name='a', executor='xa', dag=dag.id))
+        b = tp.add(Task(name='b', executor='xb', dag=dag.id))
+        tp.add_dependency(b.id, a.id)
+        g = DagProvider(session).graph(dag.id)
+        assert len(g['nodes']) == 2
+        assert g['edges'] == [
+            {'from': a.id, 'to': b.id, 'status': 'NotRan'}]
+
+    def test_get_counts(self, session):
+        p = ProjectProvider(session).add_project('gproj2')
+        dag = Dag(name='g2', config='', project=p.id, created=now())
+        session.add(dag)
+        TaskProvider(session).add(
+            Task(name='a', executor='xa', dag=dag.id))
+        res = DagProvider(session).get({'project': p.id})
+        assert res['total'] == 1
+        assert res['data'][0]['task_count'] == 1
+
+
+class TestQueue:
+    def test_claim_complete(self, session):
+        q = QueueProvider(session)
+        m1 = q.enqueue('host_default', {'action': 'execute', 'task_id': 1})
+        q.enqueue('host_default', {'action': 'execute', 'task_id': 2})
+        claimed = q.claim(['host_default'], 'w1')
+        assert claimed is not None
+        msg_id, payload = claimed
+        assert msg_id == m1 and payload['task_id'] == 1
+        q.complete(msg_id)
+        assert q.status(msg_id) == 'done'
+        # second message still claimable, third claim returns None
+        assert q.claim(['host_default'], 'w2') is not None
+        assert q.claim(['host_default'], 'w3') is None
+
+    def test_revoke(self, session):
+        q = QueueProvider(session)
+        m = q.enqueue('qq', {'action': 'execute', 'task_id': 3})
+        assert q.revoke(m) is True
+        assert q.claim(['qq'], 'w') is None
+        assert q.revoke(m) is False  # already revoked
+
+
+class TestLayouts:
+    def test_seeded(self, session):
+        lp = ReportLayoutProvider(session)
+        layouts = lp.all_layouts()
+        for name in DEFAULT_LAYOUTS:
+            assert name in layouts
+
+    def test_extend_resolution(self, session):
+        lp = ReportLayoutProvider(session)
+        resolved = lp.resolved('img_classify')
+        # img_classify extends classify extends base
+        assert 'throughput' in resolved['items']
+        assert 'accuracy' in resolved['items']
+        assert 'img_classify' in resolved['items']
+        assert resolved['metric']['name'] == 'accuracy'
+
+
+class TestComputerAux:
+    def test_computer_roundtrip(self, session):
+        from mlcomp_tpu.db.models import Computer
+        cp = ComputerProvider(session)
+        cp.create_or_update(
+            Computer(name='host1', cores=8, cpu=16, memory=32), 'name')
+        cp.current_usage('host1', {'cpu': 10})
+        c = cp.by_name('host1')
+        assert c.cores == 8
+        assert 'cpu' in c.usage
+
+    def test_auxiliary(self, session):
+        ap = AuxiliaryProvider(session)
+        ap.create_or_update('supervisor', {'tick': 1})
+        ap.create_or_update('supervisor', {'tick': 2})
+        assert ap.get()['supervisor']['tick'] == 2
