@@ -1,0 +1,160 @@
+"""User-code executors for the digit-recognizer example.
+
+Demonstrates the minimum end-to-end slice (SURVEY.md §7 step 2): a
+download→split→train→infer DAG where training is a jit'd JAX MLP step.
+Data is synthetic (class-conditional patterns) because the build
+environment has no network egress; the learning task is real.
+"""
+
+import os
+
+import numpy as np
+
+from mlcomp_tpu.worker.executors import Executor
+
+
+def data_dir(config):
+    folder = os.path.join('data', 'digits')
+    os.makedirs(folder, exist_ok=True)
+    return folder
+
+
+def synth_digits(n, seed=0):
+    """Synthetic 28x28 'digit' images: each class is a fixed random
+    prototype + noise. Linearly separable-ish, learnable to ~99%."""
+    rng = np.random.RandomState(seed)
+    prototypes = rng.rand(10, 28 * 28).astype(np.float32)
+    y = rng.randint(0, 10, size=n)
+    x = prototypes[y] + 0.35 * rng.randn(n, 28 * 28).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+@Executor.register
+class PrepareDigits(Executor):
+    def __init__(self, n_samples: int = 4096, **kwargs):
+        self.n_samples = n_samples
+
+    def work(self):
+        folder = data_dir(self.config)
+        x, y = synth_digits(self.n_samples)
+        np.savez(os.path.join(folder, 'digits.npz'), x=x, y=y)
+        self.info(f'prepared {self.n_samples} samples -> {folder}')
+
+
+@Executor.register
+class SplitDigits(Executor):
+    def __init__(self, n_folds: int = 5, **kwargs):
+        self.n_folds = n_folds
+
+    def work(self):
+        folder = data_dir(self.config)
+        data = np.load(os.path.join(folder, 'digits.npz'))
+        n = len(data['y'])
+        folds = np.arange(n) % self.n_folds
+        np.random.RandomState(0).shuffle(folds)
+        np.save(os.path.join(folder, 'fold.npy'), folds)
+        self.info(f'split {n} samples into {self.n_folds} folds')
+
+
+@Executor.register
+class TrainDigits(Executor):
+    def __init__(self, epochs: int = 3, batch_size: int = 256,
+                 lr: float = 1e-3, hidden: int = 256, **kwargs):
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.lr = lr
+        self.hidden = hidden
+
+    def work(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        folder = data_dir(self.config)
+        data = np.load(os.path.join(folder, 'digits.npz'))
+        folds = np.load(os.path.join(folder, 'fold.npy'))
+        x, y = data['x'], data['y']
+        train_mask = folds != 0
+        xt, yt = x[train_mask], y[train_mask]
+        xv, yv = x[~train_mask], y[~train_mask]
+
+        key = jax.random.PRNGKey(0)
+        k1, k2 = jax.random.split(key)
+        params = {
+            'w1': jax.random.normal(k1, (784, self.hidden)) * 0.05,
+            'b1': jnp.zeros(self.hidden),
+            'w2': jax.random.normal(k2, (self.hidden, 10)) * 0.05,
+            'b2': jnp.zeros(10),
+        }
+        tx = optax.adam(self.lr)
+        opt_state = tx.init(params)
+
+        def forward(params, xb):
+            h = jax.nn.relu(xb @ params['w1'] + params['b1'])
+            return h @ params['w2'] + params['b2']
+
+        def loss_fn(params, xb, yb):
+            logits = forward(params, xb)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb).mean()
+
+        @jax.jit
+        def train_step(params, opt_state, xb, yb):
+            loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
+            updates, opt_state = tx.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        @jax.jit
+        def accuracy(params, xb, yb):
+            return (forward(params, xb).argmax(-1) == yb).mean()
+
+        n = len(xt)
+        steps = max(1, n // self.batch_size)
+        for epoch in range(self.epochs):
+            self.step.start(2, f'epoch_{epoch}')
+            perm = np.random.RandomState(epoch).permutation(n)
+            losses = []
+            for s in range(steps):
+                idx = perm[s * self.batch_size:(s + 1) * self.batch_size]
+                params, opt_state, loss = train_step(
+                    params, opt_state, jnp.asarray(xt[idx]),
+                    jnp.asarray(yt[idx]))
+                losses.append(float(loss))
+            acc = float(accuracy(params, jnp.asarray(xv), jnp.asarray(yv)))
+            self.info(
+                f'epoch {epoch}: loss={np.mean(losses):.4f} acc={acc:.4f}')
+
+        os.makedirs('models', exist_ok=True)
+        np.savez(os.path.join('models', 'digits_mlp.npz'),
+                 **{k: np.asarray(v) for k, v in params.items()})
+        self.task.score = acc
+        from mlcomp_tpu.db.providers import TaskProvider
+        TaskProvider(self.session).update(self.task, ['score'])
+        return {'accuracy': acc}
+
+
+@Executor.register
+class InferDigits(Executor):
+    def __init__(self, **kwargs):
+        pass
+
+    def work(self):
+        import jax
+        import jax.numpy as jnp
+
+        folder = data_dir(self.config)
+        data = np.load(os.path.join(folder, 'digits.npz'))
+        weights = np.load(os.path.join('models', 'digits_mlp.npz'))
+
+        def forward(xb):
+            h = jax.nn.relu(xb @ weights['w1'] + weights['b1'])
+            return h @ weights['w2'] + weights['b2']
+
+        preds = np.asarray(
+            jax.jit(forward)(jnp.asarray(data['x'][:512])).argmax(-1))
+        out = os.path.join(folder, 'predictions.npy')
+        np.save(out, preds)
+        acc = float((preds == data['y'][:512]).mean())
+        self.info(f'inferred 512 samples, acc={acc:.4f} -> {out}')
+        return {'n': len(preds), 'accuracy': acc}

@@ -1,0 +1,128 @@
+"""CLI (parity: reference mlcomp/__main__.py:32-175).
+
+- ``mlcomp_tpu dag CONFIG``     — submit a DAG (client → DB writes only;
+  the supervisor picks tasks up on its next tick)
+- ``mlcomp_tpu execute CONFIG`` — run a whole DAG in-process without the
+  scheduler/queues (debug mode, reference __main__.py:90-123): tasks run
+  sequentially in topological order with all local TPU cores assigned
+- ``mlcomp_tpu init``           — create folders + migrate the DB
+- ``mlcomp_tpu sync``           — manual data/model sync
+"""
+
+import json
+import os
+
+import click
+
+from mlcomp_tpu.db.core import Session
+from mlcomp_tpu.db.enums import TaskStatus
+from mlcomp_tpu.db.migration import migrate
+from mlcomp_tpu.utils.config import dict_from_list_str, merge_dicts_smart
+from mlcomp_tpu.utils.io import yaml_load
+from mlcomp_tpu.utils.logging import create_logger
+
+
+@click.group()
+def main():
+    pass
+
+
+def _load_config(config_path: str, params):
+    if not os.path.exists(config_path):
+        raise click.ClickException(f'config not found: {config_path}')
+    config = yaml_load(file=config_path)
+    if params:
+        overrides = dict_from_list_str(params)
+        config = merge_dicts_smart(config, overrides)
+        # store the MERGED config in the dag row — workers re-read the
+        # executor spec from dag.config, so overrides must be persisted
+        from mlcomp_tpu.utils.io import yaml_dump
+        text = yaml_dump(config)
+    else:
+        with open(config_path) as fh:
+            text = fh.read()
+    return config, text
+
+
+def _dag(config_path: str, params=(), debug: bool = False):
+    from mlcomp_tpu.server.create_dags import dag_standard
+    session = Session.create_session()
+    migrate(session)
+    config, text = _load_config(config_path, params)
+    logger = create_logger(session)
+    dag, tasks = dag_standard(
+        session, config, debug=debug, config_text=text,
+        upload_folder=os.path.dirname(os.path.abspath(config_path)) or '.',
+        logger=logger)
+    return session, dag, tasks, config
+
+
+@main.command()
+@click.argument('config')
+@click.option('--params', multiple=True,
+              help='override config values, e.g. --params lr:0.01')
+def dag(config, params):
+    """Submit a DAG to the scheduler."""
+    _, dag_row, tasks, _ = _dag(config, params)
+    total = sum(len(v) for v in tasks.values())
+    click.echo(f'dag {dag_row.id} created with {total} tasks')
+
+
+@main.command()
+@click.argument('config')
+@click.option('--params', multiple=True)
+def execute(config, params):
+    """Run a DAG in-process without the scheduler (debug mode)."""
+    from mlcomp_tpu.worker.tasks import execute_by_id
+    from mlcomp_tpu.db.providers import TaskProvider
+
+    session, dag_row, tasks, cfg = _dag(config, params, debug=True)
+    provider = TaskProvider(session)
+    folder = os.path.dirname(os.path.abspath(config)) or '.'
+
+    # topological order = creation order (builder creates deps first)
+    all_ids = sorted(tid for ids in tasks.values() for tid in ids)
+    for task_id in all_ids:
+        task = provider.by_id(task_id)
+        dep_statuses = provider.dependency_status([task_id])[task_id]
+        bad = {int(TaskStatus.Failed), int(TaskStatus.Stopped),
+               int(TaskStatus.Skipped)}
+        if dep_statuses & bad:
+            provider.change_status(task, TaskStatus.Skipped)
+            click.echo(f'task {task_id} ({task.name}): skipped '
+                       f'(dependency failed)')
+            continue
+        click.echo(f'task {task_id} ({task.name}): running')
+        try:
+            execute_by_id(task_id, exit=False, folder=folder,
+                          session=session)
+            click.echo(f'task {task_id} ({task.name}): success')
+        except Exception as e:  # noqa
+            click.echo(f'task {task_id} ({task.name}): FAILED — {e}')
+    statuses = {}
+    for task_id in all_ids:
+        t = provider.by_id(task_id)
+        statuses[t.name] = TaskStatus(t.status).name
+    click.echo(json.dumps(statuses))
+
+
+@main.command()
+def init():
+    """Create folders and migrate the DB."""
+    session = Session.create_session()
+    migrate(session)
+    import mlcomp_tpu
+    click.echo(f'initialized at {mlcomp_tpu.ROOT_FOLDER}')
+
+
+@main.command()
+@click.option('--computer', default=None, help='sync only this computer')
+def sync(computer):
+    """Manually sync data/models folders from other computers."""
+    from mlcomp_tpu.worker.sync import FileSync
+    FileSync().sync_manual(computer)
+    click.echo('sync complete')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,1 @@
+from mlcomp_tpu.server.create_dags.standard import dag_standard

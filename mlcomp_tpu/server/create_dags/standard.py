@@ -1,0 +1,233 @@
+"""Standard DAG builder (parity: reference server/back/create_dags/standard.py:20-276).
+
+yaml config → Project (auto-created) / Report (from layout) / Dag rows;
+uploads the experiment folder into the DB; creates tasks topologically with
+dependency validation; fans out one task per grid cell; parses the TPU-core
+spec ``"a-b"`` into (cores, cores_max) (the reference parsed a GPU spec the
+same way, standard.py:127-134); wires per-train-task reports.
+"""
+
+import os
+
+from mlcomp_tpu.contrib.search.grid import grid_cells
+from mlcomp_tpu.db.enums import DagType, TaskStatus, TaskType
+from mlcomp_tpu.db.models import Dag, Report, ReportTasks, Task
+from mlcomp_tpu.db.providers import (
+    DagProvider, ProjectProvider, ReportLayoutProvider, ReportProvider,
+    ReportTasksProvider, TaskProvider
+)
+from mlcomp_tpu.utils.io import yaml_dump
+from mlcomp_tpu.utils.misc import now
+from mlcomp_tpu.worker.executors import Executor
+from mlcomp_tpu.worker.storage import Storage
+
+
+def parse_cores(value):
+    """'2-4' → (2, 4); 3 → (3, 3); None/0 → (0, 0)."""
+    if value in (None, '', 0):
+        return 0, 0
+    if isinstance(value, int):
+        return value, value
+    text = str(value)
+    if '-' in text:
+        lo, hi = text.split('-', 1)
+        lo, hi = int(lo), int(hi)
+    else:
+        lo = hi = int(text)
+    if lo > hi or lo < 0:
+        raise ValueError(f'invalid core spec {value!r}')
+    return lo, hi
+
+
+class DagStandardBuilder:
+    def __init__(self, session, config: dict, debug: bool = False,
+                 config_text: str = None, upload_folder: str = None,
+                 logger=None, component=None):
+        self.session = session
+        self.config = config
+        self.debug = debug
+        self.config_text = config_text
+        self.upload_folder = upload_folder
+        self.logger = logger
+
+        self.info = config.get('info', {})
+        self.project_provider = ProjectProvider(session)
+        self.dag_provider = DagProvider(session)
+        self.task_provider = TaskProvider(session)
+        self.report_provider = ReportProvider(session)
+        self.report_tasks_provider = ReportTasksProvider(session)
+        self.layout_provider = ReportLayoutProvider(session)
+        self.storage = Storage(session, logger)
+
+        self.project = None
+        self.dag = None
+        self.dag_report_id = None
+        self.tasks = {}  # executor name -> [task ids]
+
+    # ------------------------------------------------------------- phases
+    def load_base(self):
+        name = self.info.get('project')
+        assert name, 'info.project is required'
+        project = self.project_provider.by_name(name)
+        if project is None:
+            project = self.project_provider.add_project(name)
+        self.project = project
+
+    def create_report(self):
+        layout_name = self.info.get('layout')
+        if not layout_name:
+            return
+        layout = self.layout_provider.by_name(layout_name)
+        assert layout is not None, f'unknown layout {layout_name!r}'
+        resolved = self.layout_provider.resolved(layout_name)
+        report = Report(
+            name=self.info.get('name', 'report'),
+            project=self.project.id, time=now(),
+            layout=layout_name, config=yaml_dump(resolved))
+        self.report_provider.add(report)
+        self.dag_report_id = report.id
+
+    def create_dag(self):
+        dag = Dag(
+            name=self.info.get('name', 'dag'),
+            config=self.config_text or yaml_dump(dict(self.config)),
+            project=self.project.id,
+            docker_img=self.info.get('docker_img')
+            or self.info.get('runtime_img'),
+            type=int(DagType.Standard),
+            created=now(),
+            report=self.dag_report_id,
+        )
+        self.dag_provider.add(dag)
+        self.dag = dag
+
+    def upload(self):
+        expdir = self.info.get('expdir')
+        folder = self.upload_folder or expdir
+        if folder and os.path.isdir(folder):
+            self.storage.upload(folder, self.dag)
+
+    def create_tasks(self):
+        executors = self.config.get('executors', {})
+        # dependency validation (reference standard.py:183-205)
+        for name, spec in executors.items():
+            depends = spec.get('depends') or []
+            if isinstance(depends, str):
+                depends = [depends]
+            for dep in depends:
+                if dep == name:
+                    raise ValueError(f'executor {name!r} depends on itself')
+                if dep not in executors:
+                    raise ValueError(
+                        f'executor {name!r} depends on unknown {dep!r}')
+
+        created = {}  # name -> [Task]
+        pending = dict(executors)
+        while pending:
+            progressed = False
+            for name in list(pending):
+                spec = pending[name]
+                depends = spec.get('depends') or []
+                if isinstance(depends, str):
+                    depends = [depends]
+                if any(d in pending for d in depends):
+                    continue
+                created[name] = self._create_executor_tasks(
+                    name, spec, depends, created)
+                del pending[name]
+                progressed = True
+            if not progressed:
+                raise ValueError(
+                    f'dependency cycle among executors: {sorted(pending)}')
+        self.tasks = {
+            name: [t.id for t in tasks] for name, tasks in created.items()
+        }
+
+    def _create_executor_tasks(self, name, spec, depends, created):
+        grid = spec.get('grid')
+        cells = grid_cells(grid) if grid else [(None, None)]
+        tasks = []
+        for cell_index, (cell, cell_name_str) in enumerate(cells):
+            task = self._create_task(
+                name, spec, cell, cell_name_str, cell_index)
+            for dep in depends:
+                for dep_task in created[dep]:
+                    self.task_provider.add_dependency(task.id, dep_task.id)
+            tasks.append(task)
+        return tasks
+
+    def _create_task(self, name, spec, cell, cell_name_str, cell_index):
+        cores, cores_max = parse_cores(
+            spec.get('cores', spec.get('gpu', 0)))
+        executor_type = spec.get('type', name)
+        trainable = Executor.is_trainable(executor_type)
+        task_name = name
+        if cell_name_str:
+            task_name = f'{name} {cell_name_str}'
+
+        additional_info = {}
+        if cell is not None:
+            additional_info['grid_cell'] = cell_index
+            additional_info['grid'] = cell
+        if spec.get('env'):
+            additional_info['env'] = spec['env']
+        if self.info.get('stages'):
+            additional_info['stages'] = self.info['stages']
+
+        task = Task(
+            name=task_name[:180],
+            executor=name,
+            computer=spec.get('computer'),
+            cores=cores, cores_max=cores_max,
+            cpu=int(spec.get('cpu', 1)),
+            memory=float(spec.get('memory', 0.1)),
+            dag=self.dag.id,
+            status=int(TaskStatus.NotRan),
+            type=int(TaskType.Train if trainable else TaskType.User),
+            debug=self.debug,
+            gpu_requirement=str(spec.get('cores', spec.get('gpu', '')) or ''),
+            single_node=bool(spec.get('single_node', True)),
+            additional_info=yaml_dump(additional_info)
+            if additional_info else None,
+            last_activity=now(),
+        )
+        self.task_provider.add(task)
+
+        if trainable:
+            layout_name = spec.get('report') or self.info.get('layout')
+            if layout_name and self.layout_provider.by_name(layout_name):
+                resolved = self.layout_provider.resolved(layout_name)
+                report = Report(
+                    name=task_name[:100], project=self.project.id,
+                    time=now(), layout=layout_name,
+                    config=yaml_dump(resolved))
+                self.report_provider.add(report)
+                task.report = report.id
+                self.task_provider.update(task, ['report'])
+                if self.dag_report_id:
+                    self.report_tasks_provider.add(ReportTasks(
+                        report=self.dag_report_id, task=task.id))
+                self.report_tasks_provider.add(ReportTasks(
+                    report=report.id, task=task.id))
+        return task
+
+    # --------------------------------------------------------------- build
+    def build(self):
+        self.load_base()
+        self.create_report()
+        self.create_dag()
+        self.upload()
+        self.create_tasks()
+        return self.dag, self.tasks
+
+
+def dag_standard(session, config: dict, debug: bool = False,
+                 config_text: str = None, upload_folder: str = None,
+                 logger=None, component=None):
+    builder = DagStandardBuilder(
+        session, config, debug=debug, config_text=config_text,
+        upload_folder=upload_folder, logger=logger, component=component)
+    return builder.build()
+
+
+__all__ = ['dag_standard', 'DagStandardBuilder', 'parse_cores']

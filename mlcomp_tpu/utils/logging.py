@@ -81,26 +81,32 @@ class _Logger(logging.Logger):
         for key, val in zip(keys, args):
             if val is not None:
                 extra[key] = val
-        base(msg, extra=extra, exc_info=exc_info)
+        # stacklevel=3: skip _meta_call + the public wrapper, so the
+        # record points at the real call site
+        base(msg, extra=extra, exc_info=exc_info, stacklevel=3)
 
     def debug(self, msg, *args, **kw):
         if args:
             return self._meta_call(super().debug, msg, *args, **kw)
+        kw.setdefault('stacklevel', 2)
         return super().debug(msg, **kw)
 
     def info(self, msg, *args, **kw):
         if args:
             return self._meta_call(super().info, msg, *args, **kw)
+        kw.setdefault('stacklevel', 2)
         return super().info(msg, **kw)
 
     def warning(self, msg, *args, **kw):
         if args:
             return self._meta_call(super().warning, msg, *args, **kw)
+        kw.setdefault('stacklevel', 2)
         return super().warning(msg, **kw)
 
     def error(self, msg, *args, **kw):
         if args:
             return self._meta_call(super().error, msg, *args, **kw)
+        kw.setdefault('stacklevel', 2)
         return super().error(msg, **kw)
 
 

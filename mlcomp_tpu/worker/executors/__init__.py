@@ -1,0 +1,5 @@
+"""Executor registry — importing this package registers built-in executors."""
+
+from mlcomp_tpu.worker.executors.base import Executor, StepWrap
+
+__all__ = ['Executor', 'StepWrap']

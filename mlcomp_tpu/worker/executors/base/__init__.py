@@ -1,0 +1,4 @@
+from mlcomp_tpu.worker.executors.base.executor import Executor
+from mlcomp_tpu.worker.executors.base.step import StepWrap
+
+__all__ = ['Executor', 'StepWrap']

@@ -1,0 +1,170 @@
+"""Executor framework (parity: reference worker/executors/base/executor.py:13-114).
+
+An Executor is the unit of work a task runs. Concrete executors register
+via ``@Executor.register`` under their snake_case class name; DAG configs
+reference them by ``type``. ``__call__`` wraps ``work()`` with the
+hierarchical step tracker and the optional data-sync barrier.
+"""
+
+import json
+from abc import ABC, abstractmethod
+
+from mlcomp_tpu.db.enums import ComponentType, TaskStatus
+from mlcomp_tpu.utils.config import Config
+from mlcomp_tpu.utils.io import yaml_load
+from mlcomp_tpu.utils.misc import now, to_snake
+
+
+class Executor(ABC):
+    _registry = {}
+
+    session = None
+    logger = None
+    step = None
+    task = None
+    dag = None
+
+    # ------------------------------------------------------------- registry
+    @classmethod
+    def register(cls, subclass):
+        cls._registry[to_snake(subclass.__name__)] = subclass
+        return subclass
+
+    @classmethod
+    def is_registered(cls, name: str) -> bool:
+        return to_snake(name) in cls._registry
+
+    @classmethod
+    def get(cls, name: str):
+        return cls._registry[to_snake(name)]
+
+    # -------------------------------------------------------------- factory
+    @classmethod
+    def from_config(cls, executor_name: str, config: Config,
+                    additional_info: dict = None, session=None,
+                    logger=None):
+        """Instantiate the executor named in config['executors']
+        (reference base/executor.py:60-77)."""
+        executors = config.get('executors', {})
+        if executor_name not in executors:
+            raise KeyError(
+                f'executor {executor_name!r} not present in config')
+        spec = dict(executors[executor_name])
+        executor_type = spec.get('type', executor_name)
+        subclass = cls.get(executor_type)
+        additional_info = additional_info or {}
+        # grid-search cell: merge the cell's overrides into the executor
+        # spec so each fanned-out task actually runs its own configuration
+        # (reference merges the cell into the train config at run time,
+        # catalyst.py:177-179, 211-212)
+        cell = additional_info.get('grid')
+        if cell:
+            from mlcomp_tpu.utils.config import merge_dicts_smart
+            spec = merge_dicts_smart(spec, dict(cell))
+        kwargs = subclass._parse_config(spec, config, additional_info)
+        instance = subclass(**kwargs)
+        instance.executor_name = executor_name
+        instance.spec = spec
+        instance.config = config
+        instance.additional_info = additional_info
+        instance.session = session
+        instance.logger = logger
+        return instance
+
+    @classmethod
+    def _parse_config(cls, executor_spec: dict, config: Config,
+                      additional_info: dict) -> dict:
+        """Default: pass every non-framework key as a constructor kwarg."""
+        skip = {'type', 'gpu', 'cores', 'cpu', 'memory', 'depends', 'grid',
+                'env', 'distr', 'single_node', 'computer', 'params',
+                'report', 'slot', 'slots'}
+        kwargs = dict(executor_spec.get('params', {}))
+        for k, v in executor_spec.items():
+            if k not in skip and k != 'params':
+                kwargs[k] = v
+        return kwargs
+
+    # ------------------------------------------------------------ execution
+    def __call__(self, task, dag, session=None, logger=None, step=None):
+        """Run work() inside step tracking (reference base/executor.py:33-48)."""
+        from mlcomp_tpu.worker.executors.base.step import StepWrap
+        self.task = task
+        self.dag = dag
+        self.session = session or self.session
+        self.logger = logger or self.logger
+        if step is None:
+            step = StepWrap(self.session, self.logger, task)
+            step.enter()
+        self.step = step
+        if self.wait_data_sync_required():
+            self.wait_data_sync()
+        try:
+            return self.work()
+        finally:
+            self.step.end_all()
+
+    @abstractmethod
+    def work(self):
+        ...
+
+    # -------------------------------------------------------------- logging
+    def info(self, message):
+        if self.step:
+            self.step.info(message)
+        elif self.logger:
+            self.logger.info(message)
+
+    def debug(self, message):
+        if self.step:
+            self.step.debug(message)
+        elif self.logger:
+            self.logger.debug(message)
+
+    def error(self, message):
+        if self.step:
+            self.step.error(message)
+        elif self.logger:
+            self.logger.error(message)
+
+    @classmethod
+    def is_trainable(cls, executor_type: str) -> bool:
+        """Trainable executors get reports + TPU cores
+        (reference base/executor.py:111-114 — type == 'Catalyst'; here the
+        JAX training executor)."""
+        return to_snake(executor_type) in ('jax_train', 'train')
+
+    # ------------------------------------------------------------ data sync
+    def wait_data_sync_required(self) -> bool:
+        return bool(getattr(self, 'spec', {}).get('wait_sync', False))
+
+    def wait_data_sync(self):
+        """Barrier until this computer has pulled all remote successful
+        tasks OF THIS PROJECT (reference base/executor.py:90-109 waits only
+        while project.id == dag.project)."""
+        import socket
+        import time
+        from mlcomp_tpu.db.providers import TaskSyncedProvider
+        provider = TaskSyncedProvider(self.session)
+        hostname = socket.gethostname()
+        project = self.dag.project if self.dag else None
+        for _ in range(600):
+            pending = [
+                entry for entry in provider.for_computer(hostname)
+                if project is None or entry[1] == project
+            ]
+            if not pending:
+                return
+            time.sleep(1)
+        raise TimeoutError('data sync barrier timed out')
+
+    # -------------------------------------------------------------- helpers
+    def result_serialize(self, result) -> str:
+        if result is None:
+            return None
+        try:
+            return json.dumps(result)
+        except TypeError:
+            return str(result)
+
+
+__all__ = ['Executor']

@@ -1,0 +1,143 @@
+"""Data/model/checkpoint movement between computers.
+
+Parity: reference worker/sync.py:20-143 (``sync_directed``/``FileSync``/
+``copy_remote`` — rsync-over-SSH with a 3-case local/remote matrix, driven
+by the TaskSynced ledger). TPU-first redesign: on TPU pods bulk data lives
+on shared storage (GCS/NFS), so the primary path is a filesystem copy that
+is a no-op when source and destination resolve to the same files; an rsync
+fallback covers genuinely disjoint hosts when the binary exists.
+"""
+
+import os
+import shutil
+import socket
+import subprocess
+
+from mlcomp_tpu import DATA_FOLDER, MODEL_FOLDER
+from mlcomp_tpu.db.core import Session
+from mlcomp_tpu.db.providers import (
+    ComputerProvider, ProjectProvider, TaskSyncedProvider
+)
+from mlcomp_tpu.utils.misc import now
+
+
+def _same_file_tree(a: str, b: str) -> bool:
+    return os.path.realpath(a) == os.path.realpath(b)
+
+
+def _copy_tree(src: str, dst: str):
+    if not os.path.exists(src) or _same_file_tree(src, dst):
+        return
+    os.makedirs(dst, exist_ok=True)
+    shutil.copytree(src, dst, dirs_exist_ok=True)
+
+
+def _rsync_available() -> bool:
+    return shutil.which('rsync') is not None and \
+        shutil.which('ssh') is not None
+
+
+def copy_remote(session: Session, computer_from: str, path_from: str,
+                path_to: str) -> bool:
+    """Fetch a file/folder that lives on `computer_from`
+    (reference worker/sync.py:60-71 — scp). Local/shared-fs fast path
+    first; ssh+rsync only for genuinely remote hosts."""
+    if computer_from == socket.gethostname() or os.path.exists(path_from):
+        if os.path.isdir(path_from):
+            _copy_tree(path_from, path_to)
+        elif os.path.exists(path_from):
+            if not _same_file_tree(path_from, path_to):
+                os.makedirs(os.path.dirname(path_to) or '.', exist_ok=True)
+                shutil.copy2(path_from, path_to)
+        return os.path.exists(path_to)
+
+    computer = ComputerProvider(session).by_name(computer_from)
+    if computer is None or not _rsync_available():
+        return False
+    dest = f'{computer.user}@{computer.ip}' if computer.user \
+        else computer.ip
+    cmd = ['rsync', '-a', '-e', f'ssh -p {computer.port}',
+           f'{dest}:{path_from}', path_to]
+    return subprocess.call(cmd) == 0
+
+
+def sync_directed(session: Session, source: 'str|object',
+                  target: 'str|object', folders=None) -> bool:
+    """Pull `folders` (default: project data/models) from source computer to
+    target computer. Returns True when the data is known to be present
+    (shared-filesystem deployments resolve to trivially-true no-ops);
+    a failed rsync returns False so callers must NOT mark tasks synced
+    (reference worker/sync.py:58 raised via check_output)."""
+    src_name = source if isinstance(source, str) else source.name
+    tgt_name = target if isinstance(target, str) else target.name
+    if src_name == tgt_name:
+        return True
+    folders = folders or [DATA_FOLDER, MODEL_FOLDER]
+    if not _rsync_available():
+        # shared-storage deployment: nothing to move
+        return True
+    provider = ComputerProvider(session)
+    src = provider.by_name(src_name)
+    if src is None:
+        return False
+    dest = f'{src.user}@{src.ip}' if src.user else src.ip
+    ok = True
+    for folder in folders:
+        code = subprocess.call([
+            'rsync', '-a', '-e', f'ssh -p {src.port}',
+            f'{dest}:{folder}/', f'{folder}/'])
+        ok = ok and code == 0
+    return ok
+
+
+class FileSync:
+    """Background sync loop (reference worker/sync.py:74-143): pull data
+    produced by successful tasks on other computers, then mark them synced
+    in the TaskSynced ledger so executors' ``wait_data_sync`` barrier can
+    release."""
+
+    def __init__(self, session: Session = None, only_computer: str = None):
+        self.session = session or Session.create_session(key='sync')
+        self.hostname = socket.gethostname()
+        self.only_computer = only_computer
+
+    def sync(self):
+        provider = TaskSyncedProvider(self.session)
+        computer_provider = ComputerProvider(self.session)
+        project_provider = ProjectProvider(self.session)
+
+        me = computer_provider.by_name(self.hostname)
+        if me is not None and not me.sync_with_this_computer:
+            return 0
+
+        synced = 0
+        for source, project_id, tasks in provider.for_computer(
+                self.hostname):
+            if self.only_computer and source != self.only_computer:
+                continue
+            project = project_provider.by_id(project_id)
+            folders = []
+            if project is not None:
+                folders = [
+                    os.path.join(DATA_FOLDER, project.name),
+                    os.path.join(MODEL_FOLDER, project.name),
+                ]
+            ok = sync_directed(self.session, source, self.hostname,
+                               folders)
+            if not ok:
+                continue  # do not release the barrier on failed transfer
+            for task in tasks:
+                provider.mark_synced(self.hostname, task.id)
+                synced += 1
+        if me is not None:
+            me.last_synced = now()
+            computer_provider.update(me, ['last_synced'])
+        return synced
+
+    def sync_manual(self, computer: str = None):
+        if computer:
+            self.only_computer = computer
+        return self.sync()
+
+
+__all__ = ['FileSync', 'sync_directed', 'copy_remote']

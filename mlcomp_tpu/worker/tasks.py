@@ -1,0 +1,230 @@
+"""Worker task runtime (parity: reference worker/tasks.py:29-368).
+
+``ExecuteBuilder`` is the per-task pipeline: fetch task+dag → check status →
+mark InProgress (pid, worker index) → download code from the DB → import
+the executor → pin TPU cores → run → store the result → handle multi-stage
+requeue → Success. ``execute_by_id(id, exit=False)`` is the in-process
+debug path used by ``mlcomp_tpu execute`` (reference __main__.py:90-123).
+
+TPU specifics: instead of remapping ``CUDA_VISIBLE_DEVICES``
+(reference worker/tasks.py:188-194) we pin the runtime to the assigned TPU
+chips via ``TPU_VISIBLE_CHIPS``/``TPU_PROCESS_BOUNDS`` before jax import,
+and per-task process hygiene (reference ``os._exit(0)``,
+worker/tasks.py:279) stays optional because TPU runtime init is expensive —
+a persistent worker keeps the device client alive between tasks when
+``exit=False``.
+"""
+
+import json
+import os
+import sys
+import traceback
+
+from mlcomp_tpu import TASK_FOLDER
+from mlcomp_tpu.db.core import Session
+from mlcomp_tpu.db.enums import ComponentType, TaskStatus
+from mlcomp_tpu.db.providers import (
+    DagProvider, QueueProvider, TaskProvider
+)
+from mlcomp_tpu.utils.config import Config
+from mlcomp_tpu.utils.io import yaml_load
+from mlcomp_tpu.utils.logging import create_logger
+from mlcomp_tpu.utils.misc import now, set_global_seed
+from mlcomp_tpu.worker.storage import Storage
+
+
+class ExecuteBuilder:
+    def __init__(self, task_id: int, repeat_count: int = 1,
+                 exit_on_finish: bool = False, worker_index: int = -1,
+                 folder: str = None, session: Session = None):
+        self.task_id = task_id
+        self.repeat_count = repeat_count
+        self.exit_on_finish = exit_on_finish
+        self.worker_index = worker_index
+        self.folder = folder  # pre-existing code folder (debug mode)
+        self.session = session or Session.create_session(key='worker')
+        self.logger = create_logger(self.session)
+        self.provider = TaskProvider(self.session)
+        self.dag_provider = DagProvider(self.session)
+        self.storage = Storage(self.session, self.logger)
+        self.queue_provider = QueueProvider(self.session)
+
+        self.task = None
+        self.dag = None
+        self.executor = None
+
+    # ------------------------------------------------------------ pipeline
+    def create_base(self):
+        self.task = self.provider.by_id(self.task_id)
+        if self.task is None:
+            raise LookupError(f'task {self.task_id} not found')
+        self.dag = self.dag_provider.by_id(self.task.dag)
+        set_global_seed(self.task.id)
+        # tame host-side BLAS threads; the math runs on TPU
+        os.environ.setdefault('OMP_NUM_THREADS', '1')
+        os.environ.setdefault('MKL_NUM_THREADS', '1')
+        info = self.additional_info()
+        for k, v in (info.get('env') or {}).items():
+            os.environ[str(k)] = str(v)
+
+    def additional_info(self) -> dict:
+        if not self.task.additional_info:
+            return {}
+        return yaml_load(self.task.additional_info)
+
+    def check_status(self):
+        if self.task.status == int(TaskStatus.InProgress):
+            raise RuntimeError(
+                f'task {self.task.id} is already InProgress')
+        if self.task.status > int(TaskStatus.InProgress):
+            raise RuntimeError(
+                f'task {self.task.id} is already finished: '
+                f'{TaskStatus(self.task.status).name}')
+
+    def mark_in_progress(self):
+        self.task.pid = os.getpid()
+        self.task.worker_index = self.worker_index
+        self.provider.update(self.task, ['pid', 'worker_index'])
+        self.provider.change_status(self.task, TaskStatus.InProgress)
+
+    def download(self) -> str:
+        if self.folder is not None:
+            folder = self.folder
+        else:
+            folder = self.storage.download(self.task.id, dag=self.dag)
+        os.makedirs(folder, exist_ok=True)
+        return folder
+
+    def pin_cores(self):
+        """Restrict the TPU runtime to the assigned chips before jax init
+        (TPU analogue of CUDA_VISIBLE_DEVICES remapping,
+        reference worker/tasks.py:188-194)."""
+        if not self.task.cores_assigned:
+            return
+        try:
+            cores = json.loads(self.task.cores_assigned)
+        except (TypeError, ValueError):
+            return
+        if cores:
+            os.environ['TPU_VISIBLE_CHIPS'] = ','.join(
+                str(c) for c in cores)
+            os.environ['TPU_CHIPS_PER_PROCESS_BOUNDS'] = f'1,1,{len(cores)}'
+
+    def create_executor(self, folder: str):
+        config = Config.from_yaml(self.dag.config)
+        info = self.additional_info()
+        executor_name = self.task.executor
+        executor_type = (
+            config.get('executors', {})
+            .get(executor_name, {})
+            .get('type', executor_name))
+        self.storage.import_executor(folder, executor_type)
+        self.executor = __import__(
+            'mlcomp_tpu.worker.executors', fromlist=['Executor']
+        ).Executor.from_config(
+            executor_name, config, additional_info=info,
+            session=self.session, logger=self.logger)
+
+    def execute(self, folder: str):
+        cwd = os.getcwd()
+        os.chdir(folder)
+        try:
+            result = self.executor(self.task, self.dag,
+                                   session=self.session,
+                                   logger=self.logger)
+        finally:
+            os.chdir(cwd)
+        self.task.result = self.executor.result_serialize(result)
+        self.provider.update(self.task, ['result'])
+
+        # multi-stage requeue-to-same-worker
+        # (reference worker/tasks.py:215-236)
+        if isinstance(result, dict) and 'stage' in result \
+                and 'stages' in result:
+            stages = result['stages']
+            stage = result['stage']
+            idx = stages.index(stage) if stage in stages else -1
+            if 0 <= idx < len(stages) - 1:
+                info = self.additional_info()
+                info['stage'] = stages[idx + 1]
+                from mlcomp_tpu.utils.io import yaml_dump
+                self.task.additional_info = yaml_dump(info)
+                self.provider.update(self.task, ['additional_info'])
+                self.provider.change_status(self.task, TaskStatus.Queued)
+                if self.task.queue_id is not None:
+                    queue = self.personal_queue()
+                    msg_id = self.queue_provider.enqueue(queue, {
+                        'action': 'execute', 'task_id': self.task.id})
+                    # point the task at the NEW message so kill/revoke
+                    # targets the pending stage, not the consumed one
+                    self.task.queue_id = msg_id
+                    self.provider.update(self.task, ['queue_id'])
+                    return 'requeued'
+                # debug mode: loop stages in-process
+                return self.build()
+        self.provider.change_status(self.task, TaskStatus.Success)
+        return 'success'
+
+    def personal_queue(self) -> str:
+        import socket
+        docker = self.task.docker_assigned or 'default'
+        return f'{socket.gethostname()}_{docker}_{self.worker_index}'
+
+    # ----------------------------------------------------------------- main
+    def build(self):
+        try:
+            self.create_base()
+            self.check_status()
+            self.mark_in_progress()
+            folder = self.download()
+            self.pin_cores()
+            self.create_executor(folder)
+            return self.execute(folder)
+        except Exception as e:
+            if self.task is not None:
+                self.logger.error(
+                    f'task {self.task_id} failed: '
+                    f'{traceback.format_exc()}',
+                    ComponentType.Worker, None, self.task_id)
+                task = self.provider.by_id(self.task_id)
+                if task is not None and task.status < int(
+                        TaskStatus.Failed):
+                    self.provider.change_status(task, TaskStatus.Failed)
+            raise
+        finally:
+            if self.exit_on_finish:
+                os._exit(0)  # noqa — per-task process hygiene
+
+
+def execute_by_id(task_id: int, exit: bool = False, folder: str = None,
+                  worker_index: int = -1, session: Session = None):
+    builder = ExecuteBuilder(
+        task_id, exit_on_finish=exit, folder=folder,
+        worker_index=worker_index, session=session)
+    return builder.build()
+
+
+def kill_task(task_id: int, session: Session = None):
+    """Stop a task: revoke its queue message if pending, kill its process
+    tree if running (reference worker/tasks.py:336-362)."""
+    session = session or Session.create_session(key='worker')
+    provider = TaskProvider(session)
+    task = provider.by_id(task_id)
+    if task is None:
+        return False
+    if task.queue_id is not None:
+        QueueProvider(session).revoke(task.queue_id)
+    if task.status == int(TaskStatus.InProgress) and task.pid:
+        from mlcomp_tpu.utils.misc import kill_child_processes
+        import signal
+        kill_child_processes(task.pid)
+        try:
+            os.kill(task.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+    if task.status < int(TaskStatus.Failed):
+        provider.change_status(task, TaskStatus.Stopped)
+    return True
+
+
+__all__ = ['ExecuteBuilder', 'execute_by_id', 'kill_task']

@@ -1,0 +1,258 @@
+"""Executor framework + in-process DAG execution tests (SURVEY.md §4)."""
+
+import os
+import textwrap
+
+import pytest
+
+from mlcomp_tpu.db.enums import TaskStatus
+from mlcomp_tpu.db.models import Dag, Task
+from mlcomp_tpu.db.providers import (
+    DagStorageProvider, ProjectProvider, StepProvider, TaskProvider
+)
+from mlcomp_tpu.server.create_dags.standard import dag_standard, parse_cores
+from mlcomp_tpu.utils.misc import now
+from mlcomp_tpu.worker.executors import Executor
+from mlcomp_tpu.worker.storage import Storage
+from mlcomp_tpu.worker.tasks import execute_by_id
+
+
+EXPDIR_CONFIG = """\
+info:
+  name: test_dag
+  project: test_exec_proj
+
+executors:
+  write:
+    type: write_marker
+    marker: hello
+  check:
+    type: check_marker
+    depends: write
+"""
+
+EXPDIR_CODE = '''\
+import os
+from mlcomp_tpu.worker.executors import Executor
+
+
+@Executor.register
+class WriteMarker(Executor):
+    def __init__(self, marker='x', **kwargs):
+        self.marker = marker
+
+    def work(self):
+        with open(os.path.join('data', 'marker.txt'), 'w') as fh:
+            fh.write(self.marker)
+        self.info('marker written')
+
+
+@Executor.register
+class CheckMarker(Executor):
+    def __init__(self, **kwargs):
+        pass
+
+    def work(self):
+        with open(os.path.join('data', 'marker.txt')) as fh:
+            content = fh.read()
+        assert content == 'hello', content
+        return {'content': content}
+'''
+
+
+@pytest.fixture()
+def expdir(tmp_path):
+    folder = tmp_path / 'exp'
+    folder.mkdir()
+    (folder / 'config.yml').write_text(EXPDIR_CONFIG)
+    (folder / 'executors.py').write_text(EXPDIR_CODE)
+    return str(folder)
+
+
+class TestRegistry:
+    def test_register_and_get(self):
+        @Executor.register
+        class MyCustomThing(Executor):
+            def work(self):
+                return 1
+
+        assert Executor.is_registered('my_custom_thing')
+        assert Executor.is_registered('MyCustomThing')
+        assert Executor.get('my_custom_thing') is MyCustomThing
+
+    def test_parse_cores(self):
+        assert parse_cores('2-4') == (2, 4)
+        assert parse_cores(3) == (3, 3)
+        assert parse_cores(None) == (0, 0)
+        assert parse_cores('8') == (8, 8)
+        with pytest.raises(ValueError):
+            parse_cores('4-2')
+
+
+class TestDagBuilder:
+    def test_build_with_deps_and_upload(self, session, expdir):
+        from mlcomp_tpu.utils.io import yaml_load
+        config = yaml_load(file=os.path.join(expdir, 'config.yml'))
+        dag, tasks = dag_standard(
+            session, config, upload_folder=expdir)
+        assert set(tasks) == {'write', 'check'}
+        tp = TaskProvider(session)
+        check_task = tp.by_id(tasks['check'][0])
+        deps = tp.dependencies(check_task.id)
+        assert len(deps) == 1 and deps[0].id == tasks['write'][0]
+        # code uploaded
+        items = DagStorageProvider(session).by_dag(dag.id)
+        paths = [s.path for s, _ in items]
+        assert 'executors.py' in paths and 'config.yml' in paths
+
+    def test_unknown_dependency_fails(self, session):
+        config = {
+            'info': {'name': 'x', 'project': 'p_unknown_dep'},
+            'executors': {'a': {'type': 'a', 'depends': 'missing'}},
+        }
+        with pytest.raises(ValueError, match='unknown'):
+            dag_standard(session, config)
+
+    def test_self_dependency_fails(self, session):
+        config = {
+            'info': {'name': 'x', 'project': 'p_self_dep'},
+            'executors': {'a': {'type': 'a', 'depends': 'a'}},
+        }
+        with pytest.raises(ValueError, match='itself'):
+            dag_standard(session, config)
+
+    def test_grid_fanout(self, session):
+        config = {
+            'info': {'name': 'x', 'project': 'p_grid'},
+            'executors': {
+                'train': {
+                    'type': 'train',
+                    'grid': [{'lr': [0.1, 0.01, 0.001]}],
+                },
+            },
+        }
+        _, tasks = dag_standard(session, config)
+        assert len(tasks['train']) == 3
+        tp = TaskProvider(session)
+        from mlcomp_tpu.utils.io import yaml_load as yl
+        infos = [yl(tp.by_id(t).additional_info) for t in tasks['train']]
+        assert [i['grid_cell'] for i in infos] == [0, 1, 2]
+        assert infos[1]['grid']['lr'] == 0.01
+
+
+class TestExecution:
+    def test_full_dag_through_db_storage(self, session, expdir):
+        """End-to-end: build dag (code uploaded to DB), execute tasks by
+        downloading code from the DB — no direct folder sharing."""
+        from mlcomp_tpu.utils.io import yaml_load
+        config = yaml_load(file=os.path.join(expdir, 'config.yml'))
+        dag, tasks = dag_standard(
+            session, config, upload_folder=expdir)
+        tp = TaskProvider(session)
+        for name in ('write', 'check'):
+            for tid in tasks[name]:
+                execute_by_id(tid, exit=False, session=session)
+        check = tp.by_id(tasks['check'][0])
+        assert check.status == int(TaskStatus.Success)
+        assert '"content": "hello"' in check.result
+        # steps recorded
+        steps = StepProvider(session).by_task(tasks['write'][0])
+        assert len(steps) >= 1
+        assert all(s.finished is not None for s in steps)
+
+    def test_failed_task_marks_failed(self, session, tmp_path):
+        folder = tmp_path / 'exp2'
+        folder.mkdir()
+        (folder / 'bad.py').write_text(textwrap.dedent('''\
+            from mlcomp_tpu.worker.executors import Executor
+
+            @Executor.register
+            class AlwaysFails(Executor):
+                def __init__(self, **kwargs):
+                    pass
+                def work(self):
+                    raise RuntimeError('boom')
+            '''))
+        config = {
+            'info': {'name': 'f', 'project': 'p_fail'},
+            'executors': {'bad': {'type': 'always_fails'}},
+        }
+        _, tasks = dag_standard(
+            session, config, upload_folder=str(folder))
+        with pytest.raises(RuntimeError, match='boom'):
+            execute_by_id(tasks['bad'][0], session=session)
+        t = TaskProvider(session).by_id(tasks['bad'][0])
+        assert t.status == int(TaskStatus.Failed)
+
+    def test_already_finished_not_rerun(self, session, expdir):
+        from mlcomp_tpu.utils.io import yaml_load
+        config = yaml_load(file=os.path.join(expdir, 'config.yml'))
+        _, tasks = dag_standard(session, config, upload_folder=expdir)
+        tid = tasks['write'][0]
+        execute_by_id(tid, session=session)
+        with pytest.raises(RuntimeError, match='finished'):
+            execute_by_id(tid, session=session)
+
+
+class TestStorage:
+    def test_md5_dedup(self, session, tmp_path):
+        folder = tmp_path / 'dup'
+        folder.mkdir()
+        (folder / 'a.py').write_text('same = 1\n')
+        (folder / 'b.py').write_text('same = 1\n')
+        p = ProjectProvider(session).add_project('dedup_proj')
+        dag = Dag(name='d', config='', project=p.id, created=now())
+        session.add(dag)
+        storage = Storage(session)
+        stats = storage.upload(str(folder), dag, control_reqs=False)
+        assert stats['count'] == 2
+        from mlcomp_tpu.db.providers import FileProvider
+        # identical content stored once
+        assert len(FileProvider(session).hashs(p.id)) == 1
+
+    def test_ignore_patterns(self, session, tmp_path):
+        folder = tmp_path / 'ign'
+        folder.mkdir()
+        (folder / '.ignore').write_text('secret*\n')
+        (folder / 'keep.py').write_text('x = 1\n')
+        (folder / 'secret.txt').write_text('nope\n')
+        p = ProjectProvider(session).add_project('ign_proj')
+        dag = Dag(name='d', config='', project=p.id, created=now())
+        session.add(dag)
+        Storage(session).upload(str(folder), dag, control_reqs=False)
+        paths = [s.path for s, _ in
+                 DagStorageProvider(session).by_dag(dag.id)]
+        assert 'keep.py' in paths
+        assert 'secret.txt' not in paths
+
+
+class TestGridCellMerge:
+    def test_grid_cell_reaches_executor_kwargs(self, session):
+        """Regression: each fanned-out task must run ITS OWN grid cell."""
+        from mlcomp_tpu.utils.config import Config
+
+        @Executor.register
+        class GridProbe(Executor):
+            def __init__(self, lr=0.5, **kwargs):
+                self.lr = lr
+
+            def work(self):
+                return self.lr
+
+        config = Config({
+            'info': {'name': 'g', 'project': 'p_gridmerge'},
+            'executors': {
+                'train': {'type': 'grid_probe', 'params': {'lr': 0.5},
+                          'grid': [{'lr': [0.1, 0.01]}]},
+            },
+        })
+        _, tasks = dag_standard(session, config)
+        from mlcomp_tpu.utils.io import yaml_load as yl
+        tp = TaskProvider(session)
+        lrs = []
+        for tid in tasks['train']:
+            info = yl(tp.by_id(tid).additional_info)
+            ex = Executor.from_config('train', config,
+                                      additional_info=info)
+            lrs.append(ex.lr)
+        assert sorted(lrs) == [0.01, 0.1]
