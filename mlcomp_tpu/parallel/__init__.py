@@ -1,0 +1,26 @@
+"""TPU parallelism: meshes, shardings, and sequence-parallel attention.
+
+Replaces the reference's NCCL/env-var distributed model (SURVEY.md §2.3)
+with jax.sharding over a named device mesh; adds TP/SP capabilities the
+reference never had.
+"""
+
+from mlcomp_tpu.parallel.mesh import (
+    AXIS_ORDER, DATA_AXES, mesh_from_spec, normalize_mesh_spec,
+    single_device_mesh, mesh_axis_size,
+)
+from mlcomp_tpu.parallel.sharding import (
+    DEFAULT_LOGICAL_RULES, logical_rules, logical_to_sharding,
+    batch_sharding, replicated, data_parallel_size,
+    with_sharding_constraint,
+)
+from mlcomp_tpu.parallel.ring import ring_attention, make_ring_attention
+
+__all__ = [
+    'AXIS_ORDER', 'DATA_AXES', 'mesh_from_spec', 'normalize_mesh_spec',
+    'single_device_mesh', 'mesh_axis_size',
+    'DEFAULT_LOGICAL_RULES', 'logical_rules', 'logical_to_sharding',
+    'batch_sharding', 'replicated', 'data_parallel_size',
+    'with_sharding_constraint',
+    'ring_attention', 'make_ring_attention',
+]

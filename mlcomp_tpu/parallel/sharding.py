@@ -1,0 +1,122 @@
+"""Logical-axis sharding rules and helpers.
+
+Models annotate parameters with *logical* axis names (via
+``flax.linen.with_logical_partitioning``); this module maps logical names
+to mesh axes and produces `NamedSharding` trees for params, optimizer
+state, and batches. This replaces the reference's resource model of "GPU
+index arrays + CUDA_VISIBLE_DEVICES" (reference worker/tasks.py:188-194,
+supervisor.py:75-111) with declarative shardings that XLA lowers to ICI
+collectives.
+"""
+
+from typing import Optional
+
+import jax
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Default logical→mesh rules. First matching mesh axis present in the mesh
+# wins; a logical axis maps to None (replicated) if none of its candidate
+# mesh axes exist in the mesh. Tuples mean "shard over both axes".
+DEFAULT_LOGICAL_RULES = (
+    # activations
+    ('batch', ('dp', 'fsdp')),
+    ('seq', 'sp'),
+    # params
+    ('embed', 'fsdp'),        # embedding/hidden dim of weights: FSDP shards
+    ('heads', 'tp'),
+    ('kv', None),
+    ('mlp', 'tp'),            # ffn hidden
+    ('vocab', 'tp'),
+    ('expert', 'ep'),
+    ('stage', 'pp'),
+    ('conv_in', None),
+    ('conv_out', None),
+    ('norm', None),
+)
+
+
+def logical_rules(mesh: Mesh, extra=()) -> list:
+    """Filter DEFAULT_LOGICAL_RULES down to axes the mesh actually has."""
+    have = set(mesh.axis_names)
+
+    def resolve(target):
+        if target is None:
+            return None
+        if isinstance(target, str):
+            return target if target in have else None
+        picked = tuple(t for t in target if t in have)
+        if not picked:
+            return None
+        return picked if len(picked) > 1 else picked[0]
+
+    rules = []
+    seen = set()
+    for name, target in tuple(extra) + DEFAULT_LOGICAL_RULES:
+        if name in seen:
+            continue
+        seen.add(name)
+        rules.append((name, resolve(target)))
+    return rules
+
+
+def logical_to_sharding(tree, mesh: Mesh, extra_rules=()):
+    """Map a tree of logical PartitionSpecs (e.g. from
+    ``nn.get_partition_spec``) to concrete NamedShardings on the mesh."""
+    rules = logical_rules(mesh, extra_rules)
+    specs = nn.logical_to_mesh(nn.get_partition_spec(tree), rules)
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def batch_sharding(mesh: Mesh, ndim: int, seq_dim: Optional[int] = None
+                   ) -> NamedSharding:
+    """Sharding for an input batch: dim0 over (dp, fsdp), optionally one
+    dim over sp, everything else replicated."""
+    data = tuple(a for a in ('dp', 'fsdp') if a in mesh.axis_names)
+    parts = [None] * ndim
+    parts[0] = data if len(data) > 1 else (data[0] if data else None)
+    if seq_dim is not None and 'sp' in mesh.axis_names:
+        parts[seq_dim] = 'sp'
+    return NamedSharding(mesh, P(*parts))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    n = 1
+    for a in ('dp', 'fsdp'):
+        if a in mesh.axis_names:
+            n *= mesh.shape[a]
+    return n
+
+
+def with_sharding_constraint(x, logical_spec, mesh: Optional[Mesh] = None):
+    """Constrain an intermediate activation to a logical spec inside jit.
+    Under no mesh (plain eager), this is the identity."""
+    mesh = mesh or get_abstract_mesh()
+    if mesh is None:
+        return x
+    rules = logical_rules(mesh)
+    spec = nn.logical_to_mesh(logical_spec, rules)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def get_abstract_mesh():
+    """The mesh of the enclosing `with mesh:` context, if any."""
+    try:
+        from jax._src.mesh import thread_resources
+        env = thread_resources.env
+        mesh = env.physical_mesh
+        return None if mesh.empty else mesh
+    except Exception:
+        return None
+
+
+__all__ = ['DEFAULT_LOGICAL_RULES', 'logical_rules', 'logical_to_sharding',
+           'batch_sharding', 'replicated', 'data_parallel_size',
+           'with_sharding_constraint', 'get_abstract_mesh']

@@ -5,12 +5,19 @@ Must set env vars BEFORE jax or mlcomp_tpu are imported anywhere.
 
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+os.environ['JAX_PLATFORMS'] = 'cpu'  # force off the TPU tunnel for tests
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (
         _flags + ' --xla_force_host_platform_device_count=8').strip()
 os.environ.setdefault('MLCOMP_TPU_TEST', '1')
+
+# The image's sitecustomize registers the 'axon' TPU backend and forces
+# jax_platforms='axon,cpu' via jax.config (which beats the env var), so we
+# must override at the config level to get the 8-device CPU mesh.
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 import pytest  # noqa: E402
 
