@@ -148,6 +148,18 @@ CAN_PROCESS_TASKS = _ENV.get('CAN_PROCESS_TASKS', 'True') == 'True'
 DOCKER_IMG = _ENV.get('DOCKER_IMG', 'default')
 DOCKER_MAIN = _ENV.get('DOCKER_MAIN', 'True') == 'True'
 
+# Honor an explicit JAX_PLATFORMS=cpu request (CPU-emulated device meshes
+# for tests/debug). Site boot hooks may force the TPU platform at the
+# jax.config level, which beats the env var — so when the user explicitly
+# asks for cpu, push it through jax.config as well.
+if os.environ.get('JAX_PLATFORMS') == 'cpu':
+    try:
+        import jax as _jax
+
+        _jax.config.update('jax_platforms', 'cpu')
+    except Exception:  # pragma: no cover — jax missing/already initialised
+        pass
+
 __all__ = [
     '__version__', 'ROOT_FOLDER', 'DATA_FOLDER', 'MODEL_FOLDER',
     'TASK_FOLDER', 'LOG_FOLDER', 'CONFIG_FOLDER', 'DB_FOLDER', 'TMP_FOLDER',

@@ -1,0 +1,18 @@
+"""Flax model zoo (parity: reference contrib/model/ + contrib/segmentation/;
+selection-by-name parity: contrib/catalyst/register.py:17-41)."""
+
+from mlcomp_tpu.models.base import (
+    create_model, model_names, param_count, register_model,
+)
+from mlcomp_tpu.models.mlp import MLP
+from mlcomp_tpu.models.resnet import ResNet, BasicBlock, Bottleneck
+from mlcomp_tpu.models.transformer import (
+    TransformerConfig, TransformerLM,
+)
+from mlcomp_tpu.models.unet import UNet
+
+__all__ = [
+    'create_model', 'model_names', 'param_count', 'register_model',
+    'MLP', 'ResNet', 'BasicBlock', 'Bottleneck',
+    'TransformerConfig', 'TransformerLM', 'UNet',
+]

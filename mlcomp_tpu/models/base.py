@@ -1,0 +1,40 @@
+"""Model registry.
+
+TPU-native analogue of the reference's model glue: the reference registers
+its contrib models into Catalyst's registry by name
+(reference contrib/catalyst/register.py:17-41) and DAG configs select
+models by string. Here the registry holds flax module factories; the
+training executor instantiates by ``model.name`` from the DAG config.
+"""
+
+from typing import Callable, Dict
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_model(name: str):
+    def deco(factory):
+        _REGISTRY[name.lower()] = factory
+        return factory
+    return deco
+
+
+def create_model(name: str, **kwargs):
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise KeyError(
+            f'unknown model {name!r}; registered: {sorted(_REGISTRY)}')
+    return _REGISTRY[key](**kwargs)
+
+
+def model_names():
+    return sorted(_REGISTRY)
+
+
+def param_count(params) -> int:
+    import jax
+    import numpy as np
+    return int(sum(np.prod(x.shape) for x in jax.tree.leaves(params)))
+
+
+__all__ = ['register_model', 'create_model', 'model_names', 'param_count']

@@ -1,0 +1,42 @@
+"""MLP classifier — the digit-recognizer workload
+(reference examples/digit-recognizer trains a small net via Catalyst;
+here a flax module jitted onto the MXU)."""
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mlcomp_tpu.models.base import register_model
+
+
+class MLP(nn.Module):
+    num_classes: int = 10
+    hidden: Sequence[int] = (256, 256)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, h in enumerate(self.hidden):
+            x = nn.Dense(
+                h, dtype=self.dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), ('embed', 'mlp')),
+                name=f'dense_{i}')(x)
+            x = nn.relu(x)
+        x = nn.Dense(
+            self.num_classes, dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ('mlp', 'vocab')),
+            name='head')(x)
+        return x
+
+
+@register_model('mlp')
+def _mlp(num_classes=10, hidden=(256, 256), dtype='float32', **_):
+    return MLP(num_classes=num_classes, hidden=tuple(hidden),
+               dtype=jnp.dtype(dtype))
+
+
+__all__ = ['MLP']

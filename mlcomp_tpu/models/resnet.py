@@ -1,0 +1,132 @@
+"""ResNet family in flax (CIFAR + ImageNet stems).
+
+Parity target: the reference's classification zoo wraps pretrainedmodels
+(reference contrib/model/pretrained.py:6-59) and its examples train
+ResNet-18 on CIFAR (reference examples/cifar_simple/catalyst.yml). Here
+the family is implemented natively in flax with NHWC layout and bf16
+compute support — convs lower straight onto the MXU.
+"""
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mlcomp_tpu.models.base import register_model
+
+ModuleDef = Any
+
+
+def conv_kernel_init():
+    return nn.with_logical_partitioning(
+        nn.initializers.variance_scaling(2.0, 'fan_out', 'normal'),
+        ('conv_h', 'conv_w', 'conv_in', 'conv_out'))
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name='conv_proj')(residual)
+            residual = self.norm(name='norm_proj')(residual)
+        return self.act(residual + y)
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name='conv_proj')(residual)
+            residual = self.norm(name='norm_proj')(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block: ModuleDef
+    num_classes: int = 10
+    num_filters: int = 64
+    cifar_stem: bool = True      # 3x3 stride-1 stem, no maxpool
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       kernel_init=conv_kernel_init())
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        act = nn.relu
+
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = conv(self.num_filters, (3, 3), name='conv_stem')(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name='conv_stem')(x)
+        x = norm(name='norm_stem')(x)
+        x = act(x)
+        if not self.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
+
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block(self.num_filters * 2 ** i, conv=conv,
+                               norm=norm, act=act, strides=strides)(x)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(
+            self.num_classes, dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ('embed', 'vocab')),
+            name='head')(x)
+        return x
+
+
+_VARIANTS = {
+    'resnet18': ([2, 2, 2, 2], BasicBlock),
+    'resnet34': ([3, 4, 6, 3], BasicBlock),
+    'resnet50': ([3, 4, 6, 3], Bottleneck),
+    'resnet101': ([3, 4, 23, 3], Bottleneck),
+    'resnet152': ([3, 8, 36, 3], Bottleneck),
+}
+
+for _name, (_sizes, _block) in _VARIANTS.items():
+    def _factory(num_classes=10, cifar_stem=True, dtype='bfloat16',
+                 _sizes=_sizes, _block=_block, **_):
+        return ResNet(stage_sizes=_sizes, block=_block,
+                      num_classes=num_classes, cifar_stem=cifar_stem,
+                      dtype=jnp.dtype(dtype))
+    register_model(_name)(_factory)
+
+
+__all__ = ['ResNet', 'BasicBlock', 'Bottleneck']

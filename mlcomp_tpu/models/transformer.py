@@ -1,0 +1,190 @@
+"""Decoder-only Transformer LM — the flagship long-context model.
+
+Green-field relative to the reference (its zoo is CNNs only — SURVEY.md
+§2.3): this model exists to exercise the framework's TPU parallelism:
+
+- params carry *logical* axis names (``embed``/``heads``/``kv``/``mlp``/
+  ``vocab``) which `parallel.sharding.logical_rules` maps to mesh axes —
+  tensor parallelism is a rule change, not a model change;
+- activations are constrained to ('batch', 'seq', 'embed') so the batch
+  rides dp/fsdp and the sequence rides sp;
+- attention goes through `parallel.ring.make_ring_attention`: when the
+  mesh has an sp axis the sequence dimension never materialises on one
+  device (exact ring attention over ICI), otherwise a single fused dense
+  attention;
+- bf16 compute / f32 params by default for the MXU.
+"""
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from mlcomp_tpu.models.base import register_model
+from mlcomp_tpu.parallel.ring import make_ring_attention, _plain_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    dropout: float = 0.0
+    dtype: str = 'bfloat16'
+    remat: bool = False           # jax.checkpoint each layer (HBM savings)
+    # MoE (expert parallelism); 0 = dense MLP everywhere
+    n_experts: int = 0
+    moe_every: int = 2            # every k-th layer is MoE when n_experts>0
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+
+def _dense(features, axes, dtype, name=None):
+    return nn.DenseGeneral(
+        features, axis=-1, dtype=dtype, use_bias=False,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), axes),
+        name=name)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        h, d = cfg.n_heads, cfg.head_dim
+
+        qkv = nn.DenseGeneral(
+            (3, h, d), axis=-1, dtype=dtype, use_bias=False,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ('embed', 'qkv', 'heads',
+                                                 'kv')),
+            name='qkv')(x)
+        q, k, v = (jnp.squeeze(a, 2) for a in jnp.split(qkv, 3, axis=2))
+        q = nn.with_logical_constraint(q, ('batch', 'seq', 'heads', 'kv'))
+        k = nn.with_logical_constraint(k, ('batch', 'seq', 'heads', 'kv'))
+        v = nn.with_logical_constraint(v, ('batch', 'seq', 'heads', 'kv'))
+
+        if self.mesh is not None:
+            attend = make_ring_attention(self.mesh, causal=True)
+            out = attend(q, k, v)
+        else:
+            out = _plain_attention(q, k, v, causal=True)
+        out = nn.with_logical_constraint(
+            out, ('batch', 'seq', 'heads', 'kv'))
+
+        out = nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), dtype=dtype, use_bias=False,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ('heads', 'kv', 'embed')),
+            name='out')(out)
+        if cfg.dropout:
+            out = nn.Dropout(cfg.dropout, deterministic=not train)(out)
+        return nn.with_logical_constraint(out, ('batch', 'seq', 'embed'))
+
+
+class MlpBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        gate = _dense(cfg.d_ff, ('embed', 'mlp'), dtype, 'wi_gate')(x)
+        up = _dense(cfg.d_ff, ('embed', 'mlp'), dtype, 'wi_up')(x)
+        y = nn.silu(gate) * up
+        y = nn.with_logical_constraint(y, ('batch', 'seq', 'mlp'))
+        y = _dense(cfg.d_model, ('mlp', 'embed'), dtype, 'wo')(y)
+        if cfg.dropout:
+            y = nn.Dropout(cfg.dropout, deterministic=not train)(y)
+        return nn.with_logical_constraint(y, ('batch', 'seq', 'embed'))
+
+
+class DecoderLayer(nn.Module):
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        norm = lambda name: nn.RMSNorm(  # noqa: E731
+            dtype=dtype, name=name,
+            scale_init=nn.with_logical_partitioning(
+                nn.initializers.ones, ('norm',)))
+        y = norm('norm_attn')(x)
+        x = x + Attention(cfg, mesh=self.mesh, name='attn')(y, train)
+        y = norm('norm_mlp')(x)
+        x = x + MlpBlock(cfg, name='mlp')(y, train)
+        return nn.with_logical_constraint(x, ('batch', 'seq', 'embed'))
+
+
+class TransformerLM(nn.Module):
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        cfg = self.cfg
+        if cfg.n_experts:
+            raise NotImplementedError(
+                'MoE (n_experts > 0) requires MoeTransformerLM — '
+                'see mlcomp_tpu/models/moe.py')
+        dtype = jnp.dtype(cfg.dtype)
+
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ('vocab', 'embed')),
+            name='embed')
+        x = embed(tokens)
+        pos = self.param(
+            'pos_embed',
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ('seq', 'embed')),
+            (cfg.max_seq_len, cfg.d_model))
+        x = x + pos[None, :tokens.shape[1], :].astype(dtype)
+        x = nn.with_logical_constraint(x, ('batch', 'seq', 'embed'))
+
+        layer_cls = DecoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(DecoderLayer, static_argnums=(2,))
+        for i in range(cfg.n_layers):
+            if cfg.remat:
+                x = layer_cls(cfg, mesh=self.mesh, name=f'layer_{i}')(
+                    x, train)
+            else:
+                x = layer_cls(cfg, mesh=self.mesh, name=f'layer_{i}')(
+                    x, train=train)
+
+        x = nn.RMSNorm(
+            dtype=dtype, name='norm_final',
+            scale_init=nn.with_logical_partitioning(
+                nn.initializers.ones, ('norm',)))(x)
+        # tied-untied head: separate projection, vocab sharded over tp
+        logits = _dense(cfg.vocab_size, ('embed', 'vocab'), jnp.float32,
+                        'lm_head')(x)
+        return nn.with_logical_constraint(
+            logits, ('batch', 'seq', 'vocab'))
+
+
+@register_model('transformer_lm')
+def _transformer(mesh=None, **kwargs):
+    fields = {f.name for f in dataclasses.fields(TransformerConfig)}
+    cfg = TransformerConfig(
+        **{k: v for k, v in kwargs.items() if k in fields})
+    return TransformerLM(cfg, mesh=mesh)
+
+
+__all__ = ['TransformerConfig', 'TransformerLM', 'DecoderLayer',
+           'Attention', 'MlpBlock']

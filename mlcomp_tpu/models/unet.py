@@ -1,0 +1,67 @@
+"""U-Net for semantic segmentation.
+
+Parity target: the reference vendors a 3,170-LoC torch segmentation zoo
+(Unet/Linknet/FPN/PSPNet/DeepLabV3 — reference contrib/segmentation/,
+SURVEY.md §2.1). Here the family starts with a native flax U-Net (NHWC,
+bf16 compute); further decoders hang off the same encoder interface.
+"""
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mlcomp_tpu.models.base import register_model
+from mlcomp_tpu.models.resnet import conv_kernel_init
+
+
+class ConvBlock(nn.Module):
+    filters: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       kernel_init=conv_kernel_init())
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=self.dtype)
+        x = nn.relu(norm()(conv(self.filters, (3, 3))(x)))
+        x = nn.relu(norm()(conv(self.filters, (3, 3))(x)))
+        return x
+
+
+class UNet(nn.Module):
+    num_classes: int = 2
+    filters: Sequence[int] = (32, 64, 128, 256)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        skips = []
+        for i, f in enumerate(self.filters[:-1]):
+            x = ConvBlock(f, self.dtype, name=f'down_{i}')(x, train)
+            skips.append(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = ConvBlock(self.filters[-1], self.dtype, name='bottleneck')(
+            x, train)
+        for i, f in reversed(list(enumerate(self.filters[:-1]))):
+            b, h, w, c = x.shape
+            x = jax.image.resize(x, (b, h * 2, w * 2, c), 'nearest')
+            x = jnp.concatenate([x, skips[i]], axis=-1)
+            x = ConvBlock(f, self.dtype, name=f'up_{i}')(x, train)
+        x = nn.Conv(self.num_classes, (1, 1), dtype=jnp.float32,
+                    name='head')(x)
+        return x
+
+
+@register_model('unet')
+def _unet(num_classes=2, filters=(32, 64, 128, 256), dtype='bfloat16',
+          **_):
+    return UNet(num_classes=num_classes, filters=tuple(filters),
+                dtype=jnp.dtype(dtype))
+
+
+__all__ = ['UNet']

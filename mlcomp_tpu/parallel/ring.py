@@ -80,27 +80,35 @@ def ring_attention(q, k, v, *, axis_name: str, axis_size: int,
     v_ = jnp.transpose(v, (0, 2, 1, 3))
     b, h, t, d = q_.shape
 
-    my_idx = lax.axis_index(axis_name)
+    my_idx = lax.axis_index(axis_name) if axis_size > 1 else 0
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
     m0 = jnp.full((b, h, t), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, t), jnp.float32)
     o0 = jnp.zeros((b, h, t, d), jnp.float32)
 
-    def step(carry, i):
-        m, l, o, k_blk, v_blk = carry
-        kv_idx = (my_idx - i) % axis_size
-        m, l, o = _block_attention(
-            q_, k_blk, v_blk, m, l, o,
-            q_offset=my_idx * t, k_offset=kv_idx * t,
-            causal=causal, scale=scale)
-        if axis_size > 1:
+    # own (diagonal) block first — no communication
+    m, l, o = _block_attention(
+        q_, k_, v_, m0, l0, o0, q_offset=my_idx * t,
+        k_offset=my_idx * t, causal=causal, scale=scale)
+
+    if axis_size > 1:
+        # then n_sp-1 rotate-and-accumulate steps (rotate FIRST so the
+        # final iteration does no wasted ppermute)
+        def step(carry, i):
+            m, l, o, k_blk, v_blk = carry
             k_blk = lax.ppermute(k_blk, axis_name, perm)
             v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return (m, l, o, k_blk, v_blk), None
+            kv_idx = (my_idx - i) % axis_size
+            m, l, o = _block_attention(
+                q_, k_blk, v_blk, m, l, o,
+                q_offset=my_idx * t, k_offset=kv_idx * t,
+                causal=causal, scale=scale)
+            return (m, l, o, k_blk, v_blk), None
 
-    (m, l, o, _, _), _ = lax.scan(
-        step, (m0, l0, o0, k_, v_), jnp.arange(axis_size))
+        (m, l, o, _, _), _ = lax.scan(
+            step, (m, l, o, k_, v_), jnp.arange(1, axis_size))
+
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(in_dtype)
 

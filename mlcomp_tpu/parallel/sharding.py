@@ -30,6 +30,9 @@ DEFAULT_LOGICAL_RULES = (
     ('vocab', 'tp'),
     ('expert', 'ep'),
     ('stage', 'pp'),
+    ('qkv', None),
+    ('conv_h', None),
+    ('conv_w', None),
     ('conv_in', None),
     ('conv_out', None),
     ('norm', None),

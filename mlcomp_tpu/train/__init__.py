@@ -1,0 +1,24 @@
+"""JAX training stack: state/loop/optim/checkpoint/data + the JaxTrain
+executor (TPU-native replacement for the reference's Catalyst layer)."""
+
+from mlcomp_tpu.train.checkpoint import (
+    restore_checkpoint, resume_plan, save_checkpoint,
+)
+from mlcomp_tpu.train.data import (
+    create_dataset, iterate_batches, place_batch, register_dataset,
+)
+from mlcomp_tpu.train.loop import (
+    LOSSES, TrainState, create_train_state, loss_for_task,
+    make_eval_step, make_train_step,
+)
+from mlcomp_tpu.train.optim import make_optimizer, make_schedule
+from mlcomp_tpu.train.executor import JaxTrain
+
+__all__ = [
+    'restore_checkpoint', 'resume_plan', 'save_checkpoint',
+    'create_dataset', 'iterate_batches', 'place_batch',
+    'register_dataset',
+    'LOSSES', 'TrainState', 'create_train_state', 'loss_for_task',
+    'make_eval_step', 'make_train_step',
+    'make_optimizer', 'make_schedule', 'JaxTrain',
+]

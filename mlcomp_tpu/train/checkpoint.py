@@ -1,0 +1,105 @@
+"""Checkpoint save/restore with stage/epoch resume arithmetic.
+
+Parity: the reference delegates checkpoints to Catalyst
+(best.pth/last_full.pth) and adds resume plumbing — cross-machine fetch +
+"trim completed stages, decrement num_epochs" arithmetic
+(reference worker/executors/catalyst/catalyst.py:218-296, SURVEY.md §5).
+Here checkpoints are flax msgpack blobs + a JSON meta sidecar; the same
+``best``/``last`` naming convention is kept so restart-with-resume
+(reference server/back/app.py:488-552) has identical semantics.
+
+Layout: ``<dir>/last.msgpack``, ``<dir>/best.msgpack``, each with
+``.meta.json`` carrying {step, stage, stage_epoch, epoch, score, time}.
+"""
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Optional, Tuple
+
+import jax
+from flax import serialization
+
+
+def _meta_path(path: str) -> str:
+    return path + '.meta.json'
+
+
+def save_checkpoint(directory: str, state: Any, meta: dict,
+                    best: bool = False) -> str:
+    """Serialise ``state`` (a pytree) to ``last.msgpack`` (and
+    ``best.msgpack`` when ``best``). Returns the last-checkpoint path."""
+    os.makedirs(directory, exist_ok=True)
+    # pull to host once; donated/sharded arrays gather here
+    state = jax.device_get(state)
+    blob = serialization.to_bytes(state)
+    meta = dict(meta, time=time.time())
+    last = os.path.join(directory, 'last.msgpack')
+    tmp = last + '.tmp'
+    with open(tmp, 'wb') as fh:
+        fh.write(blob)
+    os.replace(tmp, last)
+    with open(_meta_path(last), 'w') as fh:
+        json.dump(meta, fh)
+    if best:
+        best_path = os.path.join(directory, 'best.msgpack')
+        shutil.copyfile(last, best_path)
+        shutil.copyfile(_meta_path(last), _meta_path(best_path))
+    return last
+
+
+def load_meta(directory: str, kind: str = 'last') -> Optional[dict]:
+    """Read just the meta sidecar — lets resume logic decide the restore
+    target's structure (e.g. which stage's optimizer) BEFORE
+    deserialising the blob."""
+    path = _meta_path(os.path.join(directory, f'{kind}.msgpack'))
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def restore_checkpoint(directory: str, target: Any,
+                       kind: str = 'last'
+                       ) -> Tuple[Optional[Any], Optional[dict]]:
+    """Restore ``<kind>.msgpack`` into the structure of ``target``.
+    Returns (state, meta) or (None, None) when absent."""
+    path = os.path.join(directory, f'{kind}.msgpack')
+    if not os.path.exists(path):
+        return None, None
+    with open(path, 'rb') as fh:
+        blob = fh.read()
+    state = serialization.from_bytes(target, blob)
+    meta = {}
+    if os.path.exists(_meta_path(path)):
+        with open(_meta_path(path)) as fh:
+            meta = json.load(fh)
+    return state, meta
+
+
+def resume_plan(stages: list, meta: Optional[dict]) -> Tuple[list, int]:
+    """Given config stages [{name, epochs, ...}] and a restored meta,
+    return (remaining_stages, epochs_done_in_first_remaining_stage).
+
+    Mirrors the reference's `_checkpoint_fix_config` arithmetic
+    (catalyst.py:274-296): completed stages are dropped; the stage the
+    checkpoint was taken in resumes with its epoch counter advanced.
+    """
+    if not meta:
+        return list(stages), 0
+    ck_stage = meta.get('stage')
+    ck_epoch = int(meta.get('stage_epoch', 0))
+    names = [s['name'] for s in stages]
+    if ck_stage not in names:
+        return list(stages), 0
+    idx = names.index(ck_stage)
+    stage_epochs = int(stages[idx].get('epochs', 1))
+    if ck_epoch + 1 >= stage_epochs:
+        # stage finished → resume at the next stage from scratch
+        return list(stages[idx + 1:]), 0
+    return list(stages[idx:]), ck_epoch + 1
+
+
+__all__ = ['save_checkpoint', 'restore_checkpoint', 'resume_plan',
+           'load_meta']

@@ -1,0 +1,331 @@
+"""The JAX training executor — TPU-native replacement for the reference's
+Catalyst executor (reference worker/executors/catalyst/catalyst.py:29-379).
+
+Capability parity map:
+- config-driven model/optimizer/stages       → catalyst.py Args/config
+- per-epoch metric series + best score to DB → on_epoch_end,
+  catalyst.py:100-145
+- hierarchical steps per stage/epoch         → catalyst.py:86-98
+- grid-cell merge                            → catalyst.py:177-179 (done
+  upstream in Executor.from_config)
+- checkpoint save/resume w/ stage arithmetic → catalyst.py:218-296
+- one-stage-per-dispatch + requeue           → catalyst.py:354-368 +
+  worker/tasks.py:215-236
+- distributed training                       → mesh + shardings instead of
+  MASTER_ADDR/RANK env vars (catalyst.py:195-207); the supervisor hands
+  the task a mesh spec, XLA handles the collectives
+
+Example spec::
+
+    train:
+      type: jax_train
+      model: {name: resnet18, num_classes: 10, dtype: bfloat16}
+      dataset: {name: synthetic_images}
+      loss: softmax_ce
+      batch_size: 128
+      mesh: {dp: -1}
+      stages:
+        - {name: stage1, epochs: 3, optimizer: {name: adam, lr: 1e-3}}
+      main_metric: accuracy
+      minimize: false
+      model_name: my_model      # optional Model-registry entry
+"""
+
+import os
+import time
+
+import jax
+import numpy as np
+
+from mlcomp_tpu.models import create_model, param_count
+from mlcomp_tpu.parallel import (
+    batch_sharding, data_parallel_size, mesh_from_spec,
+)
+from mlcomp_tpu.train.checkpoint import (
+    load_meta, restore_checkpoint, resume_plan, save_checkpoint,
+)
+from mlcomp_tpu.train.data import (
+    create_dataset, iterate_batches, place_batch,
+)
+from mlcomp_tpu.train.loop import (
+    create_train_state, loss_for_task, make_eval_step, make_train_step,
+)
+from mlcomp_tpu.train.optim import make_optimizer
+from mlcomp_tpu.worker.executors import Executor
+
+
+@Executor.register
+class JaxTrain(Executor):
+    def __init__(self, model=None, dataset=None, loss='softmax_ce',
+                 batch_size=32, eval_batch_size=None, mesh=None,
+                 stages=None, epochs=1, optimizer=None,
+                 main_metric='accuracy', minimize=False,
+                 model_name=None, seed=0, checkpoint_dir=None,
+                 stage_per_dispatch=False, log_every=50, **kwargs):
+        self.model_spec = dict(model or {'name': 'mlp'})
+        self.dataset_spec = dict(dataset or {})
+        self.loss_name = loss
+        self.batch_size = int(batch_size)
+        self.eval_batch_size = int(eval_batch_size or batch_size)
+        self.mesh_spec = mesh
+        self.stages = [dict(s) for s in (stages or [])] or [
+            {'name': 'stage1', 'epochs': int(epochs),
+             'optimizer': optimizer or {'name': 'adam', 'lr': 1e-3}}]
+        self.main_metric = main_metric
+        self.minimize = bool(minimize)
+        self.model_name = model_name
+        self.seed = int(seed)
+        self.checkpoint_dir = checkpoint_dir
+        self.stage_per_dispatch = bool(stage_per_dispatch)
+        self.log_every = int(log_every)
+
+    # ------------------------------------------------------------ plumbing
+    def _mesh(self):
+        spec = self.mesh_spec
+        if spec is None:
+            spec = {'dp': -1}
+        return mesh_from_spec(spec)
+
+    def _checkpoint_folder(self):
+        if self.checkpoint_dir:
+            return self.checkpoint_dir
+        from mlcomp_tpu import TASK_FOLDER
+        task_id = self.task.id if self.task else 0
+        return os.path.join(TASK_FOLDER, str(task_id), 'checkpoints')
+
+    def _report_series(self, name, value, epoch, part, stage):
+        if self.session is None or self.task is None:
+            return
+        from mlcomp_tpu.db.models import ReportSeries
+        from mlcomp_tpu.db.providers import ReportSeriesProvider
+        from mlcomp_tpu.utils.misc import now
+        ReportSeriesProvider(self.session).add(ReportSeries(
+            task=self.task.id, time=now(), epoch=int(epoch),
+            value=float(value), name=name, part=part, stage=stage))
+
+    def _update_scores(self, score):
+        """task.score + Model.score_local best tracking
+        (reference catalyst.py:131-145, valid.py:74-81)."""
+        if self.session is None or self.task is None:
+            return
+        from mlcomp_tpu.db.providers import ModelProvider, TaskProvider
+        better = (self.task.score is None or
+                  (score < self.task.score if self.minimize
+                   else score > self.task.score))
+        if better:
+            self.task.score = float(score)
+            TaskProvider(self.session).update(self.task, ['score'])
+            if self.model_name:
+                from mlcomp_tpu.db.models import Model
+                from mlcomp_tpu.utils.misc import now
+                provider = ModelProvider(self.session)
+                row = provider.by_name(self.model_name)
+                if row is None:
+                    row = Model(
+                        name=self.model_name, project=self.dag.project,
+                        dag=self.dag.id, created=now())
+                row.score_local = float(score)
+                provider.create_or_update(row, 'name')
+
+    # ---------------------------------------------------------------- work
+    def work(self):
+        t_start = time.time()
+        mesh = self._mesh()
+        loss_fn = loss_for_task(self.loss_name)
+        self_supervised = self.loss_name == 'lm_ce'
+
+        data = create_dataset(**self.dataset_spec) \
+            if self.dataset_spec.get('name') else \
+            create_dataset('synthetic_images')
+        x_train, y_train = data['x_train'], data['y_train']
+        x_valid, y_valid = data['x_valid'], data['y_valid']
+        seq_dim = 1 if self_supervised and 'sp' in mesh.axis_names else None
+
+        model = create_model(mesh=mesh, **self.model_spec)
+
+        # resume (reference catalyst.py:218-296): restore last checkpoint,
+        # trim completed stages
+        info = dict(getattr(self, 'additional_info', None) or {})
+        ck_dir = self._checkpoint_folder()
+        steps_per_epoch = max(1, len(x_train) // self.batch_size)
+
+        def stage_opt_spec(stage):
+            return stage.get('optimizer') or \
+                self.stages[0].get('optimizer')
+
+        def stage_steps(stage):
+            return int(stage.get('epochs', 1)) * steps_per_epoch
+
+        # stage-per-dispatch (distributed parity, catalyst.py:354-368):
+        # the task's additional_info names the stage this dispatch runs
+        dispatch_stage = info.get('stage') if self.stage_per_dispatch \
+            else None
+
+        stage_names = [s['name'] for s in self.stages]
+        # Read the checkpoint meta FIRST: the restore target's opt_state
+        # structure must match the optimizer of the stage that SAVED the
+        # checkpoint, not stages[0] (they can be different optim types).
+        meta = load_meta(ck_dir)
+        target_stage = self.stages[0]
+        if meta and meta.get('stage') in stage_names:
+            target_stage = self.stages[stage_names.index(meta['stage'])]
+        optimizer, _ = make_optimizer(
+            stage_opt_spec(target_stage), stage_steps(target_stage))
+        # init batch must divide the data-parallel axes (shard_map inside
+        # the model sees global shapes during init's forward trace)
+        sample = x_train[:max(1, data_parallel_size(mesh))]
+        state = create_train_state(
+            model, optimizer, sample, jax.random.PRNGKey(self.seed),
+            mesh=mesh, with_dropout_rng=True)
+        n_params = param_count(state.params)
+        self.info(
+            f'model={self.model_spec.get("name")} params={n_params:,} '
+            f'mesh={dict(mesh.shape)} devices={len(mesh.devices.flat)}')
+
+        epochs_done_global = 0
+        restored = None
+        if meta is not None:
+            try:
+                restored, meta = restore_checkpoint(ck_dir, state)
+            except Exception as e:  # config drift: start fresh
+                self.error(f'checkpoint restore failed ({e}); '
+                           f'starting from scratch')
+                meta = None
+                if target_stage is not self.stages[0]:
+                    # the state above was built with the saved stage's
+                    # optimizer — rebuild for a true from-scratch start
+                    optimizer, _ = make_optimizer(
+                        stage_opt_spec(self.stages[0]),
+                        stage_steps(self.stages[0]))
+                    state = create_train_state(
+                        model, optimizer, sample,
+                        jax.random.PRNGKey(self.seed), mesh=mesh,
+                        with_dropout_rng=True)
+        best = None
+        if restored is not None:
+            state = restored
+            epochs_done_global = int(meta.get('epoch', -1)) + 1
+            # seed best-score tracking from the surviving best checkpoint
+            # so a post-resume epoch can't clobber a better best.msgpack
+            best_meta = load_meta(ck_dir, 'best')
+            if best_meta and best_meta.get('score') is not None:
+                best = float(best_meta['score'])
+            self.info(
+                f'resumed from checkpoint: stage={meta.get("stage")} '
+                f'epoch={meta.get("epoch")} best={best}')
+        remaining, start_epoch = resume_plan(self.stages, meta)
+        if dispatch_stage is not None:
+            remaining = [s for s in remaining
+                         if s['name'] == dispatch_stage] or remaining[:1]
+        global_epoch = epochs_done_global
+        images_seen = 0
+        for stage in remaining:
+            stage_name = stage['name']
+            stage_idx = stage_names.index(stage_name)
+            optimizer, _ = make_optimizer(
+                stage_opt_spec(stage), stage_steps(stage))
+            train_step = make_train_step(
+                model, optimizer, loss_fn, mesh=mesh,
+                self_supervised=self_supervised)
+            eval_step = make_eval_step(
+                model, loss_fn, mesh=mesh,
+                self_supervised=self_supervised)
+            first_epoch = start_epoch if stage is remaining[0] else 0
+            if first_epoch == 0 and stage is not self.stages[0]:
+                # stage boundary: fresh optimizer state, keep params
+                # (resuming mid-stage keeps the restored opt state)
+                state = state.replace(
+                    opt_state=optimizer.init(state.params))
+            self.step.start(1, f'stage {stage_name}', stage_idx)
+            for epoch in range(first_epoch, int(stage.get('epochs', 1))):
+                self.step.start(2, f'epoch {epoch}', epoch)
+                ep_rng = np.random.RandomState(self.seed * 1000 + epoch)
+                t_ep = time.time()
+                train_metrics = []
+                for bi, batch in enumerate(iterate_batches(
+                        x_train, y_train, self.batch_size, ep_rng)):
+                    x, y = place_batch(batch, mesh, seq_dim=seq_dim)
+                    state, metrics = train_step(state, x, y)
+                    train_metrics.append(metrics)
+                    images_seen += self.batch_size
+                if not train_metrics:
+                    raise ValueError(
+                        f'dataset has {len(x_train)} train samples — '
+                        f'fewer than batch_size={self.batch_size}; no '
+                        f'full batch to train on')
+                # metrics: device→host once per epoch
+                train_agg = {
+                    k: float(np.mean([float(m[k]) for m in train_metrics]))
+                    for k in train_metrics[0]}
+                # evaluate EVERY validation sample: tail batches are
+                # padded (duplicate samples) up to a multiple of the
+                # data-parallel width, with zero weights on the padding so
+                # aggregates stay exact
+                dp = max(1, data_parallel_size(mesh))
+                valid_metrics, valid_weights = [], []
+                for bx, by in iterate_batches(
+                        x_valid, y_valid, self.eval_batch_size,
+                        drop_last=False):
+                    n_real = len(bx)
+                    n_padded = -(-n_real // dp) * dp
+                    w = np.ones(n_padded, np.float32)
+                    if n_padded != n_real:
+                        take = np.resize(np.arange(n_real), n_padded)
+                        bx = bx[take]
+                        if by is not None:
+                            by = by[take]
+                        w[n_real:] = 0.0
+                    x, y = place_batch((bx, by), mesh, seq_dim=seq_dim)
+                    w_dev = jax.device_put(w, batch_sharding(mesh, 1))
+                    valid_metrics.append(eval_step(state, x, y, w_dev))
+                    valid_weights.append(n_real)
+                valid_agg = {
+                    k: float(np.average(
+                        [float(m[k]) for m in valid_metrics],
+                        weights=valid_weights))
+                    for k in valid_metrics[0]} if valid_metrics else {}
+
+                dt = time.time() - t_ep
+                n_train = steps_per_epoch * self.batch_size
+                for k, v in train_agg.items():
+                    self._report_series(k, v, global_epoch, 'train',
+                                        stage_name)
+                for k, v in valid_agg.items():
+                    self._report_series(k, v, global_epoch, 'valid',
+                                        stage_name)
+                self._report_series('images_per_sec', n_train / dt,
+                                    global_epoch, 'train', stage_name)
+                self.info(
+                    f'[{stage_name}] epoch {global_epoch}: '
+                    f'train {train_agg} valid {valid_agg} '
+                    f'({n_train / dt:.0f} samples/s)')
+
+                score = valid_agg.get(self.main_metric,
+                                      train_agg.get(self.main_metric))
+                is_best = score is not None and (
+                    best is None or
+                    (score < best if self.minimize else score > best))
+                if is_best:
+                    best = score
+                    self._update_scores(score)
+                save_checkpoint(
+                    ck_dir, state,
+                    {'stage': stage_name, 'stage_epoch': epoch,
+                     'epoch': global_epoch, 'score': score,
+                     'step': int(state.step)},
+                    best=is_best)
+                global_epoch += 1
+            if dispatch_stage is not None or (
+                    self.stage_per_dispatch and stage is not remaining[-1]):
+                # return for requeue: next dispatch runs the next stage
+                return {'stage': stage_name, 'stages': stage_names,
+                        'best_score': best}
+
+        wall = time.time() - t_start
+        return {'stage': stage_names[-1], 'stages': stage_names,
+                'best_score': best, 'n_params': n_params,
+                'wall_time_s': wall,
+                'samples_per_sec': images_seen / max(wall, 1e-9)}
+
+
+__all__ = ['JaxTrain']

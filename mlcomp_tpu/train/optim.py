@@ -1,0 +1,93 @@
+"""Optimizer + LR-schedule factory (optax).
+
+Replaces the reference's Catalyst-config optimizer blocks (torch optims +
+apex, e.g. examples/cifar_simple/catalyst.yml `optimizer_params`) and the
+contrib `OneCycleCosineAnnealLR` (reference
+contrib/catalyst/optim/cosineanneal.py:4-26) with optax transforms —
+pure-functional, jit-safe, shardable opt state.
+
+Config shape::
+
+    optimizer:
+      name: adamw            # sgd | adam | adamw | lamb | adafactor
+      lr: 0.001
+      weight_decay: 0.01
+      grad_clip: 1.0
+      schedule:
+        name: warmup_cosine  # constant | cosine | warmup_cosine | onecycle
+        warmup_steps: 100
+        decay_steps: 10000
+"""
+
+from typing import Optional
+
+import optax
+
+
+def make_schedule(lr: float, spec: Optional[dict],
+                  total_steps: Optional[int] = None):
+    spec = dict(spec or {'name': 'constant'})
+    name = spec.get('name', 'constant').lower()
+    decay_steps = int(spec.get('decay_steps') or total_steps or 10000)
+    warmup = int(spec.get('warmup_steps', 0))
+    final = float(spec.get('final_lr', 0.0))
+    if name == 'constant':
+        sched = optax.constant_schedule(lr)
+    elif name == 'cosine':
+        sched = optax.cosine_decay_schedule(lr, decay_steps,
+                                            alpha=final / lr if lr else 0)
+    elif name in ('warmup_cosine', 'onecycle'):
+        warmup = warmup or max(1, decay_steps // 25)
+        sched = optax.warmup_cosine_decay_schedule(
+            init_value=float(spec.get('init_lr', lr / 25)),
+            peak_value=lr, warmup_steps=warmup,
+            decay_steps=decay_steps, end_value=final)
+    elif name == 'step':
+        boundaries = {
+            int(b): float(g) for b, g in
+            zip(spec.get('boundaries', []), spec.get('gammas', []))
+        } or {decay_steps // 2: 0.1}
+        sched = optax.piecewise_constant_schedule(lr, boundaries)
+    else:
+        raise ValueError(f'unknown schedule {name!r}')
+    return sched
+
+
+def make_optimizer(spec: Optional[dict],
+                   total_steps: Optional[int] = None):
+    """Build an optax GradientTransformation from an optimizer spec."""
+    spec = dict(spec or {})
+    name = spec.get('name', 'adam').lower()
+    lr = float(spec.get('lr', 1e-3))
+    wd = float(spec.get('weight_decay', 0.0))
+    sched = make_schedule(lr, spec.get('schedule'), total_steps)
+
+    if name == 'sgd':
+        opt = optax.sgd(sched, momentum=float(spec.get('momentum', 0.9)),
+                        nesterov=bool(spec.get('nesterov', False)))
+        if wd:
+            opt = optax.chain(optax.add_decayed_weights(wd), opt)
+    elif name == 'adam':
+        opt = optax.adam(sched, b1=float(spec.get('b1', 0.9)),
+                         b2=float(spec.get('b2', 0.999)))
+        if wd:
+            opt = optax.chain(optax.add_decayed_weights(wd), opt)
+    elif name == 'adamw':
+        opt = optax.adamw(
+            sched, b1=float(spec.get('b1', 0.9)),
+            b2=float(spec.get('b2', 0.999)),
+            weight_decay=float(spec.get('weight_decay', 1e-2)))
+    elif name == 'lamb':
+        opt = optax.lamb(sched, weight_decay=wd)
+    elif name == 'adafactor':
+        opt = optax.adafactor(sched)
+    else:
+        raise ValueError(f'unknown optimizer {name!r}')
+
+    clip = float(spec.get('grad_clip', 0.0))
+    if clip:
+        opt = optax.chain(optax.clip_by_global_norm(clip), opt)
+    return opt, sched
+
+
+__all__ = ['make_optimizer', 'make_schedule']

@@ -1,5 +1,35 @@
-"""Executor registry — importing this package registers built-in executors."""
+"""Executor registry — importing this package registers built-in executors
+(parity: reference worker/executors/__init__.py imports all builtins so
+the registry is populated before user code is scanned)."""
+
+import sys as _sys
 
 from mlcomp_tpu.worker.executors.base import Executor, StepWrap
 
-__all__ = ['Executor', 'StepWrap']
+# Built-in executors (registration side effects). Guarded against the
+# circular import that happens when a builtin module itself imports this
+# package: if it is mid-import, its @Executor.register decorator will run
+# when that import finishes — skipping here is safe.
+_BUILTIN_MODULES = (
+    'mlcomp_tpu.train.executor',
+)
+
+
+def _register_builtins():
+    import importlib
+    for mod in _BUILTIN_MODULES:
+        if mod not in _sys.modules:
+            importlib.import_module(mod)
+
+
+_register_builtins()
+
+
+def __getattr__(name):
+    if name == 'JaxTrain':
+        from mlcomp_tpu.train.executor import JaxTrain
+        return JaxTrain
+    raise AttributeError(name)
+
+
+__all__ = ['Executor', 'StepWrap', 'JaxTrain']
