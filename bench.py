@@ -1,0 +1,80 @@
+"""Driver benchmark: CIFAR-10 ResNet-18 training throughput (images/sec)
+on the available accelerator (BASELINE.md primary metric).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no numbers (BASELINE.md), so vs_baseline is
+relative to BASELINE.json's "published" entry when present, else 1.0.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.parallel import mesh_from_spec
+    from mlcomp_tpu.train import (
+        create_train_state, loss_for_task, make_optimizer,
+        make_train_step, place_batch,
+    )
+
+    batch_size = int(os.environ.get('BENCH_BATCH', '256'))
+    n_steps = int(os.environ.get('BENCH_STEPS', '30'))
+    warmup = 5
+
+    mesh = mesh_from_spec({'dp': -1})
+    model = create_model('resnet18', num_classes=10, dtype='bfloat16')
+    optimizer, _ = make_optimizer(
+        {'name': 'sgd', 'lr': 0.1, 'momentum': 0.9}, 1000)
+    loss_fn = loss_for_task('softmax_ce')
+
+    rng = np.random.RandomState(0)
+    x_np = rng.rand(batch_size, 32, 32, 3).astype(np.float32)
+    y_np = rng.randint(0, 10, batch_size).astype(np.int32)
+
+    state = create_train_state(
+        model, optimizer, x_np[:max(1, len(mesh.devices.flat))],
+        jax.random.PRNGKey(0), mesh=mesh)
+    train_step = make_train_step(model, optimizer, loss_fn, mesh=mesh)
+
+    x, y = place_batch((x_np, y_np), mesh)
+    for _ in range(warmup):
+        state, metrics = train_step(state, x, y)
+    # fetch a VALUE, not block_until_ready: on remote-tunneled devices the
+    # ready signal can resolve before execution; a host transfer cannot
+    float(metrics['loss'])
+
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = train_step(state, x, y)
+    float(metrics['loss'])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch_size * n_steps / dt
+    n_devices = len(mesh.devices.flat)
+
+    baseline = None
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               'BASELINE.json')) as fh:
+            published = json.load(fh).get('published', {})
+        baseline = published.get('cifar_resnet18_images_per_sec')
+    except Exception:
+        pass
+    vs_baseline = (images_per_sec / baseline) if baseline else 1.0
+
+    print(json.dumps({
+        'metric': 'cifar10_resnet18_train_throughput',
+        'value': round(images_per_sec, 1),
+        'unit': f'images/sec ({n_devices} device(s), bf16, bs={batch_size})',
+        'vs_baseline': round(vs_baseline, 3),
+    }))
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -138,8 +138,8 @@ class TransformerLM(nn.Module):
         cfg = self.cfg
         if cfg.n_experts:
             raise NotImplementedError(
-                'MoE (n_experts > 0) requires MoeTransformerLM — '
-                'see mlcomp_tpu/models/moe.py')
+                'MoE (n_experts > 0) is not implemented yet; '
+                'set n_experts: 0')
         dtype = jnp.dtype(cfg.dtype)
 
         embed = nn.Embed(

@@ -40,8 +40,10 @@ def save_checkpoint(directory: str, state: Any, meta: dict,
     with open(tmp, 'wb') as fh:
         fh.write(blob)
     os.replace(tmp, last)
-    with open(_meta_path(last), 'w') as fh:
+    meta_tmp = _meta_path(last) + '.tmp'
+    with open(meta_tmp, 'w') as fh:
         json.dump(meta, fh)
+    os.replace(meta_tmp, _meta_path(last))
     if best:
         best_path = os.path.join(directory, 'best.msgpack')
         shutil.copyfile(last, best_path)
@@ -56,8 +58,13 @@ def load_meta(directory: str, kind: str = 'last') -> Optional[dict]:
     path = _meta_path(os.path.join(directory, f'{kind}.msgpack'))
     if not os.path.exists(path):
         return None
-    with open(path) as fh:
-        return json.load(fh)
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (json.JSONDecodeError, OSError):
+        # truncated/corrupt sidecar (crash mid-save) — treat as absent so
+        # the caller starts fresh instead of wedging the task forever
+        return None
 
 
 def restore_checkpoint(directory: str, target: Any,
@@ -71,10 +78,7 @@ def restore_checkpoint(directory: str, target: Any,
     with open(path, 'rb') as fh:
         blob = fh.read()
     state = serialization.from_bytes(target, blob)
-    meta = {}
-    if os.path.exists(_meta_path(path)):
-        with open(_meta_path(path)) as fh:
-            meta = json.load(fh)
+    meta = load_meta(directory, kind) or {}
     return state, meta
 
 

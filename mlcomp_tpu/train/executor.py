@@ -253,10 +253,12 @@ class JaxTrain(Executor):
                         f'dataset has {len(x_train)} train samples — '
                         f'fewer than batch_size={self.batch_size}; no '
                         f'full batch to train on')
-                # metrics: device→host once per epoch
+                # metrics: device→host once per epoch (the float() pulls
+                # force all queued steps to finish — honest timing point)
                 train_agg = {
                     k: float(np.mean([float(m[k]) for m in train_metrics]))
                     for k in train_metrics[0]}
+                train_dt = time.time() - t_ep
                 # evaluate EVERY validation sample: tail batches are
                 # padded (duplicate samples) up to a multiple of the
                 # data-parallel width, with zero weights on the padding so
@@ -285,7 +287,6 @@ class JaxTrain(Executor):
                         weights=valid_weights))
                     for k in valid_metrics[0]} if valid_metrics else {}
 
-                dt = time.time() - t_ep
                 n_train = steps_per_epoch * self.batch_size
                 for k, v in train_agg.items():
                     self._report_series(k, v, global_epoch, 'train',
@@ -293,12 +294,12 @@ class JaxTrain(Executor):
                 for k, v in valid_agg.items():
                     self._report_series(k, v, global_epoch, 'valid',
                                         stage_name)
-                self._report_series('images_per_sec', n_train / dt,
+                self._report_series('images_per_sec', n_train / train_dt,
                                     global_epoch, 'train', stage_name)
                 self.info(
                     f'[{stage_name}] epoch {global_epoch}: '
                     f'train {train_agg} valid {valid_agg} '
-                    f'({n_train / dt:.0f} samples/s)')
+                    f'({n_train / train_dt:.0f} samples/s)')
 
                 score = valid_agg.get(self.main_metric,
                                       train_agg.get(self.main_metric))

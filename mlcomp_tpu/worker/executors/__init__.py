@@ -1,28 +1,15 @@
-"""Executor registry — importing this package registers built-in executors
-(parity: reference worker/executors/__init__.py imports all builtins so
-the registry is populated before user code is scanned)."""
-
-import sys as _sys
+"""Executor registry. Built-in executors are registered LAZILY: the
+module list below is imported on the first registry miss
+(Executor.get/is_registered), so DAG-submit and server paths that only
+validate executor names never pay the jax/flax import cost. (The
+reference eagerly imports all builtins, worker/executors/__init__.py —
+cheap there because torch is imported anyway; jax init is not.)"""
 
 from mlcomp_tpu.worker.executors.base import Executor, StepWrap
 
-# Built-in executors (registration side effects). Guarded against the
-# circular import that happens when a builtin module itself imports this
-# package: if it is mid-import, its @Executor.register decorator will run
-# when that import finishes — skipping here is safe.
-_BUILTIN_MODULES = (
+Executor._builtin_modules = (
     'mlcomp_tpu.train.executor',
 )
-
-
-def _register_builtins():
-    import importlib
-    for mod in _BUILTIN_MODULES:
-        if mod not in _sys.modules:
-            importlib.import_module(mod)
-
-
-_register_builtins()
 
 
 def __getattr__(name):

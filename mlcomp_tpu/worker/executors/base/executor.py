@@ -17,6 +17,10 @@ from mlcomp_tpu.utils.misc import now, to_snake
 
 class Executor(ABC):
     _registry = {}
+    # module paths registered by the executors package; imported lazily on
+    # the first registry miss so DAG-submit/server paths that only
+    # validate names never pay for the jax/flax training-stack import
+    _builtin_modules = ()
 
     session = None
     logger = None
@@ -31,11 +35,23 @@ class Executor(ABC):
         return subclass
 
     @classmethod
+    def _load_builtins(cls):
+        import importlib
+        import sys
+        for mod in cls._builtin_modules:
+            if mod not in sys.modules:
+                importlib.import_module(mod)
+
+    @classmethod
     def is_registered(cls, name: str) -> bool:
+        if to_snake(name) not in cls._registry:
+            cls._load_builtins()
         return to_snake(name) in cls._registry
 
     @classmethod
     def get(cls, name: str):
+        if to_snake(name) not in cls._registry:
+            cls._load_builtins()
         return cls._registry[to_snake(name)]
 
     # -------------------------------------------------------------- factory
