@@ -25,9 +25,17 @@ class DockerProvider(BaseDataProvider):
         return [Docker.from_row(r) for r in rows]
 
     def heartbeat(self, computer: str, name: str):
-        self.session.execute(
+        """Upsert: first heartbeat registers the (computer, runtime) pair
+        (reference worker/__main__.py:147-160 registers the Docker row at
+        worker-supervisor start; folding it into the heartbeat makes the
+        liveness contract self-contained)."""
+        cur = self.session.execute(
             'UPDATE docker SET last_activity=? WHERE computer=? AND name=?',
             (now(), computer, name))
+        if cur.rowcount == 0:
+            self.session.execute(
+                'INSERT INTO docker (computer, name, last_activity) '
+                'VALUES (?, ?, ?)', (computer, name, now()))
 
 
 __all__ = ['DockerProvider']

@@ -173,6 +173,12 @@ class DagStandardBuilder:
             additional_info['env'] = spec['env']
         if self.info.get('stages'):
             additional_info['stages'] = self.info['stages']
+        # scheduler hints for distributed placement
+        # (reference supervisor.py:228-313 reads `distr`/`single_node`)
+        if 'distr' in spec:
+            additional_info['distr'] = bool(spec['distr'])
+        if isinstance(spec.get('mesh'), dict):
+            additional_info['mesh'] = spec['mesh']
 
         task = Task(
             name=task_name[:180],

@@ -1,0 +1,388 @@
+"""Supervisor — the 1 Hz scheduling loop
+(parity: reference server/back/supervisor.py:23-434).
+
+Each tick:
+1. ``create_base``       — live queues from Docker heartbeats (<15 s)
+2. ``process_parent_tasks`` — child→parent status aggregation; a failed
+   child stops its siblings (reference supervisor.py:350-394)
+3. ``load_tasks``        — NotRan tasks + dependency status sets
+4. ``load_computers``    — free-resource model per host: TPU core slot
+   array + cpu + memory, minus Queued/InProgress assignments (the
+   reference's GPU slot array, supervisor.py:75-111, re-based on chips)
+5. ``process_tasks``     — dependency gating (failed dep → Skipped)
+6. placement + dispatch  — fit filter, single-node packing, multi-host
+   fan-out into service tasks with ``distr_info`` (rank/world_size env
+   vars in the reference, supervisor.py:228-317; here a jax coordinator
+   address + process indices + a mesh spec — XLA does the collectives)
+7. ``write_auxiliary``   — full decision trace into the auxiliary table
+   (reference supervisor.py:396-403)
+
+Dispatch rides the DB-backed queue transport (QueueProvider) instead of
+Celery/Redis; queue naming keeps the reference scheme
+``{computer}_{docker}`` (worker/__main__.py:130-144).
+"""
+
+import json
+import traceback
+from mlcomp_tpu import MASTER_PORT_RANGE
+from mlcomp_tpu.db.core import Session
+from mlcomp_tpu.db.enums import ComponentType, TaskStatus, TaskType
+from mlcomp_tpu.db.models import Task
+from mlcomp_tpu.db.providers import (
+    AuxiliaryProvider, ComputerProvider, DagProvider, DockerProvider,
+    QueueProvider, TaskProvider,
+)
+from mlcomp_tpu.utils.io import yaml_dump, yaml_load
+from mlcomp_tpu.utils.misc import now
+
+
+class SupervisorBuilder:
+    def __init__(self, session: Session = None, logger=None,
+                 queue_liveness_window: float = 15.0):
+        self.session = session or Session.create_session(key='supervisor')
+        self.logger = logger
+        self.queue_liveness_window = queue_liveness_window
+        self.provider = TaskProvider(self.session)
+        self.computer_provider = ComputerProvider(self.session)
+        self.docker_provider = DockerProvider(self.session)
+        self.queue_provider = QueueProvider(self.session)
+        self.dag_provider = DagProvider(self.session)
+        self.auxiliary_provider = AuxiliaryProvider(self.session)
+
+        self.queues = []
+        self.tasks = []
+        self.dep_status = {}
+        self.computers = []
+        self.aux = {}
+
+    # ----------------------------------------------------------- base state
+    def create_base(self):
+        """Live queues = (computer, docker) pairs with a fresh heartbeat
+        (reference supervisor.py:38-52)."""
+        self.aux = {'time': str(now()), 'duration': None}
+        alive = self.docker_provider.alive(self.queue_liveness_window)
+        self.queues = [f'{d.computer}_{d.name}' for d in alive]
+        self.aux['queues'] = list(self.queues)
+
+    # -------------------------------------------------------- parent tasks
+    def process_parent_tasks(self):
+        """Aggregate child statuses into distributed parents; stop
+        siblings when one child fails (reference supervisor.py:350-394)."""
+        processed = []
+        for parent_task, _started, _finished, statuses in \
+                self.provider.parent_tasks_stats():
+            # statuses: dict int(TaskStatus) -> count
+            total = sum(statuses.values())
+            bad = statuses.get(int(TaskStatus.Failed), 0) + \
+                statuses.get(int(TaskStatus.Stopped), 0) + \
+                statuses.get(int(TaskStatus.Skipped), 0)
+            done = statuses.get(int(TaskStatus.Success), 0)
+            new_status = None
+            if bad:
+                new_status = TaskStatus.Failed
+            elif total and done == total:
+                new_status = TaskStatus.Success
+            elif statuses.get(int(TaskStatus.InProgress), 0):
+                new_status = TaskStatus.InProgress
+            if new_status is not None and \
+                    parent_task.status != int(new_status):
+                if new_status == TaskStatus.Failed:
+                    self.stop_children(parent_task.id)
+                self.provider.change_status(parent_task, new_status)
+                processed.append(
+                    {'parent': parent_task.id, 'status': new_status.name})
+        self.aux['parent_tasks'] = processed
+
+    def stop_children(self, parent_id: int):
+        from mlcomp_tpu.worker.tasks import kill_task
+        for child in self.provider.children(
+                parent_id,
+                statuses=[TaskStatus.NotRan, TaskStatus.Queued,
+                          TaskStatus.InProgress]):
+            try:
+                kill_task(child.id, session=self.session)
+            except Exception:
+                if self.logger:
+                    self.logger.error(
+                        f'failed stopping child {child.id}:\n'
+                        f'{traceback.format_exc()}',
+                        ComponentType.Supervisor)
+
+    # -------------------------------------------------------------- loading
+    def load_tasks(self):
+        """NotRan tasks + dependency status sets
+        (reference supervisor.py:54-73)."""
+        self.tasks = [
+            t for t in self.provider.by_status(TaskStatus.NotRan)
+            if not t.debug]
+        self.dep_status = self.provider.dependency_status(
+            [t.id for t in self.tasks])
+        self.aux['tasks_to_process'] = [t.id for t in self.tasks]
+
+    def load_computers(self):
+        """Free-resource model per computer
+        (reference supervisor.py:75-111): core slot array + cpu + memory
+        minus everything Queued/InProgress there; ports in use for
+        coordinator-address assignment."""
+        computers = []
+        for c in self.computer_provider.all():
+            comp = {
+                'name': c.name,
+                'cpu': c.cpu,
+                'memory': c.memory,
+                'cores': [False] * (c.cores or 0),  # False = free
+                'ports': set(),
+                'can_process_tasks': bool(c.can_process_tasks),
+                'ip': c.ip,
+            }
+            computers.append(comp)
+        index = {c['name']: c for c in computers}
+        busy = self.provider.by_status(
+            TaskStatus.Queued, TaskStatus.InProgress)
+        for task in busy:
+            comp = index.get(task.computer_assigned)
+            if comp is None:
+                continue
+            comp['cpu'] -= task.cpu or 0
+            comp['memory'] -= task.memory or 0
+            if task.cores_assigned:
+                try:
+                    for core in json.loads(task.cores_assigned):
+                        if 0 <= core < len(comp['cores']):
+                            comp['cores'][core] = True
+                except (TypeError, ValueError):
+                    pass
+            info = yaml_load(task.additional_info) \
+                if task.additional_info else {}
+            distr = (info or {}).get('distr_info') or {}
+            port = distr.get('port')
+            if port:
+                comp['ports'].add(int(port))
+        self.computers = computers
+        self.aux['computers'] = [
+            {**c, 'cores': ''.join(
+                'x' if b else '.' for b in c['cores']),
+             'ports': sorted(c['ports'])}
+            for c in computers]
+
+    # ------------------------------------------------------------ placement
+    def _free_cores(self, comp):
+        return [i for i, used in enumerate(comp['cores']) if not used]
+
+    def _valid_computer(self, task: Task, comp) -> str:
+        """'' if the computer can host the task, else the reason
+        (reference supervisor.py:171-198)."""
+        if not comp['can_process_tasks']:
+            return 'cannot process tasks'
+        if task.computer and task.computer != comp['name']:
+            return f'pinned to {task.computer}'
+        if (task.cpu or 0) > comp['cpu']:
+            return f'cpu: need {task.cpu} have {comp["cpu"]}'
+        if (task.memory or 0) > comp['memory']:
+            return f'memory: need {task.memory} have {comp["memory"]}'
+        queue = f'{comp["name"]}_{task.docker_assigned or "default"}'
+        if queue not in self.queues:
+            return f'queue {queue} not alive'
+        free = len(self._free_cores(comp))
+        if (task.cores or 0) > 0 and free < 1:
+            return f'no free cores (need up to {task.cores_max})'
+        return ''
+
+    def _candidate_computers(self, task: Task):
+        reasons = {}
+        fits = []
+        for comp in self.computers:
+            reason = self._valid_computer(task, comp)
+            if reason:
+                reasons[comp['name']] = reason
+            else:
+                fits.append(comp)
+        # most-free-cores first (single-node packing,
+        # reference supervisor.py:200-226)
+        fits.sort(key=lambda c: -len(self._free_cores(c)))
+        return fits, reasons
+
+    def find_port(self, comp) -> int:
+        """Coordinator port from the per-computer range
+        (reference supervisor.py:163-169)."""
+        lo, hi = MASTER_PORT_RANGE
+        for port in range(lo, hi + 1):
+            if port not in comp['ports']:
+                comp['ports'].add(port)
+                return port
+        raise RuntimeError(f'no free port on {comp["name"]}')
+
+    # ------------------------------------------------------------- dispatch
+    def dispatch(self, task: Task, comp, cores):
+        """Assign cores and enqueue to {computer}_{docker}
+        (reference process_to_celery, supervisor.py:113-129)."""
+        task.computer_assigned = comp['name']
+        task.cores_assigned = json.dumps(cores)
+        docker = task.docker_assigned or 'default'
+        queue = f'{comp["name"]}_{docker}'
+        msg_id = self.queue_provider.enqueue(
+            queue, {'action': 'execute', 'task_id': task.id})
+        task.queue_id = msg_id
+        self.provider.update(
+            task, ['computer_assigned', 'cores_assigned', 'queue_id'])
+        self.provider.change_status(task, TaskStatus.Queued)
+        for core in cores:
+            comp['cores'][core] = True
+        comp['cpu'] -= task.cpu or 0
+        comp['memory'] -= task.memory or 0
+        return queue
+
+    def create_service_task(self, task: Task, comp, cores,
+                            distr_info: dict, index: int) -> Task:
+        """One child per host of a multi-host job
+        (reference supervisor.py:131-161 creates one per GPU slot; a TPU
+        host's chips belong to one jax process, so fan-out is per host)."""
+        info = yaml_load(task.additional_info) \
+            if task.additional_info else {}
+        info = dict(info or {})
+        info['distr_info'] = distr_info
+        service = Task(
+            name=f'{task.name}_{index}',
+            status=int(TaskStatus.NotRan),
+            computer=comp['name'],
+            executor=task.executor,
+            computer_assigned=comp['name'],
+            cores=len(cores), cores_max=len(cores),
+            cpu=task.cpu, memory=task.memory,
+            dag=task.dag, parent=task.id,
+            docker_assigned=task.docker_assigned,
+            type=int(TaskType.Service),
+            additional_info=yaml_dump(info),
+            gpu_requirement=task.gpu_requirement,
+            single_node=task.single_node,
+        )
+        self.provider.add(service)
+        return service
+
+    def process_task(self, task: Task):
+        """Placement + dispatch for one runnable task
+        (reference supervisor.py:228-317)."""
+        fits, reasons = self._candidate_computers(task)
+        if not fits:
+            self.aux.setdefault('not_placed', {})[task.id] = reasons
+            return
+        info = yaml_load(task.additional_info) \
+            if task.additional_info else {}
+        distr = bool((info or {}).get('distr', task.cores_max > 1))
+        single_node = bool(task.single_node)
+
+        if task.cores_max <= 1 or single_node:
+            comp = fits[0]
+            free = self._free_cores(comp)
+            want = task.cores_max or task.cores or 0
+            cores = free[:want] if want else []
+            if (task.cores or 0) > len(cores):
+                self.aux.setdefault('not_placed', {})[task.id] = {
+                    comp['name']: f'need {task.cores} cores, '
+                                  f'free {len(free)}'}
+                return
+            queue = self.dispatch(task, comp, cores)
+            self.aux.setdefault('dispatched', []).append(
+                {'task': task.id, 'queue': queue, 'cores': cores})
+            return
+
+        # multi-host distributed: service task per computer
+        # (coordinator = first host; jax distributed runtime over DCN)
+        total_cores = 0
+        placements = []
+        for comp in fits:
+            free = self._free_cores(comp)
+            if not free:
+                continue
+            take = free[:max(1, task.cores_max - total_cores)]
+            placements.append((comp, take))
+            total_cores += len(take)
+            if total_cores >= task.cores_max:
+                break
+        if total_cores < (task.cores or 1):
+            self.aux.setdefault('not_placed', {})[task.id] = {
+                'distributed': f'need {task.cores} cores, '
+                               f'found {total_cores}'}
+            return
+        master_comp = placements[0][0]
+        port = self.find_port(master_comp)
+        world = len(placements)
+        for rank, (comp, cores) in enumerate(placements):
+            distr_info = {
+                'coordinator_address': f'{master_comp["ip"]}:{port}',
+                'port': port,
+                'process_index': rank,
+                'process_count': world,
+                'master_computer': master_comp['name'],
+                'mesh': (info or {}).get('mesh'),
+            }
+            service = self.create_service_task(
+                task, comp, cores, distr_info, rank)
+            queue = self.dispatch(service, comp, cores)
+            self.aux.setdefault('dispatched', []).append(
+                {'task': service.id, 'parent': task.id, 'queue': queue,
+                 'cores': cores, 'rank': rank})
+        self.provider.change_status(task, TaskStatus.Queued)
+
+    def process_tasks(self):
+        """Dependency gating then placement
+        (reference supervisor.py:319-340)."""
+        bad = {int(TaskStatus.Failed), int(TaskStatus.Stopped),
+               int(TaskStatus.Skipped)}
+        unfinished = {int(TaskStatus.NotRan), int(TaskStatus.Queued),
+                      int(TaskStatus.InProgress)}
+        for task in self.tasks:
+            deps = self.dep_status.get(task.id, set())
+            if deps & bad:
+                self.provider.change_status(task, TaskStatus.Skipped)
+                continue
+            if deps & unfinished:
+                continue
+            try:
+                self.process_task(task)
+            except Exception:
+                if self.logger:
+                    self.logger.error(
+                        f'failed processing task {task.id}:\n'
+                        f'{traceback.format_exc()}',
+                        ComponentType.Supervisor)
+
+    # ---------------------------------------------------------------- aux
+    def write_auxiliary(self):
+        """Persist the full decision trace
+        (reference supervisor.py:396-403)."""
+        self.auxiliary_provider.create_or_update('supervisor', self.aux)
+
+    # ---------------------------------------------------------------- main
+    def build(self):
+        start = now()
+        try:
+            self.create_base()
+            self.process_parent_tasks()
+            self.load_tasks()
+            self.load_computers()
+            self.process_tasks()
+            self.aux['duration'] = (now() - start).total_seconds()
+            self.write_auxiliary()
+        except Exception:
+            # heal-by-recreating-session (reference supervisor.py:423-427)
+            if self.logger:
+                self.logger.error(
+                    f'supervisor tick failed:\n{traceback.format_exc()}',
+                    ComponentType.Supervisor)
+            self.session = Session.create_session(key='supervisor')
+            self.__init__(session=self.session, logger=self.logger,
+                          queue_liveness_window=self.queue_liveness_window)
+
+
+def register_supervisor(session: Session = None, logger=None,
+                        interval: float = 1.0):
+    """Start the supervisor loop on a background thread
+    (reference supervisor.py:432-434 — APScheduler 1 s interval)."""
+    from mlcomp_tpu.utils.schedule import start_schedule
+    builder = SupervisorBuilder(session=session, logger=logger)
+    jobs = start_schedule([(builder.build, interval)], logger=logger)
+    return builder, jobs
+
+
+__all__ = ['SupervisorBuilder', 'register_supervisor']

@@ -13,6 +13,14 @@ def now():
     return datetime.datetime.now(datetime.timezone.utc).replace(tzinfo=None)
 
 
+def parse_time(value):
+    """Inverse of the DB's text timestamp storage: accepts datetime or the
+    isoformat/space-separated text sqlite hands back."""
+    if isinstance(value, datetime.datetime):
+        return value
+    return datetime.datetime.fromisoformat(str(value))
+
+
 def set_global_seed(seed: int):
     """Seed every RNG we control. JAX is functional — jax.random keys are
     derived from this seed explicitly at use sites; here we seed numpy and

@@ -1,0 +1,352 @@
+"""Worker daemons (parity: reference worker/__main__.py).
+
+- ``worker N``            — task consumer #N: claims execute/kill messages
+  from its queues (``{host}_{docker}``, ``{host}_{docker}_{N}``) and runs
+  each task in a fresh subprocess (the reference's per-task
+  ``os._exit(0)`` hygiene, worker/tasks.py:279, as process isolation that
+  doesn't tear down THIS daemon's state). ``--in-process`` keeps the task
+  in the daemon instead — avoids re-initialising the TPU runtime per task.
+- ``worker-supervisor``   — registers Computer+Docker rows, heartbeats,
+  dead-pid reaper (reference worker/__main__.py:64-88), usage telemetry
+  (psutil + TPU HBM when available, reference worker/__main__.py:91-127),
+  data sync loop.
+- ``start``               — process manager: spawns worker-supervisor +
+  N workers as child processes with autorestart (supervisord parity,
+  reference worker/__main__.py:184-224).
+- ``run-task ID``         — internal: execute one task in this process.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import traceback
+
+import click
+
+from mlcomp_tpu import (
+    CAN_PROCESS_TASKS, DOCKER_IMG, QUEUE_POLL_INTERVAL, ROOT_FOLDER,
+    SYNC_WITH_THIS_COMPUTER, WORKER_USAGE_INTERVAL,
+)
+from mlcomp_tpu.db.core import Session
+from mlcomp_tpu.db.enums import ComponentType, TaskStatus
+from mlcomp_tpu.db.migration import migrate
+from mlcomp_tpu.db.models import Computer, Docker
+from mlcomp_tpu.db.providers import (
+    ComputerProvider, DockerProvider, QueueProvider, TaskProvider,
+)
+from mlcomp_tpu.utils.logging import create_logger
+from mlcomp_tpu.utils.misc import disk, memory, now
+
+HOSTNAME = socket.gethostname()
+
+
+@click.group()
+def main():
+    pass
+
+
+def _tpu_core_count() -> int:
+    """TPU chips visible on this host. Env override for tests/clusters;
+    jax probe otherwise (heavy import, done once at daemon start)."""
+    env = os.environ.get('MLCOMP_TPU_CORES')
+    if env is not None:
+        return int(env)
+    try:
+        import jax
+        return len([d for d in jax.devices()
+                    if d.platform not in ('cpu',)])
+    except Exception:
+        return 0
+
+
+def register_computer(session, cores: int = None):
+    """Register/refresh this host's Computer row
+    (reference worker/__main__.py:231-260)."""
+    import multiprocessing
+    provider = ComputerProvider(session)
+    computer = Computer(
+        name=HOSTNAME,
+        cores=cores if cores is not None else _tpu_core_count(),
+        cpu=multiprocessing.cpu_count(),
+        memory=memory()['total'],
+        disk=disk(ROOT_FOLDER)['total'],
+        ip=os.environ.get('IP', 'localhost'),
+        port=int(os.environ.get('PORT', 22)),
+        user=os.environ.get('USER', 'root'),
+        can_process_tasks=CAN_PROCESS_TASKS,
+        sync_with_this_computer=SYNC_WITH_THIS_COMPUTER,
+    )
+    provider.create_or_update(computer, 'name')
+    return computer
+
+
+def queue_names(index: int = None):
+    base = f'{HOSTNAME}_{DOCKER_IMG}'
+    queues = [base]
+    if index is not None:
+        queues.append(f'{base}_{index}')
+    return queues
+
+
+# --------------------------------------------------------------- consumer
+def _run_subprocess(task_id: int, index: int, logger, session) -> bool:
+    """Execute a task in a child process; returns success."""
+    env = dict(os.environ)
+    cmd = [sys.executable, '-m', 'mlcomp_tpu.worker', 'run-task',
+           str(task_id), '--index', str(index)]
+    proc = subprocess.Popen(cmd, env=env)
+    proc.wait()
+    return proc.returncode == 0
+
+
+def _consume_one(session, queue_provider, logger, index: int,
+                 in_process: bool) -> bool:
+    claim = queue_provider.claim(
+        queue_names(index), f'{HOSTNAME}:{index}')
+    if claim is None:
+        return False
+    msg_id, payload = claim
+    action = payload.get('action')
+    task_id = payload.get('task_id')
+    try:
+        if action == 'execute':
+            if in_process:
+                from mlcomp_tpu.worker.tasks import execute_by_id
+                execute_by_id(task_id, exit=False, worker_index=index,
+                              session=session)
+                ok = True
+            else:
+                ok = _run_subprocess(task_id, index, logger, session)
+            if ok:
+                queue_provider.complete(msg_id)
+            else:
+                queue_provider.fail(msg_id, 'subprocess failed')
+                # the subprocess may have died before marking the task
+                provider = TaskProvider(session)
+                task = provider.by_id(task_id)
+                if task is not None and \
+                        task.status < int(TaskStatus.Failed):
+                    provider.change_status(task, TaskStatus.Failed)
+        elif action == 'kill':
+            from mlcomp_tpu.worker.tasks import kill_task
+            kill_task(task_id, session=session)
+            queue_provider.complete(msg_id)
+        else:
+            queue_provider.fail(msg_id, f'unknown action {action!r}')
+    except Exception:
+        queue_provider.fail(msg_id, traceback.format_exc()[-4000:])
+        logger.error(
+            f'message {msg_id} ({action} task {task_id}) failed:\n'
+            f'{traceback.format_exc()}',
+            ComponentType.Worker, HOSTNAME, task_id)
+    return True
+
+
+@main.command()
+@click.argument('index', type=int)
+@click.option('--in-process', is_flag=True,
+              help='run tasks inside the daemon (persistent TPU client)')
+def worker(index, in_process):
+    """Task consumer #INDEX (reference worker/__main__.py:130-144)."""
+    session = Session.create_session(key=f'worker{index}')
+    migrate(session)
+    logger = create_logger(session)
+    queue_provider = QueueProvider(session)
+    logger.info(f'worker {index} consuming {queue_names(index)}',
+                ComponentType.Worker, HOSTNAME)
+    while True:
+        try:
+            if not _consume_one(session, queue_provider, logger, index,
+                                in_process):
+                time.sleep(QUEUE_POLL_INTERVAL)
+        except KeyboardInterrupt:
+            break
+        except Exception:
+            logger.error(
+                f'worker loop error:\n{traceback.format_exc()}',
+                ComponentType.Worker, HOSTNAME)
+            session = Session.create_session(key=f'worker{index}')
+            queue_provider = QueueProvider(session)
+            time.sleep(1)
+
+
+@main.command(name='run-task')
+@click.argument('task_id', type=int)
+@click.option('--index', type=int, default=-1)
+def run_task(task_id, index):
+    """Execute one task in this process (internal)."""
+    from mlcomp_tpu.worker.tasks import execute_by_id
+    execute_by_id(task_id, exit=False, worker_index=index)
+
+
+# --------------------------------------------------- worker supervisor
+def stop_processes_not_exist(session, logger):
+    """Dead-pid reaper (reference worker/__main__.py:64-88): fail
+    InProgress tasks on this host whose pid vanished (30 s grace on
+    last_activity)."""
+    import psutil
+    provider = TaskProvider(session)
+    for task in provider.by_status(TaskStatus.InProgress,
+                                   computer=HOSTNAME):
+        if not task.pid or psutil.pid_exists(task.pid):
+            continue
+        grace_ok = True
+        if task.last_activity:
+            from mlcomp_tpu.utils.misc import parse_time
+            age = (now() - parse_time(task.last_activity)).total_seconds()
+            grace_ok = age > 30
+        if grace_ok:
+            logger.error(
+                f'task {task.id}: pid {task.pid} no longer exists — '
+                f'marking Failed', ComponentType.WorkerSupervisor,
+                HOSTNAME, task.id)
+            provider.change_status(task, TaskStatus.Failed)
+
+
+def worker_usage(session, logger):
+    """Resource telemetry → computer row + usage history
+    (reference worker/__main__.py:91-127)."""
+    import psutil
+    provider = ComputerProvider(session)
+    usage = {
+        'cpu': psutil.cpu_percent(),
+        'memory': psutil.virtual_memory().percent,
+        'disk': psutil.disk_usage(ROOT_FOLDER).percent,
+        'tpu': _tpu_usage(),
+    }
+    provider.current_usage(HOSTNAME, usage)
+    provider.add_usage_history(HOSTNAME, usage)
+
+
+def _tpu_usage():
+    """Per-chip HBM occupancy when a jax client is alive in this process
+    (TPU analogue of GPUtil load/memory, reference
+    worker/__main__.py:111-117)."""
+    try:
+        import jax
+        out = []
+        for d in jax.devices():
+            if d.platform == 'cpu':
+                continue
+            stats = {}
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                pass
+            out.append({
+                'id': d.id,
+                'kind': getattr(d, 'device_kind', str(d)),
+                'hbm_used': stats.get('bytes_in_use', 0),
+                'hbm_limit': stats.get('bytes_limit', 0),
+            })
+        return out
+    except Exception:
+        return []
+
+
+@main.command(name='worker-supervisor')
+@click.option('--cores', type=int, default=None,
+              help='override detected TPU core count')
+def worker_supervisor(cores):
+    """Host agent: registration, heartbeats, reaper, telemetry, sync
+    (reference worker/__main__.py:147-181)."""
+    from mlcomp_tpu.utils.schedule import start_schedule
+    from mlcomp_tpu.worker.sync import FileSync
+
+    session = Session.create_session(key='worker_supervisor')
+    migrate(session)
+    logger = create_logger(session)
+    register_computer(session, cores)
+    docker_provider = DockerProvider(session)
+
+    def heartbeat():
+        docker_provider.heartbeat(HOSTNAME, DOCKER_IMG)
+
+    def reaper():
+        stop_processes_not_exist(session, logger)
+
+    def usage():
+        worker_usage(session, logger)
+
+    file_sync = FileSync(session=session)
+    heartbeat()
+    start_schedule([
+        (heartbeat, 5),
+        (reaper, 10),
+        (usage, WORKER_USAGE_INTERVAL),
+        (file_sync.sync, 60),
+    ], logger=logger)
+    logger.info(f'worker-supervisor up on {HOSTNAME} '
+                f'({_tpu_core_count() if cores is None else cores} cores)',
+                ComponentType.WorkerSupervisor, HOSTNAME)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
+# ------------------------------------------------------------------ start
+@main.command()
+@click.argument('n_workers', type=int)
+@click.option('--in-process', is_flag=True)
+def start(n_workers, in_process):
+    """Spawn worker-supervisor + N workers with autorestart
+    (supervisord parity, reference worker/__main__.py:184-224)."""
+    specs = [['worker-supervisor']] + [
+        ['worker', str(i)] + (['--in-process'] if in_process else [])
+        for i in range(n_workers)
+    ]
+    children = {}
+
+    def spawn(spec_idx):
+        spec = specs[spec_idx]
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'mlcomp_tpu.worker'] + spec)
+        children[proc.pid] = (proc, spec_idx)
+        return proc
+
+    for i in range(len(specs)):
+        spawn(i)
+    print(f'started worker-supervisor + {n_workers} workers')
+
+    def shutdown(*_):
+        for proc, _idx in list(children.values()):
+            proc.terminate()
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, shutdown)
+    try:
+        while True:
+            time.sleep(2)
+            for pid, (proc, idx) in list(children.items()):
+                if proc.poll() is not None:
+                    del children[pid]
+                    print(f'child {specs[idx]} exited '
+                          f'({proc.returncode}); restarting')
+                    spawn(idx)
+    except KeyboardInterrupt:
+        shutdown()
+
+
+@main.command()
+def stop():
+    """Stop daemons started by ``start`` (best effort, by cmdline)."""
+    import psutil
+    me = os.getpid()
+    for proc in psutil.process_iter(['pid', 'cmdline']):
+        cmd = ' '.join(proc.info.get('cmdline') or [])
+        if 'mlcomp_tpu.worker' in cmd and proc.info['pid'] != me:
+            try:
+                proc.terminate()
+            except psutil.Error:
+                pass
+    print('stopped')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,253 @@
+"""Supervisor scheduling-loop tests: placement, dependency gating, parent
+aggregation, distributed fan-out, queue dispatch, worker consumption
+(parity scenarios from reference server/back/supervisor.py)."""
+
+import json
+
+import pytest
+
+from mlcomp_tpu.db.enums import TaskStatus, TaskType
+from mlcomp_tpu.db.models import Computer, Docker, Task
+from mlcomp_tpu.db.providers import (
+    ComputerProvider, DockerProvider, QueueProvider, TaskProvider,
+)
+from mlcomp_tpu.server.supervisor import SupervisorBuilder
+from mlcomp_tpu.utils.misc import now
+from mlcomp_tpu.worker.executors import Executor
+
+
+@Executor.register
+class NoopExec(Executor):
+    def __init__(self, **kwargs):
+        pass
+
+    def work(self):
+        return {'ok': True}
+
+
+def add_computer(session, name='host1', cores=8, cpu=16, memory=64,
+                 docker='default', heartbeat=True):
+    ComputerProvider(session).create_or_update(
+        Computer(name=name, cores=cores, cpu=cpu, memory=memory,
+                 ip='127.0.0.1', can_process_tasks=True), 'name')
+    if heartbeat:
+        DockerProvider(session).heartbeat(name, docker)
+
+
+def add_task(session, dag_id, name='t', cores=1, cores_max=None, cpu=1,
+             memory=0.5, status=TaskStatus.NotRan, computer=None,
+             single_node=True, additional_info=None):
+    task = Task(
+        name=name, executor=name, dag=dag_id, cores=cores,
+        cores_max=cores_max if cores_max is not None else cores,
+        cpu=cpu, memory=memory, status=int(status), computer=computer,
+        single_node=single_node, additional_info=additional_info,
+        last_activity=now(),
+    )
+    TaskProvider(session).add(task)
+    return task
+
+
+@pytest.fixture()
+def dag_id(session):
+    from mlcomp_tpu.server.create_dags.standard import dag_standard
+    config = {
+        'info': {'name': 'sup_dag', 'project': 'p_supervisor'},
+        'executors': {'noop_exec': {'type': 'noop_exec'}},
+    }
+    dag, _ = dag_standard(session, config)
+    return dag.id
+
+
+class TestPlacement:
+    def test_dispatch_assigns_cores_and_queues(self, session, dag_id):
+        add_computer(session, cores=8)
+        task = add_task(session, dag_id, cores=2, cores_max=2)
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        task = TaskProvider(session).by_id(task.id)
+        assert task.status == int(TaskStatus.Queued)
+        assert task.computer_assigned == 'host1'
+        assert json.loads(task.cores_assigned) == [0, 1]
+        assert task.queue_id is not None
+        pending = QueueProvider(session).pending('host1_default')
+        assert task.id in [
+            json.loads(m.payload)['task_id'] for m in pending]
+
+    def test_no_alive_queue_no_dispatch(self, session, dag_id):
+        add_computer(session, heartbeat=False)
+        task = add_task(session, dag_id)
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        assert TaskProvider(session).by_id(task.id).status == \
+            int(TaskStatus.NotRan)
+        assert task.id in sup.aux.get('not_placed', {})
+
+    def test_resource_fit_excludes_busy_computer(self, session, dag_id):
+        add_computer(session, cores=2)
+        # a running task occupying both cores
+        busy = add_task(session, dag_id, name='busy', cores=2,
+                        status=TaskStatus.InProgress)
+        busy.computer_assigned = 'host1'
+        busy.cores_assigned = json.dumps([0, 1])
+        TaskProvider(session).update(
+            busy, ['computer_assigned', 'cores_assigned'])
+        task = add_task(session, dag_id, cores=1)
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        assert TaskProvider(session).by_id(task.id).status == \
+            int(TaskStatus.NotRan)
+
+    def test_computer_pin(self, session, dag_id):
+        add_computer(session, name='host1')
+        add_computer(session, name='host2')
+        task = add_task(session, dag_id, computer='host2')
+        SupervisorBuilder(session=session).build()
+        assert TaskProvider(session).by_id(task.id).computer_assigned == \
+            'host2'
+
+    def test_cpu_memory_gate(self, session, dag_id):
+        add_computer(session, cpu=2, memory=1)
+        task = add_task(session, dag_id, cpu=4, memory=0.5)
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        assert TaskProvider(session).by_id(task.id).status == \
+            int(TaskStatus.NotRan)
+        assert 'cpu' in str(sup.aux.get('not_placed', {}).get(task.id))
+
+
+class TestDependencies:
+    def test_waits_for_unfinished_dep(self, session, dag_id):
+        add_computer(session)
+        dep = add_task(session, dag_id, name='dep')
+        task = add_task(session, dag_id, name='after')
+        TaskProvider(session).add_dependency(task.id, dep.id)
+        # freeze dep in InProgress so only 'after' is gated
+        TaskProvider(session).change_status(dep, TaskStatus.InProgress)
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        assert TaskProvider(session).by_id(task.id).status == \
+            int(TaskStatus.NotRan)
+
+    def test_failed_dep_skips(self, session, dag_id):
+        add_computer(session)
+        dep = add_task(session, dag_id, name='dep')
+        task = add_task(session, dag_id, name='after')
+        TaskProvider(session).add_dependency(task.id, dep.id)
+        TaskProvider(session).change_status(dep, TaskStatus.Failed)
+        SupervisorBuilder(session=session).build()
+        assert TaskProvider(session).by_id(task.id).status == \
+            int(TaskStatus.Skipped)
+
+
+class TestParentAggregation:
+    def test_children_success_finishes_parent(self, session, dag_id):
+        parent = add_task(session, dag_id, name='parent',
+                          status=TaskStatus.Queued)
+        for i in range(2):
+            child = add_task(session, dag_id, name=f'c{i}',
+                             status=TaskStatus.Success)
+            child.parent = parent.id
+            TaskProvider(session).update(child, ['parent'])
+        SupervisorBuilder(session=session).build()
+        assert TaskProvider(session).by_id(parent.id).status == \
+            int(TaskStatus.Success)
+
+    def test_failed_child_fails_parent_and_stops_siblings(
+            self, session, dag_id):
+        parent = add_task(session, dag_id, name='parent',
+                          status=TaskStatus.InProgress)
+        bad = add_task(session, dag_id, name='bad',
+                       status=TaskStatus.Failed)
+        sibling = add_task(session, dag_id, name='sib',
+                           status=TaskStatus.NotRan)
+        tp = TaskProvider(session)
+        for c in (bad, sibling):
+            c.parent = parent.id
+            tp.update(c, ['parent'])
+        SupervisorBuilder(session=session).build()
+        assert tp.by_id(parent.id).status == int(TaskStatus.Failed)
+        assert tp.by_id(sibling.id).status == int(TaskStatus.Stopped)
+
+
+class TestDistributed:
+    def test_multi_host_fanout_creates_service_tasks(self, session,
+                                                     dag_id):
+        add_computer(session, name='host1', cores=4)
+        add_computer(session, name='host2', cores=4)
+        task = add_task(session, dag_id, name='train', cores=8,
+                        cores_max=8, single_node=False,
+                        additional_info='distr: true\n')
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        tp = TaskProvider(session)
+        children = tp.children(task.id)
+        assert len(children) == 2
+        ranks = set()
+        from mlcomp_tpu.utils.io import yaml_load
+        for child in children:
+            assert child.type == int(TaskType.Service)
+            assert child.status == int(TaskStatus.Queued)
+            info = yaml_load(child.additional_info)
+            di = info['distr_info']
+            assert di['process_count'] == 2
+            assert di['coordinator_address'].startswith('127.0.0.1:')
+            ranks.add(di['process_index'])
+            assert len(json.loads(child.cores_assigned)) == 4
+        assert ranks == {0, 1}
+        assert tp.by_id(task.id).status == int(TaskStatus.Queued)
+
+    def test_single_node_prefers_most_free_cores(self, session, dag_id):
+        add_computer(session, name='small', cores=2)
+        add_computer(session, name='big', cores=8)
+        task = add_task(session, dag_id, cores=2, cores_max=4)
+        SupervisorBuilder(session=session).build()
+        task = TaskProvider(session).by_id(task.id)
+        assert task.computer_assigned == 'big'
+        assert len(json.loads(task.cores_assigned)) == 4
+
+    def test_find_port_skips_used(self, session):
+        sup = SupervisorBuilder(session=session)
+        comp = {'name': 'h', 'ports': {29500, 29501}}
+        assert sup.find_port(comp) == 29502
+
+
+class TestWorkerConsume:
+    def test_consume_executes_task(self, session, tmp_path, monkeypatch):
+        """End-to-end: supervisor enqueues, worker claims + runs
+        in-process, task succeeds, queue message completes."""
+        from mlcomp_tpu.server.create_dags.standard import dag_standard
+        from mlcomp_tpu.worker.__main__ import _consume_one, queue_names
+        import mlcomp_tpu.worker.__main__ as wmain
+
+        folder = tmp_path / 'exp'
+        folder.mkdir()
+        (folder / 'executors.py').write_text(
+            'from mlcomp_tpu.worker.executors import Executor\n'
+            '@Executor.register\n'
+            'class NoopExec2(Executor):\n'
+            '    def __init__(self, **kw):\n'
+            '        pass\n'
+            '    def work(self):\n'
+            '        return {"done": 1}\n')
+        config = {
+            'info': {'name': 'consume_dag', 'project': 'p_consume'},
+            'executors': {'job': {'type': 'noop_exec2'}},
+        }
+        dag, tasks = dag_standard(session, config,
+                                  upload_folder=str(folder))
+        monkeypatch.setattr(wmain, 'HOSTNAME', 'host1')
+        add_computer(session, name='host1')
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+
+        from mlcomp_tpu.utils.logging import create_logger
+        logger = create_logger(session)
+        qp = QueueProvider(session)
+        consumed = _consume_one(session, qp, logger, 0, in_process=True)
+        assert consumed
+        tp = TaskProvider(session)
+        task = tp.by_id(tasks['job'][0])
+        assert task.status == int(TaskStatus.Success)
+        msg_status = qp.status(task.queue_id)
+        assert msg_status == 'done'
