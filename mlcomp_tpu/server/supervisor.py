@@ -271,7 +271,10 @@ class SupervisorBuilder:
         distr = bool((info or {}).get('distr', task.cores_max > 1))
         single_node = bool(task.single_node)
 
-        if task.cores_max <= 1 or single_node:
+        # multi-host fan-out only for tasks that asked for distributed
+        # execution (distr, default True when cores_max>1) AND are not
+        # pinned to a single node (reference supervisor.py:228-263)
+        if task.cores_max <= 1 or single_node or not distr:
             comp = fits[0]
             free = self._free_cores(comp)
             want = task.cores_max or task.cores or 0
@@ -370,7 +373,15 @@ class SupervisorBuilder:
                 self.logger.error(
                     f'supervisor tick failed:\n{traceback.format_exc()}',
                     ComponentType.Supervisor)
+            # create_session is a keyed singleton — drop the cached
+            # (possibly wedged) connection first so a FRESH one is built
+            Session.cleanup('supervisor')
             self.session = Session.create_session(key='supervisor')
+            if self.logger is not None:
+                # rebind the cached logger's DbHandler to the new session
+                # (the old handler would write to a closed connection)
+                from mlcomp_tpu.utils.logging import create_logger
+                self.logger = create_logger(self.session)
             self.__init__(session=self.session, logger=self.logger,
                           queue_liveness_window=self.queue_liveness_window)
 

@@ -146,11 +146,18 @@ def create_logger(session=None, name: str = 'mlcomp_tpu'):
             logger.addHandler(file_handler)
             _loggers[name] = logger
 
-        if session is not None and not any(
-                isinstance(h, DbHandler) for h in logger.handlers):
-            db_handler = DbHandler(session)
-            db_handler.setLevel(os.getenv('DB_LOG_LEVEL', 'INFO'))
-            logger.addHandler(db_handler)
+        if session is not None:
+            existing = [h for h in logger.handlers
+                        if isinstance(h, DbHandler)]
+            if existing:
+                # session heal: the old connection may be closed — rebind
+                # every cached DbHandler to the fresh session
+                for h in existing:
+                    h.session = session
+            else:
+                db_handler = DbHandler(session)
+                db_handler.setLevel(os.getenv('DB_LOG_LEVEL', 'INFO'))
+                logger.addHandler(db_handler)
 
     return logger
 

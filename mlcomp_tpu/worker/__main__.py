@@ -72,8 +72,8 @@ def register_computer(session, cores: int = None):
         name=HOSTNAME,
         cores=cores if cores is not None else _tpu_core_count(),
         cpu=multiprocessing.cpu_count(),
-        memory=memory()['total'],
-        disk=disk(ROOT_FOLDER)['total'],
+        memory=memory()[0],
+        disk=disk(ROOT_FOLDER)[0],
         ip=os.environ.get('IP', 'localhost'),
         port=int(os.environ.get('PORT', 22)),
         user=os.environ.get('USER', 'root'),
@@ -96,6 +96,9 @@ def queue_names(index: int = None):
 def _run_subprocess(task_id: int, index: int, logger, session) -> bool:
     """Execute a task in a child process; returns success."""
     env = dict(os.environ)
+    # exec-time marker read back via /proc/<pid>/environ by kill_task's
+    # pid-reuse guard
+    env['MLCOMP_TASK_ID'] = str(task_id)
     cmd = [sys.executable, '-m', 'mlcomp_tpu.worker', 'run-task',
            str(task_id), '--index', str(index)]
     proc = subprocess.Popen(cmd, env=env)
@@ -169,8 +172,11 @@ def worker(index, in_process):
             logger.error(
                 f'worker loop error:\n{traceback.format_exc()}',
                 ComponentType.Worker, HOSTNAME)
+            # drop the cached singleton so a fresh connection is built
+            Session.cleanup(f'worker{index}')
             session = Session.create_session(key=f'worker{index}')
             queue_provider = QueueProvider(session)
+            logger = create_logger(session)
             time.sleep(1)
 
 
@@ -248,6 +254,34 @@ def _tpu_usage():
         return []
 
 
+def consume_control_queue(session, logger):
+    """Drain the host agent's control queue
+    (``{host}_{docker}_supervisor``): kill actions routed here drain even
+    when every worker is blocked on a running task."""
+    queue_provider = QueueProvider(session)
+    queue = f'{HOSTNAME}_{DOCKER_IMG}_supervisor'
+    while True:
+        claim = queue_provider.claim([queue], f'{HOSTNAME}:supervisor')
+        if claim is None:
+            return
+        msg_id, payload = claim
+        action = payload.get('action')
+        task_id = payload.get('task_id')
+        try:
+            if action == 'kill':
+                from mlcomp_tpu.worker.tasks import kill_task
+                kill_task(task_id, session=session)
+                queue_provider.complete(msg_id)
+            else:
+                queue_provider.fail(msg_id, f'unknown action {action!r}')
+        except Exception:
+            queue_provider.fail(msg_id, traceback.format_exc()[-4000:])
+            logger.error(
+                f'control message {msg_id} ({action} task {task_id}) '
+                f'failed:\n{traceback.format_exc()}',
+                ComponentType.WorkerSupervisor, HOSTNAME, task_id)
+
+
 @main.command(name='worker-supervisor')
 @click.option('--cores', type=int, default=None,
               help='override detected TPU core count')
@@ -272,6 +306,9 @@ def worker_supervisor(cores):
     def usage():
         worker_usage(session, logger)
 
+    def control():
+        consume_control_queue(session, logger)
+
     file_sync = FileSync(session=session)
     heartbeat()
     start_schedule([
@@ -279,6 +316,7 @@ def worker_supervisor(cores):
         (reaper, 10),
         (usage, WORKER_USAGE_INTERVAL),
         (file_sync.sync, 60),
+        (control, 2),
     ], logger=logger)
     logger.info(f'worker-supervisor up on {HOSTNAME} '
                 f'({_tpu_core_count() if cores is None else cores} cores)',

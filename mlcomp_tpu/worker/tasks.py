@@ -204,9 +204,39 @@ def execute_by_id(task_id: int, exit: bool = False, folder: str = None,
     return builder.build()
 
 
+def _pid_is_task_process(pid: int, task_id: int = None) -> bool:
+    """Guard against pid reuse: only SIGTERM a process that carries the
+    MLCOMP_TASK_ID exec-time env marker for this task (set by the worker
+    when spawning the task subprocess) or that is an mlcomp_tpu process
+    (in-process worker daemon mode)."""
+    try:
+        import psutil
+        proc = psutil.Process(pid)
+        if task_id is not None:
+            try:
+                env = proc.environ()
+            except (psutil.AccessDenied, psutil.ZombieProcess):
+                env = {}
+            marker = env.get('MLCOMP_TASK_ID')
+            if marker is not None:
+                # a marker naming a DIFFERENT task means the pid was
+                # reused by another task's subprocess — never kill it
+                return marker == str(task_id)
+        # no marker readable: in-process daemon mode (the daemon itself
+        # runs the task) — match on the daemon cmdline
+        return 'mlcomp_tpu' in ' '.join(proc.cmdline())
+    except Exception:
+        return False
+
+
 def kill_task(task_id: int, session: Session = None):
-    """Stop a task: revoke its queue message if pending, kill its process
-    tree if running (reference worker/tasks.py:336-362)."""
+    """Stop a task: revoke its queue message if pending; kill its process
+    tree if it runs on THIS host; otherwise route the kill through the
+    owning host's queue, whose worker daemon handles the 'kill' action
+    (reference worker/tasks.py:336-362 revokes via celery + kills via a
+    task sent to the remote worker — a local os.kill on a foreign pid
+    would hit an unrelated process)."""
+    import socket
     session = session or Session.create_session(key='worker')
     provider = TaskProvider(session)
     task = provider.by_id(task_id)
@@ -214,14 +244,30 @@ def kill_task(task_id: int, session: Session = None):
         return False
     if task.queue_id is not None:
         QueueProvider(session).revoke(task.queue_id)
-    if task.status == int(TaskStatus.InProgress) and task.pid:
-        from mlcomp_tpu.utils.misc import kill_child_processes
-        import signal
-        kill_child_processes(task.pid)
-        try:
-            os.kill(task.pid, signal.SIGTERM)
-        except (ProcessLookupError, PermissionError):
-            pass
+    # Stopped included: a remote-routed kill arrives AFTER the initiator
+    # already flipped the status, but the process is still alive
+    if task.status in (int(TaskStatus.InProgress),
+                       int(TaskStatus.Stopped)) and task.pid:
+        local = task.computer_assigned in (None, '', socket.gethostname())
+        if local:
+            if _pid_is_task_process(task.pid, task.id):
+                from mlcomp_tpu.utils.misc import kill_child_processes
+                import signal
+                kill_child_processes(task.pid)
+                try:
+                    os.kill(task.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        else:
+            # route (and re-route on repeat calls — the first message may
+            # have been lost) through the owning host's SUPERVISOR queue:
+            # the host agent is never blocked on a running task, so the
+            # kill drains even when every worker is busy (reference queue
+            # naming {host}_{docker}_supervisor, worker/__main__.py:147-181)
+            docker = task.docker_assigned or 'default'
+            queue = f'{task.computer_assigned}_{docker}_supervisor'
+            QueueProvider(session).enqueue(
+                queue, {'action': 'kill', 'task_id': task.id})
     if task.status < int(TaskStatus.Failed):
         provider.change_status(task, TaskStatus.Stopped)
     return True

@@ -251,3 +251,156 @@ class TestWorkerConsume:
         assert task.status == int(TaskStatus.Success)
         msg_status = qp.status(task.queue_id)
         assert msg_status == 'done'
+
+
+class TestKill:
+    def test_remote_kill_routes_through_queue(self, session, dag_id):
+        """A kill for a task InProgress on ANOTHER host must not os.kill
+        locally — it enqueues {'action':'kill'} to the owner's queue
+        (reference worker/tasks.py:336-362 routes kill via the worker)."""
+        from mlcomp_tpu.worker.tasks import kill_task
+        task = add_task(session, dag_id, name='remote_job')
+        tp = TaskProvider(session)
+        task.computer_assigned = 'far_away_host'
+        task.pid = 1  # would be fatal if os.kill'ed locally
+        tp.update(task, ['computer_assigned', 'pid'])
+        tp.change_status(task, TaskStatus.InProgress)
+        assert kill_task(task.id, session=session)
+        # routed to the host AGENT's queue, which is never blocked on a
+        # running task (a busy worker can't drain its own kill)
+        queue = 'far_away_host_default_supervisor'
+        pending = QueueProvider(session).pending(queue)
+        payloads = [json.loads(m.payload) for m in pending]
+        assert {'action': 'kill', 'task_id': task.id} in payloads
+        assert tp.by_id(task.id).status == int(TaskStatus.Stopped)
+        # a repeat kill (first message lost) must re-route, not no-op
+        assert kill_task(task.id, session=session)
+        pending = QueueProvider(session).pending(queue)
+        kills = [m for m in pending
+                 if json.loads(m.payload).get('action') == 'kill']
+        assert len(kills) == 2
+
+    def test_control_queue_drains_kill(self, session, dag_id,
+                                       monkeypatch):
+        """The worker-supervisor's control loop consumes a routed kill
+        and terminates the task process."""
+        import os
+        import socket
+        import subprocess
+        import sys
+        import time
+        import mlcomp_tpu.worker.__main__ as wmain
+        from mlcomp_tpu.utils.logging import create_logger
+        from mlcomp_tpu.worker.__main__ import consume_control_queue
+        task = add_task(session, dag_id, name='ctl_job')
+        proc = subprocess.Popen(
+            [sys.executable, '-c', 'import time; time.sleep(300)'],
+            env={**os.environ, 'MLCOMP_TASK_ID': str(task.id)})
+        try:
+            tp = TaskProvider(session)
+            task.computer_assigned = socket.gethostname()
+            task.pid = proc.pid
+            tp.update(task, ['computer_assigned', 'pid'])
+            tp.change_status(task, TaskStatus.InProgress)
+            tp.change_status(task, TaskStatus.Stopped)
+            host = socket.gethostname()
+            QueueProvider(session).enqueue(
+                f'{host}_default_supervisor',
+                {'action': 'kill', 'task_id': task.id})
+            monkeypatch.setattr(wmain, 'HOSTNAME', host)
+            consume_control_queue(session, create_logger(session))
+            deadline = time.time() + 10
+            while proc.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            assert proc.poll() is not None
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+    def test_pid_guard_rejects_foreign_marker(self):
+        """A live process whose MLCOMP_TASK_ID names a DIFFERENT task must
+        never be killed (pid reuse across task subprocesses)."""
+        import os
+        import subprocess
+        import sys
+        from mlcomp_tpu.worker.tasks import _pid_is_task_process
+        proc = subprocess.Popen(
+            [sys.executable, '-c', 'import time; time.sleep(60)'],
+            env={**os.environ, 'MLCOMP_TASK_ID': '999'})
+        try:
+            assert _pid_is_task_process(proc.pid, 999)
+            assert not _pid_is_task_process(proc.pid, 5)
+        finally:
+            proc.kill()
+
+    def test_local_kill_terminates_process(self, session, dag_id):
+        import os
+        import socket
+        import subprocess
+        import sys
+        import time
+        from mlcomp_tpu.worker.tasks import kill_task
+        task = add_task(session, dag_id, name='local_job')
+        proc = subprocess.Popen(
+            [sys.executable, '-c', 'import time; time.sleep(300)'],
+            env={**os.environ, 'MLCOMP_TASK_ID': str(task.id)})
+        try:
+            tp = TaskProvider(session)
+            task.computer_assigned = socket.gethostname()
+            task.pid = proc.pid
+            tp.update(task, ['computer_assigned', 'pid'])
+            tp.change_status(task, TaskStatus.InProgress)
+            assert kill_task(task.id, session=session)
+            deadline = time.time() + 10
+            while proc.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            assert proc.poll() is not None
+            assert tp.by_id(task.id).status == int(TaskStatus.Stopped)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+    def test_stopped_remote_task_kill_still_kills_pid(self, session,
+                                                      dag_id):
+        """The owning host's worker receives the routed kill AFTER the
+        initiator flipped the status to Stopped — the pid must still die."""
+        import os
+        import socket
+        import subprocess
+        import sys
+        import time
+        from mlcomp_tpu.worker.tasks import kill_task
+        task = add_task(session, dag_id, name='stopped_job')
+        proc = subprocess.Popen(
+            [sys.executable, '-c', 'import time; time.sleep(300)'],
+            env={**os.environ, 'MLCOMP_TASK_ID': str(task.id)})
+        try:
+            tp = TaskProvider(session)
+            task.computer_assigned = socket.gethostname()
+            task.pid = proc.pid
+            tp.update(task, ['computer_assigned', 'pid'])
+            tp.change_status(task, TaskStatus.InProgress)
+            tp.change_status(task, TaskStatus.Stopped)
+            assert kill_task(task.id, session=session)
+            deadline = time.time() + 10
+            while proc.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            assert proc.poll() is not None
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+    def test_distr_false_stays_single_node(self, session, dag_id):
+        """cores_max>1 with distr:false must take the single-node path
+        (no service-task fan-out)."""
+        add_computer(session, name='host1', cores=4)
+        add_computer(session, name='host2', cores=4)
+        task = add_task(session, dag_id, name='train', cores=2,
+                        cores_max=8, single_node=False,
+                        additional_info='distr: false\n')
+        SupervisorBuilder(session=session).build()
+        tp = TaskProvider(session)
+        assert tp.children(task.id) == []
+        task = tp.by_id(task.id)
+        assert task.status == int(TaskStatus.Queued)
+        assert task.computer_assigned in ('host1', 'host2')
