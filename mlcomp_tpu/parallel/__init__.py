@@ -15,8 +15,14 @@ from mlcomp_tpu.parallel.sharding import (
     with_sharding_constraint,
 )
 from mlcomp_tpu.parallel.ring import ring_attention, make_ring_attention
+from mlcomp_tpu.parallel.distributed import (
+    initialize_from_distr_info, process_index, process_count,
+    is_main_process, host_replicated_copy,
+)
 
 __all__ = [
+    'initialize_from_distr_info', 'process_index', 'process_count',
+    'is_main_process', 'host_replicated_copy',
     'AXIS_ORDER', 'DATA_AXES', 'mesh_from_spec', 'normalize_mesh_spec',
     'single_device_mesh', 'mesh_axis_size',
     'DEFAULT_LOGICAL_RULES', 'logical_rules', 'logical_to_sharding',

@@ -1,0 +1,89 @@
+"""Multi-host bootstrap: consume the supervisor's ``distr_info``.
+
+The reference exports the torch.distributed env contract
+(``MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK``) and lets NCCL allreduce
+(reference worker/executors/catalyst/catalyst.py:195-207). The TPU-native
+equivalent is ``jax.distributed.initialize``: every fanned-out service
+task calls it with the coordinator address + process indices the
+supervisor manufactured (server/supervisor.py), after which
+``jax.devices()`` is the GLOBAL device list, meshes span hosts, and XLA
+collectives ride ICI within a host / DCN across hosts.
+
+Must run BEFORE the first jax backend use in the process (importing jax
+is fine; querying devices is not).
+"""
+
+from typing import Any, Optional
+
+_state = {'initialized': False}
+
+
+def initialize_from_distr_info(distr_info: Optional[dict]) -> bool:
+    """Idempotently initialize the jax distributed runtime from the
+    supervisor's distr_info {coordinator_address, process_index,
+    process_count}. Returns True when running multi-process."""
+    if not distr_info:
+        return False
+    count = int(distr_info.get('process_count') or 1)
+    if count <= 1:
+        return False
+    if _state['initialized']:
+        return True
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=distr_info['coordinator_address'],
+        num_processes=count,
+        process_id=int(distr_info.get('process_index') or 0))
+    _state['initialized'] = True
+    return True
+
+
+def process_index() -> int:
+    import jax
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+    return jax.process_count()
+
+
+def is_main_process() -> bool:
+    """Rank-0 check: DB reporting, checkpoint writes, and model-registry
+    updates happen only here (reference suppresses checkpointing and
+    reporting on rank>0, catalyst.py:298-311)."""
+    return process_index() == 0
+
+
+def host_replicated_copy(tree: Any, mesh=None) -> Any:
+    """Pull a (possibly cross-process sharded) pytree fully to host.
+
+    Single-process: plain ``device_get``. Multi-process: arrays sharded
+    over other hosts are not addressable, so reshard to fully-replicated
+    first (an all-gather every process participates in), then
+    ``device_get``. Used by the checkpoint path before rank-0 writes.
+    """
+    import jax
+    if jax.process_count() == 1:
+        return jax.device_get(tree)
+    leaves = [x for x in jax.tree.leaves(tree)
+              if isinstance(x, jax.Array)]
+    if all(x.is_fully_addressable for x in leaves):
+        return jax.device_get(tree)
+    if mesh is None:
+        raise ValueError(
+            'host_replicated_copy needs the mesh to gather '
+            'cross-process shards')
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rep = NamedSharding(mesh, PartitionSpec())
+
+    def gather(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return jax.jit(lambda a: a, out_shardings=rep)(x)
+        return x
+    return jax.device_get(jax.tree.map(gather, tree))
+
+
+__all__ = ['initialize_from_distr_info', 'process_index', 'process_count',
+           'is_main_process', 'host_replicated_copy']

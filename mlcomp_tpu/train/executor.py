@@ -80,10 +80,27 @@ class JaxTrain(Executor):
         self.log_every = int(log_every)
 
     # ------------------------------------------------------------ plumbing
+    def _init_distributed(self):
+        """Join a multi-host job when this is a fanned-out service task
+        (reference catalyst.py:195-207). ExecuteBuilder normally does this
+        before the executor is built; doing it here too covers direct
+        invocation (tests, notebooks). Returns True on rank 0."""
+        from mlcomp_tpu.parallel.distributed import (
+            initialize_from_distr_info, is_main_process,
+        )
+        info = dict(getattr(self, 'additional_info', None) or {})
+        initialize_from_distr_info(info.get('distr_info'))
+        return is_main_process()
+
     def _mesh(self):
         spec = self.mesh_spec
         if spec is None:
             spec = {'dp': -1}
+        info = dict(getattr(self, 'additional_info', None) or {})
+        distr = info.get('distr_info') or {}
+        # the supervisor may pin the mesh for the whole fanned-out job
+        if distr.get('mesh'):
+            spec = distr['mesh']
         return mesh_from_spec(spec)
 
     def _checkpoint_folder(self):
@@ -91,10 +108,18 @@ class JaxTrain(Executor):
             return self.checkpoint_dir
         from mlcomp_tpu import TASK_FOLDER
         task_id = self.task.id if self.task else 0
+        # service tasks of one distributed job share the PARENT's folder
+        # so every rank sees the same resume state (reference fetches the
+        # master's checkpoint, catalyst.py:244-249; here: shared dir on
+        # one host, FileSync across hosts)
+        if self.task is not None and self.task.parent:
+            task_id = self.task.parent
         return os.path.join(TASK_FOLDER, str(task_id), 'checkpoints')
 
     def _report_series(self, name, value, epoch, part, stage):
         if self.session is None or self.task is None:
+            return
+        if not getattr(self, '_is_main', True):
             return
         from mlcomp_tpu.db.models import ReportSeries
         from mlcomp_tpu.db.providers import ReportSeriesProvider
@@ -107,6 +132,8 @@ class JaxTrain(Executor):
         """task.score + Model.score_local best tracking
         (reference catalyst.py:131-145, valid.py:74-81)."""
         if self.session is None or self.task is None:
+            return
+        if not getattr(self, '_is_main', True):
             return
         from mlcomp_tpu.db.providers import ModelProvider, TaskProvider
         better = (self.task.score is None or
@@ -130,6 +157,7 @@ class JaxTrain(Executor):
     # ---------------------------------------------------------------- work
     def work(self):
         t_start = time.time()
+        self._is_main = self._init_distributed()
         mesh = self._mesh()
         loss_fn = loss_for_task(self.loss_name)
         self_supervised = self.loss_name == 'lm_ce'
@@ -203,7 +231,8 @@ class JaxTrain(Executor):
                         with_dropout_rng=True)
         best = None
         if restored is not None:
-            state = restored
+            from mlcomp_tpu.train.loop import place_state
+            state = place_state(restored, mesh)
             epochs_done_global = int(meta.get('epoch', -1)) + 1
             # seed best-score tracking from the surviving best checkpoint
             # so a post-resume epoch can't clobber a better best.msgpack
@@ -309,12 +338,20 @@ class JaxTrain(Executor):
                 if is_best:
                     best = score
                     self._update_scores(score)
-                save_checkpoint(
-                    ck_dir, state,
-                    {'stage': stage_name, 'stage_epoch': epoch,
-                     'epoch': global_epoch, 'score': score,
-                     'step': int(state.step)},
-                    best=is_best)
+                # the host gather is a collective every rank joins;
+                # only rank 0 touches the filesystem
+                # (reference rank>0 suppression, catalyst.py:298-311)
+                from mlcomp_tpu.parallel.distributed import (
+                    host_replicated_copy,
+                )
+                host_state = host_replicated_copy(state, mesh)
+                if self._is_main:
+                    save_checkpoint(
+                        ck_dir, host_state,
+                        {'stage': stage_name, 'stage_epoch': epoch,
+                         'epoch': global_epoch, 'score': score,
+                         'step': int(state.step)},
+                        best=is_best)
                 global_epoch += 1
             if dispatch_stage is not None or (
                     self.stage_per_dispatch and stage is not remaining[-1]):

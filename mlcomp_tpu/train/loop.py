@@ -201,6 +201,25 @@ def state_sharding(state: TrainState, mesh: Mesh):
     return logical_to_sharding(jax.eval_shape(lambda: state), mesh)
 
 
+def place_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Place a host-side (e.g. checkpoint-restored numpy) state onto the
+    mesh with its logical shardings. Multi-process safe: leaves are first
+    device_put fully-replicated (identical host values on every process),
+    then resharded to their target specs in one jit."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rep = NamedSharding(mesh, PartitionSpec())
+    replicated_state = jax.tree.map(
+        lambda leaf: jax.device_put(leaf, rep)
+        if not (isinstance(leaf, jax.Array) and leaf.committed)
+        else leaf,
+        state)
+    shardings = logical_to_sharding(state, mesh)
+    with mesh, nn.logical_axis_rules(logical_rules(mesh)):
+        return jax.jit(lambda s: s,
+                       out_shardings=shardings)(replicated_state)
+
+
 __all__ = ['TrainState', 'make_train_step', 'make_eval_step',
-           'create_train_state', 'state_sharding', 'loss_for_task',
-           'LOSSES', 'softmax_ce', 'lm_ce', 'seg_ce']
+           'create_train_state', 'state_sharding', 'place_state',
+           'loss_for_task', 'LOSSES', 'softmax_ce', 'lm_ce', 'seg_ce']

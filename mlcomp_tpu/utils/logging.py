@@ -65,7 +65,8 @@ def _extract_meta(record):
     """Positional log args are (component, computer, task, step) — parity
     with the reference's convention (utils/logging.py:76-103)."""
     component = getattr(record, 'component', ComponentType.API)
-    computer = getattr(record, 'computer', socket.gethostname())
+    from mlcomp_tpu.utils.misc import hostname
+    computer = getattr(record, 'computer', hostname())
     task = getattr(record, 'task', None)
     step = getattr(record, 'step', None)
     return component, computer, task, step

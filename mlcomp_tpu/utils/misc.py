@@ -13,6 +13,15 @@ def now():
     return datetime.datetime.now(datetime.timezone.utc).replace(tzinfo=None)
 
 
+def hostname() -> str:
+    """This computer's name in the control plane. ``MLCOMP_HOSTNAME``
+    overrides the OS hostname — used by tests that emulate several
+    computers on one machine and by containers whose hostname differs
+    from their registered name."""
+    import socket
+    return os.environ.get('MLCOMP_HOSTNAME') or socket.gethostname()
+
+
 def parse_time(value):
     """Inverse of the DB's text timestamp storage: accepts datetime or the
     isoformat/space-separated text sqlite hands back."""

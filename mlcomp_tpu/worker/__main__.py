@@ -41,7 +41,8 @@ from mlcomp_tpu.db.providers import (
 from mlcomp_tpu.utils.logging import create_logger
 from mlcomp_tpu.utils.misc import disk, memory, now
 
-HOSTNAME = socket.gethostname()
+from mlcomp_tpu.utils.misc import hostname as _hostname
+HOSTNAME = _hostname()
 
 
 @click.group()

@@ -161,7 +161,8 @@ class Executor(ABC):
         import time
         from mlcomp_tpu.db.providers import TaskSyncedProvider
         provider = TaskSyncedProvider(self.session)
-        hostname = socket.gethostname()
+        from mlcomp_tpu.utils.misc import hostname as _hostname
+        hostname = _hostname()
         project = self.dag.project if self.dag else None
         for _ in range(600):
             pending = [

@@ -42,7 +42,7 @@ def copy_remote(session: Session, computer_from: str, path_from: str,
     """Fetch a file/folder that lives on `computer_from`
     (reference worker/sync.py:60-71 — scp). Local/shared-fs fast path
     first; ssh+rsync only for genuinely remote hosts."""
-    if computer_from == socket.gethostname() or os.path.exists(path_from):
+    if computer_from == hostname() or os.path.exists(path_from):
         if os.path.isdir(path_from):
             _copy_tree(path_from, path_to)
         elif os.path.exists(path_from):
@@ -98,7 +98,7 @@ class FileSync:
 
     def __init__(self, session: Session = None, only_computer: str = None):
         self.session = session or Session.create_session(key='sync')
-        self.hostname = socket.gethostname()
+        self.hostname = hostname()
         self.only_computer = only_computer
 
     def sync(self):

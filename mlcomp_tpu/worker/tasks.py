@@ -110,6 +110,24 @@ class ExecuteBuilder:
                 str(c) for c in cores)
             os.environ['TPU_CHIPS_PER_PROCESS_BOUNDS'] = f'1,1,{len(cores)}'
 
+    def init_distributed(self):
+        """Join the multi-host job this service task belongs to
+        (reference set_dist_env, catalyst.py:195-207): consume the
+        supervisor-manufactured distr_info BEFORE the first jax backend
+        use so jax.devices() becomes the global device list."""
+        distr_info = self.additional_info().get('distr_info')
+        if distr_info:
+            from mlcomp_tpu.parallel.distributed import (
+                initialize_from_distr_info,
+            )
+            if initialize_from_distr_info(distr_info):
+                self.logger.info(
+                    f'task {self.task.id}: joined distributed job as '
+                    f'process {distr_info.get("process_index")}/'
+                    f'{distr_info.get("process_count")} '
+                    f'(coordinator {distr_info.get("coordinator_address")})',
+                    ComponentType.Worker, None, self.task.id)
+
     def create_executor(self, folder: str):
         config = Config.from_yaml(self.dag.config)
         info = self.additional_info()
@@ -168,7 +186,8 @@ class ExecuteBuilder:
     def personal_queue(self) -> str:
         import socket
         docker = self.task.docker_assigned or 'default'
-        return f'{socket.gethostname()}_{docker}_{self.worker_index}'
+        from mlcomp_tpu.utils.misc import hostname
+        return f'{hostname()}_{docker}_{self.worker_index}'
 
     # ----------------------------------------------------------------- main
     def build(self):
@@ -178,6 +197,7 @@ class ExecuteBuilder:
             self.mark_in_progress()
             folder = self.download()
             self.pin_cores()
+            self.init_distributed()
             self.create_executor(folder)
             return self.execute(folder)
         except Exception as e:
@@ -248,7 +268,8 @@ def kill_task(task_id: int, session: Session = None):
     # already flipped the status, but the process is still alive
     if task.status in (int(TaskStatus.InProgress),
                        int(TaskStatus.Stopped)) and task.pid:
-        local = task.computer_assigned in (None, '', socket.gethostname())
+        from mlcomp_tpu.utils.misc import hostname
+        local = task.computer_assigned in (None, '', hostname())
         if local:
             if _pid_is_task_process(task.pid, task.id):
                 from mlcomp_tpu.utils.misc import kill_child_processes
