@@ -40,6 +40,11 @@ class ReportTasksProvider(BaseDataProvider):
             'SELECT task FROM report_tasks WHERE report=?', (report,))
         return [r['task'] for r in rows]
 
+    def remove_task(self, report: int, task: int):
+        self.session.execute(
+            'DELETE FROM report_tasks WHERE report=? AND task=?',
+            (report, task))
+
 
 class ReportLayoutProvider(BaseDataProvider):
     model = ReportLayout

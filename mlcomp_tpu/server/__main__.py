@@ -1,0 +1,122 @@
+"""Server CLI (parity: reference mlcomp/server/__main__.py:18-105).
+
+- ``python -m mlcomp_tpu.server start-site`` — migrate + run the
+  supervisor loop and the JSON API in this process (reference
+  ``start-site``: migrate + flask with register_supervisor)
+- ``python -m mlcomp_tpu.server start N`` — full deployment: spawn
+  start-site + worker-supervisor + N workers as an autorestarting
+  process group (supervisord parity, reference server/__main__.py:44-92;
+  no redis child — the queue lives in the DB)
+- ``python -m mlcomp_tpu.server stop`` — terminate the group
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import click
+
+from mlcomp_tpu import WEB_HOST, WEB_PORT
+from mlcomp_tpu.db.core import Session
+from mlcomp_tpu.db.enums import ComponentType
+from mlcomp_tpu.utils.logging import create_logger
+
+
+@click.group()
+def main():
+    pass
+
+
+@main.command(name='start-site')
+@click.option('--host', default=None)
+@click.option('--port', type=int, default=None)
+@click.option('--no-supervisor', is_flag=True,
+              help='serve the API without the scheduler loop')
+def start_site(host, port, no_supervisor):
+    """Migrate + supervisor + API server in this process."""
+    from mlcomp_tpu.server.api import start_server
+    session = Session.create_session(key='server_site')
+    logger = create_logger(session)
+    logger.info(
+        f'API on {host or WEB_HOST}:{port or WEB_PORT}', ComponentType.API)
+    start_server(host=host, port=port, logger=logger,
+                 with_supervisor=not no_supervisor)
+
+
+@main.command()
+@click.argument('n_workers', type=int)
+@click.option('--in-process', is_flag=True)
+def start(n_workers, in_process):
+    """Spawn start-site + worker-supervisor + N workers with autorestart."""
+    specs = [
+        (['mlcomp_tpu.server', 'start-site'], None),
+        (['mlcomp_tpu.worker', 'worker-supervisor'], None),
+    ] + [
+        (['mlcomp_tpu.worker', 'worker', str(i)]
+         + (['--in-process'] if in_process else []), None)
+        for i in range(n_workers)
+    ]
+    children = {}
+    spawned_at = {}
+    fail_streak = [0] * len(specs)
+
+    def spawn(idx):
+        module, *args = specs[idx][0]
+        proc = subprocess.Popen([sys.executable, '-m', module] + args)
+        children[proc.pid] = (proc, idx)
+        spawned_at[idx] = time.time()
+        return proc
+
+    for i in range(len(specs)):
+        spawn(i)
+    print(f'started site + worker-supervisor + {n_workers} workers '
+          f'(http://{WEB_HOST}:{WEB_PORT})')
+
+    def shutdown(*_):
+        for proc, _idx in list(children.values()):
+            proc.terminate()
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, shutdown)
+    try:
+        while True:
+            time.sleep(2)
+            for pid, (proc, idx) in list(children.items()):
+                if proc.poll() is not None:
+                    del children[pid]
+                    # crash-loop backoff (supervisord startretries
+                    # parity): double the restart delay, up to 30 s,
+                    # while the child keeps dying within 10 s of spawn
+                    fast = time.time() - spawned_at[idx] < 10
+                    fail_streak[idx] = fail_streak[idx] + 1 if fast else 0
+                    delay = min(30, 2 ** fail_streak[idx]) if fast else 0
+                    print(f'child {specs[idx][0]} exited '
+                          f'({proc.returncode}); restarting'
+                          + (f' in {delay}s' if delay else ''))
+                    if delay:
+                        time.sleep(delay)
+                    spawn(idx)
+    except KeyboardInterrupt:
+        shutdown()
+
+
+@main.command()
+def stop():
+    """Stop daemons started by ``start`` (best effort, by cmdline)."""
+    import psutil
+    me = os.getpid()
+    for proc in psutil.process_iter(['pid', 'cmdline']):
+        cmd = ' '.join(proc.info.get('cmdline') or [])
+        if ('mlcomp_tpu.server' in cmd or 'mlcomp_tpu.worker' in cmd) \
+                and proc.info['pid'] != me:
+            try:
+                proc.terminate()
+            except psutil.Error:
+                pass
+    print('stopped')
+
+
+if __name__ == '__main__':
+    main()

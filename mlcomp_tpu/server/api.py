@@ -1,0 +1,676 @@
+"""JSON server API (parity: reference mlcomp/server/back/app.py:31-748).
+
+The reference serves ~40 Flask POST endpoints under ``/api/*`` with token
+auth, an error handler that heals wedged DB sessions, and static frontend
+files. Flask is not a given in this image, so the API is built on stdlib
+``http.server.ThreadingHTTPServer`` — one process, thread-per-request,
+sqlite WAL underneath (each worker thread gets its own session key).
+
+Endpoint map (all POST JSON unless noted; reference file:line cited where
+the behavior is subtle):
+
+- ``/api/token``                    auth check (app.py:650-661)
+- ``/api/computers``                machine list + live usage (app.py:134-143)
+- ``/api/projects`` + add/edit/remove (app.py:146-183, 663-668)
+- ``/api/layouts`` + layout/add/edit/remove (app.py:211-261)
+- ``/api/report/add_start|add_end`` new-report dialog (app.py:186-208)
+- ``/api/models``, ``/api/model/remove|start_begin|start_end|add``
+- ``/api/img_classify``, ``/api/img_segment`` galleries (app.py:300-317)
+- ``/api/config``, ``/api/graph``, ``/api/dags`` (app.py:320-346)
+- ``/api/code``, GET ``/api/code_download`` code browser (app.py:349-424)
+- ``/api/tasks``, ``/api/task/stop|info|steps`` (app.py:427-473, 642-649)
+- ``/api/dag/stop|start|remove|toogle_report`` — ``dag/start`` is
+  restart-with-resume: Failed/Stopped/Skipped tasks reset to NotRan with
+  ``resume{master_computer, master_task_id, load_last}`` attached,
+  including distributed-master discovery (app.py:488-552)
+- ``/api/auxiliary`` supervisor introspection, no auth (app.py:555-558)
+- ``/api/logs``, ``/api/reports``, ``/api/report``,
+  ``/api/report/update_layout_start|update_layout_end``
+- ``/api/remove_imgs``, ``/api/remove_files`` (app.py:672-688)
+- ``/api/stop``, ``/api/shutdown`` (app.py:710-730)
+- GET ``/`` and ``/ui``: built-in single-file HTML dashboard (the
+  reference ships an Angular SPA; see server/front.py for the stand-in)
+"""
+
+import io
+import json
+import threading
+import traceback
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from mlcomp_tpu import TOKEN, WEB_HOST, WEB_PORT
+from mlcomp_tpu.db.core import Session
+from mlcomp_tpu.db.enums import ComponentType, TaskStatus
+from mlcomp_tpu.db.migration import migrate
+from mlcomp_tpu.db.options import PaginatorOptions
+from mlcomp_tpu.db.providers import (
+    AuxiliaryProvider, ComputerProvider, DagProvider, DagStorageProvider,
+    LogProvider, ModelProvider, ProjectProvider,
+    ReportImgProvider, ReportLayoutProvider, ReportProvider,
+    ReportTasksProvider, StepProvider, TaskProvider
+)
+from mlcomp_tpu.db.models import Report
+from mlcomp_tpu.utils.io import yaml_dump, yaml_load
+from mlcomp_tpu.utils.misc import now, to_snake
+
+_SESSION_KEY = 'server_api'
+
+
+class ApiError(Exception):
+    def __init__(self, message, status=400):
+        super().__init__(message)
+        self.status = status
+
+
+def _session():
+    """One shared session for the API process — the Session core opens
+    sqlite with check_same_thread=False and serializes statements behind
+    an RLock, so serving threads can share it (the supervisor and worker
+    daemons use the same pattern)."""
+    return Session.create_session(key=_SESSION_KEY)
+
+
+def _heal_session():
+    Session.cleanup(_SESSION_KEY)
+    return Session.create_session(key=_SESSION_KEY)
+
+
+# --------------------------------------------------------------- handlers
+# Each handler: (data: dict, session) -> jsonable object (or bytes for
+# file downloads). Registered in _ROUTES at the bottom.
+
+def _paginator(data):
+    return PaginatorOptions.from_request(data)
+
+
+def api_token(data, s):
+    if str(data.get('token', '')).strip() != TOKEN:
+        raise ApiError('invalid token', status=401)
+    return {'success': True}
+
+
+def api_computers(data, s):
+    return ComputerProvider(s).get(data, _paginator(data))
+
+
+def api_projects(data, s):
+    return ProjectProvider(s).get(data, _paginator(data))
+
+
+def api_project_add(data, s):
+    ProjectProvider(s).add_project(
+        data['name'],
+        class_names=yaml_dump(data['class_names'])
+        if isinstance(data.get('class_names'), (dict, list))
+        else data.get('class_names'),
+        ignore_folders=data.get('ignore_folders'))
+    return {'success': True}
+
+
+def api_project_edit(data, s):
+    provider = ProjectProvider(s)
+    p = provider.by_id(data['id']) if data.get('id') \
+        else provider.by_name(data['name'])
+    if p is None:
+        raise ApiError('project not found', status=404)
+    for field in ('name', 'class_names', 'ignore_folders', 'sync_folders'):
+        if field in data:
+            setattr(p, field, data[field])
+    provider.update(p)
+    return {'success': True}
+
+
+def api_project_remove(data, s):
+    ProjectProvider(s).remove(data['id'])
+    return {'success': True}
+
+
+def api_layouts(data, s):
+    provider = ReportLayoutProvider(s)
+    layouts = provider.query('', (), _paginator(data), default_sort='name')
+    return {'total': provider.count(),
+            'data': [l.to_dict() for l in layouts]}
+
+
+def api_layout_add(data, s):
+    ReportLayoutProvider(s).add_layout(
+        data['name'], data.get('content', ''))
+    return {'success': True}
+
+
+def api_layout_edit(data, s):
+    ok = ReportLayoutProvider(s).update_layout(
+        data['name'], data['content'], new_name=data.get('new_name'))
+    if not ok:
+        raise ApiError('layout not found', status=404)
+    return {'success': True}
+
+
+def api_layout_remove(data, s):
+    provider = ReportLayoutProvider(s)
+    layout = provider.by_name(data['name'])
+    if layout is not None:
+        provider.remove(layout.id)
+    return {'success': True}
+
+
+def api_report_add_start(data, s):
+    return {
+        'projects': ProjectProvider(s).get()['data'],
+        'layouts': list(ReportLayoutProvider(s).all_layouts()),
+    }
+
+
+def api_report_add_end(data, s):
+    layouts = ReportLayoutProvider(s)
+    resolved = layouts.resolved(data['layout'])
+    ReportProvider(s).add(Report(
+        name=data['name'], project=data['project'],
+        config=yaml_dump(resolved), layout=data['layout'], time=now()))
+    return {'success': True}
+
+
+def api_models(data, s):
+    return ModelProvider(s).get(data, _paginator(data))
+
+
+def api_model_remove(data, s):
+    provider = ModelProvider(s)
+    m = provider.by_id(data['id']) if data.get('id') \
+        else provider.by_name(data['name'])
+    if m is not None:
+        provider.remove(m.id)
+    return {'success': True}
+
+
+def api_model_start_begin(data, s):
+    return ModelProvider(s).model_start_begin(data['model_id'])
+
+
+def api_model_add(data, s):
+    try:
+        from mlcomp_tpu.server.create_dags import dag_model_add
+    except ImportError:
+        raise ApiError('model ops not available in this build', status=501)
+    dag = dag_model_add(s, data)
+    return {'success': True, 'dag': dag.id}
+
+
+def api_model_start_end(data, s):
+    try:
+        from mlcomp_tpu.server.create_dags import dag_model_start
+    except ImportError:
+        raise ApiError('model ops not available in this build', status=501)
+    dag = dag_model_start(s, data)
+    return {'success': True, 'dag': dag.id}
+
+
+def api_img_classify(data, s):
+    provider = ReportImgProvider(s)
+    res = provider.get(data, _paginator(data))
+    res['confusion'] = provider.confusion_matrix(data)
+    return res
+
+
+def api_img_segment(data, s):
+    return ReportImgProvider(s).get(data, _paginator(data))
+
+
+def api_config(data, s):
+    dag_id = data['id'] if isinstance(data, dict) else data
+    return {'data': DagProvider(s).config(int(dag_id))}
+
+
+def api_graph(data, s):
+    return DagProvider(s).graph(int(data['id']))
+
+
+def api_dags(data, s):
+    return DagProvider(s).get(data, _paginator(data))
+
+
+def api_code(data, s):
+    """File tree of a DAG's stored code (reference app.py:349-402)."""
+    items = DagStorageProvider(s).by_dag(int(data['id']))
+    root = {'name': '', 'children': {}, 'content': None, 'id': None}
+    for storage, content in items:
+        parts = [p for p in storage.path.split('/') if p]
+        node = root
+        for part in parts[:-1]:
+            node = node['children'].setdefault(
+                part, {'name': part, 'children': {}, 'content': None,
+                       'id': None})
+        if not parts:
+            continue
+        leaf = parts[-1]
+        if storage.is_dir:
+            node['children'].setdefault(
+                leaf, {'name': leaf, 'children': {}, 'content': None,
+                       'id': None})
+        else:
+            text = None
+            if content is not None:
+                try:
+                    text = content.decode() \
+                        if isinstance(content, (bytes, bytearray)) \
+                        else str(content)
+                except UnicodeDecodeError:
+                    text = '<binary>'
+            node['children'][leaf] = {
+                'name': leaf, 'children': {}, 'content': text,
+                'id': storage.file}
+
+    def flatten(node):
+        children = [flatten(c) for c in node['children'].values()]
+        # folders first, then files, each alphabetical (app.py:386-397)
+        children.sort(key=lambda x: (0 if x['children'] else 1, x['name']))
+        return {'name': node['name'], 'children': children,
+                'content': node['content'], 'id': node['id']}
+
+    return {'items': flatten(root)['children']}
+
+
+def api_code_download(data, s):
+    """GET → zip bytes of the DAG's stored code (reference app.py:405-424)."""
+    dag_id = int(data['id'])
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, 'w', zipfile.ZIP_DEFLATED) as zf:
+        for storage, content in DagStorageProvider(s).by_dag(dag_id):
+            if storage.is_dir or content is None:
+                continue
+            raw = content if isinstance(content, (bytes, bytearray)) \
+                else str(content).encode()
+            zf.writestr(storage.path, raw)
+    return ('application/zip', buf.getvalue(),
+            f'attachment; filename=dag_{dag_id}.zip')
+
+
+def api_tasks(data, s):
+    return TaskProvider(s).get(data, _paginator(data))
+
+
+def _stop_task(s, task):
+    from mlcomp_tpu.worker.tasks import kill_task
+    kill_task(task.id, session=s)
+    provider = TaskProvider(s)
+    for child in provider.children(task.id):
+        kill_task(child.id, session=s)
+    refreshed = provider.by_id(task.id)
+    return TaskStatus(refreshed.status)
+
+
+def api_task_stop(data, s):
+    task = TaskProvider(s).by_id(data['id'])
+    if task is None:
+        raise ApiError('task not found', status=404)
+    status = _stop_task(s, task)
+    return {'status': to_snake(status.name)}
+
+
+def api_task_info(data, s):
+    task = TaskProvider(s).by_id(data['id'])
+    if task is None:
+        raise ApiError('task not found', status=404)
+    return {
+        'id': task.id,
+        'pid': task.pid,
+        'worker_index': task.worker_index,
+        'cores_assigned': task.cores_assigned,
+        'queue_id': task.queue_id,
+        'additional_info': task.additional_info or '',
+        'result': task.result or '',
+    }
+
+
+def api_task_steps(data, s):
+    return {'data': StepProvider(s).get(int(data['id']))}
+
+
+def api_dag_stop(data, s):
+    provider = DagProvider(s)
+    dag_id = int(data['id'])
+    for t in TaskProvider(s).by_dag(dag_id):
+        _stop_task(s, t)
+    return {'dag': provider.get({'id': dag_id})['data'][0]}
+
+
+def api_dag_start(data, s):
+    """Restart-with-resume (reference app.py:488-552): reset every
+    Failed/Stopped/Skipped non-service task to NotRan and attach
+    ``resume`` info pointing at the checkpoint's master task."""
+    provider = TaskProvider(s)
+    dag_id = int(data['id'])
+    can_start = {int(TaskStatus.Failed), int(TaskStatus.Skipped),
+                 int(TaskStatus.Stopped)}
+    restarted = []
+
+    def find_resume(task):
+        children = sorted(provider.children(task.id),
+                          key=lambda c: c.id, reverse=True)
+        if children:
+            for c in children:
+                info = yaml_load(c.additional_info) \
+                    if c.additional_info else {}
+                distr = info.get('distr_info')
+                if not distr:
+                    continue
+                if distr.get('process_index', distr.get('rank')) == 0:
+                    return {'master_computer': c.computer_assigned,
+                            'master_task_id': c.id,
+                            'load_last': True}
+            raise ApiError('master task not found', status=500)
+        return {'master_computer': task.computer_assigned,
+                'master_task_id': task.id,
+                'load_last': True}
+
+    for t in provider.by_dag(dag_id):
+        if t.status not in can_start or t.parent:
+            continue
+        info = yaml_load(t.additional_info) if t.additional_info else {}
+        info['resume'] = find_resume(t)
+        t.additional_info = yaml_dump(info)
+        t.status = int(TaskStatus.NotRan)
+        t.pid = None
+        t.started = None
+        t.finished = None
+        t.computer_assigned = None
+        t.queue_id = None
+        t.worker_index = None
+        t.docker_assigned = None
+        provider.update(t)
+        restarted.append(t.id)
+    return {'restarted': restarted}
+
+
+def api_dag_remove(data, s):
+    dag_id = int(data['id'])
+    for t in TaskProvider(s).by_dag(dag_id):
+        _stop_task(s, t)
+    DagProvider(s).remove(dag_id)
+    return {'success': True}
+
+
+def api_dag_toggle_report(data, s):
+    """Attach/detach every train task of a dag to a report
+    (reference app.py:561-572)."""
+    from mlcomp_tpu.db.enums import TaskType
+    report = int(data['report'])
+    dag_id = int(data['id'])
+    provider = ReportTasksProvider(s)
+    tasks = [t for t in TaskProvider(s).by_dag(dag_id)
+             if t.type != int(TaskType.Service)]
+    if data.get('remove'):
+        for t in tasks:
+            provider.remove_task(report, t.id)
+    else:
+        existing = set(provider.tasks_of(report))
+        for t in tasks:
+            if t.id not in existing:
+                provider.add_task(report, t.id)
+    return {'success': True}
+
+
+def api_task_toggle_report(data, s):
+    report = int(data['report'])
+    task = int(data['id'])
+    provider = ReportTasksProvider(s)
+    if data.get('remove'):
+        provider.remove_task(report, task)
+    elif task not in provider.tasks_of(report):
+        provider.add_task(report, task)
+    return {'success': True}
+
+
+def api_auxiliary(data, s):
+    return AuxiliaryProvider(s).get()
+
+
+def api_logs(data, s):
+    return LogProvider(s).get(data, _paginator(data))
+
+
+def api_reports(data, s):
+    return ReportProvider(s).get(data, _paginator(data))
+
+
+def api_report(data, s):
+    return ReportProvider(s).detail(int(data['id']))
+
+
+def api_report_update_layout_start(data, s):
+    return ReportProvider(s).update_layout_start(int(data['id']))
+
+
+def api_report_update_layout_end(data, s):
+    ok = ReportProvider(s).update_layout_end(
+        int(data['id']), data['layout'])
+    if not ok:
+        raise ApiError('report not found', status=404)
+    return {'success': True}
+
+
+def api_remove_imgs(data, s):
+    ReportImgProvider(s).remove_with_predicate(data)
+    return {'success': True}
+
+
+def api_remove_files(data, s):
+    dag_id = data.get('dag')
+    if dag_id:
+        s.execute('DELETE FROM dag_storage WHERE dag=?', (dag_id,))
+        s.execute('DELETE FROM file WHERE dag=?', (dag_id,))
+    return {'success': True}
+
+
+def api_stop(data, s):
+    return {'success': True}
+
+
+_ROUTES = {
+    '/api/token': (api_token, False),
+    '/api/computers': (api_computers, True),
+    '/api/projects': (api_projects, True),
+    '/api/project/add': (api_project_add, True),
+    '/api/project/edit': (api_project_edit, True),
+    '/api/project/remove': (api_project_remove, True),
+    '/api/layouts': (api_layouts, True),
+    '/api/layout/add': (api_layout_add, True),
+    '/api/layout/edit': (api_layout_edit, True),
+    '/api/layout/remove': (api_layout_remove, True),
+    '/api/report/add_start': (api_report_add_start, True),
+    '/api/report/add_end': (api_report_add_end, True),
+    '/api/models': (api_models, True),
+    '/api/model/add': (api_model_add, True),
+    '/api/model/remove': (api_model_remove, True),
+    '/api/model/start_begin': (api_model_start_begin, True),
+    '/api/model/start_end': (api_model_start_end, True),
+    '/api/img_classify': (api_img_classify, True),
+    '/api/img_segment': (api_img_segment, True),
+    '/api/config': (api_config, True),
+    '/api/graph': (api_graph, True),
+    '/api/dags': (api_dags, True),
+    '/api/code': (api_code, True),
+    '/api/tasks': (api_tasks, True),
+    '/api/task/stop': (api_task_stop, True),
+    '/api/task/info': (api_task_info, True),
+    '/api/task/steps': (api_task_steps, True),
+    '/api/dag/stop': (api_dag_stop, True),
+    '/api/dag/start': (api_dag_start, True),
+    '/api/dag/remove': (api_dag_remove, True),
+    '/api/dag/toogle_report': (api_dag_toggle_report, True),
+    '/api/task/toogle_report': (api_task_toggle_report, True),
+    '/api/auxiliary': (api_auxiliary, False),
+    '/api/logs': (api_logs, True),
+    '/api/reports': (api_reports, True),
+    '/api/report': (api_report, True),
+    '/api/report/update_layout_start': (api_report_update_layout_start, True),
+    '/api/report/update_layout_end': (api_report_update_layout_end, True),
+    '/api/remove_imgs': (api_remove_imgs, True),
+    '/api/remove_files': (api_remove_files, True),
+    '/api/stop': (api_stop, True),
+}
+
+
+class ApiHandler(BaseHTTPRequestHandler):
+    server_version = 'mlcomp_tpu'
+    protocol_version = 'HTTP/1.1'
+
+    # quiet by default; the daemon's logger records errors
+    def log_message(self, fmt, *args):  # noqa
+        pass
+
+    def _send_json(self, obj, status=200):
+        body = json.dumps(obj, default=str).encode()
+        self.send_response(status)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.send_header('Access-Control-Allow-Origin', '*')
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_bytes(self, content_type, body, disposition=None, status=200):
+        self.send_response(status)
+        self.send_header('Content-Type', content_type)
+        self.send_header('Content-Length', str(len(body)))
+        if disposition:
+            self.send_header('Content-Disposition', disposition)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _authorized(self):
+        return self.headers.get('Authorization', '').strip() == TOKEN
+
+    def _dispatch(self, path, data):
+        route = _ROUTES.get(path)
+        if route is None:
+            self._send_json({'success': False, 'reason': 'not found'}, 404)
+            return
+        handler, needs_auth = route
+        if needs_auth and not self._authorized():
+            self._send_json(
+                {'success': False, 'reason': 'unauthorized'}, 401)
+            return
+        try:
+            res = handler(data, _session())
+        except ApiError as e:
+            self._send_json(
+                {'success': False, 'reason': str(e)}, e.status)
+            return
+        except Exception:
+            # heal-by-recreating-session (reference app.py:91-131) then
+            # report the failure; the next request gets a fresh session
+            _heal_session()
+            err = traceback.format_exc()
+            if getattr(self.server, 'logger', None):
+                try:
+                    self.server.logger.error(
+                        f'api {path} failed:\n{err}', ComponentType.API)
+                except Exception:
+                    pass
+            # tracebacks only to authenticated callers (some routes —
+            # auxiliary, token — are open)
+            reason = err if self._authorized() else 'internal error'
+            self._send_json({'success': False, 'reason': reason}, 500)
+            return
+        if isinstance(res, tuple):  # (content_type, bytes, disposition)
+            self._send_bytes(*res)
+        else:
+            self._send_json(res if res is not None else {'success': True})
+
+    def do_POST(self):  # noqa
+        length = int(self.headers.get('Content-Length') or 0)
+        raw = self.rfile.read(length) if length else b''
+        try:
+            data = json.loads(raw) if raw else {}
+        except ValueError:
+            self._send_json(
+                {'success': False, 'reason': 'invalid json'}, 400)
+            return
+        path = urlparse(self.path).path
+        if path == '/api/shutdown':
+            # reference app.py:725-730; shutdown() must run off the
+            # serving thread or serve_forever deadlocks
+            if not self._authorized():
+                self._send_json(
+                    {'success': False, 'reason': 'unauthorized'}, 401)
+                return
+            self._send_json({'success': True,
+                             'reason': 'server shutting down'})
+            threading.Thread(
+                target=self.server.shutdown, daemon=True).start()
+            return
+        self._dispatch(path, data)
+
+    def do_GET(self):  # noqa
+        parsed = urlparse(self.path)
+        if parsed.path == '/api/code_download':
+            qs = parse_qs(parsed.query)
+            if not self._authorized() and qs.get('token', [''])[0] != TOKEN:
+                self._send_json(
+                    {'success': False, 'reason': 'unauthorized'}, 401)
+                return
+            try:
+                res = api_code_download(
+                    {'id': qs.get('id', ['0'])[0]}, _session())
+                self._send_bytes(*res)
+            except Exception:
+                _heal_session()
+                self._send_json(
+                    {'success': False,
+                     'reason': traceback.format_exc()}, 500)
+            return
+        if parsed.path in ('/', '/ui') or parsed.path.startswith('/ui/'):
+            from mlcomp_tpu.server.front import dashboard_html
+            body = dashboard_html().encode()
+            self._send_bytes('text/html; charset=utf-8', body)
+            return
+        self._send_json({'success': False, 'reason': 'not found'}, 404)
+
+
+class ApiServer:
+    """Threaded HTTP server wrapper with start/stop for tests and the CLI."""
+
+    def __init__(self, host: str = None, port: int = None, logger=None):
+        self.host = host if host is not None else WEB_HOST
+        self.port = port if port is not None else WEB_PORT
+        self.httpd = ThreadingHTTPServer((self.host, self.port), ApiHandler)
+        self.httpd.logger = logger
+        self.port = self.httpd.server_address[1]  # resolved if port=0
+        self._thread = None
+
+    def start_background(self):
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self.httpd.serve_forever()
+
+    def shutdown(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def start_server(host: str = None, port: int = None, logger=None,
+                 with_supervisor: bool = True, background: bool = False):
+    """Migrate, optionally start the supervisor loop in-process (the
+    reference registers it from the Flask process, app.py:736-741), then
+    serve the API."""
+    session = Session.create_session(key=_SESSION_KEY)
+    migrate(session)
+    if with_supervisor:
+        from mlcomp_tpu.server.supervisor import register_supervisor
+        register_supervisor(logger=logger)
+    server = ApiServer(host=host, port=port, logger=logger)
+    if background:
+        return server.start_background()
+    server.serve_forever()
+    return server
+
+
+__all__ = ['ApiServer', 'start_server', 'ApiError']

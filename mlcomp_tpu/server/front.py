@@ -1,0 +1,344 @@
+"""Built-in single-file HTML dashboard — the UI stand-in.
+
+The reference ships a ~9k-line Angular 7 SPA (reference
+mlcomp/server/front/: paginated tables for projects/computers/dags/tasks/
+models/logs/reports, a vis.js DAG graph, plotly metric series, a code
+browser, resource dashboards). Rebuilding Angular is out of scope and
+off-idiom here; instead the server serves one dependency-free HTML page
+(vanilla JS + inline SVG) covering the same read paths and the main
+actions:
+
+- tabs: Dags / Tasks / Computers / Models / Logs / Reports / Supervisor
+  (reference app-routing.module.ts:13-62)
+- DAG detail: layered SVG graph with per-status colors (vis.js parity,
+  front/src/app/dag/dag-detail/graph/), config viewer, code browser
+- task detail: step tree + logs (front/src/app/task/)
+- report detail: metric series as SVG line charts (plotly parity)
+- actions: stop task, stop/start/remove dag (restart-with-resume)
+- token login stored in localStorage; auto-refresh every 5 s
+
+All data comes from the JSON API in server/api.py, same as the
+reference's SPA consumed its Flask endpoints.
+"""
+
+_DASHBOARD = r"""<!doctype html>
+<html><head><meta charset="utf-8"><title>mlcomp_tpu</title>
+<style>
+:root { --bg:#101418; --panel:#1a2129; --text:#d6dde6; --dim:#7b8894;
+  --acc:#4da3ff; --ok:#41c07c; --bad:#e2574c; --warn:#d9a13c; }
+* { box-sizing:border-box; }
+body { margin:0; background:var(--bg); color:var(--text);
+  font:14px/1.45 system-ui,sans-serif; }
+header { display:flex; gap:4px; align-items:center; padding:8px 14px;
+  background:var(--panel); position:sticky; top:0; }
+header h1 { font-size:16px; margin:0 18px 0 0; color:var(--acc); }
+nav button { background:none; border:none; color:var(--dim); padding:6px 12px;
+  cursor:pointer; font:inherit; border-radius:6px; }
+nav button.active { background:var(--bg); color:var(--text); }
+main { padding:14px; }
+table { border-collapse:collapse; width:100%; }
+th,td { text-align:left; padding:5px 10px; border-bottom:1px solid #232c36;
+  vertical-align:top; }
+th { color:var(--dim); font-weight:500; }
+tr.row:hover { background:#1d252f; cursor:pointer; }
+.status { padding:1px 8px; border-radius:9px; font-size:12px; }
+.s-Success { background:#15392a; color:var(--ok); }
+.s-Failed { background:#43211e; color:var(--bad); }
+.s-InProgress { background:#14334d; color:var(--acc); }
+.s-Queued,.s-NotRan { background:#2c2c20; color:var(--warn); }
+.s-Stopped,.s-Skipped { background:#2a2f35; color:var(--dim); }
+.btn { background:#232c36; color:var(--text); border:1px solid #303b46;
+  border-radius:6px; padding:3px 10px; cursor:pointer; font:inherit; }
+.btn:hover { border-color:var(--acc); }
+pre { background:var(--panel); padding:12px; border-radius:8px;
+  overflow:auto; max-height:60vh; }
+.cards { display:flex; gap:12px; flex-wrap:wrap; }
+.card { background:var(--panel); border-radius:10px; padding:12px 16px;
+  min-width:220px; }
+.card h3 { margin:0 0 6px; font-size:14px; }
+.dim { color:var(--dim); }
+svg text { fill:var(--text); font-size:11px; }
+#login { max-width:320px; margin:18vh auto; background:var(--panel);
+  padding:24px; border-radius:12px; }
+input { background:var(--bg); border:1px solid #30383b; color:var(--text);
+  padding:7px 10px; border-radius:6px; width:100%; font:inherit; }
+.charts { display:grid; grid-template-columns:repeat(auto-fill,minmax(380px,1fr));
+  gap:12px; }
+.tree { margin-left:16px; }
+a { color:var(--acc); }
+</style></head><body>
+<header><h1>mlcomp_tpu</h1><nav id="nav"></nav>
+ <span style="flex:1"></span><span id="clock" class="dim"></span></header>
+<main id="main"></main>
+<script>
+'use strict';
+const TABS = ['dags','tasks','computers','models','logs','reports','supervisor'];
+let tab = location.hash.replace('#','') || 'dags';
+let detail = null;          // {kind:'dag'|'task'|'report', id}
+let token = localStorage.getItem('token') || '';
+
+async function api(path, data) {
+  const r = await fetch('/api/' + path, {method:'POST',
+    headers:{'Content-Type':'application/json','Authorization':token},
+    body: JSON.stringify(data || {paginator:{page_number:0,page_size:100}})});
+  if (r.status === 401) { token=''; render(); throw new Error('auth'); }
+  return r.json();
+}
+function h(html) { const t=document.createElement('template');
+  t.innerHTML=html.trim(); return t.content; }
+function esc(s) { return String(s==null?'':s).replace(/[&<>"]/g,
+  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;'}[c])); }
+function badge(s) { return `<span class="status s-${s}">${s}</span>`; }
+
+function nav() {
+  document.getElementById('nav').innerHTML = TABS.map(t =>
+    `<button class="${t===tab?'active':''}" onclick="go('${t}')">${t}</button>`
+  ).join('');
+}
+function go(t) { tab=t; detail=null; location.hash=t; render(); }
+function open_(kind,id) { detail={kind,id}; render(); }
+
+// ------------------------------------------------------------ tab views
+async function viewDags(el) {
+  const res = await api('dags');
+  el.appendChild(h(`<table><tr><th>id</th><th>name</th><th>project</th>
+    <th>tasks</th><th>statuses</th><th>created</th><th></th></tr>` +
+    res.data.map(d => `<tr class="row" onclick="open_('dag',${d.id})">
+      <td>${d.id}</td><td>${esc(d.name)}</td><td>${d.project}</td>
+      <td>${d.task_count}</td>
+      <td>${d.task_statuses.filter(s=>s.count)
+            .map(s=>badge(s.name)+'&times;'+s.count).join(' ')}</td>
+      <td class="dim">${esc(d.created||'')}</td>
+      <td><button class="btn" onclick="event.stopPropagation();
+        dagAction(${d.id},'stop')">stop</button>
+        <button class="btn" onclick="event.stopPropagation();
+        dagAction(${d.id},'start')">restart</button>
+        <button class="btn" onclick="event.stopPropagation();
+        dagAction(${d.id},'remove')">remove</button></td></tr>`).join('')
+    + '</table>'));
+}
+async function dagAction(id, action) {
+  if (action==='remove' && !confirm('remove dag '+id+'?')) return;
+  await api('dag/'+action, {id}); render();
+}
+async function taskStop(id) { await api('task/stop',{id}); render(); }
+
+async function viewTasks(el) {
+  const res = await api('tasks');
+  el.appendChild(h(`<table><tr><th>id</th><th>name</th><th>dag</th>
+    <th>status</th><th>computer</th><th>step</th><th>score</th><th></th></tr>`
+    + res.data.map(t => `<tr class="row" onclick="open_('task',${t.id})">
+      <td>${t.id}</td><td>${esc(t.name)}</td><td>${esc(t.dag_name)}</td>
+      <td>${badge(statusName(t.status))}</td>
+      <td>${esc(t.computer_assigned||'')}</td>
+      <td class="dim">${esc(t.current_step||'')}</td>
+      <td>${t.score==null?'':t.score.toFixed(4)}</td>
+      <td><button class="btn" onclick="event.stopPropagation();
+        taskStop(${t.id})">stop</button></td></tr>`).join('')
+    + '</table>'));
+}
+const STATUS = ['NotRan','Queued','InProgress','Failed','Stopped',
+  'Skipped','Success'];
+function statusName(v) { return typeof v==='number' ? STATUS[v] : v; }
+
+async function viewComputers(el) {
+  const res = await api('computers');
+  el.appendChild(h('<div class="cards">' + res.data.map(c => {
+    const u = c.usage || {};
+    return `<div class="card"><h3>${esc(c.name)}</h3>
+      <div class="dim">${c.cores||0} TPU cores &middot; ${c.cpu||0} cpu
+       &middot; ${(c.memory||0).toFixed ? (c.memory||0).toFixed(1):c.memory} GB</div>
+      <div>cpu ${u.cpu!=null?u.cpu.toFixed(0)+'%':'—'}
+        &middot; mem ${u.memory!=null?u.memory.toFixed(0)+'%':'—'}
+        &middot; hbm ${u.tpu_hbm!=null?u.tpu_hbm.toFixed(0)+'%':'—'}</div>
+      <div class="dim">last activity: ${esc(c.last_activity||'')}</div>
+      </div>`; }).join('') + '</div>'));
+}
+
+async function viewModels(el) {
+  const res = await api('models');
+  el.appendChild(h(`<table><tr><th>id</th><th>name</th><th>project</th>
+    <th>score local</th><th>score public</th><th>created</th></tr>` +
+    res.data.map(m => `<tr><td>${m.id}</td><td>${esc(m.name)}</td>
+      <td>${m.project}</td><td>${m.score_local==null?'':m.score_local}</td>
+      <td>${m.score_public==null?'':m.score_public}</td>
+      <td class="dim">${esc(m.created||'')}</td></tr>`).join('')
+    + '</table>'));
+}
+
+async function viewLogs(el) {
+  const res = await api('logs');
+  el.appendChild(h(`<table><tr><th>time</th><th>level</th><th>component</th>
+    <th>computer</th><th>task</th><th>message</th></tr>` +
+    res.data.map(l => `<tr><td class="dim">${esc(l.time)}</td>
+      <td>${esc(l.level_name)}</td><td>${esc(l.component_name)}</td>
+      <td>${esc(l.computer||'')}</td><td>${l.task||''}</td>
+      <td><pre style="margin:0;max-height:120px">${esc(l.message)}</pre></td>
+      </tr>`).join('') + '</table>'));
+}
+
+async function viewReports(el) {
+  const res = await api('reports');
+  el.appendChild(h(`<table><tr><th>id</th><th>name</th><th>tasks</th>
+    <th>layout</th><th>time</th></tr>` +
+    res.data.map(r => `<tr class="row" onclick="open_('report',${r.id})">
+      <td>${r.id}</td><td>${esc(r.name)}</td><td>${r.tasks_count}</td>
+      <td>${esc(r.layout||'')}</td>
+      <td class="dim">${esc(r.time||'')}</td></tr>`).join('')
+    + '</table>'));
+}
+
+async function viewSupervisor(el) {
+  const res = await api('auxiliary');
+  el.appendChild(h('<pre>'+esc(JSON.stringify(res,null,2))+'</pre>'));
+}
+
+// ---------------------------------------------------------- detail views
+function layerGraph(nodes, edges) {
+  // longest-path layering, then grid placement — vis.js-like output
+  const level = {}; const inc = {};
+  nodes.forEach(n => { level[n.id]=0; inc[n.id]=[]; });
+  edges.forEach(e => inc[e.to] && inc[e.to].push(e.from));
+  for (let i=0;i<nodes.length;i++)
+    edges.forEach(e => { if (level[e.from]!=null && level[e.to]!=null &&
+      level[e.to] < level[e.from]+1) level[e.to]=level[e.from]+1; });
+  const byLevel = {};
+  nodes.forEach(n => (byLevel[level[n.id]] ||= []).push(n));
+  const W=190, H=74, pos={};
+  Object.entries(byLevel).forEach(([lv,ns]) => ns.forEach((n,i) =>
+    pos[n.id]={x:30+i*W, y:30+lv*H}));
+  const width = Math.max(...Object.values(pos).map(p=>p.x))+W,
+        height = Math.max(...Object.values(pos).map(p=>p.y))+H;
+  const color = {Success:'#41c07c',Failed:'#e2574c',InProgress:'#4da3ff',
+    Queued:'#d9a13c',NotRan:'#d9a13c',Stopped:'#7b8894',Skipped:'#7b8894'};
+  let svg = `<svg width="${width}" height="${height}">`;
+  edges.forEach(e => { const a=pos[e.from], b=pos[e.to]; if(!a||!b) return;
+    svg += `<line x1="${a.x+70}" y1="${a.y+22}" x2="${b.x+70}" y2="${b.y}"
+      stroke="${color[e.status]||'#555'}" stroke-width="1.5"
+      marker-end="url(#arr)"/>`; });
+  svg += `<defs><marker id="arr" markerWidth="8" markerHeight="8" refX="7"
+    refY="3" orient="auto"><path d="M0,0 L7,3 L0,6" fill="none"
+    stroke="#667"/></marker></defs>`;
+  nodes.forEach(n => { const p=pos[n.id];
+    svg += `<g onclick="open_('task',${n.id})" style="cursor:pointer">
+      <rect x="${p.x}" y="${p.y}" rx="7" width="150" height="44"
+        fill="#1a2129" stroke="${color[n.status]||'#555'}"/>
+      <text x="${p.x+8}" y="${p.y+17}">${esc(n.label.split('\n')[0]).slice(0,20)}</text>
+      <text x="${p.x+8}" y="${p.y+33}" fill="#7b8894">${n.status} #${n.id}</text>
+      </g>`; });
+  return svg + '</svg>';
+}
+
+async function viewDagDetail(el, id) {
+  const [g, cfg, code] = await Promise.all([
+    api('graph',{id}), api('config',{id}), api('code',{id})]);
+  el.appendChild(h(`<p><a href="#" onclick="detail=null;render();return false">
+    &larr; back</a> &nbsp; <b>dag ${id}</b></p>`));
+  el.appendChild(h('<div class="card" style="overflow:auto">' +
+    layerGraph(g.nodes, g.edges) + '</div>'));
+  el.appendChild(h('<h3>config</h3><pre>'+esc(cfg.data)+'</pre>'));
+  const tree = (items) => '<div class="tree">' + items.map(it =>
+    it.children.length ? `<div>&#128193; ${esc(it.name)}${tree(it.children)}</div>`
+    : `<div>&#128196; <a href="#" onclick="showCode(this.dataset.c);return false"
+        data-c="${esc(encodeURIComponent(it.content||''))}">${esc(it.name)}</a></div>`
+  ).join('') + '</div>';
+  el.appendChild(h('<h3>code</h3>' + tree(code.items) +
+    '<pre id="codeview" class="dim">select a file…</pre>'));
+}
+function showCode(c) {
+  document.getElementById('codeview').textContent = decodeURIComponent(c);
+}
+
+async function viewTaskDetail(el, id) {
+  const [info, steps, logs] = await Promise.all([
+    api('task/info',{id}), api('task/steps',{id}),
+    api('logs',{task:id, paginator:{page_number:0,page_size:50}})]);
+  el.appendChild(h(`<p><a href="#" onclick="detail=null;render();return false">
+    &larr; back</a> &nbsp; <b>task ${id}</b></p>`));
+  el.appendChild(h('<pre>'+esc(JSON.stringify(info,null,2))+'</pre>'));
+  const tree = (nodes) => '<div class="tree">' + nodes.map(s =>
+    `<div>&#9656; ${esc(s.name)} <span class="dim">${esc(s.started||'')}
+     ${s.finished?'&rarr; '+esc(s.finished):''}</span>
+     ${s.log_statuses.filter(x=>x.count).map(x=>x.name+':'+x.count).join(' ')}
+     ${tree(s.children)}</div>`).join('') + '</div>';
+  el.appendChild(h('<h3>steps</h3>' + tree(steps.data)));
+  el.appendChild(h('<h3>logs</h3><table>' + logs.data.map(l =>
+    `<tr><td class="dim">${esc(l.time)}</td><td>${esc(l.level_name)}</td>
+     <td><pre style="margin:0">${esc(l.message)}</pre></td></tr>`).join('')
+    + '</table>'));
+}
+
+function lineChart(name, part, points) {
+  const w=360, hgt=180, pad=34;
+  const xs = points.map(p=>p.epoch), ys = points.map(p=>p.value);
+  const x0=Math.min(...xs), x1=Math.max(...xs,x0+1);
+  const y0=Math.min(...ys), y1=Math.max(...ys,y0+1e-9);
+  const X=e=>pad+(e-x0)/(x1-x0)*(w-pad-10);
+  const Y=v=>hgt-pad+ (y0===y1?0:-(v-y0)/(y1-y0)*(hgt-pad-16));
+  const byTask = {};
+  points.forEach(p => (byTask[p.task_name||p.task] ||= []).push(p));
+  const colors=['#4da3ff','#41c07c','#d9a13c','#e2574c','#b07fe8','#5bc8c8'];
+  let svg = `<svg width="${w}" height="${hgt}">
+    <text x="8" y="14">${esc(name)} / ${esc(part)}</text>
+    <text x="8" y="${hgt-6}" fill="#7b8894">${y0.toPrecision(4)}..${y1.toPrecision(4)}</text>`;
+  Object.values(byTask).forEach((pts,i) => {
+    const d = pts.map((p,j)=>(j?'L':'M')+X(p.epoch)+','+Y(p.value)).join(' ');
+    svg += `<path d="${d}" fill="none" stroke="${colors[i%6]}" stroke-width="1.6"/>`;
+  });
+  return '<div class="card">'+svg+'</svg></div>';
+}
+
+async function viewReportDetail(el, id) {
+  const res = await api('report',{id});
+  el.appendChild(h(`<p><a href="#" onclick="detail=null;render();return false">
+    &larr; back</a> &nbsp; <b>report ${id}</b></p>`));
+  el.appendChild(h('<div class="charts">' + res.series.map(s =>
+    lineChart(s.name, s.part, s.data)).join('') + '</div>'));
+}
+
+// --------------------------------------------------------------- render
+const VIEWS = {dags:viewDags, tasks:viewTasks, computers:viewComputers,
+  models:viewModels, logs:viewLogs, reports:viewReports,
+  supervisor:viewSupervisor};
+
+async function render() {
+  nav();
+  const el = document.getElementById('main');
+  el.innerHTML = '';
+  if (!token) {
+    el.appendChild(h(`<div id="login"><h3>token</h3>
+      <input id="tok" type="password" placeholder="access token">
+      <br><br><button class="btn" onclick="login()">enter</button></div>`));
+    return;
+  }
+  try {
+    if (detail && detail.kind==='dag') await viewDagDetail(el, detail.id);
+    else if (detail && detail.kind==='task') await viewTaskDetail(el, detail.id);
+    else if (detail && detail.kind==='report') await viewReportDetail(el, detail.id);
+    else await VIEWS[tab](el);
+  } catch (e) {
+    if (e.message !== 'auth')
+      el.appendChild(h('<pre>'+esc(e.stack||e)+'</pre>'));
+  }
+}
+async function login() {
+  const t = document.getElementById('tok').value.trim();
+  const r = await fetch('/api/token', {method:'POST',
+    headers:{'Content-Type':'application/json'},
+    body: JSON.stringify({token:t})});
+  if (r.ok) { token=t; localStorage.setItem('token',t); render(); }
+  else alert('invalid token');
+}
+setInterval(() => { document.getElementById('clock').textContent =
+  new Date().toLocaleTimeString(); }, 1000);
+setInterval(() => { if (token && !detail) render(); }, 5000);
+render();
+</script></body></html>
+"""
+
+
+def dashboard_html() -> str:
+    return _DASHBOARD
+
+
+__all__ = ['dashboard_html']

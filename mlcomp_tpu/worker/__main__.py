@@ -341,12 +341,15 @@ def start(n_workers, in_process):
         for i in range(n_workers)
     ]
     children = {}
+    spawned_at = {}
+    fail_streak = [0] * len(specs)
 
     def spawn(spec_idx):
         spec = specs[spec_idx]
         proc = subprocess.Popen(
             [sys.executable, '-m', 'mlcomp_tpu.worker'] + spec)
         children[proc.pid] = (proc, spec_idx)
+        spawned_at[spec_idx] = time.time()
         return proc
 
     for i in range(len(specs)):
@@ -365,8 +368,15 @@ def start(n_workers, in_process):
             for pid, (proc, idx) in list(children.items()):
                 if proc.poll() is not None:
                     del children[pid]
+                    # crash-loop backoff (supervisord startretries parity)
+                    fast = time.time() - spawned_at[idx] < 10
+                    fail_streak[idx] = fail_streak[idx] + 1 if fast else 0
+                    delay = min(30, 2 ** fail_streak[idx]) if fast else 0
                     print(f'child {specs[idx]} exited '
-                          f'({proc.returncode}); restarting')
+                          f'({proc.returncode}); restarting'
+                          + (f' in {delay}s' if delay else ''))
+                    if delay:
+                        time.sleep(delay)
                     spawn(idx)
     except KeyboardInterrupt:
         shutdown()

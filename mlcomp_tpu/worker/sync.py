@@ -10,7 +10,6 @@ fallback covers genuinely disjoint hosts when the binary exists.
 
 import os
 import shutil
-import socket
 import subprocess
 
 from mlcomp_tpu import DATA_FOLDER, MODEL_FOLDER
@@ -18,7 +17,7 @@ from mlcomp_tpu.db.core import Session
 from mlcomp_tpu.db.providers import (
     ComputerProvider, ProjectProvider, TaskSyncedProvider
 )
-from mlcomp_tpu.utils.misc import now
+from mlcomp_tpu.utils.misc import hostname, now
 
 
 def _same_file_tree(a: str, b: str) -> bool:

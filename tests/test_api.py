@@ -1,0 +1,363 @@
+"""Server API round-trip tests (parity: reference server/back/app.py:31-748).
+
+Every endpoint family gets a real HTTP request against a live
+ThreadingHTTPServer on an ephemeral port — auth, pagination, DAG detail
+payloads, stop/restart-with-resume semantics, and the built-in dashboard.
+"""
+
+import io
+import json
+import urllib.error
+import urllib.request
+import zipfile
+
+import pytest
+
+from mlcomp_tpu import TOKEN
+from mlcomp_tpu.db.enums import TaskStatus
+from mlcomp_tpu.db.models import ReportImg, Task
+from mlcomp_tpu.db.providers import (
+    ProjectProvider, ReportImgProvider, ReportProvider, TaskProvider
+)
+from mlcomp_tpu.server.api import ApiServer
+from mlcomp_tpu.server.create_dags.standard import dag_standard
+from mlcomp_tpu.utils.io import yaml_load
+from mlcomp_tpu.utils.misc import now
+
+from tests.test_executors import EXPDIR_CODE, EXPDIR_CONFIG
+
+
+@pytest.fixture()
+def api(session):
+    server = ApiServer(host='127.0.0.1', port=0).start_background()
+    base = f'http://127.0.0.1:{server.port}'
+
+    def call(path, data=None, token=TOKEN, method='POST', raw=False):
+        url = base + path
+        if method == 'GET':
+            req = urllib.request.Request(url)
+        else:
+            body = json.dumps(data or {}).encode()
+            req = urllib.request.Request(
+                url, data=body,
+                headers={'Content-Type': 'application/json'})
+        if token is not None:
+            req.add_header('Authorization', token)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            payload = resp.read()
+            return payload if raw else json.loads(payload)
+
+    call.base = base
+    call.session = session
+    yield call
+    server.shutdown()
+
+
+@pytest.fixture()
+def dag(session, tmp_path):
+    folder = tmp_path / 'exp'
+    folder.mkdir()
+    (folder / 'config.yml').write_text(EXPDIR_CONFIG)
+    (folder / 'executors.py').write_text(EXPDIR_CODE)
+    config = yaml_load(EXPDIR_CONFIG)
+    dag_row, tasks = dag_standard(
+        session, config, config_text=EXPDIR_CONFIG,
+        upload_folder=str(folder))
+    return dag_row, tasks
+
+
+class TestAuth:
+    def test_token_valid(self, api):
+        assert api('/api/token', {'token': TOKEN})['success']
+
+    def test_token_invalid(self, api):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/api/token', {'token': 'wrong'})
+        assert e.value.code == 401
+
+    def test_endpoints_require_auth(self, api):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/api/dags', token='bad-token')
+        assert e.value.code == 401
+
+    def test_auxiliary_is_open(self, api):
+        # reference app.py:555-558 serves auxiliary without auth
+        assert isinstance(api('/api/auxiliary', token=None), dict)
+
+    def test_unknown_route_404(self, api):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/api/definitely_not_there')
+        assert e.value.code == 404
+
+
+class TestProjects:
+    def test_crud(self, api):
+        api('/api/project/add', {'name': 'proj_api'})
+        res = api('/api/projects')
+        names = [p['name'] for p in res['data']]
+        assert 'proj_api' in names
+        pid = next(p['id'] for p in res['data'] if p['name'] == 'proj_api')
+        api('/api/project/edit', {'id': pid, 'name': 'proj_api2'})
+        res = api('/api/projects')
+        assert 'proj_api2' in [p['name'] for p in res['data']]
+        api('/api/project/remove', {'id': pid})
+        res = api('/api/projects')
+        assert 'proj_api2' not in [p['name'] for p in res['data']]
+
+
+class TestDags:
+    def test_dags_list(self, api, dag):
+        res = api('/api/dags')
+        assert res['total'] >= 1
+        item = res['data'][0]
+        assert item['task_count'] == 2
+        assert any(s['name'] == 'NotRan' and s['count'] == 2
+                   for s in item['task_statuses'])
+
+    def test_config(self, api, dag):
+        res = api('/api/config', {'id': dag[0].id})
+        assert 'executors' in res['data']
+
+    def test_graph(self, api, dag):
+        res = api('/api/graph', {'id': dag[0].id})
+        assert len(res['nodes']) == 2
+        assert len(res['edges']) == 1
+        statuses = {n['status'] for n in res['nodes']}
+        assert statuses == {'NotRan'}
+
+    def test_code_tree(self, api, dag):
+        res = api('/api/code', {'id': dag[0].id})
+        names = [i['name'] for i in res['items']]
+        assert 'config.yml' in names
+        assert 'executors.py' in names
+        code = next(i for i in res['items'] if i['name'] == 'executors.py')
+        assert 'WriteMarker' in code['content']
+
+    def test_code_download_zip(self, api, dag):
+        raw = api(f'/api/code_download?id={dag[0].id}', method='GET',
+                  raw=True)
+        zf = zipfile.ZipFile(io.BytesIO(raw))
+        assert 'executors.py' in zf.namelist()
+        assert b'WriteMarker' in zf.read('executors.py')
+
+    def test_dag_stop(self, api, dag):
+        res = api('/api/dag/stop', {'id': dag[0].id})
+        statuses = [s for s in res['dag']['task_statuses'] if s['count']]
+        assert all(s['name'] == 'Stopped' for s in statuses)
+
+    def test_dag_remove(self, api, dag):
+        api('/api/dag/remove', {'id': dag[0].id})
+        res = api('/api/dags')
+        assert dag[0].id not in [d['id'] for d in res['data']]
+
+
+class TestTasks:
+    def test_tasks_list(self, api, dag):
+        res = api('/api/tasks')
+        assert res['total'] == 2
+        assert {t['name'] for t in res['data']} == {'write', 'check'}
+
+    def test_task_info_and_steps(self, api, dag):
+        tid = dag[1]['write'][0]
+        info = api('/api/task/info', {'id': tid})
+        assert info['id'] == tid
+        steps = api('/api/task/steps', {'id': tid})
+        assert steps['data'] == []
+
+    def test_task_stop(self, api, dag):
+        tid = dag[1]['write'][0]
+        res = api('/api/task/stop', {'id': tid})
+        assert res['status'] == 'stopped'
+        task = TaskProvider(api.session).by_id(tid)
+        assert task.status == int(TaskStatus.Stopped)
+
+    def test_logs(self, api, dag):
+        res = api('/api/logs')
+        assert 'data' in res and 'total' in res
+
+
+class TestDagStartResume:
+    def test_failed_task_reset_with_resume(self, api, dag):
+        provider = TaskProvider(api.session)
+        tid = dag[1]['write'][0]
+        task = provider.by_id(tid)
+        task.computer_assigned = 'host_a'
+        task.pid = 4242
+        provider.update(task)
+        provider.change_status(task, TaskStatus.Failed)
+
+        res = api('/api/dag/start', {'id': dag[0].id})
+        assert tid in res['restarted']
+        task = provider.by_id(tid)
+        assert task.status == int(TaskStatus.NotRan)
+        assert task.pid is None
+        assert task.computer_assigned is None
+        info = yaml_load(task.additional_info)
+        assert info['resume'] == {
+            'master_computer': 'host_a', 'master_task_id': tid,
+            'load_last': True}
+
+    def test_distributed_master_discovery(self, api, dag):
+        provider = TaskProvider(api.session)
+        tid = dag[1]['write'][0]
+        parent = provider.by_id(tid)
+        provider.change_status(parent, TaskStatus.Failed)
+        # two service children, ranks 1 and 0 — resume must find rank 0
+        for idx, (comp, rank) in enumerate(
+                [('host_b', 1), ('host_a', 0)]):
+            child = Task(
+                name=f'svc{idx}', executor='svc', dag=dag[0].id, parent=tid,
+                computer_assigned=comp, status=int(TaskStatus.Failed),
+                additional_info=json.dumps(
+                    {'distr_info': {'process_index': rank}}),
+                last_activity=now())
+            provider.add(child)
+        api('/api/dag/start', {'id': dag[0].id})
+        info = yaml_load(provider.by_id(tid).additional_info)
+        assert info['resume']['master_computer'] == 'host_a'
+        assert info['resume']['load_last'] is True
+
+
+class TestRestartResumeEndToEnd:
+    def test_killed_training_resumes_from_checkpoint(
+            self, api, session, tmp_path):
+        """VERDICT r1 item 2 'done' criterion: a killed training task,
+        restarted via /api/dag/start, resumes from its checkpoint instead
+        of retraining (reference app.py:488-552 + catalyst resume)."""
+        from mlcomp_tpu.worker.tasks import execute_by_id
+
+        folder = tmp_path / 'exp'
+        folder.mkdir()
+        config = {
+            'info': {'name': 'resume_dag', 'project': 'p_resume'},
+            'executors': {
+                'train': {
+                    'type': 'jax_train',
+                    'model': {'name': 'mlp', 'num_classes': 4,
+                              'hidden': [16], 'dtype': 'float32'},
+                    'dataset': {'name': 'synthetic_images',
+                                'n_train': 128, 'n_valid': 64,
+                                'image_size': 8, 'channels': 1,
+                                'num_classes': 4},
+                    'batch_size': 32,
+                    'stages': [{'name': 's1', 'epochs': 1}],
+                },
+            },
+        }
+        dag_row, tasks = dag_standard(session, config,
+                                      upload_folder=str(folder))
+        tid = tasks['train'][0]
+        execute_by_id(tid, exit=False, folder=str(folder), session=session)
+        provider = TaskProvider(session)
+        task = provider.by_id(tid)
+        assert task.status == int(TaskStatus.Success)
+
+        # simulate a crash after the checkpoint was written
+        provider.change_status(task, TaskStatus.Failed)
+        res = api('/api/dag/start', {'id': dag_row.id})
+        assert tid in res['restarted']
+        task = provider.by_id(tid)
+        assert task.status == int(TaskStatus.NotRan)
+
+        # re-execute: resume_plan finds everything done → zero epochs run
+        execute_by_id(tid, exit=False, folder=str(folder), session=session)
+        task = provider.by_id(tid)
+        assert task.status == int(TaskStatus.Success)
+        result = yaml_load(task.result)
+        assert result['samples_per_sec'] == 0  # resumed, not retrained
+        assert result['best_score'] is not None
+
+
+class TestLayoutsReports:
+    def test_layouts_seeded(self, api):
+        res = api('/api/layouts')
+        assert 'base' in [l['name'] for l in res['data']]
+
+    def test_layout_crud(self, api):
+        api('/api/layout/add',
+            {'name': 'mine', 'content': 'layout: []\n'})
+        assert 'mine' in [l['name'] for l in api('/api/layouts')['data']]
+        api('/api/layout/edit',
+            {'name': 'mine', 'content': 'layout: [{type: series}]\n'})
+        api('/api/layout/remove', {'name': 'mine'})
+        assert 'mine' not in [l['name'] for l in api('/api/layouts')['data']]
+
+    def test_report_add_and_detail(self, api, dag):
+        start = api('/api/report/add_start')
+        assert 'base' in start['layouts']
+        pid = ProjectProvider(api.session).by_name('test_exec_proj').id
+        api('/api/report/add_end',
+            {'name': 'rep1', 'project': pid, 'layout': 'base'})
+        reports = api('/api/reports')
+        assert 'rep1' in [r['name'] for r in reports['data']]
+        rid = next(r['id'] for r in reports['data'] if r['name'] == 'rep1')
+
+        # attach the dag's tasks, then detail shows them
+        api('/api/dag/toogle_report', {'id': dag[0].id, 'report': rid})
+        detail = api('/api/report', {'id': rid})
+        assert set(detail['tasks']) == set(
+            t.id for t in TaskProvider(api.session).by_dag(dag[0].id))
+
+        # detach one task
+        tid = dag[1]['write'][0]
+        api('/api/task/toogle_report',
+            {'id': tid, 'report': rid, 'remove': True})
+        detail = api('/api/report', {'id': rid})
+        assert tid not in detail['tasks']
+
+    def test_update_layout(self, api, dag):
+        pid = ProjectProvider(api.session).by_name('test_exec_proj').id
+        ReportProvider(api.session).add(
+            __import__('mlcomp_tpu.db.models', fromlist=['Report'])
+            .Report(name='r2', project=pid, config='', layout='base',
+                    time=now()))
+        rid = api('/api/reports')['data'][0]['id']
+        start = api('/api/report/update_layout_start', {'id': rid})
+        assert 'base' in start['layouts']
+        api('/api/report/update_layout_end',
+            {'id': rid, 'layout': 'base'})
+        detail = api('/api/report', {'id': rid})
+        assert detail['layout'].get('items')
+
+
+class TestImgs:
+    def test_img_classify_and_confusion(self, api, dag):
+        tid = dag[1]['write'][0]
+        provider = ReportImgProvider(api.session)
+        for y, y_pred in [(0, 0), (0, 1), (1, 1)]:
+            provider.add(ReportImg(
+                group='test', task=tid, dag=dag[0].id,
+                img=b'\x89PNG-fake', y=y, y_pred=y_pred, part='valid'))
+        res = api('/api/img_classify', {'task': tid})
+        assert res['total'] == 3
+        assert res['data'][0]['img']  # base64
+        assert res['confusion']['matrix'] == [[1, 1], [0, 1]]
+
+        api('/api/remove_imgs', {'task': tid})
+        assert api('/api/img_classify', {'task': tid})['total'] == 0
+
+
+class TestComputersModels:
+    def test_computers(self, api):
+        assert api('/api/computers')['data'] == []
+
+    def test_models(self, api):
+        assert api('/api/models')['total'] == 0
+
+
+class TestFrontend:
+    def test_dashboard_served(self, api):
+        raw = api('/', method='GET', raw=True, token=None)
+        assert b'mlcomp_tpu' in raw
+        assert b'<html' in raw
+
+
+class TestShutdown:
+    def test_shutdown_requires_auth(self, api):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/api/shutdown', token='bad')
+        assert e.value.code == 401
+
+    def test_shutdown(self, api):
+        res = api('/api/shutdown')
+        assert res['success']
