@@ -80,6 +80,12 @@ def execute(config, params):
     provider = TaskProvider(session)
     folder = os.path.dirname(os.path.abspath(config)) or '.'
 
+    # debug mode runs tasks in the config folder — give it the same
+    # data/ models/ symlinks a downloaded task folder gets so relative
+    # data/... paths behave identically in both modes
+    from mlcomp_tpu.worker.storage import link_project_folders
+    link_project_folders(folder, cfg['info']['project'])
+
     # topological order = creation order (builder creates deps first)
     all_ids = sorted(tid for ids in tasks.values() for tid in ids)
     for task_id in all_ids:

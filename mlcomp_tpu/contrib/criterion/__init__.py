@@ -1,0 +1,5 @@
+from mlcomp_tpu.contrib.criterion.losses import (
+    bce_dice, dice_loss, focal_loss, ring_penalty,
+)
+
+__all__ = ['dice_loss', 'focal_loss', 'bce_dice', 'ring_penalty']

@@ -1,0 +1,7 @@
+from mlcomp_tpu.contrib.dataset.classify import (
+    ImageDataset, NpzDataset, apply_fold_filter, balance_max_count,
+)
+from mlcomp_tpu.contrib.dataset.segment import ImageWithMaskDataset
+
+__all__ = ['ImageDataset', 'NpzDataset', 'ImageWithMaskDataset',
+           'apply_fold_filter', 'balance_max_count']

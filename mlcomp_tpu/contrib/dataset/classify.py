@@ -1,0 +1,160 @@
+"""Classification datasets (parity: reference contrib/dataset/classify.py:17-135).
+
+TPU-first restructuring: the reference wraps torch ``Dataset`` objects
+yielding one transformed sample at a time; here a dataset materialises
+**dense numpy arrays** (or memory-mapped views) that the batch pipeline
+shuffles, augments per-epoch on the host, and device_puts with a
+NamedSharding — per-sample Python in the inner loop is exactly what
+stalls an MXU. Fold-csv filtering, class-balanced ``max_count``, and
+file readers keep the reference's semantics.
+"""
+
+import os
+from numbers import Number
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+
+def _read_image(path: str, gray_scale: bool = False) -> np.ndarray:
+    ext = os.path.splitext(path)[1].lower()
+    if ext == '.npy':
+        return np.load(path)
+    import cv2
+    flag = cv2.IMREAD_GRAYSCALE if gray_scale else cv2.IMREAD_COLOR
+    img = cv2.imread(path, flag)
+    if img is None:
+        raise FileNotFoundError(f'could not read image {path!r}')
+    if not gray_scale:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    return img
+
+
+def apply_fold_filter(rows, fold_csv: Optional[str],
+                      fold_number: Optional[int], is_test: bool):
+    """fold==k is validation, rest is train (reference
+    contrib/dataset/classify.py:37-45)."""
+    if not fold_csv:
+        return rows
+    import pandas as pd
+    df = pd.read_csv(fold_csv)
+    if rows is None:
+        rows = df.to_dict(orient='records')
+        if fold_number is None:
+            return rows
+        keep = (df['fold'] == fold_number) if is_test \
+            else (df['fold'] != fold_number)
+        return [r for r, k in zip(rows, keep) if k]
+    folds = np.asarray(df['fold'])
+    keep = (folds == fold_number) if is_test else (folds != fold_number)
+    return [r for r, k in zip(rows, keep) if k]
+
+
+def balance_max_count(rows: list, max_count, label_key: str = 'label'):
+    """Class-balanced truncation: list-form max_count keeps classes in
+    the given ratio anchored at the scarcest class (reference
+    contrib/dataset/classify.py:59-73)."""
+    if max_count is None:
+        return rows
+    if isinstance(max_count, Number):
+        return rows[:int(max_count)]
+    by_label = {}
+    for row in rows:
+        by_label.setdefault(int(row[label_key]), []).append(row)
+    ratios = list(max_count)
+    min_cls = int(np.argmin(ratios))
+    base = len(by_label.get(min_cls, ()))
+    out = []
+    for cls in sorted(by_label):
+        want = int(base * ratios[cls] / ratios[min_cls]) \
+            if cls < len(ratios) else len(by_label[cls])
+        out.extend(by_label[cls][:want])
+    return out
+
+
+class ImageDataset:
+    """Folder-of-images + fold-csv classification dataset.
+
+    ``arrays()`` returns (x: float32 NHWC, y: int32 N) ready for the
+    training pipeline; images load lazily on first access and cache.
+    """
+
+    def __init__(self, *, img_folder: str, fold_csv: str = None,
+                 fold_number: int = None, is_test: bool = False,
+                 gray_scale: bool = False, max_count=None,
+                 transforms=None,
+                 postprocess_func: Callable[[dict], dict] = None):
+        self.img_folder = img_folder
+        if fold_csv:
+            rows = apply_fold_filter(None, fold_csv, fold_number, is_test)
+        else:
+            rows = [{'image': f} for f in sorted(os.listdir(img_folder))]
+        rows = balance_max_count(rows, max_count)
+        self.rows = rows
+        self.gray_scale = gray_scale
+        self.transforms = transforms
+        self.postprocess_func = postprocess_func
+        self._cache = None
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __getitem__(self, i: int) -> dict:
+        row = self.rows[i]
+        img = _read_image(os.path.join(self.img_folder, row['image']),
+                          self.gray_scale)
+        item = {'features': img.astype(np.float32),
+                'image_name': row['image']}
+        if 'label' in row:
+            item['targets'] = int(row['label'])
+        if self.transforms is not None:
+            item['features'], _ = self.transforms(item['features'])
+        if self.postprocess_func is not None:
+            item = self.postprocess_func(item)
+        return item
+
+    def arrays(self):
+        """Dense (x, y) for the TPU pipeline; y is None for unlabeled."""
+        if self._cache is None:
+            xs = [self[i]['features'] for i in range(len(self))]
+            x = np.stack(xs).astype(np.float32)
+            y = None
+            if self.rows and 'label' in self.rows[0]:
+                y = np.array([int(r['label']) for r in self.rows],
+                             np.int32)
+            self._cache = (x, y)
+        return self._cache
+
+
+class NpzDataset:
+    """Array-file dataset with the same fold semantics — the fast path
+    when data is already dense (x: NHWC, y: N, optional fold column)."""
+
+    def __init__(self, *, path: str, fold_csv: str = None,
+                 fold_number: int = None, is_test: bool = False,
+                 x_key: str = 'x', y_key: str = 'y', max_count=None):
+        data = np.load(path)
+        x = data[x_key]
+        y = data[y_key] if y_key in data else None
+        keep = np.ones(len(x), bool)
+        if fold_csv and fold_number is not None:
+            import pandas as pd
+            folds = np.asarray(pd.read_csv(fold_csv)['fold'])
+            keep = (folds == fold_number) if is_test \
+                else (folds != fold_number)
+        self.x = x[keep].astype(np.float32)
+        self.y = None if y is None else np.asarray(y)[keep].astype(np.int32)
+        if isinstance(max_count, Number):
+            self.x = self.x[:int(max_count)]
+            if self.y is not None:
+                self.y = self.y[:int(max_count)]
+
+    def __len__(self):
+        return len(self.x)
+
+    def arrays(self):
+        return self.x, self.y
+
+
+__all__ = ['ImageDataset', 'NpzDataset', 'apply_fold_filter',
+           'balance_max_count']

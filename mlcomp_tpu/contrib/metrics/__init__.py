@@ -1,0 +1,6 @@
+from mlcomp_tpu.contrib.metrics.numpy_metrics import (
+    accuracy, confusion_matrix, dice_numpy, f1_macro, iou_numpy,
+)
+
+__all__ = ['dice_numpy', 'iou_numpy', 'accuracy', 'f1_macro',
+           'confusion_matrix']

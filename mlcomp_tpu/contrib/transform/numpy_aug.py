@@ -1,0 +1,152 @@
+"""Host-side numpy augmentations (parity: reference
+contrib/transform/albumentations.py + the albumentations dependency).
+
+TPU-first split of responsibilities: augmentation runs on the HOST in
+numpy over HWC uint8/float arrays (cheap, overlappable with device
+compute via the prefetcher in train/data.py); normalization and dtype
+casts run ON DEVICE where they fuse into the first conv. Each transform
+is a callable ``(image, mask=None) -> (image, mask)``; ``p`` gates
+random application. Batched variants operate on NHWC.
+"""
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class Transform:
+    p = 1.0
+
+    def apply(self, img, rng):
+        return img
+
+    def apply_mask(self, mask, rng):
+        return mask
+
+    def __call__(self, img, mask=None, rng: Optional[np.random.RandomState]
+                 = None):
+        rng = rng or np.random
+        if self.p >= 1.0 or rng.rand() < self.p:
+            # one draw consumed per transform so img/mask stay aligned
+            state = rng.randint(0, 2 ** 31)
+            img = self.apply(img, np.random.RandomState(state))
+            if mask is not None:
+                mask = self.apply_mask(mask, np.random.RandomState(state))
+        return img, mask
+
+
+class Compose(Transform):
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+
+    def __call__(self, img, mask=None, rng=None):
+        for t in self.transforms:
+            img, mask = t(img, mask, rng)
+        return img, mask
+
+
+class HorizontalFlip(Transform):
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def apply(self, img, rng):
+        return img[..., ::-1, :] if img.ndim == 3 else img[..., ::-1]
+
+    apply_mask = apply
+
+
+class VerticalFlip(Transform):
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def apply(self, img, rng):
+        return img[::-1] if img.ndim <= 3 else img[:, ::-1]
+
+    apply_mask = apply
+
+
+class Transpose(Transform):
+    """Swap H and W."""
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def apply(self, img, rng):
+        axes = (1, 0, 2) if img.ndim == 3 else (1, 0)
+        return np.transpose(img, axes)
+
+    apply_mask = apply
+
+
+class PadCrop(Transform):
+    """Reflect-pad by ``pad`` then take a random crop back to the original
+    size — the standard CIFAR augmentation (pad 4, crop 32)."""
+    def __init__(self, pad: int = 4, p: float = 1.0):
+        self.pad = pad
+        self.p = p
+        self._offset = None
+
+    def apply(self, img, rng):
+        pad = self.pad
+        width = ((pad, pad), (pad, pad), (0, 0))[:img.ndim]
+        padded = np.pad(img, width, mode='reflect')
+        dy, dx = rng.randint(0, 2 * pad + 1, 2)
+        h, w = img.shape[:2]
+        return padded[dy:dy + h, dx:dx + w]
+
+    apply_mask = apply
+
+
+class Cutout(Transform):
+    """Zero a random square — regularizer from the CIFAR SOTA recipes."""
+    def __init__(self, size: int = 8, p: float = 0.5):
+        self.size = size
+        self.p = p
+
+    def apply(self, img, rng):
+        h, w = img.shape[:2]
+        cy, cx = rng.randint(0, h), rng.randint(0, w)
+        s = self.size // 2
+        out = img.copy()
+        out[max(0, cy - s):cy + s, max(0, cx - s):cx + s] = 0
+        return out
+
+
+def augment_batch(x: np.ndarray, transform: Transform,
+                  rng: np.random.RandomState,
+                  masks: Optional[np.ndarray] = None):
+    """Apply a per-sample transform over an NHWC batch."""
+    out = np.empty_like(x)
+    out_m = np.empty_like(masks) if masks is not None else None
+    for i in range(len(x)):
+        img, m = transform(x[i], masks[i] if masks is not None else None,
+                           rng)
+        out[i] = img
+        if out_m is not None:
+            out_m[i] = m
+    return (out, out_m) if masks is not None else out
+
+
+_AUG = {
+    'hflip': HorizontalFlip, 'vflip': VerticalFlip,
+    'transpose': Transpose, 'pad_crop': PadCrop, 'cutout': Cutout,
+}
+
+
+def parse_transforms(specs) -> Compose:
+    """Build a Compose from config specs: strings ('hflip') or dicts
+    ({name: pad_crop, pad: 4}) — the config-driven equivalent of the
+    reference's albumentations yaml parser (utils/config.py:78-104)."""
+    out = []
+    for spec in specs or ():
+        if isinstance(spec, str):
+            out.append(_AUG[spec]())
+        else:
+            spec = dict(spec)
+            name = spec.pop('name')
+            out.append(_AUG[name](**spec))
+    return Compose(out)
+
+
+__all__ = ['Transform', 'Compose', 'HorizontalFlip', 'VerticalFlip',
+           'Transpose', 'PadCrop', 'Cutout', 'augment_batch',
+           'parse_transforms']

@@ -11,10 +11,6 @@
 """
 
 import os
-import signal
-import subprocess
-import sys
-import time
 
 import click
 
@@ -50,56 +46,19 @@ def start_site(host, port, no_supervisor):
 @click.option('--in-process', is_flag=True)
 def start(n_workers, in_process):
     """Spawn start-site + worker-supervisor + N workers with autorestart."""
+    from mlcomp_tpu.utils.procgroup import run_process_group
     specs = [
-        (['mlcomp_tpu.server', 'start-site'], None),
-        (['mlcomp_tpu.worker', 'worker-supervisor'], None),
+        ['mlcomp_tpu.server', 'start-site'],
+        ['mlcomp_tpu.worker', 'worker-supervisor'],
     ] + [
-        (['mlcomp_tpu.worker', 'worker', str(i)]
-         + (['--in-process'] if in_process else []), None)
+        ['mlcomp_tpu.worker', 'worker', str(i)]
+        + (['--in-process'] if in_process else [])
         for i in range(n_workers)
     ]
-    children = {}
-    spawned_at = {}
-    fail_streak = [0] * len(specs)
-
-    def spawn(idx):
-        module, *args = specs[idx][0]
-        proc = subprocess.Popen([sys.executable, '-m', module] + args)
-        children[proc.pid] = (proc, idx)
-        spawned_at[idx] = time.time()
-        return proc
-
-    for i in range(len(specs)):
-        spawn(i)
-    print(f'started site + worker-supervisor + {n_workers} workers '
-          f'(http://{WEB_HOST}:{WEB_PORT})')
-
-    def shutdown(*_):
-        for proc, _idx in list(children.values()):
-            proc.terminate()
-        sys.exit(0)
-
-    signal.signal(signal.SIGTERM, shutdown)
-    try:
-        while True:
-            time.sleep(2)
-            for pid, (proc, idx) in list(children.items()):
-                if proc.poll() is not None:
-                    del children[pid]
-                    # crash-loop backoff (supervisord startretries
-                    # parity): double the restart delay, up to 30 s,
-                    # while the child keeps dying within 10 s of spawn
-                    fast = time.time() - spawned_at[idx] < 10
-                    fail_streak[idx] = fail_streak[idx] + 1 if fast else 0
-                    delay = min(30, 2 ** fail_streak[idx]) if fast else 0
-                    print(f'child {specs[idx][0]} exited '
-                          f'({proc.returncode}); restarting'
-                          + (f' in {delay}s' if delay else ''))
-                    if delay:
-                        time.sleep(delay)
-                    spawn(idx)
-    except KeyboardInterrupt:
-        shutdown()
+    run_process_group(
+        specs,
+        banner=f'started site + worker-supervisor + {n_workers} workers '
+               f'(http://{WEB_HOST}:{WEB_PORT})')
 
 
 @main.command()

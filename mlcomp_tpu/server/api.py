@@ -34,6 +34,7 @@ the behavior is subtle):
 
 import io
 import json
+import sqlite3
 import threading
 import traceback
 import zipfile
@@ -465,7 +466,23 @@ def api_remove_files(data, s):
 
 
 def api_stop(data, s):
-    return {'success': True}
+    """Stop worker daemons on this host (reference app.py:710-730 stops
+    the celery components; the API/supervisor process itself stays up —
+    use /api/shutdown for that)."""
+    import os
+
+    import psutil
+    me = os.getpid()
+    stopped = []
+    for proc in psutil.process_iter(['pid', 'cmdline']):
+        cmd = ' '.join(proc.info.get('cmdline') or [])
+        if 'mlcomp_tpu.worker' in cmd and proc.info['pid'] != me:
+            try:
+                proc.terminate()
+                stopped.append(proc.info['pid'])
+            except psutil.Error:
+                pass
+    return {'success': True, 'stopped': stopped}
 
 
 _ROUTES = {
@@ -553,15 +570,23 @@ class ApiHandler(BaseHTTPRequestHandler):
                 {'success': False, 'reason': 'unauthorized'}, 401)
             return
         try:
-            res = handler(data, _session())
+            try:
+                res = handler(data, _session())
+            except sqlite3.ProgrammingError:
+                # another thread healed the shared session mid-request
+                # (closed connection) — retry once on the fresh one
+                res = handler(data, _session())
         except ApiError as e:
             self._send_json(
                 {'success': False, 'reason': str(e)}, e.status)
             return
-        except Exception:
-            # heal-by-recreating-session (reference app.py:91-131) then
-            # report the failure; the next request gets a fresh session
-            _heal_session()
+        except Exception as exc:
+            # heal-by-recreating-session, but ONLY for DB-level errors
+            # (reference app.py:91-131 heals on SQLAlchemyError only —
+            # healing on logic errors would close the shared connection
+            # under concurrently-serving threads for no reason)
+            if isinstance(exc, sqlite3.Error):
+                _heal_session()
             err = traceback.format_exc()
             if getattr(self.server, 'logger', None):
                 try:
@@ -615,8 +640,9 @@ class ApiHandler(BaseHTTPRequestHandler):
                 res = api_code_download(
                     {'id': qs.get('id', ['0'])[0]}, _session())
                 self._send_bytes(*res)
-            except Exception:
-                _heal_session()
+            except Exception as exc:
+                if isinstance(exc, sqlite3.Error):
+                    _heal_session()
                 self._send_json(
                     {'success': False,
                      'reason': traceback.format_exc()}, 500)

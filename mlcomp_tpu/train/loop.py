@@ -83,6 +83,9 @@ LOSSES = {'softmax_ce': softmax_ce, 'lm_ce': lm_ce, 'seg_ce': seg_ce}
 
 def loss_for_task(task: str) -> Callable:
     if task not in LOSSES:
+        # contrib losses (dice/bce_dice/focal) register on import
+        import mlcomp_tpu.contrib.criterion  # noqa: F401
+    if task not in LOSSES:
         raise KeyError(f'unknown loss {task!r}; have {sorted(LOSSES)}')
     return LOSSES[task]
 

@@ -1,0 +1,70 @@
+"""Autorestarting process group (supervisord parity — the reference
+generates supervisord configs at server/__main__.py:66-92 and
+worker/__main__.py:184-224; here the group runner is first-party).
+
+Used by both ``mlcomp_tpu.server start`` and ``mlcomp_tpu.worker start``.
+Backoff is per-child and non-blocking: a crash-looping child waits out
+its delay while every other child keeps being supervised.
+"""
+
+import signal
+import subprocess
+import sys
+import time
+
+
+def run_process_group(specs, banner: str = None, poll_interval: float = 2.0,
+                      fast_exit_window: float = 10.0,
+                      max_backoff: float = 30.0):
+    """Spawn one child per spec (``[module, *args]`` run as
+    ``python -m module args...``) and babysit forever: restart on exit,
+    exponential per-child backoff while a child keeps dying within
+    ``fast_exit_window`` seconds of spawn. SIGTERM/Ctrl-C terminates the
+    whole group."""
+    children = {}        # idx -> Popen | None (None = waiting to respawn)
+    spawned_at = {}
+    restart_at = {}
+    fail_streak = [0] * len(specs)
+
+    def spawn(idx):
+        module, *args = specs[idx]
+        proc = subprocess.Popen([sys.executable, '-m', module] + args)
+        children[idx] = proc
+        spawned_at[idx] = time.time()
+
+    for i in range(len(specs)):
+        spawn(i)
+    if banner:
+        print(banner)
+
+    def shutdown(*_):
+        for proc in children.values():
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, shutdown)
+    try:
+        while True:
+            time.sleep(poll_interval)
+            now_t = time.time()
+            for idx in range(len(specs)):
+                proc = children.get(idx)
+                if proc is not None and proc.poll() is not None:
+                    fast = now_t - spawned_at[idx] < fast_exit_window
+                    fail_streak[idx] = fail_streak[idx] + 1 if fast else 0
+                    delay = min(max_backoff, 2 ** fail_streak[idx]) \
+                        if fast else 0
+                    print(f'child {specs[idx]} exited '
+                          f'({proc.returncode}); restarting'
+                          + (f' in {delay:.0f}s' if delay else ''))
+                    children[idx] = None
+                    restart_at[idx] = now_t + delay
+                if children.get(idx) is None \
+                        and now_t >= restart_at.get(idx, 0):
+                    spawn(idx)
+    except KeyboardInterrupt:
+        shutdown()
+
+
+__all__ = ['run_process_group']

@@ -18,7 +18,6 @@
 
 import json
 import os
-import signal
 import socket
 import subprocess
 import sys
@@ -336,50 +335,14 @@ def worker_supervisor(cores):
 def start(n_workers, in_process):
     """Spawn worker-supervisor + N workers with autorestart
     (supervisord parity, reference worker/__main__.py:184-224)."""
-    specs = [['worker-supervisor']] + [
-        ['worker', str(i)] + (['--in-process'] if in_process else [])
+    from mlcomp_tpu.utils.procgroup import run_process_group
+    specs = [['mlcomp_tpu.worker', 'worker-supervisor']] + [
+        ['mlcomp_tpu.worker', 'worker', str(i)]
+        + (['--in-process'] if in_process else [])
         for i in range(n_workers)
     ]
-    children = {}
-    spawned_at = {}
-    fail_streak = [0] * len(specs)
-
-    def spawn(spec_idx):
-        spec = specs[spec_idx]
-        proc = subprocess.Popen(
-            [sys.executable, '-m', 'mlcomp_tpu.worker'] + spec)
-        children[proc.pid] = (proc, spec_idx)
-        spawned_at[spec_idx] = time.time()
-        return proc
-
-    for i in range(len(specs)):
-        spawn(i)
-    print(f'started worker-supervisor + {n_workers} workers')
-
-    def shutdown(*_):
-        for proc, _idx in list(children.values()):
-            proc.terminate()
-        sys.exit(0)
-
-    signal.signal(signal.SIGTERM, shutdown)
-    try:
-        while True:
-            time.sleep(2)
-            for pid, (proc, idx) in list(children.items()):
-                if proc.poll() is not None:
-                    del children[pid]
-                    # crash-loop backoff (supervisord startretries parity)
-                    fast = time.time() - spawned_at[idx] < 10
-                    fail_streak[idx] = fail_streak[idx] + 1 if fast else 0
-                    delay = min(30, 2 ** fail_streak[idx]) if fast else 0
-                    print(f'child {specs[idx]} exited '
-                          f'({proc.returncode}); restarting'
-                          + (f' in {delay}s' if delay else ''))
-                    if delay:
-                        time.sleep(delay)
-                    spawn(idx)
-    except KeyboardInterrupt:
-        shutdown()
+    run_process_group(
+        specs, banner=f'started worker-supervisor + {n_workers} workers')
 
 
 @main.command()

@@ -8,6 +8,7 @@ cheap there because torch is imported anyway; jax init is not.)"""
 from mlcomp_tpu.worker.executors.base import Executor, StepWrap
 
 Executor._builtin_modules = (
+    'mlcomp_tpu.worker.executors.split',
     'mlcomp_tpu.train.executor',
 )
 

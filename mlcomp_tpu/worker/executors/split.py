@@ -1,0 +1,78 @@
+"""Split executor (parity: reference worker/executors/split.py:10-45).
+
+Writes a ``fold.csv`` into the project's data folder from a label csv
+(variant=frame), a group column (variant=group / stratified_group), or a
+plain sample count (variant=count) — the fold file every downstream
+dataset's ``fold_csv`` filter consumes.
+"""
+
+import os
+
+import numpy as np
+
+from mlcomp_tpu.worker.executors.base import Executor
+
+
+@Executor.register
+class Split(Executor):
+    def __init__(self, variant: str = 'frame', out: str = 'fold.csv',
+                 n_splits: int = 5, file: str = None, label: str = None,
+                 group_column: str = None, count: int = None,
+                 seed: int = 0):
+        self.variant = variant
+        self.out = out
+        self.n_splits = int(n_splits)
+        self.file = file
+        self.label = label
+        self.group_column = group_column
+        self.count = count
+        self.seed = int(seed)
+
+    @classmethod
+    def _parse_config(cls, executor_spec, config, additional_info):
+        kwargs = super()._parse_config(executor_spec, config,
+                                       additional_info)
+        folder = config.data_folder
+        os.makedirs(folder, exist_ok=True)
+        if kwargs.get('file'):
+            kwargs['file'] = os.path.join(folder, kwargs['file'])
+        kwargs['out'] = os.path.join(folder, kwargs.get('out', 'fold.csv'))
+        return kwargs
+
+    def work(self):
+        import pandas as pd
+        from mlcomp_tpu.contrib.split import (
+            group_k_fold, stratified_group_k_fold, stratified_k_fold,
+        )
+        if self.variant == 'frame':
+            df = pd.read_csv(self.file)
+            fold = stratified_k_fold(self.label, df=df,
+                                     n_splits=self.n_splits,
+                                     seed=self.seed)
+            out_df = df.copy()
+        elif self.variant == 'group':
+            df = pd.read_csv(self.file)
+            fold = group_k_fold(self.group_column, df=df,
+                                n_splits=self.n_splits, seed=self.seed)
+            out_df = df.copy()
+        elif self.variant == 'stratified_group':
+            df = pd.read_csv(self.file)
+            fold = stratified_group_k_fold(
+                self.label, group_column=self.group_column, df=df,
+                n_splits=self.n_splits, seed=self.seed)
+            out_df = df.copy()
+        elif self.variant == 'count':
+            # unlabeled data: uniform random folds over `count` samples
+            rng = np.random.RandomState(self.seed)
+            fold = rng.randint(0, self.n_splits, int(self.count))
+            out_df = pd.DataFrame()
+        else:
+            raise ValueError(f'unknown split variant {self.variant!r}')
+        out_df['fold'] = fold
+        out_df.to_csv(self.out, index=False)
+        self.info(f'wrote {self.out}: {len(out_df)} rows, '
+                  f'{self.n_splits} folds')
+        return {'rows': len(out_df), 'out': self.out}
+
+
+__all__ = ['Split']

@@ -31,6 +31,24 @@ from mlcomp_tpu.utils.misc import now, to_snake
 from mlcomp_tpu.utils.req import control_requirements
 
 
+def link_project_folders(folder: str, project_name: str):
+    """Symlink ``<folder>/data`` and ``<folder>/models`` at the project's
+    shared folders. Repairs broken/stale links (a link left behind by a
+    renamed project is re-pointed); a real user-owned directory at the
+    link path is left untouched."""
+    for name, base in (('data', DATA_FOLDER), ('models', MODEL_FOLDER)):
+        target = os.path.join(base, project_name)
+        os.makedirs(target, exist_ok=True)
+        link = os.path.join(folder, name)
+        if os.path.islink(link):
+            if os.readlink(link) == target:
+                continue
+            os.remove(link)
+        elif os.path.lexists(link):
+            continue
+        os.symlink(target, link, target_is_directory=True)
+
+
 def _load_ignore(folder: str, extra: list = None):
     patterns = list(extra or [])
     ignore_file = os.path.join(folder, '.ignore')
@@ -137,13 +155,7 @@ class Storage:
 
         from mlcomp_tpu.db.providers import ProjectProvider
         project = ProjectProvider(self.session).by_id(dag.project)
-        project_name = project.name if project else 'default'
-        for name, base in (('data', DATA_FOLDER), ('models', MODEL_FOLDER)):
-            target = os.path.join(base, project_name)
-            os.makedirs(target, exist_ok=True)
-            link = os.path.join(folder, name)
-            if not os.path.exists(link):
-                os.symlink(target, link, target_is_directory=True)
+        link_project_folders(folder, project.name if project else 'default')
         return folder
 
     # ------------------------------------------------------------- importing

@@ -1,0 +1,208 @@
+"""Contrib: splitters, transforms, TTA, RLE, datasets, losses, metrics."""
+
+import numpy as np
+import pytest
+
+from mlcomp_tpu.contrib.metrics import (
+    accuracy, confusion_matrix, dice_numpy, f1_macro, iou_numpy,
+)
+from mlcomp_tpu.contrib.split import (
+    group_k_fold, stratified_group_k_fold, stratified_k_fold,
+)
+from mlcomp_tpu.contrib.transform import (
+    Compose, HorizontalFlip, PadCrop, mask2rle, parse_transforms,
+    parse_tta, rle2mask, tta_predict,
+)
+
+
+# ---------------------------------------------------------------- splitters
+def test_stratified_k_fold_balances_classes():
+    y = np.array([0] * 50 + [1] * 25 + [2] * 10)
+    folds = stratified_k_fold(y, n_splits=5, seed=1)
+    assert folds.shape == y.shape
+    for cls, total in ((0, 50), (1, 25), (2, 10)):
+        per_fold = np.bincount(folds[y == cls], minlength=5)
+        assert per_fold.max() - per_fold.min() <= 1, (cls, per_fold)
+
+
+def test_stratified_k_fold_from_dataframe(tmp_path):
+    import pandas as pd
+    df = pd.DataFrame({'label': [0, 1] * 20})
+    path = tmp_path / 'train.csv'
+    df.to_csv(path, index=False)
+    folds = stratified_k_fold('label', file=str(path), n_splits=4)
+    assert len(folds) == 40
+    assert set(folds) == {0, 1, 2, 3}
+
+
+def test_group_k_fold_keeps_groups_whole():
+    g = np.repeat(np.arange(20), 5)
+    folds = group_k_fold(g, n_splits=4)
+    for grp in np.unique(g):
+        assert len(set(folds[g == grp])) == 1
+    sizes = np.bincount(folds, minlength=4)
+    assert sizes.max() - sizes.min() <= 5
+
+
+def test_stratified_group_k_fold():
+    rng = np.random.RandomState(0)
+    g = np.repeat(np.arange(30), 4)
+    y = np.repeat(rng.randint(0, 3, 30), 4)
+    folds = stratified_group_k_fold(y, groups=g, n_splits=3)
+    for grp in np.unique(g):
+        assert len(set(folds[g == grp])) == 1
+    # every fold sees every class
+    for f in range(3):
+        assert len(set(y[folds == f])) == 3
+
+
+# --------------------------------------------------------------- transforms
+def test_hflip_deterministic_pair():
+    img = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+    mask = np.arange(4).reshape(2, 2)
+    out, m = HorizontalFlip(p=1.0)(img, mask)
+    assert np.array_equal(out, img[:, ::-1])
+    assert np.array_equal(m, mask[:, ::-1])
+
+
+def test_pad_crop_preserves_shape():
+    img = np.random.rand(32, 32, 3).astype(np.float32)
+    out, _ = PadCrop(pad=4)(img, rng=np.random.RandomState(0))
+    assert out.shape == img.shape
+
+
+def test_parse_transforms_and_compose():
+    t = parse_transforms(['hflip', {'name': 'pad_crop', 'pad': 2}])
+    assert isinstance(t, Compose) and len(t.transforms) == 2
+    img = np.random.rand(8, 8, 3).astype(np.float32)
+    out, _ = t(img, rng=np.random.RandomState(0))
+    assert out.shape == img.shape
+
+
+def test_rle_roundtrip():
+    rng = np.random.RandomState(3)
+    mask = (rng.rand(17, 23) > 0.6).astype(np.uint8)
+    rle = mask2rle(mask)
+    back = rle2mask(rle, (23, 17))
+    assert np.array_equal(back, mask)
+    assert mask2rle(np.zeros((4, 4))) == ''
+
+
+def test_tta_average_restores_orientation():
+    # prediction = the image itself → TTA mean must equal the clean image
+    x = np.random.rand(2, 6, 6, 3).astype(np.float32)
+    tfms = parse_tta(['hflip', 'vflip'])
+    out = tta_predict(lambda a: a, x, tfms)
+    np.testing.assert_allclose(out, x, atol=1e-6)
+
+
+# ------------------------------------------------------------------ metrics
+def test_dice_and_iou():
+    a = np.zeros((4, 4)); a[:2] = 1
+    b = np.zeros((4, 4)); b[1:3] = 1
+    assert dice_numpy(a, b) == pytest.approx(0.5)
+    assert iou_numpy(a, b) == pytest.approx(1 / 3)
+    assert dice_numpy(np.zeros(4), np.zeros(4)) == 1.0
+
+
+def test_confusion_f1_accuracy():
+    y = np.array([0, 0, 1, 1, 2, 2])
+    p = np.array([0, 1, 1, 1, 2, 0])
+    cm = confusion_matrix(y, p, 3)
+    assert cm.sum() == 6 and cm[0, 0] == 1 and cm[0, 1] == 1
+    assert accuracy(y, p) == pytest.approx(4 / 6)
+    assert 0 < f1_macro(y, p, 3) < 1
+
+
+# ------------------------------------------------------------------ datasets
+def test_npz_dataset_fold_filter(tmp_path):
+    import pandas as pd
+    from mlcomp_tpu.contrib.dataset import NpzDataset
+    x = np.random.rand(20, 4, 4, 3).astype(np.float32)
+    y = np.arange(20) % 2
+    np.savez(tmp_path / 'd.npz', x=x, y=y)
+    pd.DataFrame({'fold': np.arange(20) % 5}).to_csv(
+        tmp_path / 'fold.csv', index=False)
+    train = NpzDataset(path=str(tmp_path / 'd.npz'),
+                       fold_csv=str(tmp_path / 'fold.csv'), fold_number=0)
+    valid = NpzDataset(path=str(tmp_path / 'd.npz'),
+                       fold_csv=str(tmp_path / 'fold.csv'), fold_number=0,
+                       is_test=True)
+    assert len(train) == 16 and len(valid) == 4
+    xt, yt = train.arrays()
+    assert xt.shape == (16, 4, 4, 3) and yt.dtype == np.int32
+
+
+def test_image_dataset_balance(tmp_path):
+    import pandas as pd
+    from mlcomp_tpu.contrib.dataset import ImageDataset
+    folder = tmp_path / 'imgs'
+    folder.mkdir()
+    rows = []
+    for i in range(12):
+        name = f'im{i}.npy'
+        np.save(folder / name, np.full((4, 4, 3), i, np.float32))
+        rows.append({'image': name, 'label': i % 3, 'fold': i % 4})
+    pd.DataFrame(rows).to_csv(tmp_path / 'fold.csv', index=False)
+    ds = ImageDataset(img_folder=str(folder),
+                      fold_csv=str(tmp_path / 'fold.csv'), fold_number=0)
+    assert len(ds) == 9
+    item = ds[0]
+    assert item['features'].shape == (4, 4, 3)
+    assert 'targets' in item
+    x, y = ds.arrays()
+    assert x.shape == (9, 4, 4, 3) and len(y) == 9
+    ds2 = ImageDataset(img_folder=str(folder),
+                       fold_csv=str(tmp_path / 'fold.csv'),
+                       fold_number=0, max_count=[1, 1, 1])
+    counts = np.bincount(ds2.arrays()[1], minlength=3)
+    assert counts.max() - counts.min() <= 1
+
+
+def test_segmentation_dataset(tmp_path):
+    import pandas as pd
+    from mlcomp_tpu.contrib.dataset import ImageWithMaskDataset
+    imgs = tmp_path / 'imgs'; masks = tmp_path / 'masks'
+    imgs.mkdir(); masks.mkdir()
+    rows = []
+    for i in range(6):
+        np.save(imgs / f'im{i}.npy',
+                np.random.rand(8, 8, 3).astype(np.float32))
+        m = np.zeros((8, 8), np.int32); m[:i + 1] = 1
+        np.save(masks / f'im{i}.npy', m)
+        rows.append({'image': f'im{i}.npy', 'fold': i % 3})
+    pd.DataFrame(rows).to_csv(tmp_path / 'fold.csv', index=False)
+    ds = ImageWithMaskDataset(
+        img_folder=str(imgs), mask_folder=str(masks),
+        fold_csv=str(tmp_path / 'fold.csv'), fold_number=0)
+    x, y = ds.arrays()
+    assert x.shape == (4, 8, 8, 3) and y.shape == (4, 8, 8)
+    assert y.max() == 1
+
+
+# ---------------------------------------------------------------- criterion
+def test_contrib_losses_register_and_grad():
+    import jax
+    import jax.numpy as jnp
+    from mlcomp_tpu.train.loop import loss_for_task
+    logits = jnp.array(np.random.randn(2, 8, 8, 3), jnp.float32)
+    labels = jnp.array(np.random.randint(0, 3, (2, 8, 8)))
+    for name in ('dice', 'bce_dice', 'focal'):
+        fn = loss_for_task(name)
+        loss, metrics = fn(logits, labels)
+        assert np.isfinite(float(loss)), name
+        assert 'loss' in metrics and 'accuracy' in metrics
+        g = jax.grad(lambda lg: fn(lg, labels)[0])(logits)
+        assert np.isfinite(np.asarray(g)).all(), name
+
+
+def test_focal_matches_ce_at_gamma0():
+    import jax.numpy as jnp
+    import optax
+    from mlcomp_tpu.contrib.criterion import focal_loss
+    logits = jnp.array(np.random.randn(4, 5), jnp.float32)
+    labels = jnp.array([0, 1, 2, 3])
+    loss, _ = focal_loss(logits, labels, gamma=0.0)
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+    np.testing.assert_allclose(float(loss), float(ce), rtol=1e-5)

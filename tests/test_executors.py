@@ -256,3 +256,46 @@ class TestGridCellMerge:
                                       additional_info=info)
             lrs.append(ex.lr)
         assert sorted(lrs) == [0.01, 0.1]
+
+
+class TestSplitExecutor:
+    def test_split_frame_writes_fold_csv(self, session, tmp_path):
+        import numpy as np
+        import pandas as pd
+        from mlcomp_tpu.utils.config import Config
+        config = Config({
+            'info': {'name': 's', 'project': 'p_split'},
+            'executors': {
+                'split': {'type': 'split', 'variant': 'frame',
+                          'file': 'train.csv', 'label': 'label',
+                          'n_splits': 3},
+            },
+        })
+        folder = config.data_folder
+        os.makedirs(folder, exist_ok=True)
+        pd.DataFrame({'label': [0, 1, 2] * 9}).to_csv(
+            os.path.join(folder, 'train.csv'), index=False)
+        ex = Executor.from_config('split', config)
+        result = ex.work()
+        assert result['rows'] == 27
+        df = pd.read_csv(os.path.join(folder, 'fold.csv'))
+        assert set(df['fold']) == {0, 1, 2}
+        for cls in (0, 1, 2):
+            counts = np.bincount(df[df['label'] == cls]['fold'],
+                                 minlength=3)
+            assert counts.max() - counts.min() <= 1
+
+    def test_split_count_variant(self, session):
+        from mlcomp_tpu.utils.config import Config
+        import pandas as pd
+        config = Config({
+            'info': {'name': 's', 'project': 'p_split_count'},
+            'executors': {
+                'split': {'type': 'split', 'variant': 'count',
+                          'count': 50, 'n_splits': 5},
+            },
+        })
+        ex = Executor.from_config('split', config)
+        ex.work()
+        df = pd.read_csv(os.path.join(config.data_folder, 'fold.csv'))
+        assert len(df) == 50
