@@ -45,15 +45,20 @@ def _load_config(config_path: str, params):
 
 
 def _dag(config_path: str, params=(), debug: bool = False):
-    from mlcomp_tpu.server.create_dags import dag_standard
+    from mlcomp_tpu.server.create_dags import dag_pipe, dag_standard
     session = Session.create_session()
     migrate(session)
     config, text = _load_config(config_path, params)
     logger = create_logger(session)
+    folder = os.path.dirname(os.path.abspath(config_path)) or '.'
+    if 'pipes' in config:
+        # pipe registration (reference __main__.py:49-52): nothing runs
+        dag = dag_pipe(session, config, config_text=text,
+                       upload_folder=folder, logger=logger)
+        return session, dag, {}, config
     dag, tasks = dag_standard(
         session, config, debug=debug, config_text=text,
-        upload_folder=os.path.dirname(os.path.abspath(config_path)) or '.',
-        logger=logger)
+        upload_folder=folder, logger=logger)
     return session, dag, tasks, config
 
 
@@ -62,7 +67,7 @@ def _dag(config_path: str, params=(), debug: bool = False):
 @click.option('--params', multiple=True,
               help='override config values, e.g. --params lr:0.01')
 def dag(config, params):
-    """Submit a DAG to the scheduler."""
+    """Submit a DAG (or register a pipe) to the scheduler."""
     _, dag_row, tasks, _ = _dag(config, params)
     total = sum(len(v) for v in tasks.values())
     click.echo(f'dag {dag_row.id} created with {total} tasks')

@@ -62,12 +62,16 @@ def balance_max_count(rows: list, max_count, label_key: str = 'label'):
     for row in rows:
         by_label.setdefault(int(row[label_key]), []).append(row)
     ratios = list(max_count)
-    min_cls = int(np.argmin(ratios))
-    base = len(by_label.get(min_cls, ()))
+    # anchor at the class that most constrains the ratio: the one with
+    # the smallest available count per unit of requested ratio
+    scale = min(
+        (len(by_label.get(cls, ())) / ratios[cls]
+         for cls in range(len(ratios)) if ratios[cls] > 0),
+        default=0)
     out = []
     for cls in sorted(by_label):
-        want = int(base * ratios[cls] / ratios[min_cls]) \
-            if cls < len(ratios) else len(by_label[cls])
+        want = int(scale * ratios[cls]) if cls < len(ratios) \
+            else len(by_label[cls])
         out.extend(by_label[cls][:want])
     return out
 

@@ -114,16 +114,27 @@ class Cutout(Transform):
 def augment_batch(x: np.ndarray, transform: Transform,
                   rng: np.random.RandomState,
                   masks: Optional[np.ndarray] = None):
-    """Apply a per-sample transform over an NHWC batch."""
-    out = np.empty_like(x)
-    out_m = np.empty_like(masks) if masks is not None else None
+    """Apply a per-sample transform over an NHWC batch. Shape-changing
+    transforms (Transpose on rectangular images) must be deterministic
+    (p=1) so every sample keeps a common shape — a mixed batch can't be
+    stacked for the device."""
+    imgs, out_masks = [], []
     for i in range(len(x)):
         img, m = transform(x[i], masks[i] if masks is not None else None,
                            rng)
-        out[i] = img
-        if out_m is not None:
-            out_m[i] = m
-    return (out, out_m) if masks is not None else out
+        imgs.append(img)
+        if masks is not None:
+            out_masks.append(m)
+    shapes = {im.shape for im in imgs}
+    if len(shapes) > 1:
+        raise ValueError(
+            f'transforms produced mixed sample shapes {sorted(shapes)} — '
+            f'use p=1.0 for shape-changing transforms on rectangular '
+            f'images')
+    out = np.stack(imgs)
+    if masks is not None:
+        return out, np.stack(out_masks)
+    return out
 
 
 _AUG = {

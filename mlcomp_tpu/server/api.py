@@ -468,21 +468,33 @@ def api_remove_files(data, s):
 def api_stop(data, s):
     """Stop worker daemons on this host (reference app.py:710-730 stops
     the celery components; the API/supervisor process itself stays up —
-    use /api/shutdown for that)."""
+    use /api/shutdown for that). Process-group parents (``server start``
+    / ``worker start``) are terminated FIRST so their autorestart loop
+    can't respawn the workers killed right after."""
     import os
+    import re
 
     import psutil
     me = os.getpid()
+    group_parent = re.compile(r'mlcomp_tpu\.(server|worker) start( |$)')
+
+    def matching(predicate):
+        out = []
+        for proc in psutil.process_iter(['pid', 'cmdline']):
+            cmd = ' '.join(proc.info.get('cmdline') or [])
+            if proc.info['pid'] != me and predicate(cmd):
+                out.append(proc)
+        return out
+
     stopped = []
-    for proc in psutil.process_iter(['pid', 'cmdline']):
-        cmd = ' '.join(proc.info.get('cmdline') or [])
-        if 'mlcomp_tpu.worker' in cmd and proc.info['pid'] != me:
-            try:
-                proc.terminate()
-                stopped.append(proc.info['pid'])
-            except psutil.Error:
-                pass
-    return {'success': True, 'stopped': stopped}
+    for proc in matching(lambda c: bool(group_parent.search(c))) + \
+            matching(lambda c: 'mlcomp_tpu.worker' in c):
+        try:
+            proc.terminate()
+            stopped.append(proc.pid)
+        except psutil.Error:
+            pass
+    return {'success': True, 'stopped': sorted(set(stopped))}
 
 
 _ROUTES = {
@@ -530,6 +542,18 @@ _ROUTES = {
 }
 
 
+# routes safe to transparently retry after a mid-request session heal
+# (pure reads — no committed statement can be double-applied)
+_READ_ONLY_ROUTES = frozenset({
+    '/api/token', '/api/computers', '/api/projects', '/api/layouts',
+    '/api/report/add_start', '/api/models', '/api/model/start_begin',
+    '/api/img_classify', '/api/img_segment', '/api/config', '/api/graph',
+    '/api/dags', '/api/code', '/api/tasks', '/api/task/info',
+    '/api/task/steps', '/api/auxiliary', '/api/logs', '/api/reports',
+    '/api/report', '/api/report/update_layout_start',
+})
+
+
 class ApiHandler(BaseHTTPRequestHandler):
     server_version = 'mlcomp_tpu'
     protocol_version = 'HTTP/1.1'
@@ -574,7 +598,12 @@ class ApiHandler(BaseHTTPRequestHandler):
                 res = handler(data, _session())
             except sqlite3.ProgrammingError:
                 # another thread healed the shared session mid-request
-                # (closed connection) — retry once on the fresh one
+                # (closed connection). Retry once on the fresh session —
+                # but only for read-only routes: a write handler may have
+                # already committed its first statements, and re-running
+                # it would double-apply them.
+                if path not in _READ_ONLY_ROUTES:
+                    raise
                 res = handler(data, _session())
         except ApiError as e:
             self._send_json(

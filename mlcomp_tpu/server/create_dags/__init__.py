@@ -1,1 +1,6 @@
+from mlcomp_tpu.server.create_dags.model_add import dag_model_add
+from mlcomp_tpu.server.create_dags.model_start import dag_model_start
+from mlcomp_tpu.server.create_dags.pipe import dag_pipe
 from mlcomp_tpu.server.create_dags.standard import dag_standard
+
+__all__ = ['dag_standard', 'dag_pipe', 'dag_model_add', 'dag_model_start']

@@ -1,0 +1,62 @@
+"""Model-add DAG builder (parity: reference
+server/back/create_dags/model_add.py:10-55).
+
+The UI's "add model" action: with no source task, just create the Model
+row; with a train task, build a one-executor DAG running ModelAdd pinned
+to the computer holding the checkpoint (checkpoints are local files —
+the export must happen where they live).
+"""
+
+from mlcomp_tpu.db.providers import ProjectProvider, TaskProvider
+from mlcomp_tpu.server.create_dags.standard import dag_standard
+from mlcomp_tpu.utils.misc import now
+
+
+def dag_model_add(session, data: dict):
+    if not data.get('task'):
+        from mlcomp_tpu.db.models import Model
+        from mlcomp_tpu.db.providers import ModelProvider
+        model = Model(
+            name=data['name'], project=data['project'],
+            equations=data.get('equations', ''), created=now())
+        ModelProvider(session).add(model)
+        return None
+
+    task_provider = TaskProvider(session)
+    task = task_provider.by_id(int(data['task']))
+    if task is None:
+        raise ValueError(f"task {data['task']} not found")
+    # distributed ranks all write to the PARENT task's checkpoint folder
+    # (train/executor.py _checkpoint_folder), so the checkpoint stays
+    # addressed by the train task itself; children only tell us WHERE the
+    # job ran (rank 0's computer holds the files)
+    children = task_provider.children(task.id)
+    computer = children[0].computer_assigned if children \
+        else task.computer_assigned
+
+    project_id = data.get('project')
+    if project_id is None:
+        from mlcomp_tpu.db.providers import DagProvider
+        project_id = DagProvider(session).by_id(task.dag).project
+    project = ProjectProvider(session).by_id(project_id)
+    config = {
+        'info': {
+            'name': 'model_add',
+            'project': project.name,
+        },
+        'executors': {
+            'model_add': {
+                'type': 'model_add',
+                'computer': computer,
+                'project': project.id,
+                'task': int(data['task']),
+                'name': data['name'],
+                'file': data.get('file'),
+            },
+        },
+    }
+    dag, _tasks = dag_standard(session, config)
+    return dag
+
+
+__all__ = ['dag_model_add']

@@ -1,0 +1,49 @@
+"""Pipe DAG builder (parity: reference server/back/create_dags/pipe.py:10-33).
+
+A ``pipes:`` config registers named serving pipelines — dicts of
+Equation-executor specs — as a ``DagType.Pipe`` row. Nothing runs at
+registration; ``dag_model_start`` later instantiates a pipe for a
+concrete model. Models already named after this pipe are re-pointed at
+the new registration so the UI shows the latest equations.
+"""
+
+from mlcomp_tpu.db.enums import DagType
+from mlcomp_tpu.db.models import Dag
+from mlcomp_tpu.db.providers import DagProvider, ProjectProvider
+from mlcomp_tpu.utils.io import yaml_dump
+from mlcomp_tpu.utils.misc import now
+from mlcomp_tpu.worker.storage import Storage
+
+
+def dag_pipe(session, config: dict, config_text: str = None,
+             upload_folder: str = None, logger=None):
+    assert 'pipes' in config, 'config needs a pipes: section'
+    info = config.get('info', {})
+
+    project_provider = ProjectProvider(session)
+    project = project_provider.by_name(info['project'])
+    if project is None:
+        project = project_provider.add_project(info['project'])
+
+    dag = Dag(
+        name=info.get('name', 'pipe'),
+        config=config_text or yaml_dump(dict(config)),
+        project=project.id,
+        docker_img=info.get('docker_img') or info.get('runtime_img'),
+        type=int(DagType.Pipe),
+        created=now(),
+    )
+    DagProvider(session).add(dag)
+
+    if upload_folder:
+        Storage(session, logger).upload(upload_folder, dag)
+
+    # re-point same-named models at this pipe registration
+    # (reference pipe.py:31-33 ModelProvider.change_dag)
+    session.execute(
+        'UPDATE model SET dag=? WHERE project=? AND name=?',
+        (dag.id, project.id, info.get('name')))
+    return dag
+
+
+__all__ = ['dag_pipe']

@@ -359,11 +359,38 @@ class JaxTrain(Executor):
                 return {'stage': stage_name, 'stages': stage_names,
                         'best_score': best}
 
+        if self._is_main and self.model_name:
+            self._export_model(ck_dir, best)
+
         wall = time.time() - t_start
         return {'stage': stage_names[-1], 'stages': stage_names,
                 'best_score': best, 'n_params': n_params,
                 'wall_time_s': wall,
                 'samples_per_sec': images_seen / max(wall, 1e-9)}
+
+    def _export_model(self, ck_dir, best_score):
+        """Write the deployable export for the model registry — the
+        TPU-native analogue of the reference's post-train torch.jit trace
+        (catalyst.py:372-374). Best checkpoint wins; falls back to last."""
+        from mlcomp_tpu.train.export import export_from_checkpoint
+        src = os.path.join(ck_dir, 'best.msgpack')
+        if not os.path.exists(src):
+            src = os.path.join(ck_dir, 'last.msgpack')
+        if not os.path.exists(src):
+            return
+        out = os.path.join(self._model_folder(), self.model_name)
+        export_from_checkpoint(src, self.model_spec, out,
+                               meta={'score': best_score})
+        self.info(f'exported model {self.model_name!r} -> {out}.msgpack')
+
+    def _model_folder(self):
+        if self.dag is not None and self.session is not None:
+            from mlcomp_tpu import MODEL_FOLDER
+            from mlcomp_tpu.db.providers import ProjectProvider
+            project = ProjectProvider(self.session).by_id(self.dag.project)
+            if project is not None:
+                return os.path.join(MODEL_FOLDER, project.name)
+        return 'models'
 
 
 __all__ = ['JaxTrain']

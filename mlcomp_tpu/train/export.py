@@ -1,0 +1,154 @@
+"""Model export + batched TPU inference.
+
+Parity: the reference's deployable-model path is ``torch.jit`` tracing
+after training (reference catalyst.py:372-374), ModelAdd copying traced
+weights into ``models/`` (reference worker/executors/model.py:23-105),
+and ``utils/torch.py:50-69`` running a DataLoader over a jit-loaded
+model. The TPU-native artifact is a **self-describing msgpack export**:
+``<name>.msgpack`` holds unboxed ``{'params', 'batch_stats'}`` and
+``<name>.json`` holds the model spec — everything needed to rebuild the
+flax module and jit its apply on any backend, no Python class pickling.
+
+``jax_infer`` is the inference engine under the Equation mini-language's
+``infer()``: fixed-size batches (one compile), tail padded then sliced,
+bf16-friendly, optional softmax/sigmoid/argmax head on device.
+"""
+
+import json
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _unwrap_value_nodes(tree):
+    """Collapse flax Partitioned state-dict nodes ({'value': leaf}) left
+    by serializing logically-partitioned params."""
+    if isinstance(tree, dict):
+        if set(tree.keys()) == {'value'}:
+            return _unwrap_value_nodes(tree['value'])
+        return {k: _unwrap_value_nodes(v) for k, v in tree.items()}
+    return tree
+
+
+def export_model(out_path: str, params, model_spec: dict,
+                 batch_stats=None, meta: dict = None) -> str:
+    """Write ``<out_path>.msgpack`` + ``<out_path>.json``; returns the
+    msgpack path. ``params`` may be boxed (logical partitioning) or raw."""
+    import flax.linen as nn
+    import jax
+    from flax import serialization
+    variables = {'params': params}
+    if batch_stats is not None:
+        variables['batch_stats'] = batch_stats
+    variables = nn.meta.unbox(jax.device_get(variables))
+    base = out_path[:-len('.msgpack')] if out_path.endswith('.msgpack') \
+        else out_path
+    os.makedirs(os.path.dirname(base) or '.', exist_ok=True)
+    blob_path = base + '.msgpack'
+    tmp = blob_path + '.tmp'
+    with open(tmp, 'wb') as fh:
+        fh.write(serialization.to_bytes(variables))
+    os.replace(tmp, blob_path)
+    with open(base + '.json', 'w') as fh:
+        json.dump({'model': dict(model_spec), **(meta or {})}, fh)
+    return blob_path
+
+
+def export_from_checkpoint(ck_path: str, model_spec: dict,
+                           out_path: str, meta: dict = None) -> str:
+    """Export from a raw TrainState checkpoint blob (last/best.msgpack)
+    WITHOUT knowing the optimizer structure that saved it — restore the
+    untyped msgpack tree and lift params/batch_stats out."""
+    from flax import serialization
+    with open(ck_path, 'rb') as fh:
+        raw = serialization.msgpack_restore(fh.read())
+    params = _unwrap_value_nodes(raw['params'])
+    stats = _unwrap_value_nodes(raw.get('batch_stats')) \
+        if raw.get('batch_stats') is not None else None
+    return export_model(out_path, params, model_spec,
+                        batch_stats=stats, meta=meta)
+
+
+def load_export(path: str) -> Tuple[dict, dict]:
+    """Returns (variables, model_spec) from an export written by
+    export_model. ``path`` may omit the .msgpack suffix."""
+    from flax import serialization
+    base = path[:-len('.msgpack')] if path.endswith('.msgpack') else path
+    with open(base + '.msgpack', 'rb') as fh:
+        variables = serialization.msgpack_restore(fh.read())
+    spec = {}
+    if os.path.exists(base + '.json'):
+        with open(base + '.json') as fh:
+            spec = json.load(fh).get('model', {})
+    return _unwrap_value_nodes(variables), spec
+
+
+_ACTIVATIONS = ('softmax', 'sigmoid', 'argmax', None)
+
+
+def make_predictor(file: str = None, model_spec: dict = None,
+                   variables: dict = None, batch_size: int = 512,
+                   activation: Optional[str] = None):
+    """Build a reusable ``predict(x) -> np.ndarray`` over a model export.
+
+    Loads the export and builds the jitted apply ONCE — callers that
+    predict in chunks (Equation parts, TTA views) reuse the same
+    compiled computation. Static batch shape means exactly one XLA
+    compile; the tail batch is padded with repeats and sliced off after.
+    """
+    import jax
+    import jax.numpy as jnp
+    from mlcomp_tpu.models import create_model
+
+    if activation not in _ACTIVATIONS:
+        raise ValueError(f'activation must be one of {_ACTIVATIONS}')
+    if variables is None:
+        if file is None:
+            raise ValueError('need file= or variables=')
+        variables, file_spec = load_export(file)
+        model_spec = model_spec or file_spec
+    if not model_spec or 'name' not in model_spec:
+        raise ValueError('model spec missing (no .json next to export?)')
+    model = create_model(**model_spec)
+
+    @jax.jit
+    def apply(batch):
+        out = model.apply(variables, batch, train=False)
+        out = jnp.asarray(out, jnp.float32)
+        if activation == 'softmax':
+            out = jax.nn.softmax(out, axis=-1)
+        elif activation == 'sigmoid':
+            out = jax.nn.sigmoid(out)
+        elif activation == 'argmax':
+            out = jnp.argmax(out, axis=-1)
+        return out
+
+    def predict(x: np.ndarray) -> np.ndarray:
+        n = len(x)
+        bs = min(batch_size, max(n, 1))
+        outs = []
+        for start in range(0, n, bs):
+            batch = x[start:start + bs]
+            n_real = len(batch)
+            if n_real < bs:
+                take = np.resize(np.arange(n_real), bs)
+                batch = batch[take]
+            out = np.asarray(apply(batch))
+            outs.append(out[:n_real])
+        return np.concatenate(outs) if outs else np.empty((0,))
+
+    return predict
+
+
+def jax_infer(x: np.ndarray, file: str = None, model_spec: dict = None,
+              variables: dict = None, batch_size: int = 512,
+              activation: Optional[str] = None) -> np.ndarray:
+    """One-shot convenience over make_predictor."""
+    return make_predictor(
+        file=file, model_spec=model_spec, variables=variables,
+        batch_size=batch_size, activation=activation)(x)
+
+
+__all__ = ['export_model', 'export_from_checkpoint', 'load_export',
+           'make_predictor', 'jax_infer']

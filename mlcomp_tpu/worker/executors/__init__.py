@@ -9,6 +9,11 @@ from mlcomp_tpu.worker.executors.base import Executor, StepWrap
 
 Executor._builtin_modules = (
     'mlcomp_tpu.worker.executors.split',
+    'mlcomp_tpu.worker.executors.base.equation',
+    'mlcomp_tpu.worker.executors.infer',
+    'mlcomp_tpu.worker.executors.valid',
+    'mlcomp_tpu.worker.executors.prepare_submit',
+    'mlcomp_tpu.worker.executors.model',
     'mlcomp_tpu.train.executor',
 )
 

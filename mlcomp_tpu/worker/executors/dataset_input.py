@@ -1,0 +1,51 @@
+"""Shared dataset-loading mixin for the Equation harness executors.
+
+A ``dataset:`` spec in the executor config resolves to dense arrays:
+
+- ``{name: synthetic_images, ...}`` — a registered generator/loader from
+  ``train/data.py`` (``part`` selects the train/valid split)
+- ``{path: d.npz, fold_csv: fold.csv, fold_number: 0}`` — fold-filtered
+  array file via contrib.dataset.NpzDataset
+- ``{img_folder: ..., fold_csv: ...}`` — contrib.dataset.ImageDataset
+"""
+
+import os
+
+
+class DatasetInputMixin:
+    """Sets ``self.x`` / ``self.y_true`` from ``self.dataset``."""
+
+    def load_dataset_arrays(self, part: str = 'valid'):
+        spec = dict(getattr(self, 'dataset', None) or {})
+        if not spec:
+            raise ValueError(f'{type(self).__name__} needs a dataset: spec')
+        if 'name' in spec:
+            from mlcomp_tpu.train.data import create_dataset
+            data = create_dataset(**spec)
+            if part == 'train':
+                return data['x_train'], data['y_train']
+            return data['x_valid'], data['y_valid']
+        if 'img_folder' in spec:
+            from mlcomp_tpu.contrib.dataset import ImageDataset
+            spec.setdefault('is_test', part != 'train')
+            return ImageDataset(**self._abs_paths(spec)).arrays()
+        if 'path' in spec:
+            from mlcomp_tpu.contrib.dataset import NpzDataset
+            spec.setdefault('is_test', part != 'train')
+            return NpzDataset(**self._abs_paths(spec)).arrays()
+        raise ValueError(f'cannot interpret dataset spec {sorted(spec)}')
+
+    @staticmethod
+    def _abs_paths(spec: dict) -> dict:
+        """Resolve bare filenames against data/ (the task-folder symlink)."""
+        out = dict(spec)
+        for key in ('path', 'fold_csv', 'img_folder', 'mask_folder'):
+            v = out.get(key)
+            if v and not os.path.isabs(v) and not os.path.exists(v):
+                candidate = os.path.join('data', v)
+                if os.path.exists(candidate):
+                    out[key] = candidate
+        return out
+
+
+__all__ = ['DatasetInputMixin']

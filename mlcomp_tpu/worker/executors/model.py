@@ -1,0 +1,107 @@
+"""ModelAdd executor (parity: reference worker/executors/model.py:23-105).
+
+Registers a trained model: exports the train task's best checkpoint into
+the project's ``models/`` registry as a deployable msgpack export (the
+reference traces the checkpoint through torch.jit; here the artifact is
+``train.export``'s self-describing flax export) and creates the Model
+row with the task's score.
+"""
+
+import os
+
+from mlcomp_tpu.utils.io import yaml_load
+from mlcomp_tpu.worker.executors.base.executor import Executor
+
+
+@Executor.register
+class ModelAdd(Executor):
+    def __init__(self, name: str, project: int = None,
+                 train_task: int = None, file: str = None,
+                 equations: str = '', **kwargs):
+        self.name = name
+        self.project = project
+        self.train_task = train_task
+        self.file = file
+        self.equations = equations
+
+    @classmethod
+    def _parse_config(cls, executor_spec, config, additional_info):
+        kwargs = super()._parse_config(executor_spec, config,
+                                       additional_info)
+        kwargs.setdefault('train_task', kwargs.pop('task', None))
+        return kwargs
+
+    def _train_model_spec(self, task):
+        """The model spec the train task was configured with — needed to
+        rebuild the flax module at load time."""
+        from mlcomp_tpu.db.providers import DagProvider
+        dag = DagProvider(self.session).by_id(task.dag)
+        config = yaml_load(dag.config) if dag and dag.config else {}
+        spec = (config.get('executors', {})
+                .get(task.executor, {}).get('model'))
+        return dict(spec) if spec else None
+
+    def work(self):
+        from mlcomp_tpu import MODEL_FOLDER, TASK_FOLDER
+        from mlcomp_tpu.db.models import Model
+        from mlcomp_tpu.db.providers import (
+            ModelProvider, ProjectProvider, TaskProvider,
+        )
+        from mlcomp_tpu.utils.misc import now
+
+        project_id = self.project if self.project is not None \
+            else (self.dag.project if self.dag else None)
+        model = Model(name=self.name, project=project_id,
+                      equations=self.equations or '', created=now())
+        provider = ModelProvider(self.session)
+
+        if self.train_task:
+            tp = TaskProvider(self.session)
+            task = tp.by_id(self.train_task)
+            if task is None:
+                raise ValueError(f'train task {self.train_task} not found')
+            model.score_local = task.score
+            model.dag = task.dag
+
+            # checkpoints live under the task folder; a distributed job's
+            # ranks all write to the PARENT's folder (train/executor.py
+            # _checkpoint_folder), so resolve through task.parent
+            ck_task = task.parent or task.id
+            ck_dir = os.path.join(TASK_FOLDER, str(ck_task), 'checkpoints')
+            src = self.file and os.path.join(ck_dir, self.file)
+            if not src or not os.path.exists(src):
+                src = os.path.join(ck_dir, 'best.msgpack')
+            if not os.path.exists(src):
+                src = os.path.join(ck_dir, 'last.msgpack')
+            if not os.path.exists(src):
+                raise FileNotFoundError(
+                    f'no checkpoint under {ck_dir!r} to register')
+
+            spec = self._train_model_spec(task)
+            if not spec:
+                raise ValueError(
+                    f'train task {task.id} has no model spec in its '
+                    f'dag config — cannot build a loadable export')
+            project = ProjectProvider(self.session).by_id(project_id)
+            folder = os.path.join(
+                MODEL_FOLDER, project.name if project else 'default')
+            from mlcomp_tpu.train.export import export_from_checkpoint
+            out = export_from_checkpoint(
+                src, spec, os.path.join(folder, self.name),
+                meta={'score': task.score})
+            self.info(f'registered model {self.name!r} from task '
+                      f'{task.id} -> {out}')
+
+        existing = provider.by_name(self.name)
+        if existing is not None:
+            for field in ('score_local', 'dag', 'project', 'equations'):
+                value = getattr(model, field)
+                if value is not None and value != '':
+                    setattr(existing, field, value)
+            provider.update(existing)
+            return {'model': existing.id}
+        provider.add(model)
+        return {'model': model.id}
+
+
+__all__ = ['ModelAdd']

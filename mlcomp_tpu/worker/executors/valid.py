@@ -1,0 +1,123 @@
+"""Valid executors (parity: reference worker/executors/valid.py:10-82).
+
+``Valid`` is the abstract scoring harness over Equation parts; on finish
+it writes ``task.score`` and, when a model is attached, the Model row's
+``score_local`` — the numbers the UI's task/model tables rank by.
+``ValidClassify`` scores saved (or freshly inferred) class-probability
+predictions against a labeled dataset.
+"""
+
+import numpy as np
+
+from mlcomp_tpu.worker.executors.base.equation import Equation
+from mlcomp_tpu.worker.executors.base.executor import Executor
+from mlcomp_tpu.worker.executors.dataset_input import DatasetInputMixin
+
+
+@Executor.register
+class Valid(Equation):
+    def __init__(self, layout: str = None, fold_number: int = 0,
+                 plot_count: int = 0, **kwargs):
+        super().__init__(**kwargs)
+        self.layout = layout
+        self.fold_number = int(fold_number)
+        self.plot_count = int(plot_count)
+
+    def key(self) -> str:
+        return 'y'
+
+    def score(self, preds) -> float:
+        raise NotImplementedError
+
+    def score_final(self) -> float:
+        raise NotImplementedError
+
+    def plot(self, preds, score):
+        """Optional per-part report hook (wired by report builders)."""
+
+    def plot_final(self, score):
+        pass
+
+    def work(self):
+        self.create_base()
+        parts = self.generate_parts(self.count())
+        for preds in self.solve(self.key(), parts):
+            score = self.score(preds)
+            if self.layout and self.plot_count > 0:
+                self.plot(preds, score)
+        final = self.score_final()
+        final = -1.0 if final is None or np.isnan(final) else float(final)
+        if self.layout:
+            self.plot_final(final)
+        self._write_scores(final)
+        return {'score': final}
+
+    def _write_scores(self, score: float):
+        """task.score + model.score_local (reference valid.py:74-81)."""
+        if self.session is None:
+            return
+        if self.task is not None:
+            from mlcomp_tpu.db.providers import TaskProvider
+            self.task.score = score
+            TaskProvider(self.session).update(self.task, ['score'])
+        model_name = self._resolve_model_name()
+        if self.model_id or model_name:
+            from mlcomp_tpu.db.providers import ModelProvider
+            provider = ModelProvider(self.session)
+            row = provider.by_id(self.model_id) if self.model_id \
+                else provider.by_name(model_name)
+            if row is not None:
+                row.score_local = score
+                provider.update(row, ['score_local'])
+
+
+@Executor.register
+class ValidClassify(DatasetInputMixin, Valid):
+    """Accuracy/F1 of class-probability predictions vs dataset labels.
+
+    Config::
+
+        valid:
+          type: valid_classify
+          dataset: {path: d.npz, fold_csv: fold.csv, fold_number: 0}
+          y: load('my_model')           # or an ensemble expression
+          metric: accuracy              # or f1_macro
+    """
+
+    def __init__(self, y: str = None, metric: str = 'accuracy', **kwargs):
+        super().__init__(**kwargs)
+        self.y = y or "load()"
+        self.metric = metric
+        self._correct = 0
+        self._f1_true = []
+        self._f1_pred = []
+        self._seen = 0
+
+    def create_base(self):
+        self.x, self.y_true = self.load_dataset_arrays(part='valid')
+        if self.y_true is None:
+            raise ValueError('valid_classify needs a labeled dataset')
+
+    def score(self, preds) -> float:
+        preds = np.asarray(preds)
+        labels = preds.argmax(-1) if preds.ndim > 1 else preds
+        lo, hi = self.part
+        truth = self.y_true[lo:hi if hi is not None else len(self.y_true)]
+        labels = labels[:len(truth)]
+        self._correct += int((labels == truth).sum())
+        self._seen += len(truth)
+        self._f1_true.append(truth)
+        self._f1_pred.append(labels)
+        return float((labels == truth).mean()) if len(truth) else 0.0
+
+    def score_final(self) -> float:
+        if self._seen == 0:
+            return float('nan')
+        if self.metric == 'f1_macro':
+            from mlcomp_tpu.contrib.metrics import f1_macro
+            return f1_macro(np.concatenate(self._f1_true),
+                            np.concatenate(self._f1_pred))
+        return self._correct / self._seen
+
+
+__all__ = ['Valid', 'ValidClassify']

@@ -1,0 +1,158 @@
+"""Pipe DAGs + ModelAdd (VERDICT round-1 item 8): register a pipe,
+add a trained model, start the pipe for it, run it end-to-end."""
+
+import numpy as np
+import pytest
+
+from mlcomp_tpu.db.enums import DagType, TaskStatus
+from mlcomp_tpu.db.providers import (
+    DagProvider, ModelProvider, TaskProvider,
+)
+from mlcomp_tpu.server.create_dags import (
+    dag_model_add, dag_model_start, dag_pipe, dag_standard,
+)
+from mlcomp_tpu.worker.tasks import execute_by_id
+
+DATASET = {'name': 'synthetic_images', 'n_train': 256, 'n_valid': 64,
+           'image_size': 8, 'channels': 1, 'num_classes': 4}
+
+TRAIN_CONFIG = {
+    'info': {'name': 'train_dag', 'project': 'p_pipes'},
+    'executors': {
+        'train': {
+            'type': 'jax_train',
+            'model': {'name': 'mlp', 'num_classes': 4, 'hidden': [32],
+                      'dtype': 'float32'},
+            'dataset': DATASET,
+            'batch_size': 64,
+            'stages': [{'name': 's1', 'epochs': 2,
+                        'optimizer': {'name': 'adam', 'lr': 3e-3}}],
+        },
+    },
+}
+
+PIPE_CONFIG = {
+    'info': {'name': 'serve_pipe', 'project': 'p_pipes'},
+    'pipes': {
+        'serve_pipe': {
+            'infer': {
+                'type': 'infer_classify',
+                'dataset': DATASET,
+                'batch_size': 64,
+            },
+            'valid': {
+                'type': 'valid_classify',
+                'dataset': DATASET,
+                'depends': 'infer',
+            },
+        },
+    },
+}
+
+
+def _run_all(session, tasks):
+    for name in tasks:
+        for tid in tasks[name]:
+            execute_by_id(tid, exit=False, session=session)
+
+
+class TestPipeFlow:
+    def test_full_model_lifecycle(self, session):
+        tp = TaskProvider(session)
+        # 1. train
+        _dag, tasks = dag_standard(session, TRAIN_CONFIG)
+        _run_all(session, tasks)
+        train_tid = tasks['train'][0]
+        assert tp.by_id(train_tid).status == int(TaskStatus.Success)
+
+        # 2. register the model from the finished train task
+        add_dag = dag_model_add(session, {
+            'name': 'prod_model', 'task': train_tid})
+        add_tasks = tp.by_dag(add_dag.id)
+        for t in add_tasks:
+            execute_by_id(t.id, exit=False, session=session)
+        model = ModelProvider(session).by_name('prod_model')
+        assert model is not None
+        assert model.score_local is not None
+
+        # 3. register the pipe
+        pipe_dag = dag_pipe(session, PIPE_CONFIG)
+        assert pipe_dag.type == int(DagType.Pipe)
+        # no tasks created by registration
+        assert tp.by_dag(pipe_dag.id) == []
+
+        # 4. start the pipe for the model
+        run_dag = dag_model_start(session, {
+            'model_id': model.id,
+            'dag': pipe_dag.id,
+            'pipe': {'name': 'serve_pipe', 'versions': []},
+        })
+        run_tasks = tp.by_dag(run_dag.id)
+        assert len(run_tasks) == 2
+        for t in sorted(run_tasks, key=lambda t: t.id):
+            execute_by_id(t.id, exit=False, session=session)
+        for t in tp.by_dag(run_dag.id):
+            assert t.status == int(TaskStatus.Success), t.name
+        # the pipe's valid stage scored the model
+        model = ModelProvider(session).by_name('prod_model')
+        valid_task = [t for t in tp.by_dag(run_dag.id)
+                      if t.executor == 'valid'][0]
+        assert valid_task.score is not None
+        assert valid_task.score > 0.6
+        assert model.score_local == pytest.approx(valid_task.score)
+
+    def test_model_add_without_task_creates_row(self, session):
+        from mlcomp_tpu.db.providers import ProjectProvider
+        p = ProjectProvider(session).add_project('p_pipes_bare')
+        result = dag_model_add(session, {
+            'name': 'bare_model', 'project': p.id})
+        assert result is None
+        assert ModelProvider(session).by_name('bare_model') is not None
+
+    def test_pipe_repoints_same_named_models(self, session):
+        from mlcomp_tpu.db.models import Model
+        from mlcomp_tpu.db.providers import ProjectProvider
+        from mlcomp_tpu.utils.misc import now
+        p = ProjectProvider(session).add_project('p_pipes_repoint')
+        provider = ModelProvider(session)
+        config = {
+            'info': {'name': 'serve_pipe', 'project': 'p_pipes_repoint'},
+            'pipes': {'serve_pipe': {'x': {'type': 'equation'}}},
+        }
+        first = dag_pipe(session, config)
+        provider.add(Model(name='serve_pipe', project=p.id,
+                           dag=first.id, created=now()))
+        second = dag_pipe(session, config)
+        model = provider.by_name('serve_pipe')
+        assert model.dag == second.id
+
+    def test_version_overlay_merges_equations(self, session):
+        tp = TaskProvider(session)
+        _dag, tasks = dag_standard(session, TRAIN_CONFIG)
+        _run_all(session, tasks)
+        add_dag = dag_model_add(session, {
+            'name': 'ver_model', 'task': tasks['train'][0]})
+        for t in tp.by_dag(add_dag.id):
+            execute_by_id(t.id, exit=False, session=session)
+        model = ModelProvider(session).by_name('ver_model')
+        pipe_dag = dag_pipe(session, PIPE_CONFIG)
+        run_dag = dag_model_start(session, {
+            'model_id': model.id,
+            'dag': pipe_dag.id,
+            'pipe': {
+                'name': 'serve_pipe',
+                'versions': [{'name': 'v1',
+                              'equations': {'infer': {'batch_size': 32}}}],
+                'version': {'name': 'v1',
+                            'equations': {'infer': {'batch_size': 32}}},
+            },
+        })
+        from mlcomp_tpu.utils.io import yaml_load
+        config = yaml_load(DagProvider(session).by_id(run_dag.id).config)
+        assert config['executors']['infer']['batch_size'] == 32
+        assert config['executors']['infer']['model_name'] == 'ver_model'
+        # version usage recorded on the model row
+        model = ModelProvider(session).by_name('ver_model')
+        eqs = yaml_load(model.equations)
+        assert eqs['serve_pipe'][0]['name'] == 'v1'
+        assert eqs['serve_pipe'][0].get('used')
