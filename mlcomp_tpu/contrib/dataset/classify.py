@@ -63,10 +63,12 @@ def balance_max_count(rows: list, max_count, label_key: str = 'label'):
         by_label.setdefault(int(row[label_key]), []).append(row)
     ratios = list(max_count)
     # anchor at the class that most constrains the ratio: the one with
-    # the smallest available count per unit of requested ratio
+    # the smallest available count per unit of requested ratio. Classes
+    # absent from the rows don't constrain (a missing class must not
+    # zero out the whole dataset).
     scale = min(
-        (len(by_label.get(cls, ())) / ratios[cls]
-         for cls in range(len(ratios)) if ratios[cls] > 0),
+        (len(by_label[cls]) / ratios[cls]
+         for cls in by_label if cls < len(ratios) and ratios[cls] > 0),
         default=0)
     out = []
     for cls in sorted(by_label):

@@ -47,15 +47,21 @@ def confusion_matrix(y_true: np.ndarray, y_pred: np.ndarray,
     return out
 
 
-def f1_macro(y_true: np.ndarray, y_pred: np.ndarray,
-             num_classes: int = None) -> float:
+def per_class_prf(y_true: np.ndarray, y_pred: np.ndarray,
+                 num_classes: int = None):
+    """(precision, recall, f1) arrays, one entry per class."""
     cm = confusion_matrix(y_true, y_pred, num_classes)
     tp = np.diag(cm).astype(np.float64)
     precision = tp / np.maximum(cm.sum(0), 1)
     recall = tp / np.maximum(cm.sum(1), 1)
     f1 = 2 * precision * recall / np.maximum(precision + recall, 1e-12)
-    return float(f1.mean())
+    return precision, recall, f1
+
+
+def f1_macro(y_true: np.ndarray, y_pred: np.ndarray,
+             num_classes: int = None) -> float:
+    return float(per_class_prf(y_true, y_pred, num_classes)[2].mean())
 
 
 __all__ = ['dice_numpy', 'iou_numpy', 'accuracy', 'f1_macro',
-           'confusion_matrix']
+           'per_class_prf', 'confusion_matrix']

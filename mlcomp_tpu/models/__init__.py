@@ -6,6 +6,9 @@ from mlcomp_tpu.models.base import (
 )
 from mlcomp_tpu.models.mlp import MLP
 from mlcomp_tpu.models.resnet import ResNet, BasicBlock, Bottleneck
+from mlcomp_tpu.models.segmentation import (
+    DeepLabV3, FPN, LinkNet, PSPNet, ResNetEncoder,
+)
 from mlcomp_tpu.models.transformer import (
     TransformerConfig, TransformerLM,
 )
@@ -15,4 +18,5 @@ __all__ = [
     'create_model', 'model_names', 'param_count', 'register_model',
     'MLP', 'ResNet', 'BasicBlock', 'Bottleneck',
     'TransformerConfig', 'TransformerLM', 'UNet',
+    'ResNetEncoder', 'FPN', 'LinkNet', 'PSPNet', 'DeepLabV3',
 ]

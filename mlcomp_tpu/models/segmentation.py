@@ -1,0 +1,263 @@
+"""Segmentation zoo: ResNet encoders + FPN / LinkNet / PSPNet / DeepLab-
+style decoders in flax.
+
+Parity: the reference vendors ~3,170 LoC of torch segmentation models
+(reference contrib/segmentation/: Unet/Linknet/FPN/PSPNet over 8 encoder
+families + DeepLabV3). Here the same families are implemented natively:
+NHWC layout, bf16 compute, logical partitioning on conv kernels so fsdp
+meshes shard them, and ``jax.image.resize`` for the up-paths (lowers to
+XLA gather/convolution — no host round trips).
+
+Config naming: ``{name: fpn, encoder: resnet34, num_classes: 21}``,
+or the flat aliases ``fpn_resnet18`` etc.
+"""
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mlcomp_tpu.models.base import register_model
+from mlcomp_tpu.models.resnet import (
+    BasicBlock, Bottleneck, conv_kernel_init,
+)
+
+ModuleDef = Any
+
+
+class ResNetEncoder(nn.Module):
+    """ResNet trunk returning the feature pyramid [c1..c5]
+    (strides 2, 4, 8, 16, 32 for the ImageNet stem; CIFAR stem keeps
+    full resolution at c1)."""
+    stage_sizes: Sequence[int]
+    block: ModuleDef
+    num_filters: int = 64
+    cifar_stem: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       kernel_init=conv_kernel_init())
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        act = nn.relu
+
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = conv(self.num_filters, (3, 3), name='conv_stem')(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name='conv_stem')(x)
+        x = norm(name='norm_stem')(x)
+        x = act(x)
+        features = [x]                        # c1
+        if not self.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block(self.num_filters * 2 ** i, conv=conv,
+                               norm=norm, act=act, strides=strides)(x)
+            features.append(x)                # c2..c5
+        return features
+
+
+_ENCODERS = {
+    'resnet18': ([2, 2, 2, 2], BasicBlock),
+    'resnet34': ([3, 4, 6, 3], BasicBlock),
+    'resnet50': ([3, 4, 6, 3], Bottleneck),
+    'resnet101': ([3, 4, 23, 3], Bottleneck),
+}
+
+
+def make_encoder(encoder: str, dtype, cifar_stem: bool = False):
+    sizes, block = _ENCODERS[encoder]
+    return ResNetEncoder(stage_sizes=sizes, block=block,
+                         cifar_stem=cifar_stem, dtype=dtype)
+
+
+def _resize_to(x, target_hw, method: str = 'bilinear'):
+    b, h, w, c = x.shape
+    return jax.image.resize(x, (b, *target_hw, c), method=method)
+
+
+def _conv_norm_act(x, features, kernel, norm, dtype, name):
+    x = nn.Conv(features, kernel, use_bias=False, dtype=dtype,
+                kernel_init=conv_kernel_init(), name=f'{name}_conv')(x)
+    x = norm(name=f'{name}_norm')(x)
+    return nn.relu(x)
+
+
+class _SegmentationBase(nn.Module):
+    """Shared head plumbing: decoders produce a feature map at some
+    fraction of input resolution; the head projects to classes in f32
+    and resizes to the input size."""
+    num_classes: int = 2
+    encoder: str = 'resnet18'
+    dtype: jnp.dtype = jnp.bfloat16
+    cifar_stem: bool = False
+
+    def head(self, x, input_hw):
+        x = nn.Conv(self.num_classes, (1, 1), dtype=jnp.float32,
+                    kernel_init=nn.with_logical_partitioning(
+                        nn.initializers.lecun_normal(),
+                        ('conv_h', 'conv_w', 'conv_in', 'vocab')),
+                    name='classifier')(x.astype(jnp.float32))
+        return _resize_to(x, input_hw)
+
+
+class FPN(_SegmentationBase):
+    """Feature Pyramid Network decoder (reference
+    contrib/segmentation/fpn/): lateral 1x1s + top-down adds, per-level
+    3x3 segmentation blocks, merged by summation at 1/4 scale."""
+    pyramid_channels: int = 128
+    segmentation_channels: int = 64
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        input_hw = x.shape[1:3]
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        feats = make_encoder(self.encoder, self.dtype,
+                             self.cifar_stem)(x, train=train)
+        c2, c3, c4, c5 = feats[1], feats[2], feats[3], feats[4]
+
+        lateral = partial(nn.Conv, features=self.pyramid_channels,
+                          kernel_size=(1, 1), dtype=self.dtype,
+                          kernel_init=conv_kernel_init())
+        p5 = lateral(name='lateral5')(c5)
+        p4 = lateral(name='lateral4')(c4) + _resize_to(p5, c4.shape[1:3])
+        p3 = lateral(name='lateral3')(c3) + _resize_to(p4, c3.shape[1:3])
+        p2 = lateral(name='lateral2')(c2) + _resize_to(p3, c2.shape[1:3])
+
+        out_hw = c2.shape[1:3]
+        merged = None
+        for i, p in enumerate((p5, p4, p3, p2)):
+            s = _conv_norm_act(p, self.segmentation_channels, (3, 3),
+                               norm, self.dtype, name=f'seg{i}')
+            s = _resize_to(s, out_hw)
+            merged = s if merged is None else merged + s
+        return self.head(merged, input_hw)
+
+
+class LinkNet(_SegmentationBase):
+    """LinkNet decoder (reference contrib/segmentation/linknet/):
+    bottlenecked transpose-conv up-blocks with additive skips."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        input_hw = x.shape[1:3]
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        feats = make_encoder(self.encoder, self.dtype,
+                             self.cifar_stem)(x, train=train)
+        skips = feats[1:4]            # c2, c3, c4
+        y = feats[4]                  # c5
+        for i, skip in enumerate(reversed(skips)):
+            ch = skip.shape[-1]
+            y = _conv_norm_act(y, max(ch // 4, 16), (1, 1), norm,
+                               self.dtype, name=f'up{i}_reduce')
+            y = _resize_to(y, skip.shape[1:3])
+            y = _conv_norm_act(y, max(ch // 4, 16), (3, 3), norm,
+                               self.dtype, name=f'up{i}_conv')
+            y = _conv_norm_act(y, ch, (1, 1), norm, self.dtype,
+                               name=f'up{i}_expand')
+            y = y + skip
+        y = _conv_norm_act(y, 32, (3, 3), norm, self.dtype, name='final')
+        return self.head(y, input_hw)
+
+
+class PSPNet(_SegmentationBase):
+    """Pyramid Scene Parsing decoder (reference
+    contrib/segmentation/pspnet/): adaptive-pool the deepest features to
+    1/2/3/6 bins, project, resize back, concat, fuse."""
+    bins: Sequence[int] = (1, 2, 3, 6)
+    psp_channels: int = 128
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        input_hw = x.shape[1:3]
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        feats = make_encoder(self.encoder, self.dtype,
+                             self.cifar_stem)(x, train=train)
+        c5 = feats[4]
+        h, w = c5.shape[1:3]
+        pooled = [c5]
+        for bi, bins in enumerate(self.bins):
+            # adaptive average pool to bins x bins
+            ph, pw = max(h // bins, 1), max(w // bins, 1)
+            p = nn.avg_pool(c5, (ph, pw), strides=(ph, pw))
+            p = _conv_norm_act(p, self.psp_channels, (1, 1), norm,
+                               self.dtype, name=f'psp{bi}')
+            pooled.append(_resize_to(p, (h, w)))
+        y = jnp.concatenate(pooled, axis=-1)
+        y = _conv_norm_act(y, self.psp_channels * 2, (3, 3), norm,
+                           self.dtype, name='fuse')
+        return self.head(y, input_hw)
+
+
+class DeepLabV3(_SegmentationBase):
+    """ASPP decoder (reference contrib/segmentation/deeplabv3/):
+    parallel atrous convs at multiple rates + image-level pooling."""
+    aspp_channels: int = 128
+    rates: Sequence[int] = (1, 6, 12, 18)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        input_hw = x.shape[1:3]
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        feats = make_encoder(self.encoder, self.dtype,
+                             self.cifar_stem)(x, train=train)
+        c5 = feats[4]
+        h, w = c5.shape[1:3]
+        branches = []
+        for ri, rate in enumerate(self.rates):
+            kernel = (1, 1) if rate == 1 else (3, 3)
+            y = nn.Conv(self.aspp_channels, kernel, use_bias=False,
+                        kernel_dilation=(rate, rate), dtype=self.dtype,
+                        kernel_init=conv_kernel_init(),
+                        name=f'aspp{ri}_conv')(c5)
+            y = norm(name=f'aspp{ri}_norm')(y)
+            branches.append(nn.relu(y))
+        img_pool = jnp.mean(c5, axis=(1, 2), keepdims=True)
+        img_pool = _conv_norm_act(img_pool, self.aspp_channels, (1, 1),
+                                  norm, self.dtype, name='img_pool')
+        branches.append(_resize_to(img_pool, (h, w), method='nearest'))
+        y = jnp.concatenate(branches, axis=-1)
+        y = _conv_norm_act(y, self.aspp_channels, (1, 1), norm,
+                           self.dtype, name='project')
+        return self.head(y, input_hw)
+
+
+_DECODERS = {'fpn': FPN, 'linknet': LinkNet, 'pspnet': PSPNet,
+             'deeplabv3': DeepLabV3}
+
+
+def _seg_factory(decoder_cls):
+    def factory(num_classes=2, encoder='resnet18', dtype='bfloat16',
+                cifar_stem=False, **kwargs):
+        extra = {k: v for k, v in kwargs.items()
+                 if k in decoder_cls.__dataclass_fields__}
+        return decoder_cls(num_classes=num_classes, encoder=encoder,
+                           dtype=jnp.dtype(dtype),
+                           cifar_stem=bool(cifar_stem), **extra)
+    return factory
+
+
+for _dec_name, _cls in _DECODERS.items():
+    register_model(_dec_name)(_seg_factory(_cls))
+    for _enc in _ENCODERS:
+        def _alias(num_classes=2, dtype='bfloat16', cifar_stem=False,
+                   _cls=_cls, _enc=_enc, **kwargs):
+            return _seg_factory(_cls)(
+                num_classes=num_classes, encoder=_enc, dtype=dtype,
+                cifar_stem=cifar_stem, **kwargs)
+        register_model(f'{_dec_name}_{_enc}')(_alias)
+
+
+__all__ = ['ResNetEncoder', 'FPN', 'LinkNet', 'PSPNet', 'DeepLabV3',
+           'make_encoder']

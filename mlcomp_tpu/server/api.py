@@ -468,15 +468,18 @@ def api_remove_files(data, s):
 def api_stop(data, s):
     """Stop worker daemons on this host (reference app.py:710-730 stops
     the celery components; the API/supervisor process itself stays up —
-    use /api/shutdown for that). Process-group parents (``server start``
-    / ``worker start``) are terminated FIRST so their autorestart loop
-    can't respawn the workers killed right after."""
+    use /api/shutdown for that). ``worker start`` group parents are
+    terminated FIRST so their autorestart loop can't respawn the workers
+    killed right after. A ``server start`` parent is left alone — its
+    SIGTERM handler would take the API down with it; under that
+    deployment the workers it supervises come back, and stopping them
+    for good means /api/shutdown or ``mlcomp_tpu.server stop``."""
     import os
     import re
 
     import psutil
     me = os.getpid()
-    group_parent = re.compile(r'mlcomp_tpu\.(server|worker) start( |$)')
+    group_parent = re.compile(r'mlcomp_tpu\.worker start( |$)')
 
     def matching(predicate):
         out = []

@@ -38,11 +38,13 @@ def dag_pipe(session, config: dict, config_text: str = None,
     if upload_folder:
         Storage(session, logger).upload(upload_folder, dag)
 
-    # re-point same-named models at this pipe registration
-    # (reference pipe.py:31-33 ModelProvider.change_dag)
-    session.execute(
-        'UPDATE model SET dag=? WHERE project=? AND name=?',
-        (dag.id, project.id, info.get('name')))
+    # re-point same-named models at this pipe registration — match the
+    # registered pipe names AND the dag name (reference pipe.py:31-33)
+    names = set(config['pipes']) | {info.get('name')}
+    for name in filter(None, names):
+        session.execute(
+            'UPDATE model SET dag=? WHERE project=? AND name=?',
+            (dag.id, project.id, name))
     return dag
 
 

@@ -61,7 +61,8 @@ class JaxTrain(Executor):
                  stages=None, epochs=1, optimizer=None,
                  main_metric='accuracy', minimize=False,
                  model_name=None, seed=0, checkpoint_dir=None,
-                 stage_per_dispatch=False, log_every=50, **kwargs):
+                 stage_per_dispatch=False, log_every=50,
+                 report_imgs=None, **kwargs):
         self.model_spec = dict(model or {'name': 'mlp'})
         self.dataset_spec = dict(dataset or {})
         self.loss_name = loss
@@ -78,6 +79,7 @@ class JaxTrain(Executor):
         self.checkpoint_dir = checkpoint_dir
         self.stage_per_dispatch = bool(stage_per_dispatch)
         self.log_every = int(log_every)
+        self.report_imgs = dict(report_imgs) if report_imgs else None
 
     # ------------------------------------------------------------ plumbing
     def _init_distributed(self):
@@ -353,20 +355,75 @@ class JaxTrain(Executor):
                          'step': int(state.step)},
                         best=is_best)
                 global_epoch += 1
-            if dispatch_stage is not None or (
-                    self.stage_per_dispatch and stage is not remaining[-1]):
-                # return for requeue: next dispatch runs the next stage
+            if (dispatch_stage is not None or self.stage_per_dispatch) \
+                    and stage_name != stage_names[-1]:
+                # return for requeue: next dispatch runs the next stage.
+                # The LAST stage's dispatch falls through instead so the
+                # model export / report-img pass still runs.
                 return {'stage': stage_name, 'stages': stage_names,
                         'best_score': best}
 
         if self._is_main and self.model_name:
             self._export_model(ck_dir, best)
+        if self._is_main and self.report_imgs and self.session \
+                and self.task is not None:
+            self._build_report_imgs(model, state, mesh, x_valid, y_valid,
+                                    max(global_epoch - 1, 0))
 
         wall = time.time() - t_start
         return {'stage': stage_names[-1], 'stages': stage_names,
                 'best_score': best, 'n_params': n_params,
                 'wall_time_s': wall,
                 'samples_per_sec': images_seen / max(wall, 1e-9)}
+
+    def _build_report_imgs(self, model, state, mesh, x_valid, y_valid,
+                           epoch):
+        """UI gallery artifacts from the final state (reference wires
+        these as Catalyst callbacks, worker/executors/catalyst/f1.py;
+        here one post-train pass over the validation set)."""
+        import flax.linen as nn
+        import jax.numpy as jnp
+        from mlcomp_tpu.parallel.sharding import logical_rules
+        from mlcomp_tpu.train.loop import _apply
+
+        spec = self.report_imgs
+        kind = spec.get('type', 'classification')
+        rules = logical_rules(mesh)
+
+        @jax.jit
+        def forward(s, x):
+            with mesh, nn.logical_axis_rules(rules):
+                logits, _ = _apply(model, s, x, train=False)
+                return jax.nn.softmax(jnp.asarray(logits, jnp.float32))
+
+        dp = max(1, data_parallel_size(mesh))
+        probs = []
+        for bx, _ in iterate_batches(x_valid, None, self.eval_batch_size,
+                                     drop_last=False):
+            n_real = len(bx)
+            n_padded = -(-n_real // dp) * dp
+            if n_padded != n_real:
+                bx = bx[np.resize(np.arange(n_real), n_padded)]
+            x, _ = place_batch((bx, None), mesh)
+            probs.append(np.asarray(forward(state, x))[:n_real])
+        probs = np.concatenate(probs) if probs else np.empty((0,))
+
+        common = dict(
+            session=self.session, task=self.task, part='valid',
+            plot_count=int(spec.get('plot_count', 64)))
+        if kind == 'segmentation':
+            from mlcomp_tpu.worker.reports import SegmentationReportBuilder
+            builder = SegmentationReportBuilder(**common)
+            n = builder.build(x_valid, y_valid, probs.argmax(-1),
+                              epoch=epoch)
+        else:
+            from mlcomp_tpu.worker.reports import (
+                ClassificationReportBuilder,
+            )
+            builder = ClassificationReportBuilder(
+                class_names=spec.get('class_names'), **common)
+            n = builder.build(x_valid, y_valid, probs, epoch=epoch)
+        self.info(f'report imgs: {n} {kind} rows for epoch {epoch}')
 
     def _export_model(self, ck_dir, best_score):
         """Write the deployable export for the model registry — the

@@ -1,0 +1,137 @@
+"""Plot utilities (parity: reference utils/plot.py:10-185).
+
+Figure/array → compressed image bytes for ``report_img`` rows. Pure
+matplotlib (Agg) + cv2; everything returns bytes so producers never
+touch the filesystem.
+"""
+
+import io
+
+import numpy as np
+
+
+def figure_to_bytes(figure, format: str = 'jpg', **kwargs) -> bytes:
+    buf = io.BytesIO()
+    figure.savefig(buf, format=format, bbox_inches='tight', **kwargs)
+    data = buf.getvalue()
+    buf.close()
+    import matplotlib.pyplot as plt
+    plt.close(figure)
+    return data
+
+
+def img_to_bytes(img: np.ndarray, quality: int = 90) -> bytes:
+    """Encode an HWC float/uint8 image (RGB or gray) as jpeg bytes."""
+    import cv2
+    arr = np.asarray(img)
+    if arr.dtype != np.uint8:
+        lo, hi = float(arr.min()), float(arr.max())
+        scale = 255.0 / (hi - lo) if hi > lo else 1.0
+        arr = ((arr - lo) * scale).astype(np.uint8)
+    if arr.ndim == 3 and arr.shape[2] == 3:
+        arr = cv2.cvtColor(arr, cv2.COLOR_RGB2BGR)
+    ok, enc = cv2.imencode('.jpg', arr,
+                           [int(cv2.IMWRITE_JPEG_QUALITY), quality])
+    if not ok:
+        raise ValueError('jpeg encoding failed')
+    return enc.tobytes()
+
+
+def bytes_to_img(data: bytes) -> np.ndarray:
+    import cv2
+    arr = cv2.imdecode(np.frombuffer(data, np.uint8), cv2.IMREAD_COLOR)
+    return cv2.cvtColor(arr, cv2.COLOR_BGR2RGB)
+
+
+def _heatmap_figure(matrix: np.ndarray, x_labels, y_labels, title: str,
+                    xlabel: str, ylabel: str, fmt: str):
+    import matplotlib
+    matplotlib.use('Agg', force=False)
+    import matplotlib.pyplot as plt
+    matrix = np.asarray(matrix)
+    fig, ax = plt.subplots(
+        figsize=(max(4, 0.6 * matrix.shape[1] + 2),
+                 max(3, 0.5 * matrix.shape[0] + 1.5)))
+    im = ax.imshow(matrix, cmap='Blues')
+    ax.set_xticks(range(matrix.shape[1]))
+    ax.set_xticklabels(x_labels, rotation=45, ha='right')
+    ax.set_yticks(range(matrix.shape[0]))
+    ax.set_yticklabels(y_labels)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.set_title(title)
+    threshold = matrix.max() / 2 if matrix.size else 0
+    for i in range(matrix.shape[0]):
+        for j in range(matrix.shape[1]):
+            color = 'white' if matrix[i, j] > threshold else 'black'
+            ax.text(j, i, fmt % matrix[i, j], ha='center', va='center',
+                    color=color, fontsize=8)
+    fig.colorbar(im, ax=ax, fraction=0.046)
+    return fig
+
+
+def confusion_matrix_plot(cm: np.ndarray, class_names=None,
+                          title: str = 'confusion matrix') -> bytes:
+    """Annotated heatmap of a confusion matrix → jpeg bytes
+    (reference utils/plot.py classification-report heatmap)."""
+    cm = np.asarray(cm)
+    names = class_names or [str(i) for i in range(cm.shape[0])]
+    fig = _heatmap_figure(cm, names, names, title,
+                          'predicted', 'true', '%d')
+    return figure_to_bytes(fig)
+
+
+def classification_report_plot(y_true, y_pred, class_names=None,
+                               num_classes: int = None) -> bytes:
+    """Per-class precision/recall/f1 heatmap → jpeg bytes."""
+    from mlcomp_tpu.contrib.metrics import per_class_prf
+    if num_classes is None and class_names:
+        num_classes = len(class_names)
+    precision, recall, f1 = per_class_prf(y_true, y_pred, num_classes)
+    matrix = np.stack([precision, recall, f1], axis=1)
+    names = class_names or [str(i) for i in range(len(precision))]
+    fig = _heatmap_figure(matrix, ['precision', 'recall', 'f1'], names,
+                          'classification report', '', 'class', '%.2f')
+    return figure_to_bytes(fig)
+
+
+def series_plot(series: dict, title: str = '', xlabel: str = 'epoch') \
+        -> bytes:
+    """{name: [values]} line chart → jpeg bytes (describe-style panels)."""
+    import matplotlib
+    matplotlib.use('Agg', force=False)
+    import matplotlib.pyplot as plt
+    fig, ax = plt.subplots(figsize=(6, 3.5))
+    for name, values in series.items():
+        ax.plot(values, label=name)
+    ax.set_xlabel(xlabel)
+    ax.set_title(title)
+    ax.legend(fontsize=8)
+    ax.grid(alpha=0.3)
+    return figure_to_bytes(fig)
+
+
+def mask_overlay(img: np.ndarray, mask: np.ndarray,
+                 alpha: float = 0.45) -> np.ndarray:
+    """Blend a class mask over an image with a fixed color cycle —
+    the segmentation gallery artifact (reference
+    worker/reports/segmenation.py encodes overlays)."""
+    colors = np.array([
+        [0, 0, 0], [255, 56, 56], [56, 168, 255], [56, 255, 116],
+        [255, 196, 56], [178, 56, 255], [56, 255, 230], [255, 120, 190],
+    ], np.float32)
+    arr = np.asarray(img, np.float32)
+    if arr.max() <= 1.0:
+        arr = arr * 255.0
+    if arr.ndim == 2:
+        arr = np.stack([arr] * 3, -1)
+    mask = np.asarray(mask, np.int64) % len(colors)
+    overlay = colors[mask]
+    blend = np.where(mask[..., None] > 0,
+                     (1 - alpha) * arr + alpha * overlay, arr)
+    return blend.astype(np.uint8)
+
+
+__all__ = ['figure_to_bytes', 'img_to_bytes', 'bytes_to_img',
+           'confusion_matrix_plot', 'classification_report_plot',
+           'series_plot', 'mask_overlay']

@@ -84,14 +84,17 @@ class ValidClassify(DatasetInputMixin, Valid):
           metric: accuracy              # or f1_macro
     """
 
-    def __init__(self, y: str = None, metric: str = 'accuracy', **kwargs):
+    def __init__(self, y: str = None, metric: str = 'accuracy',
+                 class_names=None, **kwargs):
         super().__init__(**kwargs)
         self.y = y or "load()"
         self.metric = metric
+        self.class_names = class_names
         self._correct = 0
         self._f1_true = []
         self._f1_pred = []
         self._seen = 0
+        self._plot_remaining = self.plot_count
 
     def create_base(self):
         self.x, self.y_true = self.load_dataset_arrays(part='valid')
@@ -118,6 +121,56 @@ class ValidClassify(DatasetInputMixin, Valid):
             return f1_macro(np.concatenate(self._f1_true),
                             np.concatenate(self._f1_pred))
         return self._correct / self._seen
+
+    # ------------------------------------------------------ report hooks
+    def plot(self, preds, score):
+        """Per-part gallery rows (reference wires report builders here);
+        requires a task + session (no-op in bare library use)."""
+        if self.session is None or self.task is None \
+                or self._plot_remaining <= 0:
+            return
+        from mlcomp_tpu.worker.reports import ClassificationReportBuilder
+        preds = np.asarray(preds)
+        lo, hi = self.part
+        hi = hi if hi is not None else len(self.y_true)
+        n_part = min(hi - lo, len(preds))
+        n = min(n_part, self._plot_remaining)
+        builder = ClassificationReportBuilder(
+            self.session, self.task, part='valid',
+            plot_count=n, class_names=self.class_names)
+        # hand the builder the WHOLE part so its mistakes-first ordering
+        # picks the n samples worth looking at; the whole-set confusion
+        # matrix is written once in plot_final
+        builder.build(self.x[lo:lo + n_part], self.y_true[lo:lo + n_part],
+                      preds[:n_part], epoch=0, with_confusion=False)
+        self._plot_remaining -= n
+
+    def plot_final(self, score):
+        """Whole-set confusion matrix + classification report heatmap."""
+        if self.session is None or self.task is None or not self._f1_true:
+            return
+        from mlcomp_tpu.contrib.metrics import confusion_matrix
+        from mlcomp_tpu.db.models import ReportImg
+        from mlcomp_tpu.db.providers import ReportImgProvider
+        from mlcomp_tpu.utils.plot import (
+            classification_report_plot, confusion_matrix_plot,
+        )
+        y_true = np.concatenate(self._f1_true)
+        y_pred = np.concatenate(self._f1_pred)
+        n_cls = len(self.class_names) if self.class_names else None
+        provider = ReportImgProvider(self.session)
+        for group, img in (
+                ('classification_report',
+                 classification_report_plot(y_true, y_pred,
+                                            self.class_names)),
+                ('img_classify_confusion',
+                 confusion_matrix_plot(
+                     confusion_matrix(y_true, y_pred, n_cls),
+                     self.class_names))):
+            provider.add(ReportImg(
+                task=self.task.id, dag=self.task.dag, part='valid',
+                group=group, img=img, score=float(score),
+                size=len(img)))
 
 
 __all__ = ['Valid', 'ValidClassify']

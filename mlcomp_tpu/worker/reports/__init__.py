@@ -1,0 +1,8 @@
+from mlcomp_tpu.worker.reports.classification import (
+    ClassificationReportBuilder,
+)
+from mlcomp_tpu.worker.reports.segmentation import (
+    SegmentationReportBuilder,
+)
+
+__all__ = ['ClassificationReportBuilder', 'SegmentationReportBuilder']

@@ -1,0 +1,86 @@
+"""Classification report builder (parity: reference
+worker/reports/classification.py:22-152).
+
+Writes the UI gallery artifacts for a classification task: per-sample
+``report_img`` rows (image bytes + y/y_pred/score, filterable/pageable
+via ``/api/img_classify``) and an annotated confusion-matrix image.
+Producers call ``build`` once per epoch/part with host-side arrays —
+everything here is post-device numpy, nothing enters jit.
+"""
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from mlcomp_tpu.db.models import ReportImg
+from mlcomp_tpu.db.providers import ReportImgProvider
+from mlcomp_tpu.utils.misc import now  # noqa: F401  (kept for parity)
+from mlcomp_tpu.utils.plot import confusion_matrix_plot, img_to_bytes
+
+
+class ClassificationReportBuilder:
+    def __init__(self, session, task, part: str = 'valid',
+                 name: str = 'img_classify', plot_count: int = 64,
+                 class_names: Optional[Sequence[str]] = None,
+                 max_img_size: int = 128):
+        self.session = session
+        self.task = task
+        self.part = part
+        self.name = name
+        self.plot_count = int(plot_count)
+        self.class_names = list(class_names) if class_names else None
+        self.max_img_size = max_img_size
+        self.provider = ReportImgProvider(session)
+
+    def _resize(self, img: np.ndarray) -> np.ndarray:
+        h, w = img.shape[:2]
+        limit = self.max_img_size
+        if max(h, w) <= limit:
+            return img
+        import cv2
+        scale = limit / max(h, w)
+        return cv2.resize(img, (max(1, int(w * scale)),
+                                max(1, int(h * scale))))
+
+    def _img_row(self, **kwargs) -> ReportImg:
+        return ReportImg(
+            task=self.task.id, dag=self.task.dag, part=self.part,
+            **kwargs)
+
+    def build(self, imgs: np.ndarray, y: np.ndarray,
+              probs: np.ndarray, epoch: int = 0,
+              with_confusion: bool = True):
+        """imgs [N,H,W,C], y [N] true labels, probs [N,K] — saves the
+        ``plot_count`` LOWEST-confidence-correct + all wrong samples
+        (the ones worth looking at), then the confusion matrix."""
+        probs = np.asarray(probs)
+        y = np.asarray(y)
+        y_pred = probs.argmax(-1)
+        conf = probs[np.arange(len(probs)), y_pred]
+        # order: mistakes first, then least-confident corrects
+        order = np.lexsort((conf, (y_pred == y).astype(int)))
+        rows = []
+        for i in order[:self.plot_count]:
+            rows.append(self._img_row(
+                group=self.name, epoch=int(epoch),
+                img=img_to_bytes(self._resize(imgs[i])),
+                y=int(y[i]), y_pred=int(y_pred[i]),
+                score=float(conf[i]),
+                size=0))
+        if with_confusion:
+            from mlcomp_tpu.contrib.metrics import confusion_matrix
+            cm = confusion_matrix(
+                y, y_pred,
+                len(self.class_names) if self.class_names else None)
+            rows.append(self._img_row(
+                group=f'{self.name}_confusion', epoch=int(epoch),
+                img=confusion_matrix_plot(cm, self.class_names),
+                score=float((y_pred == y).mean()) if len(y) else 0.0,
+                size=0))
+        for row in rows:
+            row.size = len(row.img or b'')
+            self.provider.add(row)
+        return len(rows)
+
+
+__all__ = ['ClassificationReportBuilder']

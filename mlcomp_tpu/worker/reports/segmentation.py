@@ -1,0 +1,61 @@
+"""Segmentation report builder (parity: reference
+worker/reports/segmenation.py:16-173).
+
+Per-sample gallery rows showing (image | true-mask overlay | predicted-
+mask overlay) side by side, scored by dice — the artifact the UI's
+``img_segment`` gallery pages through.
+"""
+
+import numpy as np
+
+from mlcomp_tpu.contrib.metrics import dice_numpy
+from mlcomp_tpu.db.models import ReportImg
+from mlcomp_tpu.db.providers import ReportImgProvider
+from mlcomp_tpu.utils.plot import img_to_bytes, mask_overlay
+
+
+class SegmentationReportBuilder:
+    def __init__(self, session, task, part: str = 'valid',
+                 name: str = 'img_segment', plot_count: int = 16,
+                 max_img_size: int = 128):
+        self.session = session
+        self.task = task
+        self.part = part
+        self.name = name
+        self.plot_count = int(plot_count)
+        self.max_img_size = max_img_size
+        self.provider = ReportImgProvider(session)
+
+    def _panel(self, img, mask_true, mask_pred) -> np.ndarray:
+        true_overlay = mask_overlay(img, mask_true)
+        pred_overlay = mask_overlay(img, mask_pred)
+        base = mask_overlay(img, np.zeros_like(mask_true))
+        gap = np.full((base.shape[0], 2, 3), 255, np.uint8)
+        return np.concatenate(
+            [base, gap, true_overlay, gap, pred_overlay], axis=1)
+
+    def build(self, imgs: np.ndarray, masks: np.ndarray,
+              pred_masks: np.ndarray, epoch: int = 0):
+        """imgs [N,H,W,C], masks/pred_masks [N,H,W] int class ids.
+        Saves the ``plot_count`` worst-dice samples."""
+        masks = np.asarray(masks)
+        pred_masks = np.asarray(pred_masks)
+        scores = np.array([
+            dice_numpy(masks[i] > 0, pred_masks[i] > 0)
+            for i in range(len(masks))])
+        order = np.argsort(scores)
+        count = 0
+        for i in order[:self.plot_count]:
+            row = ReportImg(
+                task=self.task.id, dag=self.task.dag, part=self.part,
+                group=self.name, epoch=int(epoch),
+                img=img_to_bytes(
+                    self._panel(imgs[i], masks[i], pred_masks[i])),
+                score=float(scores[i]))
+            row.size = len(row.img or b'')
+            self.provider.add(row)
+            count += 1
+        return count
+
+
+__all__ = ['SegmentationReportBuilder']

@@ -295,3 +295,44 @@ class TestEnsemblePipeline:
                     for t in all_ids}
         assert all(s == 'Success' for s in statuses.values()), statuses
         assert tp.by_id(tasks['valid_ens'][0]).score > 0.6
+
+
+class TestStagePerDispatchExport:
+    def test_last_dispatch_exports_model(self, tmp_path, monkeypatch):
+        """Regression: with stage_per_dispatch, the FINAL stage's
+        dispatch must still write the model export."""
+        monkeypatch.chdir(tmp_path)
+        from test_train import DummyStep
+        from mlcomp_tpu.train import JaxTrain
+
+        spec = dict(
+            model={'name': 'mlp', 'num_classes': 4, 'hidden': [16],
+                   'dtype': 'float32'},
+            dataset={'name': 'synthetic_images', 'n_train': 128,
+                     'n_valid': 32, 'image_size': 8, 'channels': 1,
+                     'num_classes': 4},
+            batch_size=32, model_name='spd_model',
+            stage_per_dispatch=True,
+            checkpoint_dir=str(tmp_path / 'ck'),
+            stages=[
+                {'name': 's1', 'epochs': 1,
+                 'optimizer': {'name': 'adam', 'lr': 3e-3}},
+                {'name': 's2', 'epochs': 1,
+                 'optimizer': {'name': 'adam', 'lr': 1e-3}},
+            ])
+
+        def dispatch(info):
+            ex = JaxTrain(**spec)
+            ex.step = DummyStep()
+            ex.task = None
+            ex.session = None
+            ex.dag = None
+            ex.additional_info = info
+            return ex.work()
+
+        r1 = dispatch({})
+        assert r1['stage'] == 's1'
+        assert not os.path.exists('models/spd_model.msgpack')
+        r2 = dispatch({'stage': 's2'})
+        assert r2['stage'] == 's2'
+        assert os.path.exists('models/spd_model.msgpack')

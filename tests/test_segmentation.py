@@ -1,0 +1,113 @@
+"""Segmentation zoo + segmentation DAG (VERDICT round-1 item 7 'done'
+criterion: train→infer→report on synthetic VOC-shaped data)."""
+
+import numpy as np
+import pytest
+
+from mlcomp_tpu.models import create_model, model_names
+
+
+class TestDecoders:
+    @pytest.mark.parametrize('name', ['fpn', 'linknet', 'pspnet',
+                                      'deeplabv3'])
+    def test_forward_shape_and_grad(self, name):
+        import jax
+        import jax.numpy as jnp
+        model = create_model(name, num_classes=3, encoder='resnet18',
+                             dtype='float32')
+        x = np.random.rand(2, 16, 16, 3).astype(np.float32)
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (2, 16, 16, 3)
+
+        def loss(params):
+            logits = model.apply(
+                {'params': params,
+                 'batch_stats': variables['batch_stats']},
+                x, train=False)
+            return jnp.mean(logits ** 2)
+
+        grads = jax.grad(loss)(variables['params'])
+        flat = jax.tree.leaves(grads)
+        assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+
+    def test_encoder_aliases_registered(self):
+        names = model_names()
+        for dec in ('fpn', 'linknet', 'pspnet', 'deeplabv3'):
+            assert dec in names
+            assert f'{dec}_resnet18' in names
+            assert f'{dec}_resnet50' in names
+
+    def test_bottleneck_encoder(self):
+        import jax
+        model = create_model('fpn', num_classes=2, encoder='resnet50',
+                             dtype='float32')
+        x = np.random.rand(1, 32, 32, 3).astype(np.float32)
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        assert model.apply(variables, x,
+                           train=False).shape == (1, 32, 32, 2)
+
+
+class TestSegmentationDag:
+    def test_train_infer_report(self, session):
+        """FPN on synthetic rectangles: dice loss learns, report imgs
+        and predictions produced, all through the DAG machinery."""
+        from mlcomp_tpu.db.enums import TaskStatus
+        from mlcomp_tpu.db.providers import (
+            ReportImgProvider, TaskProvider,
+        )
+        from mlcomp_tpu.server.create_dags import dag_standard
+        from mlcomp_tpu.worker.tasks import execute_by_id
+
+        dataset = {'name': 'synthetic_segmentation', 'n_train': 64,
+                   'n_valid': 16, 'image_size': 16, 'num_classes': 2}
+        config = {
+            'info': {'name': 'seg_dag', 'project': 'p_seg'},
+            'executors': {
+                'train': {
+                    'type': 'jax_train',
+                    'model': {'name': 'fpn', 'encoder': 'resnet18',
+                              'num_classes': 2, 'dtype': 'float32',
+                              'cifar_stem': True},
+                    'dataset': dataset,
+                    'loss': 'bce_dice',
+                    'batch_size': 16,
+                    'main_metric': 'dice',
+                    'model_name': 'seg_model',
+                    'report_imgs': {'type': 'segmentation',
+                                    'plot_count': 4},
+                    'stages': [{'name': 's1', 'epochs': 2,
+                                'optimizer': {'name': 'adam',
+                                              'lr': 3e-3}}],
+                },
+                'infer': {
+                    'type': 'infer_classify',
+                    'model_name': 'seg_model',
+                    'dataset': dataset,
+                    'activation': 'argmax',
+                    'batch_size': 16,
+                    'depends': 'train',
+                },
+            },
+        }
+        dag, tasks = dag_standard(session, config)
+        tp = TaskProvider(session)
+        for name in ('train', 'infer'):
+            for tid in tasks[name]:
+                execute_by_id(tid, exit=False, session=session)
+        train_task = tp.by_id(tasks['train'][0])
+        assert train_task.status == int(TaskStatus.Success), \
+            train_task.result
+        assert train_task.score is not None and train_task.score > 0.5
+        # segmentation gallery rows written
+        rows = ReportImgProvider(session).get(
+            {'task': train_task.id, 'group': 'img_segment'})
+        assert rows['total'] == 4
+        # predictions saved as class-id masks
+        import os
+        from mlcomp_tpu import TASK_FOLDER
+        pred_path = os.path.join(TASK_FOLDER, str(tasks['infer'][0]),
+                                 'data', 'pred', 'seg_model.npy')
+        preds = np.load(pred_path)
+        assert preds.shape == (16, 16, 16)
+        assert set(np.unique(preds)).issubset({0, 1})
