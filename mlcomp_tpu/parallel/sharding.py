@@ -74,13 +74,15 @@ def logical_to_sharding(tree, mesh: Mesh, extra_rules=()):
         is_leaf=lambda x: isinstance(x, P))
 
 
-def batch_sharding(mesh: Mesh, ndim: int, seq_dim: Optional[int] = None
-                   ) -> NamedSharding:
-    """Sharding for an input batch: dim0 over (dp, fsdp), optionally one
-    dim over sp, everything else replicated."""
+def batch_sharding(mesh: Mesh, ndim: int, seq_dim: Optional[int] = None,
+                   batch_dim: int = 0) -> NamedSharding:
+    """Sharding for an input batch: ``batch_dim`` over (dp, fsdp),
+    optionally one dim over sp, everything else replicated
+    (``batch_dim=1`` fits a [steps, batch] epoch permutation)."""
     data = tuple(a for a in ('dp', 'fsdp') if a in mesh.axis_names)
     parts = [None] * ndim
-    parts[0] = data if len(data) > 1 else (data[0] if data else None)
+    parts[batch_dim] = data if len(data) > 1 else (data[0] if data
+                                                   else None)
     if seq_dim is not None and 'sp' in mesh.axis_names:
         parts[seq_dim] = 'sp'
     return NamedSharding(mesh, P(*parts))

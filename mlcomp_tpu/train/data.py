@@ -83,6 +83,40 @@ def _synth_images(n_train: int = 8192, n_valid: int = 1024,
     return {'x_train': xt, 'y_train': yt, 'x_valid': xv, 'y_valid': yv}
 
 
+@register_dataset('cifar10')
+def _cifar10(path: str = None, n_train: int = 50000, n_valid: int = 10000,
+             seed: int = 0, **_):
+    """CIFAR-10: real data when an npz is available locally (zero-egress
+    environment — no downloads), else a synthetic stand-in with CIFAR's
+    exact shapes/cardinalities so pipelines and benchmarks run the same
+    code path either way.
+
+    Expected npz keys: x_train [N,32,32,3] uint8/float, y_train [N],
+    x_test, y_test (checked at DATA_FOLDER/cifar10.npz and $CIFAR10_NPZ).
+    """
+    candidates = [path] if path else []
+    candidates.append(os.environ.get('CIFAR10_NPZ'))
+    from mlcomp_tpu import DATA_FOLDER
+    candidates.append(os.path.join(DATA_FOLDER, 'cifar10.npz'))
+    for cand in candidates:
+        if cand and os.path.exists(cand):
+            data = np.load(cand)
+            def norm(a):
+                a = np.asarray(a, np.float32)
+                return a / 255.0 if a.max() > 2.0 else a
+            return {'x_train': norm(data['x_train'])[:n_train],
+                    'y_train': np.asarray(data['y_train'],
+                                          np.int32)[:n_train],
+                    'x_valid': norm(data['x_test'])[:n_valid],
+                    'y_valid': np.asarray(data['y_test'],
+                                          np.int32)[:n_valid],
+                    'source': cand}
+    out = _synth_images(n_train=n_train, n_valid=n_valid, image_size=32,
+                        channels=3, num_classes=10, seed=seed)
+    out['source'] = 'synthetic'
+    return out
+
+
 @register_dataset('synthetic_lm')
 def _synth_lm(n_train: int = 2048, n_valid: int = 256,
               seq_len: int = 256, vocab_size: int = 1024,
@@ -130,16 +164,49 @@ def _synth_seg(n_train: int = 512, n_valid: int = 64, image_size: int = 64,
 # ---------------------------------------------------------------- batching
 def iterate_batches(x: np.ndarray, y: Optional[np.ndarray],
                     batch_size: int, rng: Optional[np.random.RandomState]
-                    = None, drop_last: bool = True
+                    = None, drop_last: bool = True,
+                    transform=None, logger=None
                     ) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
+    """Shuffled host-side batches; ``transform`` (a contrib Compose) is
+    applied per sample on the host — overlappable with device compute
+    through ``prefetch_batches``."""
     n = len(x)
     idx = np.arange(n)
     if rng is not None:
         rng.shuffle(idx)
-    end = n - (n % batch_size) if drop_last else n
+    dropped = n % batch_size if drop_last else 0
+    if dropped and logger is not None:
+        logger(f'dropping {dropped} tail samples (n={n} not divisible '
+               f'by batch_size={batch_size})')
+    end = n - dropped if drop_last else n
     for start in range(0, end, batch_size):
         take = idx[start:start + batch_size]
-        yield x[take], (y[take] if y is not None else None)
+        bx = x[take]
+        by = y[take] if y is not None else None
+        if transform is not None:
+            from mlcomp_tpu.contrib.transform import augment_batch
+            aug_rng = rng if rng is not None else np.random.RandomState(0)
+            if by is not None and by.ndim >= 3:   # masks
+                bx, by = augment_batch(bx, transform, aug_rng, masks=by)
+            else:
+                bx = augment_batch(bx, transform, aug_rng)
+        yield bx, by
+
+
+def prefetch_batches(batch_iter, mesh, seq_dim: Optional[int] = None,
+                     depth: int = 2):
+    """Double-buffering: device_put the NEXT batch(es) while the current
+    one computes. jax transfers are async — keeping ``depth`` batches in
+    flight hides host→device latency behind the step itself (the classic
+    flax prefetch pattern, on shardings instead of per-device stacks)."""
+    from collections import deque
+    buf = deque()
+    for batch in batch_iter:
+        buf.append(place_batch(batch, mesh, seq_dim=seq_dim))
+        if len(buf) >= depth:
+            yield buf.popleft()
+    while buf:
+        yield buf.popleft()
 
 
 def place_batch(batch, mesh, seq_dim: Optional[int] = None):
@@ -153,4 +220,4 @@ def place_batch(batch, mesh, seq_dim: Optional[int] = None):
 
 
 __all__ = ['register_dataset', 'create_dataset', 'iterate_batches',
-           'place_batch']
+           'prefetch_batches', 'place_batch']

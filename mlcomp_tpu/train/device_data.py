@@ -1,0 +1,129 @@
+"""Device-resident dataset path: the TPU-native input pipeline for
+datasets that fit in HBM.
+
+Measured on the real chip (see bench.py): a fresh 3 MB batch transfer
+through the device tunnel costs ~90 ms while the ResNet-18 step itself
+takes ~10 ms — the host pipeline caps training at ~13% of compute. The
+fix is structural, not incremental: put the WHOLE dataset in HBM once
+(CIFAR-10 as uint8 = 150 MB vs 16 GB HBM), then each step ships only a
+[B] int32 index vector (1 KB) and does the batch gather, dequantization,
+and augmentation ON DEVICE inside the jitted step, where XLA fuses them
+into the conv pipeline.
+
+The on-device augmentations mirror contrib/transform/numpy_aug.py's
+pad-crop/flip/cutout semantics, expressed as vectorized lax ops under
+``jax.random`` so they trace once, shard over dp, and add ~zero step
+time.
+"""
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def quantize_dataset(x: np.ndarray):
+    """(array, dequant) — uint8-pack float images in [0,1] to cut the
+    one-time host→device transfer 4x; anything else ships as-is."""
+    x = np.asarray(x)
+    if x.dtype == np.uint8:
+        return x, True
+    if np.issubdtype(x.dtype, np.floating) and x.size \
+            and 0.0 <= float(x.min()) and float(x.max()) <= 1.0:
+        return np.round(x * 255.0).astype(np.uint8), True
+    return x, False
+
+
+#: augmentation names the device path understands
+DEVICE_AUGMENTS = ('pad_crop', 'hflip', 'vflip', 'cutout')
+
+
+def normalize_augment_spec(specs) -> Optional[list]:
+    """Parse a config augment list into [(name, params)] if every entry
+    is device-expressible, else None (caller falls back to host path)."""
+    out = []
+    for spec in specs or ():
+        if isinstance(spec, str):
+            name, params = spec, {}
+        else:
+            params = dict(spec)
+            name = params.pop('name')
+        if name not in DEVICE_AUGMENTS:
+            return None
+        out.append((name, params))
+    return out
+
+
+def make_device_augment(augments: Sequence, image_shape):
+    """Build ``augment(x, rng) -> x`` for [B,H,W,C] device batches."""
+    import jax
+    import jax.numpy as jnp
+
+    h, w = image_shape[0], image_shape[1]
+
+    def augment(x, rng):
+        for i, (name, params) in enumerate(augments):
+            key = jax.random.fold_in(rng, i)
+            if name == 'pad_crop':
+                pad = int(params.get('pad', 4))
+                xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                             mode='reflect')
+                k1, k2 = jax.random.split(key)
+                n = x.shape[0]
+                dy = jax.random.randint(k1, (n,), 0, 2 * pad + 1)
+                dx = jax.random.randint(k2, (n,), 0, 2 * pad + 1)
+                rows = dy[:, None] + jnp.arange(h)[None, :]
+                cols = dx[:, None] + jnp.arange(w)[None, :]
+                x = xp[jnp.arange(n)[:, None, None],
+                       rows[:, :, None], cols[:, None, :]]
+            elif name == 'hflip':
+                p = float(params.get('p', 0.5))
+                flip = jax.random.bernoulli(key, p, (x.shape[0],))
+                x = jnp.where(flip[:, None, None, None],
+                              x[:, :, ::-1, :], x)
+            elif name == 'vflip':
+                p = float(params.get('p', 0.5))
+                flip = jax.random.bernoulli(key, p, (x.shape[0],))
+                x = jnp.where(flip[:, None, None, None],
+                              x[:, ::-1, :, :], x)
+            elif name == 'cutout':
+                size = int(params.get('size', 8))
+                p = float(params.get('p', 0.5))
+                k1, k2, k3 = jax.random.split(key, 3)
+                n = x.shape[0]
+                cy = jax.random.randint(k1, (n,), 0, h)
+                cx = jax.random.randint(k2, (n,), 0, w)
+                pick = jax.random.bernoulli(k3, p, (n,))
+                s = size // 2
+                yy = jnp.arange(h)[None, :, None]
+                xx = jnp.arange(w)[None, None, :]
+                # [c-s, c+s) window — exactly the host Cutout's slice
+                dy = yy - cy[:, None, None]
+                dx_ = xx - cx[:, None, None]
+                hole = ((dy >= -s) & (dy < s) & (dx_ >= -s) & (dx_ < s)
+                        & pick[:, None, None])
+                x = jnp.where(hole[..., None], jnp.zeros_like(x), x)
+        return x
+
+    return augment
+
+
+def place_dataset(x: np.ndarray, y: Optional[np.ndarray], mesh):
+    """Put the full dataset on device, replicated across the mesh (each
+    device gathers its batch shard by index — replication keeps the
+    gather local, and HBM-resident uint8 CIFAR is 150 MB/device)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rep = NamedSharding(mesh, PartitionSpec())
+    x_dev = jax.device_put(x, rep)
+    y_dev = jax.device_put(y, rep) if y is not None else None
+    return x_dev, y_dev
+
+
+def dataset_fits_hbm(x: np.ndarray, budget_bytes: int = 2 << 30) -> bool:
+    return x.nbytes <= budget_bytes
+
+
+__all__ = ['quantize_dataset', 'normalize_augment_spec',
+           'make_device_augment', 'place_dataset', 'dataset_fits_hbm',
+           'DEVICE_AUGMENTS']

@@ -45,7 +45,7 @@ from mlcomp_tpu.train.checkpoint import (
     load_meta, restore_checkpoint, resume_plan, save_checkpoint,
 )
 from mlcomp_tpu.train.data import (
-    create_dataset, iterate_batches, place_batch,
+    create_dataset, iterate_batches, place_batch, prefetch_batches,
 )
 from mlcomp_tpu.train.loop import (
     create_train_state, loss_for_task, make_eval_step, make_train_step,
@@ -62,7 +62,8 @@ class JaxTrain(Executor):
                  main_metric='accuracy', minimize=False,
                  model_name=None, seed=0, checkpoint_dir=None,
                  stage_per_dispatch=False, log_every=50,
-                 report_imgs=None, **kwargs):
+                 report_imgs=None, augment=None, prefetch=2,
+                 device_data='auto', epoch_scan=False, **kwargs):
         self.model_spec = dict(model or {'name': 'mlp'})
         self.dataset_spec = dict(dataset or {})
         self.loss_name = loss
@@ -80,6 +81,13 @@ class JaxTrain(Executor):
         self.stage_per_dispatch = bool(stage_per_dispatch)
         self.log_every = int(log_every)
         self.report_imgs = dict(report_imgs) if report_imgs else None
+        self.augment = list(augment) if augment else None
+        self.prefetch = int(prefetch)
+        self.device_data = device_data
+        # one-XLA-dispatch-per-epoch via lax.scan: measured ~equal to
+        # the per-step device path on TPU and pathologically slow to
+        # compile on XLA:CPU (scan-of-conv-graph), so opt-in
+        self.epoch_scan = bool(epoch_scan)
 
     # ------------------------------------------------------------ plumbing
     def _init_distributed(self):
@@ -173,6 +181,42 @@ class JaxTrain(Executor):
 
         model = create_model(mesh=mesh, **self.model_spec)
 
+        # input path selection: device-resident dataset (HBM) with
+        # on-device augmentation when possible — per-step host→device
+        # traffic drops from the batch to an index vector — else the
+        # host pipeline (vectorized augment + double-buffered transfer)
+        from mlcomp_tpu.train.device_data import (
+            DEVICE_AUGMENTS, dataset_fits_hbm, make_device_augment,
+            normalize_augment_spec, place_dataset, quantize_dataset,
+        )
+        device_augs = normalize_augment_spec(self.augment)
+        if self.device_data is True and device_augs is None:
+            raise ValueError(
+                f'device_data: true but augment={self.augment!r} has '
+                f'transforms outside the device-expressible set '
+                f'{DEVICE_AUGMENTS}; drop them or use device_data: auto '
+                f'(which falls back to the host pipeline)')
+        use_device_data = (
+            self.device_data is True
+            or (self.device_data == 'auto'
+                and device_augs is not None
+                and y_train is not None
+                and seq_dim is None
+                and dataset_fits_hbm(x_train)))
+        transform = None
+        dev_augment = None
+        dequant = False
+        x_all = y_all = None
+        if use_device_data:
+            x_q, dequant = quantize_dataset(x_train)
+            x_all, y_all = place_dataset(x_q, y_train, mesh)
+            if device_augs:
+                dev_augment = make_device_augment(
+                    device_augs, x_train.shape[1:])
+        elif self.augment:
+            from mlcomp_tpu.contrib.transform import parse_transforms
+            transform = parse_transforms(self.augment)
+
         # resume (reference catalyst.py:218-296): restore last checkpoint,
         # trim completed stages
         info = dict(getattr(self, 'additional_info', None) or {})
@@ -255,9 +299,22 @@ class JaxTrain(Executor):
             stage_idx = stage_names.index(stage_name)
             optimizer, _ = make_optimizer(
                 stage_opt_spec(stage), stage_steps(stage))
-            train_step = make_train_step(
-                model, optimizer, loss_fn, mesh=mesh,
-                self_supervised=self_supervised)
+            if use_device_data:
+                from mlcomp_tpu.train.loop import (
+                    make_device_epoch_fn, make_device_train_step,
+                )
+                if self.epoch_scan:
+                    epoch_fn = make_device_epoch_fn(
+                        model, optimizer, loss_fn, mesh=mesh,
+                        augment=dev_augment, dequantize=dequant)
+                else:
+                    train_step = make_device_train_step(
+                        model, optimizer, loss_fn, mesh=mesh,
+                        augment=dev_augment, dequantize=dequant)
+            else:
+                train_step = make_train_step(
+                    model, optimizer, loss_fn, mesh=mesh,
+                    self_supervised=self_supervised)
             eval_step = make_eval_step(
                 model, loss_fn, mesh=mesh,
                 self_supervised=self_supervised)
@@ -272,23 +329,68 @@ class JaxTrain(Executor):
                 self.step.start(2, f'epoch {epoch}', epoch)
                 ep_rng = np.random.RandomState(self.seed * 1000 + epoch)
                 t_ep = time.time()
-                train_metrics = []
-                for bi, batch in enumerate(iterate_batches(
-                        x_train, y_train, self.batch_size, ep_rng)):
-                    x, y = place_batch(batch, mesh, seq_dim=seq_dim)
-                    state, metrics = train_step(state, x, y)
-                    train_metrics.append(metrics)
-                    images_seen += self.batch_size
-                if not train_metrics:
+                if steps_per_epoch * self.batch_size > len(x_train):
                     raise ValueError(
                         f'dataset has {len(x_train)} train samples — '
                         f'fewer than batch_size={self.batch_size}; no '
                         f'full batch to train on')
-                # metrics: device→host once per epoch (the float() pulls
-                # force all queued steps to finish — honest timing point)
-                train_agg = {
-                    k: float(np.mean([float(m[k]) for m in train_metrics]))
-                    for k in train_metrics[0]}
+                if use_device_data:
+                    dropped = len(x_train) % self.batch_size
+                    if dropped and global_epoch == epochs_done_global:
+                        self.info(
+                            f'dropping {dropped} tail samples '
+                            f'(n={len(x_train)} not divisible by '
+                            f'batch_size={self.batch_size})')
+                    perm = ep_rng.permutation(
+                        len(x_train))[:steps_per_epoch * self.batch_size]
+                    perm = perm.astype(np.int32).reshape(
+                        steps_per_epoch, self.batch_size)
+                    if self.epoch_scan:
+                        perm_dev = jax.device_put(
+                            perm, batch_sharding(mesh, 2, batch_dim=1))
+                        # one XLA dispatch runs the whole epoch
+                        state, metric_arrays = epoch_fn(
+                            state, x_all, y_all, perm_dev)
+                        train_agg = {
+                            k: float(np.mean(np.asarray(v)))
+                            for k, v in metric_arrays.items()}
+                    else:
+                        train_metrics = []
+                        for s in range(steps_per_epoch):
+                            idx = jax.device_put(
+                                perm[s], batch_sharding(mesh, 1))
+                            state, metrics = train_step(
+                                state, x_all, y_all, idx)
+                            train_metrics.append(metrics)
+                        train_agg = {
+                            k: float(np.mean([float(m[k])
+                                              for m in train_metrics]))
+                            for k in train_metrics[0]}
+                    images_seen += steps_per_epoch * self.batch_size
+                else:
+                    train_metrics = []
+                    batches = iterate_batches(
+                        x_train, y_train, self.batch_size, ep_rng,
+                        transform=transform,
+                        logger=self.info if global_epoch ==
+                        epochs_done_global else None)
+                    for x, y in prefetch_batches(
+                            batches, mesh, seq_dim=seq_dim,
+                            depth=self.prefetch):
+                        state, metrics = train_step(state, x, y)
+                        train_metrics.append(metrics)
+                        images_seen += self.batch_size
+                    if not train_metrics:
+                        raise ValueError(
+                            f'dataset has {len(x_train)} train samples '
+                            f'— fewer than batch_size='
+                            f'{self.batch_size}; no full batch')
+                    # metrics: device→host once per epoch (the float()
+                    # pulls force all queued steps to finish)
+                    train_agg = {
+                        k: float(np.mean([float(m[k])
+                                          for m in train_metrics]))
+                        for k in train_metrics[0]}
                 train_dt = time.time() - t_ep
                 # evaluate EVERY validation sample: tail batches are
                 # padded (duplicate samples) up to a multiple of the

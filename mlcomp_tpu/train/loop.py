@@ -151,6 +151,103 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     return jax.jit(step_in_context, donate_argnums=(0,))
 
 
+def make_device_train_step(model, optimizer, loss_fn: Callable,
+                           mesh: Optional[Mesh] = None,
+                           augment=None, dequantize: bool = False,
+                           compute_dtype=None):
+    """Device-resident-data variant of make_train_step: the step takes
+    the FULL dataset (already in HBM) plus a [B] index vector; gather,
+    dequantization, and augmentation run inside the jit where XLA fuses
+    them ahead of the first conv. Host→device traffic per step is the
+    index vector (~1 KB) instead of the batch (~MBs) — the difference
+    between tunnel-bound and compute-bound training (see bench.py).
+    """
+    import jax.numpy as jnp
+
+    def step(state: TrainState, x_all, y_all, idx):
+        step_rng = (jax.random.fold_in(state.rng, state.step)
+                    if state.rng is not None else None)
+        x = jnp.take(x_all, idx, axis=0)
+        y = jnp.take(y_all, idx, axis=0) if y_all is not None else None
+        if dequantize:
+            x = x.astype(compute_dtype or jnp.float32) / 255.0
+        elif compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        if augment is not None:
+            # even without a dropout rng, fold the step counter so the
+            # crop/flip pattern varies every step and epoch
+            base = step_rng if step_rng is not None else \
+                jax.random.fold_in(jax.random.PRNGKey(0), state.step)
+            x = augment(x, jax.random.fold_in(base, 1))
+
+        def loss_wrapped(params):
+            logits, new_stats = _apply(
+                model, state.replace(params=params), x, train=True,
+                rng=step_rng)
+            loss, metrics = loss_fn(logits, y)
+            return loss, (metrics, new_stats)
+
+        grads, (metrics, new_stats) = jax.grad(
+            loss_wrapped, has_aux=True)(state.params)
+        updates, new_opt = optimizer.update(
+            grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1, params=new_params, opt_state=new_opt,
+            batch_stats=(new_stats if new_stats is not None
+                         else state.batch_stats))
+        return new_state, metrics
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,))
+
+    rules = logical_rules(mesh)
+
+    def step_in_context(state, x_all, y_all, idx):
+        with mesh, nn.logical_axis_rules(rules):
+            return step(state, x_all, y_all, idx)
+
+    return jax.jit(step_in_context, donate_argnums=(0,))
+
+
+def make_device_epoch_fn(model, optimizer, loss_fn: Callable,
+                         mesh: Optional[Mesh] = None,
+                         augment=None, dequantize: bool = False,
+                         compute_dtype=None):
+    """One WHOLE training epoch as a single XLA computation:
+    ``lax.scan`` over a [steps, batch] index permutation with the
+    device-resident dataset. One dispatch per epoch removes per-step
+    host round trips entirely — on a tunneled device that is the
+    difference between dispatch-bound and compute-bound (bench.py).
+    Returns ``(state, metrics)`` where each metric is a [steps] array.
+    """
+    import jax.numpy as jnp
+
+    inner = make_device_train_step(
+        model, optimizer, loss_fn, mesh=None, augment=augment,
+        dequantize=dequantize, compute_dtype=compute_dtype)
+    # unwrap the jit — scan bodies must be plain traceable fns
+    inner = inner.__wrapped__
+
+    def epoch(state: TrainState, x_all, y_all, perm):
+        def body(st, idx):
+            new_st, metrics = inner(st, x_all, y_all, idx)
+            return new_st, metrics
+        state, metrics = jax.lax.scan(body, state, perm)
+        return state, jax.tree.map(jnp.asarray, metrics)
+
+    if mesh is None:
+        return jax.jit(epoch, donate_argnums=(0,))
+
+    rules = logical_rules(mesh)
+
+    def epoch_in_context(state, x_all, y_all, perm):
+        with mesh, nn.logical_axis_rules(rules):
+            return epoch(state, x_all, y_all, perm)
+
+    return jax.jit(epoch_in_context, donate_argnums=(0,))
+
+
 def make_eval_step(model, loss_fn: Callable,
                    mesh: Optional[Mesh] = None,
                    self_supervised: bool = False):
@@ -223,6 +320,7 @@ def place_state(state: TrainState, mesh: Mesh) -> TrainState:
                        out_shardings=shardings)(replicated_state)
 
 
-__all__ = ['TrainState', 'make_train_step', 'make_eval_step',
+__all__ = ['TrainState', 'make_train_step', 'make_device_train_step',
+           'make_device_epoch_fn', 'make_eval_step',
            'create_train_state', 'state_sharding', 'place_state',
            'loss_for_task', 'LOSSES', 'softmax_ce', 'lm_ce', 'seg_ce']
