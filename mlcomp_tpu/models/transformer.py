@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from mlcomp_tpu.models.base import register_model
-from mlcomp_tpu.parallel.ring import make_ring_attention, _plain_attention
+from mlcomp_tpu.parallel.ring import make_ring_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,6 +37,11 @@ class TransformerConfig:
     dropout: float = 0.0
     dtype: str = 'bfloat16'
     remat: bool = False           # jax.checkpoint each layer (HBM savings)
+    # attention implementation: 'auto' = Pallas flash kernel on TPU when
+    # shapes tile (ops/flash_attention.py), dense jnp otherwise;
+    # 'dense'/'pallas'/'interpret' force a path (no effect under sp —
+    # ring attention owns the sharded case)
+    attn_impl: str = 'auto'
     # MoE (expert parallelism); 0 = dense MLP everywhere
     n_experts: int = 0
     moe_every: int = 2            # every k-th layer is MoE when n_experts>0
@@ -76,10 +81,13 @@ class Attention(nn.Module):
         v = nn.with_logical_constraint(v, ('batch', 'seq', 'heads', 'kv'))
 
         if self.mesh is not None:
-            attend = make_ring_attention(self.mesh, causal=True)
+            attend = make_ring_attention(self.mesh, causal=True,
+                                         attn_impl=cfg.attn_impl)
             out = attend(q, k, v)
         else:
-            out = _plain_attention(q, k, v, causal=True)
+            from mlcomp_tpu.ops.flash_attention import fused_attention
+            out = fused_attention(q, k, v, causal=True,
+                                  impl=cfg.attn_impl)
         out = nn.with_logical_constraint(
             out, ('batch', 'seq', 'heads', 'kv'))
 

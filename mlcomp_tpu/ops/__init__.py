@@ -1,0 +1,14 @@
+"""Custom TPU ops (Pallas kernels) — SURVEY.md §2.2 native equivalents.
+
+The reference's device math is all external CUDA (via torch); here the
+hot ops the compiler can't already fuse optimally are hand-written
+Pallas kernels with jnp fallbacks, selected automatically by backend
+and shape.
+"""
+
+from mlcomp_tpu.ops.flash_attention import (
+    flash_attention_forward, fused_attention, reference_attention,
+)
+
+__all__ = ['fused_attention', 'flash_attention_forward',
+           'reference_attention']

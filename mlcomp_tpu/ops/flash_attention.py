@@ -1,0 +1,213 @@
+"""Fused causal flash attention as a Pallas TPU kernel.
+
+SURVEY.md §2.2: the reference delegates all device math to torch/CUDA;
+the TPU build promises custom ops via Pallas. This is the first: an
+online-softmax attention forward that never materialises the [T, T]
+score matrix in HBM — scores live in VMEM one (block_q, block_k) tile
+at a time, flowing through the MXU per tile.
+
+Kernel structure (the canonical TPU flash layout):
+- grid = (batch*heads, T/block_q, T/block_k); the LAST axis is
+  sequential ("arbitrary" dimension semantics) so VMEM scratch carries
+  the running max / normaliser / accumulator across k-blocks
+- causal blocks strictly above the diagonal are skipped whole
+  (``pl.when`` on the block predicate — ~2x fewer tiles)
+- accumulation in f32 regardless of input dtype; the final normalised
+  block is cast back on write
+
+Backward: ``jax.custom_vjp`` — the forward runs the kernel, the
+backward recomputes through the O(T²)-memory dense reference (exact
+gradients; a fused backward kernel is a later optimisation).
+
+``fused_attention`` is the entry point the transformer uses: it picks
+the kernel on TPU, the interpreter in tests, and the dense jnp path
+anywhere else or for shapes the kernel doesn't tile.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # pallas is TPU/GPU-oriented; tolerate CPU-only installs
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover
+    _PALLAS_OK = False
+
+NEG_INF = -1e30
+
+
+def reference_attention(q, k, v, causal: bool = True,
+                        scale: Optional[float] = None):
+    """Dense softmax attention over [B, T, H, D] — the numerics the
+    kernel must reproduce, and the fallback/backward path."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum('bhqk,bkhd->bqhd', p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+               scale, causal, block_q, block_k, n_k):
+    i_q = pl.program_id(1)
+    i_k = pl.program_id(2)
+
+    @pl.when(i_k == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: the whole k-block is masked when its first key sits
+    # beyond the last query of this q-block
+    if causal:
+        live = i_k * block_k <= (i_q + 1) * block_q - 1
+    else:
+        live = True
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i_q * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = i_k * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(i_k == n_k - 1)
+    def _finalise():
+        norm = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[:] / norm).astype(o_ref.dtype)
+
+
+def _fit_block(t: int, want: int) -> int:
+    """Largest multiple of 128 ≤ want that divides t (any t % 128 == 0
+    admits at least 128 itself, so tileability == t % 128 == 0)."""
+    for cand in range(min(want, t), 127, -128):
+        if t % cand == 0:
+            return cand
+    raise ValueError(f'seq len {t} not divisible by any 128-multiple '
+                     f'block ≤ {want}')
+
+
+def flash_attention_forward(q, k, v, causal: bool = True,
+                            scale: Optional[float] = None,
+                            block_q: int = 512, block_k: int = 512,
+                            interpret: bool = False):
+    """Pallas forward over [B, T, H, D]. T must divide by both block
+    sizes (caller falls back to dense otherwise)."""
+    b, t, h, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+    block_q = _fit_block(t, block_q)
+    block_k = _fit_block(t, block_k)
+    n_q, n_k = t // block_q, t // block_k
+
+    # [B, T, H, D] -> [B*H, T, D]: contiguous (seq, head_dim) tiles
+    def fold(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, n_k=n_k)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, iq, ik: (bh, iq, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # normaliser
+            pltpu.VMEM((block_q, d), jnp.float32),     # output accum
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    return jnp.transpose(out.reshape(b, h, t, d), (0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention(q, k, v, causal, scale, interpret):
+    return flash_attention_forward(q, k, v, causal=causal, scale=scale,
+                                   interpret=interpret)
+
+
+def _fa_fwd(q, k, v, causal, scale, interpret):
+    out = flash_attention_forward(q, k, v, causal=causal, scale=scale,
+                                  interpret=interpret)
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, scale, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal=causal,
+                                               scale=scale), q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+def fused_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None, impl: str = 'auto'):
+    """Attention over [B, T, H, D] with implementation selection:
+
+    - ``pallas``: the fused kernel (TPU)
+    - ``interpret``: the kernel under the Pallas interpreter (tests)
+    - ``dense``: the jnp reference
+    - ``auto``: kernel on TPU when shapes tile, dense otherwise
+    """
+    t, d = q.shape[1], q.shape[3]
+    tiles = _PALLAS_OK and t >= 128 and t % 128 == 0
+    if impl == 'auto':
+        impl = 'pallas' if (tiles and jax.default_backend() == 'tpu') \
+            else 'dense'
+    if impl == 'dense':
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    if not tiles:
+        raise ValueError(
+            f'pallas attention needs seq divisible by 128, got {t}')
+    return _flash_attention(q, k, v, causal, scale, impl == 'interpret')
+
+
+__all__ = ['fused_attention', 'flash_attention_forward',
+           'reference_attention']

@@ -113,11 +113,13 @@ def ring_attention(q, k, v, *, axis_name: str, axis_size: int,
     return jnp.transpose(out, (0, 2, 1, 3)).astype(in_dtype)
 
 
-def make_ring_attention(mesh: Mesh, causal: bool = False):
+def make_ring_attention(mesh: Mesh, causal: bool = False,
+                        attn_impl: str = 'auto'):
     """Build an attention fn over GLOBAL [B, T, H, D] arrays: sequence
     sharded on ``sp``, batch on dp/fsdp, heads on ``tp``; exact ring
-    attention between the sp shards. Falls back to plain attention math
-    when the mesh has no sp axis (still one fused XLA computation).
+    attention between the sp shards. Without an sp axis, the Pallas
+    flash kernel (or dense fallback) runs on each device's local
+    batch/head shard.
     """
     sp = mesh.shape['sp'] if 'sp' in mesh.axis_names else 1
     data = tuple(a for a in ('dp', 'fsdp') if a in mesh.axis_names)
@@ -126,7 +128,35 @@ def make_ring_attention(mesh: Mesh, causal: bool = False):
     spec = P(batch_part, 'sp' if sp > 1 else None, head_part, None)
 
     if sp <= 1:
-        return functools.partial(_plain_attention, causal=causal)
+        if attn_impl == 'dense':
+            return functools.partial(_plain_attention, causal=causal)
+        from mlcomp_tpu.ops.flash_attention import fused_attention
+
+        # shard_map so the pallas_call sees per-device local shards
+        # (batch over dp/fsdp, heads over tp); impl-auto still picks
+        # dense off-TPU, inside the same spec
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=spec)
+        def sharded_local(q, k, v):
+            return fused_attention(q, k, v, causal=causal,
+                                   impl=attn_impl)
+
+        dp_size = 1
+        for a in ('dp', 'fsdp'):
+            if a in mesh.axis_names:
+                dp_size *= mesh.shape[a]
+        tp_size = mesh.shape.get('tp', 1)
+
+        def attend(q, k, v):
+            # shard_map needs exact divisibility; uneven shapes (tail
+            # eval batches, odd head counts) take the global dense path
+            # where GSPMD handles padding
+            if q.shape[0] % dp_size or q.shape[2] % tp_size:
+                return _plain_attention(q, k, v, causal=causal)
+            return sharded_local(q, k, v)
+
+        return attend
 
     @functools.partial(
         shard_map, mesh=mesh, in_specs=(spec, spec, spec),
@@ -139,18 +169,11 @@ def make_ring_attention(mesh: Mesh, causal: bool = False):
 
 
 def _plain_attention(q, k, v, causal: bool):
-    """Reference (non-ring) attention on global arrays [B, T, H, D]."""
-    scale = q.shape[-1] ** -0.5
-    s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
-                   preferred_element_type=jnp.float32) * scale
-    if causal:
-        tq, tk = q.shape[1], k.shape[1]
-        mask = lax.broadcasted_iota(jnp.int32, (tq, tk), 1) > \
-            lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
-        s = jnp.where(mask, NEG_INF, s)
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum('bhqk,bkhd->bqhd', p.astype(v.dtype), v)
-    return out.astype(q.dtype)
+    """Reference (non-ring) attention on global arrays [B, T, H, D] —
+    one implementation of the dense math for the whole tree (the
+    previous local copy drifted from ops/ in bf16 numerics)."""
+    from mlcomp_tpu.ops.flash_attention import reference_attention
+    return reference_attention(q, k, v, causal=causal)
 
 
 __all__ = ['ring_attention', 'make_ring_attention']

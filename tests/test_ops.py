@@ -1,0 +1,113 @@
+"""Pallas ops (VERDICT round-1 item 9): flash attention kernel
+correctness vs the dense reference, gradients, fallback selection, and
+transformer integration. Runs under the Pallas interpreter on the CPU
+test mesh; the real-chip speed comparison lives in the kernel module's
+docstring + bench history."""
+
+import numpy as np
+import pytest
+
+from mlcomp_tpu.ops import (
+    fused_attention, reference_attention,
+)
+
+
+def _qkv(b=2, t=256, h=4, d=64, seed=0, dtype='float32'):
+    import jax.numpy as jnp
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.randn(b, t, h, d).astype(np.float32), jnp.dtype(dtype))
+    return mk(), mk(), mk()
+
+
+class TestKernelNumerics:
+    @pytest.mark.parametrize('causal', [True, False])
+    def test_forward_matches_reference(self, causal):
+        import jax.numpy as jnp
+        q, k, v = _qkv()
+        ref = reference_attention(q, k, v, causal=causal)
+        out = fused_attention(q, k, v, causal=causal, impl='interpret')
+        assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+    def test_multi_block_seq(self):
+        import jax.numpy as jnp
+        # t=1024 > block 512 -> real multi-block accumulation
+        q, k, v = _qkv(b=1, t=1024, h=2, d=64)
+        ref = reference_attention(q, k, v, causal=True)
+        out = fused_attention(q, k, v, causal=True, impl='interpret')
+        assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+    def test_gradients_match_reference(self):
+        import jax
+        import jax.numpy as jnp
+        q, k, v = _qkv(t=128)
+
+        def loss(fn):
+            return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+        g_fa = jax.grad(loss(lambda q, k, v: fused_attention(
+            q, k, v, impl='interpret')), argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss(reference_attention),
+                         argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_fa, g_ref):
+            assert float(jnp.max(jnp.abs(a - b))) < 1e-4
+
+    def test_scale_override(self):
+        import jax.numpy as jnp
+        q, k, v = _qkv(t=128)
+        ref = reference_attention(q, k, v, causal=True, scale=0.25)
+        out = fused_attention(q, k, v, causal=True, scale=0.25,
+                              impl='interpret')
+        assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+
+class TestSelection:
+    def test_auto_on_cpu_is_dense(self):
+        import jax.numpy as jnp
+        q, k, v = _qkv(t=128)
+        out = fused_attention(q, k, v, impl='auto')  # cpu backend
+        ref = reference_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-6)
+
+    def test_untileable_seq_falls_back(self):
+        q, k, v = _qkv(t=100)
+        out = fused_attention(q, k, v, impl='auto')
+        assert out.shape == q.shape
+        with pytest.raises(ValueError, match='divisible'):
+            fused_attention(q, k, v, impl='interpret')
+
+
+class TestTransformerIntegration:
+    def test_attn_impl_interpret_runs_kernel_in_model(self):
+        import jax
+        from mlcomp_tpu.models import create_model
+        model_d = create_model(
+            'transformer_lm', vocab_size=128, d_model=64, n_layers=1,
+            n_heads=2, d_ff=128, max_seq_len=128, dtype='float32',
+            attn_impl='dense')
+        model_p = create_model(
+            'transformer_lm', vocab_size=128, d_model=64, n_layers=1,
+            n_heads=2, d_ff=128, max_seq_len=128, dtype='float32',
+            attn_impl='interpret')
+        tokens = np.random.RandomState(0).randint(
+            0, 128, (2, 128)).astype(np.int32)
+        var = model_d.init(jax.random.PRNGKey(0), tokens)
+        out_d = np.asarray(model_d.apply(var, tokens))
+        out_p = np.asarray(model_p.apply(var, tokens))
+        np.testing.assert_allclose(out_p, out_d, atol=2e-4)
+
+    def test_sharded_kernel_on_mesh(self):
+        """dp-sharded batch through the shard_mapped kernel path."""
+        import jax
+        import jax.numpy as jnp
+        from mlcomp_tpu.parallel import mesh_from_spec
+        from mlcomp_tpu.parallel.ring import make_ring_attention
+        mesh = mesh_from_spec({'dp': 4, 'tp': 2})
+        q, k, v = _qkv(b=4, t=128, h=4, d=64)
+        attend = make_ring_attention(mesh, causal=True,
+                                     attn_impl='interpret')
+        with mesh:
+            out = jax.jit(attend)(q, k, v)
+        ref = reference_attention(q, k, v, causal=True)
+        assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
