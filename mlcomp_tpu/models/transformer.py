@@ -19,6 +19,7 @@ import dataclasses
 from typing import Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
@@ -45,6 +46,7 @@ class TransformerConfig:
     # MoE (expert parallelism); 0 = dense MLP everywhere
     n_experts: int = 0
     moe_every: int = 2            # every k-th layer is MoE when n_experts>0
+    capacity_factor: float = 1.25
 
     @property
     def head_dim(self):
@@ -118,9 +120,86 @@ class MlpBlock(nn.Module):
         return nn.with_logical_constraint(y, ('batch', 'seq', 'embed'))
 
 
+class MoeMlpBlock(nn.Module):
+    """Switch-style top-1 mixture-of-experts MLP (expert parallelism).
+
+    TPU-first dense-dispatch formulation (the mesh-tensorflow/Switch
+    lineage): routing is expressed as one-hot dispatch/combine einsums,
+    so the whole layer is three batched matmuls that XLA lays onto the
+    MXU, and the expert dimension of the weights carries the 'expert'
+    logical axis — an ``{'ep': N}`` mesh shards experts across devices
+    with XLA inserting the all-to-alls implied by the dispatch einsums.
+
+    Capacity is static (``capacity_factor * T / n_experts`` tokens per
+    expert); overflow tokens pass through on the residual path. The
+    Switch load-balance auxiliary loss is sown under
+    ``intermediates/moe_aux_loss`` and the training loop adds it.
+    """
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        n_x = cfg.n_experts
+        b, t, m = x.shape
+        capacity = max(1, int(cfg.capacity_factor * t / n_x))
+
+        router_logits = nn.Dense(
+            n_x, dtype=jnp.float32, use_bias=False,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ('embed', 'expert')),
+            name='router')(x.astype(jnp.float32))
+        probs = jax.nn.softmax(router_logits)            # [B,T,X]
+        gate = jnp.max(probs, axis=-1)                   # [B,T]
+        choice = jnp.argmax(probs, axis=-1)              # [B,T]
+        one_hot = jax.nn.one_hot(choice, n_x, dtype=jnp.float32)
+
+        # Switch aux loss: X * Σ_i (token fraction_i · router prob_i)
+        density = one_hot.mean(axis=(0, 1))
+        prob_mean = probs.mean(axis=(0, 1))
+        self.sow('intermediates', 'moe_aux_loss',
+                 n_x * jnp.sum(density * prob_mean))
+
+        # position of each token inside its expert's capacity buffer
+        # (-1 = not routed here; one_hot of a negative index is zeros)
+        pos = (jnp.cumsum(one_hot, axis=1) * one_hot
+               - 1.0).astype(jnp.int32)                     # [B,T,X]
+        dispatch = one_hot[..., None] * jax.nn.one_hot(
+            pos, capacity, dtype=jnp.float32)               # [B,T,X,C]
+        combine = dispatch * gate[..., None, None]
+
+        w_in = self.param(
+            'w_in', nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(),
+                ('expert', 'embed', 'mlp')),
+            (n_x, m, cfg.d_ff))
+        w_out = self.param(
+            'w_out', nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(),
+                ('expert', 'mlp', 'embed')),
+            (n_x, cfg.d_ff, m))
+
+        expert_in = jnp.einsum(
+            'btxc,btm->xbcm', dispatch.astype(dtype), x.astype(dtype))
+        expert_in = nn.with_logical_constraint(
+            expert_in, ('expert', 'batch', None, 'embed'))
+        h = jnp.einsum('xbcm,xmf->xbcf', expert_in,
+                       w_in.astype(dtype))
+        h = nn.silu(h)
+        h = nn.with_logical_constraint(
+            h, ('expert', 'batch', None, 'mlp'))
+        out = jnp.einsum('xbcf,xfm->xbcm', h, w_out.astype(dtype))
+        y = jnp.einsum('btxc,xbcm->btm', combine.astype(dtype), out)
+        if cfg.dropout:
+            y = nn.Dropout(cfg.dropout, deterministic=not train)(y)
+        return nn.with_logical_constraint(y, ('batch', 'seq', 'embed'))
+
+
 class DecoderLayer(nn.Module):
     cfg: TransformerConfig
     mesh: Optional[Mesh] = None
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -133,7 +212,10 @@ class DecoderLayer(nn.Module):
         y = norm('norm_attn')(x)
         x = x + Attention(cfg, mesh=self.mesh, name='attn')(y, train)
         y = norm('norm_mlp')(x)
-        x = x + MlpBlock(cfg, name='mlp')(y, train)
+        if self.use_moe:
+            x = x + MoeMlpBlock(cfg, name='moe')(y, train)
+        else:
+            x = x + MlpBlock(cfg, name='mlp')(y, train)
         return nn.with_logical_constraint(x, ('batch', 'seq', 'embed'))
 
 
@@ -144,10 +226,6 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, train: bool = False):
         cfg = self.cfg
-        if cfg.n_experts:
-            raise NotImplementedError(
-                'MoE (n_experts > 0) is not implemented yet; '
-                'set n_experts: 0')
         dtype = jnp.dtype(cfg.dtype)
 
         embed = nn.Embed(
@@ -168,12 +246,13 @@ class TransformerLM(nn.Module):
         if cfg.remat:
             layer_cls = nn.remat(DecoderLayer, static_argnums=(2,))
         for i in range(cfg.n_layers):
-            if cfg.remat:
-                x = layer_cls(cfg, mesh=self.mesh, name=f'layer_{i}')(
-                    x, train)
-            else:
-                x = layer_cls(cfg, mesh=self.mesh, name=f'layer_{i}')(
-                    x, train=train)
+            # every moe_every-th layer is MoE (Switch convention:
+            # interleave dense and expert layers)
+            use_moe = bool(cfg.n_experts) and \
+                (i % cfg.moe_every == cfg.moe_every - 1)
+            layer = layer_cls(cfg, mesh=self.mesh, use_moe=use_moe,
+                              name=f'layer_{i}')
+            x = layer(x, train) if cfg.remat else layer(x, train=train)
 
         x = nn.RMSNorm(
             dtype=dtype, name='norm_final',

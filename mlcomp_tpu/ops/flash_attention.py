@@ -111,7 +111,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 def _fit_block(t: int, want: int) -> int:
     """Largest multiple of 128 ≤ want that divides t (any t % 128 == 0
     admits at least 128 itself, so tileability == t % 128 == 0)."""
-    for cand in range(min(want, t), 127, -128):
+    start = (min(want, t) // 128) * 128
+    for cand in range(start, 127, -128):
         if t % cand == 0:
             return cand
     raise ValueError(f'seq len {t} not divisible by any 128-multiple '
@@ -197,12 +198,17 @@ def fused_attention(q, k, v, causal: bool = True,
     - ``auto``: kernel on TPU when shapes tile, dense otherwise
     """
     t, d = q.shape[1], q.shape[3]
-    tiles = _PALLAS_OK and t >= 128 and t % 128 == 0
+    tiles = t >= 128 and t % 128 == 0
     if impl == 'auto':
-        impl = 'pallas' if (tiles and jax.default_backend() == 'tpu') \
+        impl = 'pallas' if (_PALLAS_OK and tiles
+                            and jax.default_backend() == 'tpu') \
             else 'dense'
     if impl == 'dense':
         return reference_attention(q, k, v, causal=causal, scale=scale)
+    if not _PALLAS_OK:
+        raise ImportError(
+            'jax.experimental.pallas failed to import in this '
+            'environment — use impl="dense"')
     if not tiles:
         raise ValueError(
             f'pallas attention needs seq divisible by 128, got {t}')

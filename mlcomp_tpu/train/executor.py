@@ -495,7 +495,7 @@ class JaxTrain(Executor):
         @jax.jit
         def forward(s, x):
             with mesh, nn.logical_axis_rules(rules):
-                logits, _ = _apply(model, s, x, train=False)
+                logits, _, _ = _apply(model, s, x, train=False)
                 return jax.nn.softmax(jnp.asarray(logits, jnp.float32))
 
         dp = max(1, data_parallel_size(mesh))

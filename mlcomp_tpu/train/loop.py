@@ -91,20 +91,43 @@ def loss_for_task(task: str) -> Callable:
 
 
 # ----------------------------------------------------------------- builder
+#: weight of the MoE load-balance auxiliary loss (Switch's default)
+MOE_AUX_COEF = 0.01
+
+
 def _apply(model, state: TrainState, x, train: bool, rng=None):
+    """Returns (logits, new_batch_stats, aux_loss) — aux_loss is the
+    summed sown ``moe_aux_loss`` (None when the model sows nothing)."""
     variables = {'params': state.params}
     mutable = []
     if state.batch_stats is not None:
         variables['batch_stats'] = state.batch_stats
         if train:
             mutable = ['batch_stats']
+    if train:
+        mutable = list(mutable) + ['intermediates']
     rngs = {'dropout': rng} if (train and rng is not None) else None
     out = model.apply(variables, x, train=train, mutable=mutable,
                       rngs=rngs)
     if mutable:
         logits, updates = out
-        return logits, updates.get('batch_stats')
-    return (out[0] if isinstance(out, tuple) else out), None
+        # pick out ONLY moe_aux_loss sows — other sown diagnostics must
+        # not leak into the loss
+        aux_leaves = []
+
+        def collect(tree):
+            if isinstance(tree, dict):
+                for key, value in tree.items():
+                    if key == 'moe_aux_loss':
+                        aux_leaves.extend(jax.tree.leaves(value))
+                    else:
+                        collect(value)
+
+        collect(updates.get('intermediates', {}))
+        aux = sum(jnp.asarray(a, jnp.float32).sum()
+                  for a in aux_leaves) if aux_leaves else None
+        return logits, updates.get('batch_stats'), aux
+    return (out[0] if isinstance(out, tuple) else out), None, None
 
 
 def make_train_step(model, optimizer, loss_fn: Callable,
@@ -121,11 +144,14 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                     if state.rng is not None else None)
 
         def loss_wrapped(params):
-            logits, new_stats = _apply(
+            logits, new_stats, aux = _apply(
                 model, state.replace(params=params), x, train=True,
                 rng=step_rng)
             target = x if self_supervised else y
             loss, metrics = loss_fn(logits, target)
+            if aux is not None:
+                loss = loss + MOE_AUX_COEF * aux
+                metrics = dict(metrics, moe_aux=aux)
             return loss, (metrics, new_stats)
 
         grads, (metrics, new_stats) = jax.grad(
@@ -181,10 +207,13 @@ def make_device_train_step(model, optimizer, loss_fn: Callable,
             x = augment(x, jax.random.fold_in(base, 1))
 
         def loss_wrapped(params):
-            logits, new_stats = _apply(
+            logits, new_stats, aux = _apply(
                 model, state.replace(params=params), x, train=True,
                 rng=step_rng)
             loss, metrics = loss_fn(logits, y)
+            if aux is not None:
+                loss = loss + MOE_AUX_COEF * aux
+                metrics = dict(metrics, moe_aux=aux)
             return loss, (metrics, new_stats)
 
         grads, (metrics, new_stats) = jax.grad(
@@ -252,7 +281,7 @@ def make_eval_step(model, loss_fn: Callable,
                    mesh: Optional[Mesh] = None,
                    self_supervised: bool = False):
     def step(state: TrainState, x, y, w=None):
-        logits, _ = _apply(model, state, x, train=False)
+        logits, _, _ = _apply(model, state, x, train=False)
         target = x if self_supervised else y
         _, metrics = loss_fn(logits, target, weights=w)
         return metrics

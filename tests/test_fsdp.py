@@ -1,0 +1,127 @@
+"""FSDP proof (VERDICT round-1 item 10): running {'fsdp': N} must
+actually shard parameters — per-device param bytes shrink N-fold for
+sharded leaves — and training must stay numerically equal to pure dp."""
+
+import numpy as np
+import pytest
+
+
+def _param_bytes(state):
+    import jax
+
+    def leaf_bytes(leaf):
+        if not isinstance(leaf, jax.Array):
+            return 0, 0
+        total = leaf.nbytes
+        local = max((s.data.nbytes for s in leaf.addressable_shards),
+                    default=0)
+        return total, local
+
+    totals = locals_ = 0
+    for leaf in jax.tree.leaves(state.params):
+        t, l = leaf_bytes(leaf)
+        totals += t
+        locals_ += l
+    return totals, locals_
+
+
+class TestFsdpSharding:
+    def test_params_actually_sharded(self):
+        import jax
+        from mlcomp_tpu.models import create_model
+        from mlcomp_tpu.train import create_train_state, make_optimizer
+        from mlcomp_tpu.parallel import mesh_from_spec
+
+        mesh = mesh_from_spec({'fsdp': 8})
+        model = create_model('mlp', num_classes=8, hidden=[512, 512],
+                             dtype='float32')
+        opt, _ = make_optimizer({'name': 'adam', 'lr': 1e-3}, 10)
+        x = np.random.rand(8, 16).astype(np.float32)
+        state = create_train_state(model, opt, x, jax.random.PRNGKey(0),
+                                   mesh=mesh)
+        total, local = _param_bytes(state)
+        # dense kernels carry the 'embed'/'mlp' logical axes -> fsdp
+        # shards them; biases/scalars stay replicated. The bulk of the
+        # bytes must shrink ~8x.
+        assert local < total / 4, (total, local)
+
+        # optimizer state (adam moments) shards the same way
+        m_total = m_local = 0
+        for leaf in jax.tree.leaves(state.opt_state):
+            if hasattr(leaf, 'addressable_shards'):
+                m_total += leaf.nbytes
+                m_local += max(
+                    s.data.nbytes for s in leaf.addressable_shards)
+        assert m_local < m_total / 4
+
+    def test_transformer_fsdp_sharded(self):
+        import jax
+        from mlcomp_tpu.models import create_model
+        from mlcomp_tpu.train import create_train_state, make_optimizer
+        from mlcomp_tpu.parallel import mesh_from_spec
+
+        mesh = mesh_from_spec({'fsdp': 4, 'dp': 2})
+        model = create_model(
+            'transformer_lm', vocab_size=256, d_model=128, n_layers=2,
+            n_heads=4, d_ff=256, max_seq_len=64, dtype='float32')
+        opt, _ = make_optimizer({'name': 'adam', 'lr': 1e-3}, 10)
+        tokens = np.zeros((8, 64), np.int32)
+        state = create_train_state(model, opt, tokens,
+                                   jax.random.PRNGKey(0), mesh=mesh)
+        total, local = _param_bytes(state)
+        assert local < total / 2, (total, local)
+
+    def test_fsdp_training_matches_dp(self):
+        """Same seed, same data: 3 steps under {'fsdp': 8} produce the
+        same loss trajectory as {'dp': 8} (fsdp is a layout change, not
+        a numerics change)."""
+        import jax
+        from mlcomp_tpu.models import create_model
+        from mlcomp_tpu.parallel import mesh_from_spec
+        from mlcomp_tpu.train import (
+            create_train_state, loss_for_task, make_optimizer,
+            make_train_step, place_batch,
+        )
+
+        x = np.random.RandomState(0).rand(32, 8, 8, 1).astype(np.float32)
+        y = (np.arange(32) % 4).astype(np.int32)
+
+        def run(spec):
+            mesh = mesh_from_spec(spec)
+            model = create_model('mlp', num_classes=4, hidden=[64],
+                                 dtype='float32')
+            opt, _ = make_optimizer({'name': 'sgd', 'lr': 0.1}, 10)
+            state = create_train_state(
+                model, opt, x[:8], jax.random.PRNGKey(0), mesh=mesh)
+            step = make_train_step(model, opt,
+                                   loss_for_task('softmax_ce'),
+                                   mesh=mesh)
+            losses = []
+            for _ in range(3):
+                xb, yb = place_batch((x, y), mesh)
+                state, m = step(state, xb, yb)
+                losses.append(float(m['loss']))
+            return losses
+
+        np.testing.assert_allclose(run({'fsdp': 8}), run({'dp': 8}),
+                                   rtol=1e-5)
+
+    def test_jax_train_executor_fsdp_mesh(self, tmp_path):
+        """The executor path end-to-end on an fsdp mesh."""
+        from test_train import DummyStep
+        from mlcomp_tpu.train import JaxTrain
+        ex = JaxTrain(
+            model={'name': 'mlp', 'num_classes': 4, 'hidden': [64],
+                   'dtype': 'float32'},
+            dataset={'name': 'synthetic_images', 'n_train': 256,
+                     'n_valid': 64, 'image_size': 8, 'channels': 1,
+                     'num_classes': 4},
+            batch_size=64, epochs=2, mesh={'fsdp': 8},
+            checkpoint_dir=str(tmp_path / 'ck'))
+        ex.step = DummyStep()
+        ex.task = None
+        ex.session = None
+        ex.additional_info = {}
+        result = ex.work()
+        assert result['best_score'] is not None
+        assert np.isfinite(result['best_score'])
