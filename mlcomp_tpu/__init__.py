@@ -125,6 +125,13 @@ DB_TYPE = _ENV.get('DB_TYPE', 'SQLITE')
 
 if DB_TYPE == 'SQLITE':
     SA_CONNECTION_STRING = 'sqlite:///' + os.path.join(DB_FOLDER, 'sqlite.db')
+elif DB_TYPE == 'SERVER':
+    # multi-computer deployment: this machine proxies every DB statement
+    # to the server host's /api/db (db/remote.py) — one durable store,
+    # one open port, one secret
+    SA_CONNECTION_STRING = _ENV.get(
+        'SERVER_URL', f"http://{_ENV.get('IP', 'localhost')}:"
+                      f"{_ENV.get('WEB_PORT', '4201')}")
 else:  # POSTGRESQL — capability slot for a shared multi-host metadata store
     SA_CONNECTION_STRING = (
         f"postgresql://{_ENV.get('POSTGRES_USER')}:"

@@ -1,0 +1,99 @@
+"""Contrib CLI (parity: reference contrib/__main__.py:19-82):
+fold-file generators for the standard dataset layouts.
+
+- ``split-classify IMG_PATH N`` — class-per-subfolder layout →
+  ``fold.csv`` (image, label, fold), stratified; ``--group-regex``
+  keeps same-group images in one fold
+- ``split-segment IMG_PATH MASK_PATH N`` — image+mask folders →
+  ``fold.csv`` (image, mask, fold)
+- ``split-frame CSV LABEL N`` — any csv with a label column
+"""
+
+import os
+import re
+from uuid import uuid4
+
+import click
+import numpy as np
+
+
+@click.group()
+def main():
+    pass
+
+
+@main.command(name='split-classify')
+@click.argument('img_path')
+@click.argument('n_splits', type=int)
+@click.option('--group-regex', default=None,
+              help='regex whose group(1) defines the fold-group')
+@click.option('--out', default='fold.csv')
+def split_classify(img_path, n_splits, group_regex, out):
+    import pandas as pd
+    from mlcomp_tpu.contrib.split import (
+        stratified_group_k_fold, stratified_k_fold,
+    )
+    rows = [(img, sub)
+            for sub in sorted(os.listdir(img_path))
+            if os.path.isdir(os.path.join(img_path, sub))
+            for img in sorted(os.listdir(os.path.join(img_path, sub)))]
+    if not rows:
+        raise click.ClickException(f'no class subfolders in {img_path}')
+    df = pd.DataFrame(rows, columns=['image', 'label'])
+    if group_regex:
+        pattern = re.compile(group_regex)
+
+        def group_of(name):
+            m = pattern.match(name)
+            return m.group(1) if m else str(uuid4())
+
+        groups = [group_of(img) for img in df['image']]
+        df['fold'] = stratified_group_k_fold(
+            np.asarray(df['label']), groups=groups, n_splits=n_splits)
+    else:
+        df['fold'] = stratified_k_fold(np.asarray(df['label']),
+                                       n_splits=n_splits)
+    df.to_csv(out, index=False)
+    click.echo(f'wrote {out}: {len(df)} rows, {n_splits} folds')
+
+
+@main.command(name='split-segment')
+@click.argument('img_path')
+@click.argument('mask_path')
+@click.argument('n_splits', type=int)
+@click.option('--out', default='fold.csv')
+def split_segment(img_path, mask_path, n_splits, out):
+    import pandas as pd
+    images = sorted(os.listdir(img_path))
+    masks = {os.path.splitext(m)[0]: m
+             for m in sorted(os.listdir(mask_path))}
+    rows = []
+    for img in images:
+        stem = os.path.splitext(img)[0]
+        if stem in masks:
+            rows.append((img, masks[stem]))
+    if not rows:
+        raise click.ClickException('no image/mask pairs found')
+    rng = np.random.RandomState(0)
+    df = pd.DataFrame(rows, columns=['image', 'mask'])
+    df['fold'] = rng.permutation(len(df)) % n_splits
+    df.to_csv(out, index=False)
+    click.echo(f'wrote {out}: {len(df)} rows, {n_splits} folds')
+
+
+@main.command(name='split-frame')
+@click.argument('csv_path')
+@click.argument('label')
+@click.argument('n_splits', type=int)
+@click.option('--out', default='fold.csv')
+def split_frame(csv_path, label, n_splits, out):
+    import pandas as pd
+    from mlcomp_tpu.contrib.split import stratified_k_fold
+    df = pd.read_csv(csv_path)
+    df['fold'] = stratified_k_fold(label, df=df, n_splits=n_splits)
+    df.to_csv(out, index=False)
+    click.echo(f'wrote {out}: {len(df)} rows, {n_splits} folds')
+
+
+if __name__ == '__main__':
+    main()

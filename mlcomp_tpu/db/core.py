@@ -161,6 +161,32 @@ class DBModel(metaclass=_ModelMeta):
         return f'<{type(self).__name__} id={pk}>'
 
 
+def insert_sql(obj):
+    """(sql, values) for inserting a DBModel instance — shared by the
+    local Session and the server-proxied RemoteSession."""
+    cols, vals = [], []
+    for k, col in obj.__columns__.items():
+        v = getattr(obj, k, None)
+        if col.primary_key and v is None:
+            continue
+        cols.append(f'"{k}"')
+        vals.append(v)
+    sql = (f'INSERT INTO {obj.__tablename__} '
+           f'({", ".join(cols)}) VALUES ({", ".join("?" * len(cols))})')
+    return sql, vals
+
+
+def update_sql(obj, fields=None):
+    """(sql, values) for updating a DBModel instance by primary key."""
+    pk = next(k for k, c in obj.__columns__.items() if c.primary_key)
+    fields = fields or [k for k in obj.__columns__ if k != pk]
+    sets = ', '.join(f'"{f}"=?' for f in fields)
+    vals = [getattr(obj, f, None) for f in fields]
+    vals.append(getattr(obj, pk))
+    return (f'UPDATE {obj.__tablename__} SET {sets} WHERE "{pk}"=?',
+            vals)
+
+
 class _Result:
     """Materialized statement result (rows consumed before commit)."""
 
@@ -215,7 +241,13 @@ class Session:
             if connection_string is None:
                 import mlcomp_tpu
                 connection_string = mlcomp_tpu.SA_CONNECTION_STRING
-            s = cls(connection_string, key)
+            if connection_string.startswith(('http://', 'https://')):
+                # multi-computer deployment: statements proxy to the
+                # server host's /api/db (db/remote.py)
+                from mlcomp_tpu.db.remote import RemoteSession
+                s = RemoteSession(connection_string, key)
+            else:
+                s = cls(connection_string, key)
             cls.__session_holder[key] = s
             return s
 
@@ -226,9 +258,10 @@ class Session:
             keys = [key] if key else list(cls.__session_holder)
             for k in keys:
                 s = cls.__session_holder.pop(k, None)
-                if s is not None:
+                conn = getattr(s, '_conn', None)  # RemoteSession has none
+                if conn is not None:
                     try:
-                        s._conn.close()
+                        conn.close()
                     except Exception:
                         pass
 
@@ -269,15 +302,8 @@ class Session:
 
     # --------------------------------------------------------------- object
     def add(self, obj, commit=True):
-        cols, vals = [], []
-        for k, col in obj.__columns__.items():
-            v = getattr(obj, k, None)
-            if col.primary_key and v is None:
-                continue
-            cols.append(f'"{k}"')
-            vals.append(adapt_value(v))
-        sql = (f'INSERT INTO {obj.__tablename__} '
-               f'({", ".join(cols)}) VALUES ({", ".join("?" * len(cols))})')
+        sql, raw_vals = insert_sql(obj)
+        vals = [adapt_value(v) for v in raw_vals]
         with self._lock:
             try:
                 cur = self._conn.execute(sql, vals)
@@ -297,17 +323,13 @@ class Session:
             self._conn.commit()
 
     def update_obj(self, obj, fields=None):
-        pk = next(k for k, c in obj.__columns__.items() if c.primary_key)
-        fields = fields or [k for k in obj.__columns__ if k != pk]
-        sets = ', '.join(f'"{f}"=?' for f in fields)
-        vals = [adapt_value(getattr(obj, f, None)) for f in fields]
-        vals.append(adapt_value(getattr(obj, pk)))
-        self.execute(
-            f'UPDATE {obj.__tablename__} SET {sets} WHERE "{pk}"=?', vals)
+        sql, vals = update_sql(obj, fields)
+        self.execute(sql, vals)
 
     def commit(self):
         with self._lock:
             self._conn.commit()
 
 
-__all__ = ['Session', 'Column', 'DBModel', 'adapt_value', 'parse_datetime']
+__all__ = ['Session', 'Column', 'DBModel', 'adapt_value',
+           'parse_datetime', 'insert_sql', 'update_sql']

@@ -1,0 +1,138 @@
+"""Server-backed Session: the multi-computer control plane.
+
+The reference reaches multi-machine scale by pointing every box at a
+shared PostgreSQL (reference docker/server-compose.yml); this build's
+equivalent keeps ONE durable store — the server host's sqlite/WAL — and
+lets remote workers reach it through the JSON API (``/api/db``), so a
+cluster needs exactly one open port and one secret (the API token),
+no database server administration.
+
+``RemoteSession`` implements the same interface as ``db.core.Session``
+(execute/executemany/query/query_one/add/add_all/update_obj/commit),
+so every provider works unchanged on top of it. Select it with
+``DB_TYPE=SERVER`` + ``SERVER_URL=http://head:4201`` in the ``.env``.
+
+Wire format: JSON with bytes base64-wrapped as {"__b64__": ...}
+(code blobs and report images traverse the proxy intact). Latency: one
+HTTP round trip per statement — fine for the control plane's
+per-task/per-epoch write rates; bulk work stays on the data plane.
+"""
+
+import base64
+import datetime
+import json
+import urllib.request
+from typing import Optional
+
+from mlcomp_tpu.db.core import _Result, adapt_value
+
+
+def encode_value(v):
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return {'__b64__': base64.b64encode(bytes(v)).decode()}
+    if isinstance(v, datetime.datetime):
+        return adapt_value(v)
+    return v
+
+
+def decode_value(v):
+    if isinstance(v, dict) and '__b64__' in v:
+        return base64.b64decode(v['__b64__'])
+    return v
+
+
+def encode_params(params):
+    return [encode_value(adapt_value(p)) for p in params]
+
+
+def encode_row(row) -> dict:
+    return {k: encode_value(row[k]) for k in row.keys()}
+
+
+def decode_row(row: dict) -> dict:
+    return {k: decode_value(v) for k, v in row.items()}
+
+
+class RemoteSession:
+    """Session facade proxying statements to a server's ``/api/db``."""
+
+    def __init__(self, url: str, key: str = 'default',
+                 token: Optional[str] = None, timeout: float = 30.0):
+        self.key = key
+        self.connection_string = url
+        self.base = url.rstrip('/')
+        if token is None:
+            from mlcomp_tpu import TOKEN
+            token = TOKEN
+        self.token = token
+        self.timeout = timeout
+
+    # ------------------------------------------------------------- plumbing
+    def _post(self, payload: dict) -> dict:
+        req = urllib.request.Request(
+            f'{self.base}/api/db',
+            data=json.dumps(payload).encode(),
+            headers={'Content-Type': 'application/json',
+                     'Authorization': self.token},
+            method='POST')
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
+                out = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            if e.code >= 500:  # surface the server-side reason
+                try:
+                    reason = json.loads(e.read()).get('reason', '')
+                except Exception:
+                    reason = ''
+                raise RuntimeError(
+                    f'remote db error ({e.code}): {reason}') from e
+            raise
+        if not out.get('success', True):
+            raise RuntimeError(
+                f"remote db error: {out.get('reason', 'unknown')}")
+        return out
+
+    # ------------------------------------------------------------------ api
+    def execute(self, sql, params=()):
+        out = self._post({'op': 'execute', 'sql': sql,
+                          'params': encode_params(params)})
+        rows = [decode_row(r) for r in out.get('rows', [])]
+        return _Result(rows, out.get('lastrowid'), out.get('rowcount', -1))
+
+    def executemany(self, sql, seq):
+        self._post({'op': 'executemany', 'sql': sql,
+                    'params_seq': [encode_params(row) for row in seq]})
+
+    def query(self, sql, params=()):
+        out = self._post({'op': 'query', 'sql': sql,
+                          'params': encode_params(params)})
+        return [decode_row(r) for r in out.get('rows', [])]
+
+    def query_one(self, sql, params=()):
+        rows = self.query(sql, params)
+        return rows[0] if rows else None
+
+    # --------------------------------------------------------------- object
+    def add(self, obj, commit=True):
+        from mlcomp_tpu.db.core import insert_sql
+        sql, vals = insert_sql(obj)
+        result = self.execute(sql, vals)
+        if hasattr(obj, 'id') and getattr(obj, 'id', None) is None:
+            obj.id = result.lastrowid
+        return obj
+
+    def add_all(self, objs):
+        for o in objs:
+            self.add(o)
+
+    def update_obj(self, obj, fields=None):
+        from mlcomp_tpu.db.core import update_sql
+        sql, vals = update_sql(obj, fields)
+        self.execute(sql, vals)
+
+    def commit(self):
+        pass  # every proxied statement commits server-side
+
+
+__all__ = ['RemoteSession', 'encode_row', 'decode_row', 'encode_params']

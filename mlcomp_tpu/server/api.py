@@ -465,6 +465,37 @@ def api_remove_files(data, s):
     return {'success': True}
 
 
+def api_db(data, s):
+    """DB statement proxy for remote workers (db/remote.py RemoteSession)
+    — the multi-computer control plane. Token-authed; the wire trust
+    model matches the reference's shared-postgres deployment (any
+    authed machine can issue any statement). Because that makes the
+    token a full-control credential, non-loopback clients are refused
+    while the shipped default token is still in place (gate in
+    ApiHandler._dispatch)."""
+    from mlcomp_tpu.db.remote import decode_value, encode_row
+    op = data.get('op')
+    sql = data.get('sql', '')
+    params = [decode_value(p) for p in data.get('params', [])]
+    if op == 'execute':
+        result = s.execute(sql, params)
+        return {'success': True,
+                'rows': [encode_row(r) for r in result.fetchall()],
+                'lastrowid': result.lastrowid,
+                'rowcount': result.rowcount}
+    if op == 'executemany':
+        seq = [[decode_value(p) for p in row]
+               for row in data.get('params_seq', [])]
+        s.executemany(sql, seq)
+        return {'success': True}
+    if op in ('query', 'query_one'):
+        rows = s.query(sql, params)
+        if op == 'query_one':
+            rows = rows[:1]
+        return {'success': True, 'rows': [encode_row(r) for r in rows]}
+    raise ApiError(f'unknown db op {op!r}')
+
+
 def api_stop(data, s):
     """Stop worker daemons on this host (reference app.py:710-730 stops
     the celery components; the API/supervisor process itself stays up —
@@ -542,6 +573,7 @@ _ROUTES = {
     '/api/remove_imgs': (api_remove_imgs, True),
     '/api/remove_files': (api_remove_files, True),
     '/api/stop': (api_stop, True),
+    '/api/db': (api_db, True),
 }
 
 
@@ -595,6 +627,15 @@ class ApiHandler(BaseHTTPRequestHandler):
         if needs_auth and not self._authorized():
             self._send_json(
                 {'success': False, 'reason': 'unauthorized'}, 401)
+            return
+        if path == '/api/db' and TOKEN == 'token' \
+                and self.client_address[0] not in ('127.0.0.1', '::1'):
+            # the DB proxy is a full-control credential; refuse to serve
+            # it off-host while the shipped default token is in place
+            self._send_json(
+                {'success': False,
+                 'reason': 'set a real TOKEN in configs/.env before '
+                           'multi-computer deployment'}, 403)
             return
         try:
             try:

@@ -1,0 +1,178 @@
+"""Notebook dashboard for a DAG (parity: reference utils/describe.py:1-388).
+
+The reference renders a live matplotlib/networkx dashboard of a DAG
+inside Jupyter — task table, logs, graph, metric series. Same here,
+backed by the providers: ``describe(dag_id)`` draws one figure with
+four panels; pass ``refresh=N`` inside IPython to redraw every N
+seconds while tasks run. ``dag_summary`` is the presentation-free data
+assembly (used by tests and scripts).
+"""
+
+import datetime
+from typing import Optional
+
+from mlcomp_tpu.db.enums import TaskStatus
+
+_STATUS_COLORS = {
+    'NotRan': '#b0b0b0', 'Queued': '#e8c14b', 'InProgress': '#4b9fe8',
+    'Failed': '#e85b4b', 'Stopped': '#b86fd9', 'Skipped': '#808080',
+    'Success': '#56b66b',
+}
+
+
+def dag_summary(dag_id: int, session=None, max_logs: int = 12) -> dict:
+    """Tasks, edges, metric series, and recent logs of one DAG."""
+    from mlcomp_tpu.db.core import Session
+    from mlcomp_tpu.db.providers import (
+        DagProvider, LogProvider, ReportSeriesProvider, TaskProvider,
+    )
+    session = session or Session.create_session(key='describe')
+    dag_provider = DagProvider(session)
+    dag = dag_provider.by_id(dag_id)
+    if dag is None:
+        raise ValueError(f'dag {dag_id} not found')
+    task_provider = TaskProvider(session)
+    tasks = sorted(task_provider.by_dag(dag_id), key=lambda t: t.id)
+    task_rows = []
+    for t in tasks:
+        duration = None
+        if t.started:
+            end = t.finished or datetime.datetime.utcnow()
+            duration = (end - t.started).total_seconds()
+        task_rows.append({
+            'id': t.id, 'name': t.name,
+            'status': TaskStatus(t.status).name,
+            'score': t.score,
+            'duration_s': round(duration, 1) if duration else None,
+            'computer': t.computer_assigned,
+            'step': t.current_step,
+        })
+
+    graph = dag_provider.graph(dag_id)
+
+    series = {}
+    series_provider = ReportSeriesProvider(session)
+    for t in tasks:
+        for row in series_provider.by_task(t.id):
+            key = (row.name, row.part or '')
+            series.setdefault(key, {'task': t.id, 'epochs': [],
+                                    'values': []})
+            series[key]['epochs'].append(row.epoch)
+            series[key]['values'].append(row.value)
+
+    log_result = LogProvider(session).get({'dag': dag_id})
+    logs = [{'task': row['task'], 'level': row.get('level_name'),
+             'time': str(row.get('time')), 'message': row.get('message')}
+            for row in reversed(log_result['data'][:max_logs])]
+
+    return {'dag': {'id': dag.id, 'name': dag.name},
+            'tasks': task_rows, 'graph': graph,
+            'series': {f'{n} [{p}]' if p else n: v
+                       for (n, p), v in series.items()},
+            'logs': logs}
+
+
+def _draw(summary: dict, figsize=(14, 9)):
+    import matplotlib
+    matplotlib.use('Agg', force=False)
+    import matplotlib.pyplot as plt
+
+    fig, axes = plt.subplots(2, 2, figsize=figsize)
+    (ax_table, ax_graph), (ax_series, ax_logs) = axes
+    fig.suptitle(f"dag {summary['dag']['id']}: {summary['dag']['name']}")
+
+    # ------------------------------------------------------- task table
+    ax_table.axis('off')
+    rows = summary['tasks']
+    if rows:
+        cells = [[str(r['id']), r['name'][:28], r['status'],
+                  f"{r['score']:.4f}" if r['score'] is not None else '',
+                  str(r['duration_s'] or '')] for r in rows]
+        table = ax_table.table(
+            cellText=cells,
+            colLabels=['id', 'name', 'status', 'score', 'dur (s)'],
+            loc='center', cellLoc='left')
+        table.auto_set_font_size(False)
+        table.set_fontsize(8)
+        for i, r in enumerate(rows):
+            table[i + 1, 2].set_facecolor(
+                _STATUS_COLORS.get(r['status'], 'white'))
+    ax_table.set_title('tasks')
+
+    # ------------------------------------------------------------ graph
+    ax_graph.axis('off')
+    ax_graph.set_title('graph')
+    nodes = summary['graph'].get('nodes', [])
+    edges = summary['graph'].get('edges', [])
+    if nodes:
+        import networkx as nx
+        g = nx.DiGraph()
+        labels = {}
+        colors = []
+        for n in nodes:
+            g.add_node(n['id'])
+            labels[n['id']] = n.get('label', str(n['id']))
+        for e in edges:
+            g.add_edge(e['from'], e['to'])
+        status_by_id = {r['id']: r['status'] for r in summary['tasks']}
+        for n in g.nodes:
+            colors.append(_STATUS_COLORS.get(
+                status_by_id.get(n, ''), '#cccccc'))
+        try:
+            # layered layout by topological generation
+            layers = list(nx.topological_generations(g))
+            pos = {}
+            for x, layer in enumerate(layers):
+                for y, node in enumerate(sorted(layer)):
+                    pos[node] = (x, -y)
+        except nx.NetworkXUnfeasible:
+            pos = nx.spring_layout(g, seed=0)
+        nx.draw(g, pos, ax=ax_graph, node_color=colors, with_labels=True,
+                labels=labels, node_size=900, font_size=7,
+                edge_color='#888888')
+
+    # ----------------------------------------------------------- series
+    ax_series.set_title('metric series')
+    for name, data in sorted(summary['series'].items()):
+        ax_series.plot(data['epochs'], data['values'], marker='.',
+                       label=name[:32])
+    if summary['series']:
+        ax_series.legend(fontsize=7)
+        ax_series.set_xlabel('epoch')
+        ax_series.grid(alpha=0.3)
+
+    # ------------------------------------------------------------- logs
+    ax_logs.axis('off')
+    ax_logs.set_title('recent logs')
+    text = '\n'.join(
+        f"[{log['task']}] {str(log['message'])[:90]}"
+        for log in summary['logs'])
+    ax_logs.text(0.01, 0.98, text or '(no logs)', va='top', fontsize=7,
+                 family='monospace', transform=ax_logs.transAxes,
+                 wrap=True)
+    fig.tight_layout()
+    return fig
+
+
+def describe(dag_id: int, session=None, refresh: Optional[float] = None,
+             figsize=(14, 9)):
+    """Draw the dashboard once (returns the figure), or redraw every
+    ``refresh`` seconds inside IPython until interrupted."""
+    if not refresh:
+        return _draw(dag_summary(dag_id, session), figsize)
+    import time
+
+    from IPython import display
+    try:
+        while True:
+            fig = _draw(dag_summary(dag_id, session), figsize)
+            display.clear_output(wait=True)
+            display.display(fig)
+            import matplotlib.pyplot as plt
+            plt.close(fig)
+            time.sleep(refresh)
+    except KeyboardInterrupt:
+        pass
+
+
+__all__ = ['describe', 'dag_summary']

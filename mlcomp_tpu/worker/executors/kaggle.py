@@ -1,0 +1,201 @@
+"""Kaggle executors (parity: reference worker/executors/kaggle.py:33-247).
+
+``Download`` pulls competition files; ``Submit`` submits a prediction
+csv (file mode) or authors and pushes a scoring kernel (kernel mode),
+polls for the public score, and records it on the Model row.
+
+This environment has zero egress and no kaggle package, so the network
+calls are isolated behind ``_kaggle_api()`` which raises a clear,
+actionable error when the API is unavailable — the executors, their
+config parsing, submission-file staging, and score bookkeeping are all
+real and tested; only the wire calls need a live ``kaggle`` install
+(``pip install kaggle`` + ``~/.kaggle/kaggle.json``).
+"""
+
+import json
+import os
+import shutil
+import time
+
+from mlcomp_tpu.worker.executors.base.equation import Equation
+from mlcomp_tpu.worker.executors.base.executor import Executor
+
+SUBMIT_FOLDER = os.path.join('data', 'submissions')
+
+
+def _kaggle_api():
+    """Authenticated kaggle api client, or a clear error."""
+    try:
+        from kaggle.api.kaggle_api_extended import KaggleApi
+    except ImportError as e:
+        raise RuntimeError(
+            'the kaggle package is not installed in this environment '
+            '(zero-egress image); install `kaggle` and place '
+            '~/.kaggle/kaggle.json to use Download/Submit') from e
+    api = KaggleApi()
+    api.authenticate()
+    return api
+
+
+@Executor.register
+class Download(Executor):
+    """Fetch competition files into the project data folder
+    (reference kaggle.py:33-57)."""
+
+    def __init__(self, competition: str, output: str = '.', **kwargs):
+        if not competition:
+            raise ValueError('competition is required')
+        self.competition = competition
+        self.output = output
+
+    @classmethod
+    def _parse_config(cls, executor_spec, config, additional_info):
+        kwargs = super()._parse_config(executor_spec, config,
+                                       additional_info)
+        kwargs['output'] = os.path.join(
+            config.data_folder, kwargs.get('output', '.'))
+        return kwargs
+
+    def work(self):
+        api = _kaggle_api()
+        os.makedirs(self.output, exist_ok=True)
+        self.info(f'downloading {self.competition} -> {self.output}')
+        api.competition_download_files(self.competition, self.output)
+        return {'competition': self.competition, 'output': self.output}
+
+
+@Executor.register
+class Submit(Equation):
+    """Submit predictions and record the public score
+    (reference kaggle.py:60-247).
+
+    file mode: upload ``data/submissions/<name>_<suffix>.csv``.
+    kernel mode: push the csv as a dataset + author a kernel that emits
+    it (for code competitions), then poll the kernel's status.
+    After submission, polls the leaderboard for the public score and
+    writes ``model.score_public``.
+    """
+
+    def __init__(self, competition: str, submit_type: str = 'file',
+                 file: str = None, message: str = '',
+                 kernel_suffix: str = 'api', predict_column: str = None,
+                 wait_seconds: int = 1200, **kwargs):
+        super().__init__(**kwargs)
+        if submit_type not in ('file', 'kernel'):
+            raise ValueError(f'submit_type {submit_type!r} must be '
+                             f"'file' or 'kernel'")
+        if submit_type == 'kernel' and not predict_column:
+            raise ValueError('kernel mode needs predict_column')
+        self.competition = competition
+        self.submit_type = submit_type
+        self.kernel_suffix = kernel_suffix
+        self.predict_column = predict_column
+        self.wait_seconds = int(wait_seconds)
+        self.message = message or f'model_id = {self.model_id}'
+        name = self.model_name or self.name or 'submission'
+        default = f'{name}_{self.suffix}.csv' if self.suffix \
+            else f'{name}.csv'
+        self.file = file or os.path.join(SUBMIT_FOLDER, default)
+
+    # ----------------------------------------------------------- submission
+    def file_submit(self, api):
+        self.info(f'submitting {self.file} to {self.competition}')
+        api.competition_submit(self.file, message=self.message,
+                               competition=self.competition)
+
+    def kernel_submit(self, api):
+        """Stage the csv as a kaggle dataset + push a kernel emitting it
+        (reference kaggle.py:94-200)."""
+        folder = 'submit'
+        os.makedirs(folder, exist_ok=True)
+        shutil.copy(self.file, os.path.join(folder,
+                                            os.path.basename(self.file)))
+        config = api.read_config_file()
+        username = config['username']
+        slug = f'{self.competition}-{self.kernel_suffix}'
+        dataset_id = f'{username}/{slug}-dataset'
+        with open(os.path.join(folder, 'dataset-metadata.json'),
+                  'w') as fh:
+            json.dump({'title': f'{slug}-dataset', 'id': dataset_id,
+                       'licenses': [{'name': 'CC0-1.0'}]}, fh)
+        try:
+            api.dataset_status(dataset_id)
+            api.dataset_create_version(folder, 'Updated')
+        except Exception:
+            api.dataset_create_new(folder)
+
+        kernel_id = f'{username}/{slug}'
+        code = (
+            "import pandas as pd\n"
+            f"df = pd.read_csv('../input/{slug}-dataset/"
+            f"{os.path.basename(self.file)}')\n"
+            f"df.to_csv('submission.csv', index=False)\n")
+        with open(os.path.join(folder, 'kernel.py'), 'w') as fh:
+            fh.write(code)
+        with open(os.path.join(folder, 'kernel-metadata.json'),
+                  'w') as fh:
+            json.dump({
+                'id': kernel_id, 'title': slug, 'code_file': 'kernel.py',
+                'language': 'python', 'kernel_type': 'script',
+                'is_private': True, 'enable_gpu': False,
+                'enable_internet': False,
+                'dataset_sources': [dataset_id],
+                'competition_sources': [self.competition],
+            }, fh)
+        api.kernels_push(folder)
+        deadline = time.time() + self.wait_seconds
+        while time.time() < deadline:
+            status = api.kernels_status(kernel_id)
+            state = str(getattr(status, 'status', status)).lower()
+            if 'complete' in state:
+                return
+            if 'error' in state:
+                raise RuntimeError(f'kernel failed: {status}')
+            time.sleep(30)
+        raise TimeoutError('kernel did not finish in time')
+
+    def _public_score(self, api):
+        """Poll until the NEWEST submission (ours, just made) is scored;
+        returns None on timeout/scoring error rather than falling back
+        to a stale older submission's score."""
+        deadline = time.time() + min(self.wait_seconds, 600)
+        while time.time() < deadline:
+            subs = api.competition_submissions(self.competition)
+            if subs:
+                newest = subs[0]
+                score = getattr(newest, 'publicScore', None)
+                if score not in (None, ''):
+                    return float(score)
+                status = str(getattr(newest, 'status', '')).lower()
+                if 'error' in status:
+                    self.error(f'submission failed scoring: {status}')
+                    return None
+            time.sleep(20)
+        self.info('timed out waiting for the public score')
+        return None
+
+    def work(self):
+        if not os.path.exists(self.file):
+            raise FileNotFoundError(
+                f'submission file {self.file!r} missing — run a '
+                f'prepare-submit stage first')
+        api = _kaggle_api()
+        if self.submit_type == 'file':
+            self.file_submit(api)
+        else:
+            self.kernel_submit(api)
+        score = self._public_score(api)
+        if score is not None and self.session is not None:
+            model_name = self._resolve_model_name()
+            if self.model_id or model_name:
+                from mlcomp_tpu.db.providers import ModelProvider
+                provider = ModelProvider(self.session)
+                row = provider.by_id(self.model_id) if self.model_id \
+                    else provider.by_name(model_name)
+                if row is not None:
+                    row.score_public = score
+                    provider.update(row, ['score_public'])
+        return {'competition': self.competition, 'score_public': score}
+
+
+__all__ = ['Download', 'Submit']

@@ -206,3 +206,68 @@ def test_focal_matches_ce_at_gamma0():
     ce = optax.softmax_cross_entropy_with_integer_labels(
         logits, labels).mean()
     np.testing.assert_allclose(float(loss), float(ce), rtol=1e-5)
+
+
+# ------------------------------------------------------------- contrib CLI
+def test_contrib_cli_split_classify(tmp_path, monkeypatch):
+    import pandas as pd
+    from click.testing import CliRunner
+    from mlcomp_tpu.contrib.__main__ import main as contrib_main
+    for cls in ('cat', 'dog'):
+        folder = tmp_path / 'imgs' / cls
+        folder.mkdir(parents=True)
+        for i in range(6):
+            (folder / f'{cls}{i}.png').write_bytes(b'x')
+    out = tmp_path / 'fold.csv'
+    result = CliRunner().invoke(contrib_main, [
+        'split-classify', str(tmp_path / 'imgs'), '3',
+        '--out', str(out)])
+    assert result.exit_code == 0, result.output
+    df = pd.read_csv(out)
+    assert len(df) == 12 and set(df['fold']) == {0, 1, 2}
+    for cls in ('cat', 'dog'):
+        counts = np.bincount(df[df['label'] == cls]['fold'], minlength=3)
+        assert counts.max() - counts.min() <= 1
+
+
+def test_contrib_cli_split_segment(tmp_path):
+    import pandas as pd
+    from click.testing import CliRunner
+    from mlcomp_tpu.contrib.__main__ import main as contrib_main
+    (tmp_path / 'imgs').mkdir()
+    (tmp_path / 'masks').mkdir()
+    for i in range(8):
+        (tmp_path / 'imgs' / f'im{i}.png').write_bytes(b'x')
+        (tmp_path / 'masks' / f'im{i}.png').write_bytes(b'x')
+    out = tmp_path / 'fold.csv'
+    result = CliRunner().invoke(contrib_main, [
+        'split-segment', str(tmp_path / 'imgs'), str(tmp_path / 'masks'),
+        '4', '--out', str(out)])
+    assert result.exit_code == 0, result.output
+    df = pd.read_csv(out)
+    assert len(df) == 8 and set(df['fold']) == {0, 1, 2, 3}
+
+
+# --------------------------------------------------------- kaggle (gated)
+def test_kaggle_executors_registered_and_gated(tmp_path, monkeypatch):
+    from mlcomp_tpu.worker.executors import Executor
+    assert Executor.is_registered('download')
+    assert Executor.is_registered('submit')
+    dl = Executor.get('download')(competition='titanic', output='.')
+    with pytest.raises(RuntimeError, match='kaggle'):
+        dl.work()
+    monkeypatch.chdir(tmp_path)
+    import os
+    os.makedirs('data/submissions')
+    with open('data/submissions/m.csv', 'w') as fh:
+        fh.write('id,label\n0,1\n')
+    sub = Executor.get('submit')(
+        competition='titanic', name='m', file='data/submissions/m.csv')
+    with pytest.raises(RuntimeError, match='kaggle'):
+        sub.work()
+    # missing submission file gives the actionable error first
+    sub2 = Executor.get('submit')(competition='titanic', name='absent')
+    with pytest.raises(FileNotFoundError, match='prepare-submit'):
+        sub2.work()
+    with pytest.raises(ValueError, match='predict_column'):
+        Executor.get('submit')(competition='t', submit_type='kernel')

@@ -1,0 +1,106 @@
+"""Server-backed Session (multi-computer control plane, VERDICT round-1
+item 6): providers run unchanged over the /api/db proxy — queue
+claim/heartbeat round trips, blob integrity, token auth."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def api(session):
+    from mlcomp_tpu.server.api import ApiServer
+    server = ApiServer(host='127.0.0.1', port=0).start_background()
+    yield server
+    server.shutdown()
+
+
+@pytest.fixture()
+def remote(api, session):
+    from mlcomp_tpu.db.remote import RemoteSession
+    return RemoteSession(f'http://127.0.0.1:{api.port}', key='remote')
+
+
+class TestRemoteSession:
+    def test_basic_roundtrip(self, remote):
+        from mlcomp_tpu.db.models import Project
+        from mlcomp_tpu.db.providers import ProjectProvider
+        provider = ProjectProvider(remote)
+        p = provider.add_project('remote_proj')
+        assert p.id is not None
+        got = provider.by_name('remote_proj')
+        assert got is not None and got.id == p.id
+        assert isinstance(got, Project)
+
+    def test_blob_integrity(self, remote, session):
+        """Code blobs survive the base64 proxy byte-for-byte."""
+        from mlcomp_tpu.db.models import File
+        from mlcomp_tpu.db.providers import FileProvider, ProjectProvider
+        from mlcomp_tpu.utils.misc import now
+        p = ProjectProvider(remote).add_project('remote_blob')
+        payload = bytes(range(256)) * 10
+        import hashlib
+        f = File(md5=hashlib.md5(payload).hexdigest(), content=payload,
+                 project=p.id, dag=None, created=now(), size=len(payload))
+        FileProvider(remote).add(f)
+        # read back through the LOCAL session: same bytes hit the disk
+        row = session.query_one('SELECT content FROM file WHERE id=?',
+                                (f.id,))
+        assert bytes(row['content']) == payload
+        # and back through the remote session
+        row2 = remote.query_one('SELECT content FROM file WHERE id=?',
+                                (f.id,))
+        assert bytes(row2['content']) == payload
+
+    def test_queue_claim_via_remote(self, remote, session):
+        """The worker-side hot path: enqueue locally (supervisor),
+        claim/complete remotely (worker on another computer)."""
+        from mlcomp_tpu.db.providers import QueueProvider
+        local_q = QueueProvider(session)
+        remote_q = QueueProvider(remote)
+        mid = local_q.enqueue('hostx_default', {'task_id': 42})
+        claimed = remote_q.claim(['hostx_default'],
+                                 worker='remote_worker')
+        assert claimed is not None
+        claimed_id, payload = claimed
+        assert claimed_id == mid and payload['task_id'] == 42
+        remote_q.complete(claimed_id)
+        assert local_q.status(mid) == 'done'
+
+    def test_heartbeat_via_remote(self, remote, session):
+        from mlcomp_tpu.db.models import Computer
+        from mlcomp_tpu.db.providers import ComputerProvider, DockerProvider
+        ComputerProvider(remote).create_or_update(
+            Computer(name='remote_host', cores=8, cpu=4, memory=8),
+            'name')
+        DockerProvider(remote).heartbeat('remote_host', 'default')
+        row = session.query_one(
+            "SELECT * FROM docker WHERE computer='remote_host'")
+        assert row is not None
+
+    def test_update_obj(self, remote):
+        from mlcomp_tpu.db.providers import ProjectProvider
+        provider = ProjectProvider(remote)
+        p = provider.add_project('remote_edit')
+        p.class_names = 'a,b,c'
+        provider.update(p, ['class_names'])
+        assert provider.by_id(p.id).class_names == 'a,b,c'
+
+    def test_bad_token_rejected(self, api):
+        from mlcomp_tpu.db.remote import RemoteSession
+        bad = RemoteSession(f'http://127.0.0.1:{api.port}',
+                            key='bad', token='wrong')
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError):
+            bad.query('SELECT 1 AS x')
+
+    def test_create_session_routes_http(self, api):
+        from mlcomp_tpu.db.core import Session
+        from mlcomp_tpu.db.remote import RemoteSession
+        s = Session.create_session(
+            key='routed_remote',
+            connection_string=f'http://127.0.0.1:{api.port}')
+        try:
+            assert isinstance(s, RemoteSession)
+            assert s.query_one('SELECT 1 AS one')['one'] == 1
+        finally:
+            Session.cleanup('routed_remote')

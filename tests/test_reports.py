@@ -201,3 +201,48 @@ class TestExecutorWiring:
         assert provider.get(
             {'task': task.id, 'group': 'img_classify_confusion'}
         )['total'] == 1
+
+
+class TestDescribe:
+    def test_dag_summary_and_render(self, session):
+        """describe-style dashboard (reference utils/describe.py):
+        summary assembly + a rendered figure for a real executed DAG."""
+        from mlcomp_tpu.server.create_dags import dag_standard
+        from mlcomp_tpu.utils.describe import dag_summary, describe
+        from mlcomp_tpu.worker.tasks import execute_by_id
+
+        config = {
+            'info': {'name': 'desc_dag', 'project': 'p_describe'},
+            'executors': {
+                'train': {
+                    'type': 'jax_train',
+                    'model': {'name': 'mlp', 'num_classes': 4,
+                              'hidden': [16], 'dtype': 'float32'},
+                    'dataset': {'name': 'synthetic_images',
+                                'n_train': 128, 'n_valid': 32,
+                                'image_size': 8, 'channels': 1,
+                                'num_classes': 4},
+                    'batch_size': 32, 'epochs': 2,
+                },
+                'probe': {'type': 'split', 'variant': 'count',
+                          'count': 10, 'depends': 'train'},
+            },
+        }
+        dag, tasks = dag_standard(session, config)
+        for name in ('train', 'probe'):
+            for tid in tasks[name]:
+                execute_by_id(tid, exit=False, session=session)
+
+        summary = dag_summary(dag.id, session)
+        assert len(summary['tasks']) == 2
+        assert all(r['status'] == 'Success' for r in summary['tasks'])
+        assert len(summary['graph']['nodes']) == 2
+        assert len(summary['graph']['edges']) == 1
+        # per-epoch training series present
+        assert any('accuracy' in k for k in summary['series'])
+        assert summary['logs']
+
+        fig = describe(dag.id, session)
+        assert fig is not None
+        import matplotlib.pyplot as plt
+        plt.close(fig)
