@@ -80,11 +80,14 @@ class RemoteSession:
                                         timeout=self.timeout) as resp:
                 out = json.loads(resp.read())
         except urllib.error.HTTPError as e:
-            if e.code >= 500:  # surface the server-side reason
-                try:
-                    reason = json.loads(e.read()).get('reason', '')
-                except Exception:
-                    reason = ''
+            # surface the server's reason for ANY error status — the
+            # 403 default-token gate's guidance in particular must
+            # reach the operator
+            try:
+                reason = json.loads(e.read()).get('reason', '')
+            except Exception:
+                reason = ''
+            if reason:
                 raise RuntimeError(
                     f'remote db error ({e.code}): {reason}') from e
             raise
@@ -110,8 +113,10 @@ class RemoteSession:
         return [decode_row(r) for r in out.get('rows', [])]
 
     def query_one(self, sql, params=()):
-        rows = self.query(sql, params)
-        return rows[0] if rows else None
+        out = self._post({'op': 'query_one', 'sql': sql,
+                          'params': encode_params(params)})
+        rows = out.get('rows', [])
+        return decode_row(rows[0]) if rows else None
 
     # --------------------------------------------------------------- object
     def add(self, obj, commit=True):

@@ -48,10 +48,10 @@ def start(n_workers, in_process):
     """Spawn start-site + worker-supervisor + N workers with autorestart."""
     from mlcomp_tpu.utils.procgroup import run_process_group
     specs = [
-        ['mlcomp_tpu.server', 'start-site'],
-        ['mlcomp_tpu.worker', 'worker-supervisor'],
+        ['-m', 'mlcomp_tpu.server', 'start-site'],
+        ['-m', 'mlcomp_tpu.worker', 'worker-supervisor'],
     ] + [
-        ['mlcomp_tpu.worker', 'worker', str(i)]
+        ['-m', 'mlcomp_tpu.worker', 'worker', str(i)]
         + (['--in-process'] if in_process else [])
         for i in range(n_workers)
     ]

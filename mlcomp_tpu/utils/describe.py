@@ -50,15 +50,22 @@ def dag_summary(dag_id: int, session=None, max_logs: int = 12) -> dict:
 
     graph = dag_provider.graph(dag_id)
 
+    # keyed per (task, name, part): same-named series from different
+    # tasks (grid cells, ensembles) stay separate lines
     series = {}
     series_provider = ReportSeriesProvider(session)
+    multi_task = len(tasks) > 1
     for t in tasks:
         for row in series_provider.by_task(t.id):
-            key = (row.name, row.part or '')
+            key = (t.id, row.name, row.part or '')
             series.setdefault(key, {'task': t.id, 'epochs': [],
                                     'values': []})
             series[key]['epochs'].append(row.epoch)
             series[key]['values'].append(row.value)
+
+    def series_label(task_id, name, part):
+        label = f'{name} [{part}]' if part else name
+        return f'#{task_id} {label}' if multi_task else label
 
     log_result = LogProvider(session).get({'dag': dag_id})
     logs = [{'task': row['task'], 'level': row.get('level_name'),
@@ -67,8 +74,8 @@ def dag_summary(dag_id: int, session=None, max_logs: int = 12) -> dict:
 
     return {'dag': {'id': dag.id, 'name': dag.name},
             'tasks': task_rows, 'graph': graph,
-            'series': {f'{n} [{p}]' if p else n: v
-                       for (n, p), v in series.items()},
+            'series': {series_label(t, n, p): v
+                       for (t, n, p), v in series.items()},
             'logs': logs}
 
 

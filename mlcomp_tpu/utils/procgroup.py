@@ -15,20 +15,22 @@ import time
 
 def run_process_group(specs, banner: str = None, poll_interval: float = 2.0,
                       fast_exit_window: float = 10.0,
-                      max_backoff: float = 30.0):
-    """Spawn one child per spec (``[module, *args]`` run as
-    ``python -m module args...``) and babysit forever: restart on exit,
-    exponential per-child backoff while a child keeps dying within
-    ``fast_exit_window`` seconds of spawn. SIGTERM/Ctrl-C terminates the
-    whole group."""
+                      max_backoff: float = 30.0, should_stop=None,
+                      install_signal: bool = True):
+    """Spawn one child per spec (an argv suffix run as
+    ``python <argv...>``, e.g. ``['-m', 'mlcomp_tpu.worker', 'worker',
+    '0']``) and babysit: restart on exit, exponential per-child backoff
+    while a child keeps dying within ``fast_exit_window`` seconds of
+    spawn. SIGTERM/Ctrl-C terminates the whole group. ``should_stop``
+    (tests) is polled each loop; returning True terminates the group
+    and returns instead of exiting."""
     children = {}        # idx -> Popen | None (None = waiting to respawn)
     spawned_at = {}
     restart_at = {}
     fail_streak = [0] * len(specs)
 
     def spawn(idx):
-        module, *args = specs[idx]
-        proc = subprocess.Popen([sys.executable, '-m', module] + args)
+        proc = subprocess.Popen([sys.executable] + list(specs[idx]))
         children[idx] = proc
         spawned_at[idx] = time.time()
 
@@ -37,16 +39,23 @@ def run_process_group(specs, banner: str = None, poll_interval: float = 2.0,
     if banner:
         print(banner)
 
-    def shutdown(*_):
+    def terminate_children():
         for proc in children.values():
             if proc is not None and proc.poll() is None:
                 proc.terminate()
+
+    def shutdown(*_):
+        terminate_children()
         sys.exit(0)
 
-    signal.signal(signal.SIGTERM, shutdown)
+    if install_signal:
+        signal.signal(signal.SIGTERM, shutdown)
     try:
         while True:
             time.sleep(poll_interval)
+            if should_stop is not None and should_stop():
+                terminate_children()
+                return children
             now_t = time.time()
             for idx in range(len(specs)):
                 proc = children.get(idx)

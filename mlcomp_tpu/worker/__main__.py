@@ -336,8 +336,8 @@ def start(n_workers, in_process):
     """Spawn worker-supervisor + N workers with autorestart
     (supervisord parity, reference worker/__main__.py:184-224)."""
     from mlcomp_tpu.utils.procgroup import run_process_group
-    specs = [['mlcomp_tpu.worker', 'worker-supervisor']] + [
-        ['mlcomp_tpu.worker', 'worker', str(i)]
+    specs = [['-m', 'mlcomp_tpu.worker', 'worker-supervisor']] + [
+        ['-m', 'mlcomp_tpu.worker', 'worker', str(i)]
         + (['--in-process'] if in_process else [])
         for i in range(n_workers)
     ]

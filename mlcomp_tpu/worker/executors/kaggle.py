@@ -105,9 +105,11 @@ class Submit(Equation):
 
     def kernel_submit(self, api):
         """Stage the csv as a kaggle dataset + push a kernel emitting it
-        (reference kaggle.py:94-200)."""
-        folder = 'submit'
-        os.makedirs(folder, exist_ok=True)
+        (reference kaggle.py:94-200). Staging lives in a per-call temp
+        dir — concurrent Submit tasks on one host must not overwrite
+        each other's metadata or bundle each other's csvs."""
+        import tempfile
+        folder = tempfile.mkdtemp(prefix='kaggle_submit_')
         shutil.copy(self.file, os.path.join(folder,
                                             os.path.basename(self.file)))
         config = api.read_config_file()

@@ -90,7 +90,8 @@ class TestRemoteSession:
         bad = RemoteSession(f'http://127.0.0.1:{api.port}',
                             key='bad', token='wrong')
         import urllib.error
-        with pytest.raises(urllib.error.HTTPError):
+        with pytest.raises((urllib.error.HTTPError, RuntimeError),
+                           match='401|unauthorized'):
             bad.query('SELECT 1 AS x')
 
     def test_create_session_routes_http(self, api):
