@@ -6,6 +6,7 @@ from mlcomp_tpu.models.base import (
 )
 from mlcomp_tpu.models.mlp import MLP
 from mlcomp_tpu.models.resnet import ResNet, BasicBlock, Bottleneck
+from mlcomp_tpu.models.pipelined import PipelinedTransformerLM
 from mlcomp_tpu.models.segmentation import (
     DeepLabV3, FPN, LinkNet, PSPNet, ResNetEncoder,
 )
@@ -19,4 +20,5 @@ __all__ = [
     'MLP', 'ResNet', 'BasicBlock', 'Bottleneck',
     'TransformerConfig', 'TransformerLM', 'UNet',
     'ResNetEncoder', 'FPN', 'LinkNet', 'PSPNet', 'DeepLabV3',
+    'PipelinedTransformerLM',
 ]

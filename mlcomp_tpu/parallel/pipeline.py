@@ -1,0 +1,87 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the ``pp``
+mesh axis.
+
+Green-field capability (the reference has no model parallelism of any
+kind — SURVEY.md §2.3). The design follows the scaling-book recipe:
+
+- layer parameters are STACKED with a leading ``stage`` logical axis
+  that shards over ``pp`` — each device holds ``n_layers / pp`` layers'
+  weights and nothing else;
+- the global batch splits into M microbatches; at tick t, stage s
+  processes microbatch ``t - s`` (junk during fill/drain — the pipeline
+  bubble) and hands its activation to stage s+1 via ``lax.ppermute``
+  (one ICI hop);
+- the schedule is a single ``lax.scan`` of S + M - 1 ticks inside
+  ``shard_map``, so XLA sees static control flow and overlappable
+  point-to-point transfers; the backward pass differentiates straight
+  through (the transpose of ppermute is the reverse ppermute).
+
+``pipeline_apply`` is the schedule; models call it inside shard_map
+with their per-stage parameter shard and a per-layer apply function.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def stage_apply(layer_fn, stage_params, h):
+    """Apply this stage's stack of layers (leading dim = layers on this
+    stage) to activation ``h`` — a scan so the layer loop stays compiled
+    once regardless of depth."""
+
+    def body(carry, layer_params):
+        return layer_fn(layer_params, carry), None
+
+    out, _ = lax.scan(body, h, stage_params)
+    return out
+
+
+def pipeline_apply(layer_fn, stage_params, x_microbatches,
+                   axis_name: str = 'pp'):
+    """Run microbatches [M, mb, ...] through the pipeline; call INSIDE
+    shard_map over ``axis_name``. ``stage_params`` is the local stage's
+    stacked layer params. Returns [M, mb, ...] outputs, valid on every
+    rank (the last stage's results are broadcast via psum masking).
+    """
+    n_stages = lax.axis_size(axis_name)
+    my_stage = lax.axis_index(axis_name)
+    n_micro = x_microbatches.shape[0]
+    n_ticks = n_stages + n_micro - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        buf = carry
+        # stage 0 injects microbatch t (clipped reads repeat the last
+        # microbatch during drain; those outputs are never selected)
+        inject = x_microbatches[jnp.clip(t, 0, n_micro - 1)]
+        h_in = jnp.where(my_stage == 0, inject, buf)
+        h_out = stage_apply(layer_fn, stage_params, h_in)
+        nxt = lax.ppermute(h_out, axis_name, perm)
+        return nxt, h_out
+
+    buf0 = jnp.zeros_like(x_microbatches[0])
+    _, outs = lax.scan(tick, buf0, jnp.arange(n_ticks))
+    # outs: [T, mb, ...] — on the LAST stage, ticks S-1 .. S+M-2 hold
+    # microbatches 0..M-1. Select and broadcast to all stages.
+    last = outs[n_stages - 1:]
+    is_last = (my_stage == n_stages - 1).astype(last.dtype)
+    return lax.psum(last * is_last, axis_name)
+
+
+def split_microbatches(x, n_micro: int):
+    """[B, ...] -> [M, B/M, ...] (B must divide by M)."""
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(
+            f'batch {b} not divisible by {n_micro} microbatches')
+    return x.reshape(n_micro, b // n_micro, *x.shape[1:])
+
+
+def merge_microbatches(y):
+    """[M, mb, ...] -> [B, ...]."""
+    return y.reshape(y.shape[0] * y.shape[1], *y.shape[2:])
+
+
+__all__ = ['pipeline_apply', 'stage_apply', 'split_microbatches',
+           'merge_microbatches']

@@ -55,6 +55,13 @@ def run_process_group(specs, banner: str = None, poll_interval: float = 2.0,
             time.sleep(poll_interval)
             if should_stop is not None and should_stop():
                 terminate_children()
+                for proc in children.values():
+                    if proc is not None:  # reap — no zombies for caller
+                        try:
+                            proc.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            proc.kill()
+                            proc.wait(timeout=5)
                 return children
             now_t = time.time()
             for idx in range(len(specs)):

@@ -110,6 +110,12 @@ class Submit(Equation):
         each other's metadata or bundle each other's csvs."""
         import tempfile
         folder = tempfile.mkdtemp(prefix='kaggle_submit_')
+        try:
+            self._kernel_submit_staged(api, folder)
+        finally:
+            shutil.rmtree(folder, ignore_errors=True)
+
+    def _kernel_submit_staged(self, api, folder):
         shutil.copy(self.file, os.path.join(folder,
                                             os.path.basename(self.file)))
         config = api.read_config_file()

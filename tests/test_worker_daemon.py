@@ -4,12 +4,7 @@ group, and multi-stage requeue through a real queue consume cycle."""
 
 import datetime
 import os
-import subprocess
-import sys
 import time
-
-import numpy as np
-import pytest
 
 from mlcomp_tpu.db.enums import TaskStatus
 from mlcomp_tpu.db.providers import QueueProvider, TaskProvider
@@ -149,11 +144,7 @@ class TestProcessGroup:
         from mlcomp_tpu.utils.procgroup import run_process_group
         deadline = time.time() + 30
         specs = [['-c', 'import time; time.sleep(600)']]
-        state = {'killed': False}
-
-        def should_stop():
-            procs = [p for p in state.get('children', {}).values() if p]
-            return time.time() > deadline or state.get('done', False)
+        state = {}
 
         # drive the loop from a thread so we can kill the child
         import threading
