@@ -1,0 +1,3 @@
+from mlcomp_tpu.contrib.sampler.hard_negative import HardNegativeSampler
+
+__all__ = ['HardNegativeSampler']

@@ -1,0 +1,65 @@
+"""Hard-negative sampling (parity: reference
+contrib/sampler/hard_negative.py:4-13 — a stub there; a working
+implementation here).
+
+TPU-first shape: instead of a torch Sampler yielding indices one by
+one, this produces whole epoch permutations biased toward
+hard examples, pluggable where the training loop builds its per-epoch
+permutation (the device-resident path consumes [steps, batch] index
+arrays directly).
+"""
+
+import numpy as np
+
+
+class HardNegativeSampler:
+    """Sample hard examples more often, keeping every example's
+    minimum exposure.
+
+    ``update(losses)`` records per-example difficulty (e.g. last-epoch
+    per-sample loss); ``epoch_indices(batch_size)`` returns a
+    [steps, batch] index array where a ``hard_fraction`` of each batch
+    is drawn from the hardest examples and the rest uniformly.
+    """
+
+    def __init__(self, n: int, hard_fraction: float = 0.5,
+                 top_k_fraction: float = 0.25, seed: int = 0):
+        self.n = int(n)
+        self.hard_fraction = float(hard_fraction)
+        self.top_k_fraction = float(top_k_fraction)
+        self.rng = np.random.RandomState(seed)
+        self.difficulty = np.zeros(self.n, np.float32)
+
+    def update(self, losses):
+        losses = np.asarray(losses, np.float32)
+        if losses.shape != (self.n,):
+            raise ValueError(
+                f'expected per-example losses of shape ({self.n},), '
+                f'got {losses.shape}')
+        self.difficulty = losses
+
+    def epoch_indices(self, batch_size: int) -> np.ndarray:
+        steps = self.n // batch_size
+        n_hard = int(batch_size * self.hard_fraction)
+        n_uniform = batch_size - n_hard
+        k = max(1, int(self.n * self.top_k_fraction))
+        hardest = np.argsort(-self.difficulty)[:k]
+        # the uniform half cycles through a permutation, so every
+        # example keeps its minimum exposure (sampling with replacement
+        # would leave ~e^-f of the easy set unseen per epoch)
+        cycle = self.rng.permutation(self.n)
+        out = np.empty((steps, batch_size), np.int64)
+        pos = 0
+        for s in range(steps):
+            hard = self.rng.choice(hardest, n_hard,
+                                   replace=len(hardest) < n_hard)
+            take = np.arange(pos, pos + n_uniform) % self.n
+            uniform = cycle[take]
+            pos += n_uniform
+            batch = np.concatenate([hard, uniform])
+            self.rng.shuffle(batch)
+            out[s] = batch
+        return out
+
+
+__all__ = ['HardNegativeSampler']

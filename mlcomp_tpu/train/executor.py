@@ -48,7 +48,8 @@ from mlcomp_tpu.train.data import (
     create_dataset, iterate_batches, place_batch, prefetch_batches,
 )
 from mlcomp_tpu.train.loop import (
-    create_train_state, loss_for_task, make_eval_step, make_train_step,
+    aggregate_metrics, create_train_state, loss_for_task, make_eval_step,
+    make_train_step,
 )
 from mlcomp_tpu.train.optim import make_optimizer
 from mlcomp_tpu.worker.executors import Executor
@@ -63,7 +64,8 @@ class JaxTrain(Executor):
                  model_name=None, seed=0, checkpoint_dir=None,
                  stage_per_dispatch=False, log_every=50,
                  report_imgs=None, augment=None, prefetch=2,
-                 device_data='auto', epoch_scan=False, **kwargs):
+                 device_data='auto', epoch_scan=False,
+                 checkpoint_every=1, **kwargs):
         self.model_spec = dict(model or {'name': 'mlp'})
         self.dataset_spec = dict(dataset or {})
         self.loss_name = loss
@@ -88,6 +90,7 @@ class JaxTrain(Executor):
         # the per-step device path on TPU and pathologically slow to
         # compile on XLA:CPU (scan-of-conv-graph), so opt-in
         self.epoch_scan = bool(epoch_scan)
+        self.checkpoint_every = int(checkpoint_every)
 
     # ------------------------------------------------------------ plumbing
     def _init_distributed(self):
@@ -202,14 +205,20 @@ class JaxTrain(Executor):
                 and device_augs is not None
                 and y_train is not None
                 and seq_dim is None
-                and dataset_fits_hbm(x_train)))
+                # train AND valid both become HBM-resident
+                and dataset_fits_hbm(x_train,
+                                     extra_bytes=x_valid.nbytes)))
         transform = None
         dev_augment = None
         dequant = False
         x_all = y_all = None
+        xv_all = yv_all = None
+        dequant_v = False
         if use_device_data:
             x_q, dequant = quantize_dataset(x_train)
             x_all, y_all = place_dataset(x_q, y_train, mesh)
+            xv_q, dequant_v = quantize_dataset(x_valid)
+            xv_all, yv_all = place_dataset(xv_q, y_valid, mesh)
             if device_augs:
                 dev_augment = make_device_augment(
                     device_augs, x_train.shape[1:])
@@ -318,6 +327,10 @@ class JaxTrain(Executor):
             eval_step = make_eval_step(
                 model, loss_fn, mesh=mesh,
                 self_supervised=self_supervised)
+            if use_device_data:
+                from mlcomp_tpu.train.loop import make_device_eval_step
+                eval_step_dev = make_device_eval_step(
+                    model, loss_fn, mesh=mesh, dequantize=dequant_v)
             first_epoch = start_epoch if stage is remaining[0] else 0
             if first_epoch == 0 and stage is not self.stages[0]:
                 # stage boundary: fresh optimizer state, keep params
@@ -362,10 +375,7 @@ class JaxTrain(Executor):
                             state, metrics = train_step(
                                 state, x_all, y_all, idx)
                             train_metrics.append(metrics)
-                        train_agg = {
-                            k: float(np.mean([float(m[k])
-                                              for m in train_metrics]))
-                            for k in train_metrics[0]}
+                        train_agg = aggregate_metrics(train_metrics)
                     images_seen += steps_per_epoch * self.batch_size
                 else:
                     train_metrics = []
@@ -385,40 +395,45 @@ class JaxTrain(Executor):
                             f'dataset has {len(x_train)} train samples '
                             f'— fewer than batch_size='
                             f'{self.batch_size}; no full batch')
-                    # metrics: device→host once per epoch (the float()
-                    # pulls force all queued steps to finish)
-                    train_agg = {
-                        k: float(np.mean([float(m[k])
-                                          for m in train_metrics]))
-                        for k in train_metrics[0]}
+                    # metrics: device→host ONCE per epoch
+                    train_agg = aggregate_metrics(train_metrics)
                 train_dt = time.time() - t_ep
                 # evaluate EVERY validation sample: tail batches are
                 # padded (duplicate samples) up to a multiple of the
                 # data-parallel width, with zero weights on the padding so
-                # aggregates stay exact
+                # aggregates stay exact. On the device-data path the
+                # valid set is HBM-resident too — per-batch transfer is
+                # an index + weight vector, not the images.
                 dp = max(1, data_parallel_size(mesh))
                 valid_metrics, valid_weights = [], []
-                for bx, by in iterate_batches(
-                        x_valid, y_valid, self.eval_batch_size,
-                        drop_last=False):
-                    n_real = len(bx)
+                n_valid_total = len(x_valid)
+                for start in range(0, n_valid_total,
+                                   self.eval_batch_size):
+                    n_real = min(self.eval_batch_size,
+                                 n_valid_total - start)
                     n_padded = -(-n_real // dp) * dp
+                    take = np.resize(np.arange(start, start + n_real),
+                                     n_padded)
                     w = np.ones(n_padded, np.float32)
-                    if n_padded != n_real:
-                        take = np.resize(np.arange(n_real), n_padded)
-                        bx = bx[take]
-                        if by is not None:
-                            by = by[take]
-                        w[n_real:] = 0.0
-                    x, y = place_batch((bx, by), mesh, seq_dim=seq_dim)
+                    w[n_real:] = 0.0
                     w_dev = jax.device_put(w, batch_sharding(mesh, 1))
-                    valid_metrics.append(eval_step(state, x, y, w_dev))
+                    if use_device_data:
+                        idx = jax.device_put(
+                            take.astype(np.int32),
+                            batch_sharding(mesh, 1))
+                        valid_metrics.append(eval_step_dev(
+                            state, xv_all, yv_all, idx, w_dev))
+                    else:
+                        bx = x_valid[take]
+                        by = y_valid[take] if y_valid is not None \
+                            else None
+                        x, y = place_batch((bx, by), mesh,
+                                           seq_dim=seq_dim)
+                        valid_metrics.append(
+                            eval_step(state, x, y, w_dev))
                     valid_weights.append(n_real)
-                valid_agg = {
-                    k: float(np.average(
-                        [float(m[k]) for m in valid_metrics],
-                        weights=valid_weights))
-                    for k in valid_metrics[0]} if valid_metrics else {}
+                valid_agg = aggregate_metrics(valid_metrics,
+                                              weights=valid_weights)
 
                 n_train = steps_per_epoch * self.batch_size
                 for k, v in train_agg.items():
@@ -442,20 +457,31 @@ class JaxTrain(Executor):
                 if is_best:
                     best = score
                     self._update_scores(score)
-                # the host gather is a collective every rank joins;
-                # only rank 0 touches the filesystem
-                # (reference rank>0 suppression, catalyst.py:298-311)
-                from mlcomp_tpu.parallel.distributed import (
-                    host_replicated_copy,
-                )
-                host_state = host_replicated_copy(state, mesh)
-                if self._is_main:
-                    save_checkpoint(
-                        ck_dir, host_state,
-                        {'stage': stage_name, 'stage_epoch': epoch,
-                         'epoch': global_epoch, 'score': score,
-                         'step': int(state.step)},
-                        best=is_best)
+                # checkpoint cadence: pulling the full state to host is
+                # the dominant per-epoch cost on slow host links — save
+                # on best, every checkpoint_every-th epoch, and at the
+                # stage's final epoch (so resume/export always has a
+                # fresh `last`)
+                last_of_stage = epoch == int(stage.get('epochs', 1)) - 1
+                should_save = (
+                    is_best or self.checkpoint_every <= 1
+                    or (global_epoch + 1) % self.checkpoint_every == 0
+                    or last_of_stage)
+                if should_save:
+                    # the host gather is a collective every rank joins;
+                    # only rank 0 touches the filesystem
+                    # (reference rank>0 suppression, catalyst.py:298-311)
+                    from mlcomp_tpu.parallel.distributed import (
+                        host_replicated_copy,
+                    )
+                    host_state = host_replicated_copy(state, mesh)
+                    if self._is_main:
+                        save_checkpoint(
+                            ck_dir, host_state,
+                            {'stage': stage_name, 'stage_epoch': epoch,
+                             'epoch': global_epoch, 'score': score,
+                             'step': int(state.step)},
+                            best=is_best)
                 global_epoch += 1
             if (dispatch_stage is not None or self.stage_per_dispatch) \
                     and stage_name != stage_names[-1]:

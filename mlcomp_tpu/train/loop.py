@@ -277,6 +277,35 @@ def make_device_epoch_fn(model, optimizer, loss_fn: Callable,
     return jax.jit(epoch_in_context, donate_argnums=(0,))
 
 
+def make_device_eval_step(model, loss_fn: Callable,
+                          mesh: Optional[Mesh] = None,
+                          dequantize: bool = False):
+    """Eval against the device-resident dataset: ships a [B] index
+    vector + [B] weight vector per batch instead of the batch itself
+    (the weights zero out tail padding so aggregates stay exact)."""
+    import jax.numpy as jnp
+
+    def step(state: TrainState, x_all, y_all, idx, w):
+        x = jnp.take(x_all, idx, axis=0)
+        y = jnp.take(y_all, idx, axis=0)
+        if dequantize:
+            x = x.astype(jnp.float32) / 255.0
+        logits, _, _ = _apply(model, state, x, train=False)
+        _, metrics = loss_fn(logits, y, weights=w)
+        return metrics
+
+    if mesh is None:
+        return jax.jit(step)
+
+    rules = logical_rules(mesh)
+
+    def step_in_context(state, x_all, y_all, idx, w):
+        with mesh, nn.logical_axis_rules(rules):
+            return step(state, x_all, y_all, idx, w)
+
+    return jax.jit(step_in_context)
+
+
 def make_eval_step(model, loss_fn: Callable,
                    mesh: Optional[Mesh] = None,
                    self_supervised: bool = False):
@@ -296,6 +325,30 @@ def make_eval_step(model, loss_fn: Callable,
             return step(state, x, y, w)
 
     return jax.jit(step_in_context)
+
+
+def aggregate_metrics(metrics_list, weights=None):
+    """Mean (optionally weighted) of a list of per-step metric dicts,
+    pulled from device in ONE transfer.
+
+    Per-scalar ``float()`` pulls cost a full host↔device round trip
+    each — measured 63 ms apiece through a tunneled chip, which turned
+    a 0.36 s training epoch into 4.2 s. Stacking on device and fetching
+    a single [K, S] array makes metric collection one round trip.
+    """
+    import numpy as np
+    if not metrics_list:
+        return {}
+    keys = sorted(metrics_list[0])
+    stacked = jnp.stack(
+        [jnp.stack([jnp.asarray(m[k], jnp.float32)
+                    for m in metrics_list]) for k in keys])
+    values = np.asarray(stacked)          # single device→host transfer
+    if weights is not None:
+        w = np.asarray(weights, np.float64)
+        return {k: float(np.average(values[i], weights=w))
+                for i, k in enumerate(keys)}
+    return {k: float(values[i].mean()) for i, k in enumerate(keys)}
 
 
 def create_train_state(model, optimizer, sample_x, rng,
@@ -351,5 +404,6 @@ def place_state(state: TrainState, mesh: Mesh) -> TrainState:
 
 __all__ = ['TrainState', 'make_train_step', 'make_device_train_step',
            'make_device_epoch_fn', 'make_eval_step',
+           'make_device_eval_step', 'aggregate_metrics',
            'create_train_state', 'state_sharding', 'place_state',
            'loss_for_task', 'LOSSES', 'softmax_ce', 'lm_ce', 'seg_ce']

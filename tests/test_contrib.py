@@ -271,3 +271,20 @@ def test_kaggle_executors_registered_and_gated(tmp_path, monkeypatch):
         sub2.work()
     with pytest.raises(ValueError, match='predict_column'):
         Executor.get('submit')(competition='t', submit_type='kernel')
+
+
+def test_hard_negative_sampler():
+    from mlcomp_tpu.contrib.sampler import HardNegativeSampler
+    n = 100
+    sampler = HardNegativeSampler(n, hard_fraction=0.5,
+                                  top_k_fraction=0.1, seed=0)
+    losses = np.zeros(n, np.float32)
+    losses[:10] = 10.0  # the hard set
+    sampler.update(losses)
+    idx = sampler.epoch_indices(batch_size=20)
+    assert idx.shape == (5, 20)
+    hard_share = np.isin(idx, np.arange(10)).mean()
+    # ~50% drawn from the hard 10% (plus uniform collisions)
+    assert hard_share > 0.4
+    with pytest.raises(ValueError, match='per-example'):
+        sampler.update(np.zeros(3))

@@ -276,3 +276,110 @@ class TestDataHelpers:
         data = create_dataset('cifar10', n_train=64, n_valid=16)
         assert data['source'] == 'synthetic'
         assert data['x_train'].shape == (64, 32, 32, 3)
+
+
+class TestAggregateMetrics:
+    def test_mean_and_weighted(self):
+        import jax.numpy as jnp
+        from mlcomp_tpu.train.loop import aggregate_metrics
+        ms = [{'loss': jnp.asarray(1.0), 'acc': jnp.asarray(0.5)},
+              {'loss': jnp.asarray(3.0), 'acc': jnp.asarray(1.0)}]
+        agg = aggregate_metrics(ms)
+        assert agg == {'loss': 2.0, 'acc': 0.75}
+        weighted = aggregate_metrics(ms, weights=[3, 1])
+        assert weighted['loss'] == pytest.approx(1.5)
+        assert aggregate_metrics([]) == {}
+
+
+class TestDeviceEval:
+    def test_device_eval_matches_host_eval(self):
+        """Indexed HBM-resident eval == the host-batch eval step,
+        including zero-weight tail padding."""
+        import jax
+        from mlcomp_tpu.models import create_model
+        from mlcomp_tpu.parallel import mesh_from_spec
+        from mlcomp_tpu.parallel.sharding import batch_sharding
+        from mlcomp_tpu.train import (
+            create_train_state, loss_for_task, make_optimizer,
+        )
+        from mlcomp_tpu.train.data import place_batch
+        from mlcomp_tpu.train.device_data import place_dataset
+        from mlcomp_tpu.train.loop import (
+            make_device_eval_step, make_eval_step,
+        )
+        mesh = mesh_from_spec({'dp': -1})
+        model = create_model('mlp', num_classes=4, hidden=[16],
+                             dtype='float32')
+        opt, _ = make_optimizer({'name': 'sgd', 'lr': 0.1}, 10)
+        loss_fn = loss_for_task('softmax_ce')
+        x = np.random.rand(20, 4, 4, 1).astype(np.float32)
+        y = np.random.randint(0, 4, 20).astype(np.int32)
+        state = create_train_state(model, opt, x[:8],
+                                   jax.random.PRNGKey(0), mesh=mesh)
+        x_all, y_all = place_dataset(x, y, mesh)
+        # a padded tail batch: 4 real rows padded to 8, zero weights
+        take = np.resize(np.arange(16, 20), 8)
+        w = np.array([1, 1, 1, 1, 0, 0, 0, 0], np.float32)
+        w_dev = jax.device_put(w, batch_sharding(mesh, 1))
+        dev = make_device_eval_step(model, loss_fn, mesh=mesh)
+        m_dev = dev(state, x_all, y_all,
+                    jax.device_put(take.astype(np.int32),
+                                   batch_sharding(mesh, 1)), w_dev)
+        host = make_eval_step(model, loss_fn, mesh=mesh)
+        xb, yb = place_batch((x[take], y[take]), mesh)
+        m_host = host(state, xb, yb, w_dev)
+        for k in m_host:
+            assert float(m_dev[k]) == pytest.approx(float(m_host[k]),
+                                                    rel=1e-6), k
+
+
+class TestCheckpointCadence:
+    def test_last_of_stage_always_saved(self, tmp_path):
+        """Even with a huge checkpoint_every, the stage's final epoch
+        writes `last` (resume/export depend on it)."""
+        import os
+        from test_train import DummyStep
+        from mlcomp_tpu.train import JaxTrain
+        from mlcomp_tpu.train.checkpoint import load_meta
+        ex = JaxTrain(
+            model={'name': 'mlp', 'num_classes': 4, 'hidden': [16],
+                   'dtype': 'float32'},
+            dataset={'name': 'synthetic_images', 'n_train': 128,
+                     'n_valid': 32, 'image_size': 8, 'channels': 1,
+                     'num_classes': 4},
+            batch_size=32, epochs=3, checkpoint_every=1000,
+            checkpoint_dir=str(tmp_path / 'ck'))
+        ex.step = DummyStep()
+        ex.task = None
+        ex.session = None
+        ex.additional_info = {}
+        ex.work()
+        assert os.path.exists(tmp_path / 'ck' / 'last.msgpack')
+        meta = load_meta(str(tmp_path / 'ck'))
+        assert meta['stage_epoch'] == 2  # the stage's FINAL epoch
+
+    def test_resume_after_cadenced_run(self, tmp_path):
+        from test_train import DummyStep
+        from mlcomp_tpu.train import JaxTrain
+
+        def run(epochs):
+            ex = JaxTrain(
+                model={'name': 'mlp', 'num_classes': 4, 'hidden': [16],
+                       'dtype': 'float32'},
+                dataset={'name': 'synthetic_images', 'n_train': 128,
+                         'n_valid': 32, 'image_size': 8, 'channels': 1,
+                         'num_classes': 4},
+                batch_size=32, checkpoint_every=1000,
+                stages=[{'name': 's1', 'epochs': epochs,
+                         'optimizer': {'name': 'adam', 'lr': 3e-3}}],
+                checkpoint_dir=str(tmp_path / 'ck'))
+            ex.step = DummyStep()
+            ex.task = None
+            ex.session = None
+            ex.additional_info = {}
+            return ex.work()
+
+        run(2)
+        # re-run with more epochs: resumes past the 2 completed ones
+        result = run(4)
+        assert result['best_score'] is not None
