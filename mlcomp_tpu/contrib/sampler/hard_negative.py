@@ -29,6 +29,7 @@ class HardNegativeSampler:
         self.top_k_fraction = float(top_k_fraction)
         self.rng = np.random.RandomState(seed)
         self.difficulty = np.zeros(self.n, np.float32)
+        self._updated = False
 
     def update(self, losses):
         losses = np.asarray(losses, np.float32)
@@ -37,13 +38,20 @@ class HardNegativeSampler:
                 f'expected per-example losses of shape ({self.n},), '
                 f'got {losses.shape}')
         self.difficulty = losses
+        self._updated = True
 
     def epoch_indices(self, batch_size: int) -> np.ndarray:
         steps = self.n // batch_size
         n_hard = int(batch_size * self.hard_fraction)
         n_uniform = batch_size - n_hard
         k = max(1, int(self.n * self.top_k_fraction))
-        hardest = np.argsort(-self.difficulty)[:k]
+        if self._updated:
+            hardest = np.argsort(-self.difficulty)[:k]
+        else:
+            # no difficulty signal yet: argsort of the all-zero vector
+            # would deterministically pick the dataset head — sample the
+            # "hard" half uniformly until the first update()
+            hardest = self.rng.permutation(self.n)[:k]
         # the uniform half cycles through a permutation, so every
         # example keeps its minimum exposure (sampling with replacement
         # would leave ~e^-f of the easy set unseen per epoch)

@@ -199,6 +199,12 @@ class JaxTrain(Executor):
                 f'transforms outside the device-expressible set '
                 f'{DEVICE_AUGMENTS}; drop them or use device_data: auto '
                 f'(which falls back to the host pipeline)')
+        if self.device_data is True and (y_train is None
+                                         or self_supervised):
+            raise ValueError(
+                'device_data: true supports labeled datasets only — '
+                'label-less/self-supervised training uses the host '
+                'pipeline (device_data: auto selects it automatically)')
         use_device_data = (
             self.device_data is True
             or (self.device_data == 'auto'
