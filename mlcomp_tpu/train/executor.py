@@ -210,6 +210,7 @@ class JaxTrain(Executor):
             or (self.device_data == 'auto'
                 and device_augs is not None
                 and y_train is not None
+                and not self_supervised
                 and seq_dim is None
                 # train AND valid both become HBM-resident
                 and dataset_fits_hbm(x_train,

@@ -124,8 +124,8 @@ class TestBlockwiseBackward:
                                       block_k=128)
             assert float(jnp.max(jnp.abs(blk - ref))) < 2e-5, causal
 
-    def test_gradients_via_blockwise_backward(self):
-        """The custom vjp's blockwise recompute produces the dense
+    def test_gradients_through_custom_vjp(self):
+        """The custom vjp (fused Pallas backward) produces the dense
         gradients exactly."""
         import jax
         import jax.numpy as jnp
@@ -138,4 +138,43 @@ class TestBlockwiseBackward:
             lambda q, k, v: (reference_attention(q, k, v) ** 2).sum(),
             argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(g_fa, g_ref):
+            assert float(jnp.max(jnp.abs(a - b))) < 1e-4
+
+
+class TestFusedBackward:
+    def test_lse_matches_dense_logsumexp(self):
+        import jax
+        import jax.numpy as jnp
+        from mlcomp_tpu.ops.flash_attention import (
+            flash_attention_forward,
+        )
+        q, k, v = _qkv(t=256)
+        out, lse = flash_attention_forward(q, k, v, causal=True,
+                                           interpret=True,
+                                           with_lse=True)
+        d = q.shape[-1]
+        s = jnp.einsum('bqhd,bkhd->bhqk', q, k) * (d ** -0.5)
+        t = q.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((t, t), bool)), s, -1e30)
+        ref = jax.scipy.special.logsumexp(s, axis=-1)
+        assert float(jnp.max(jnp.abs(lse - ref))) < 1e-4
+
+    def test_backward_kernel_matches_reference(self):
+        """flash_attention_backward's dq/dk/dv == autodiff of dense."""
+        import jax
+        import jax.numpy as jnp
+        from mlcomp_tpu.ops.flash_attention import (
+            flash_attention_backward, flash_attention_forward,
+        )
+        q, k, v = _qkv(t=256)
+        out, lse = flash_attention_forward(q, k, v, causal=True,
+                                           interpret=True,
+                                           with_lse=True)
+        do = jnp.ones_like(out) * 0.1
+        dq, dk, dv = flash_attention_backward(
+            q, k, v, out, lse, do, causal=True, interpret=True)
+        _, vjp = jax.vjp(lambda q_, k_, v_: reference_attention(
+            q_, k_, v_, causal=True), q, k, v)
+        rq, rk, rv = vjp(do)
+        for a, b in ((dq, rq), (dk, rk), (dv, rv)):
             assert float(jnp.max(jnp.abs(a - b))) < 1e-4
