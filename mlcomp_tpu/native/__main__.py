@@ -1,0 +1,23 @@
+"""``python -m mlcomp_tpu.native`` — build/inspect the native library."""
+
+import sys
+
+from mlcomp_tpu import native
+
+
+def main():
+    force = '--force' in sys.argv
+    try:
+        path = native.build(force=force)
+    except RuntimeError as e:
+        print(f'build failed: {e}', file=sys.stderr)
+        return 1
+    ok = native.available()
+    print(f'native library: {path} (loaded={ok}, '
+          f'cpu={native.cpu_percent():.1f}% '
+          f'mem={native.memory_percent():.1f}%)')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

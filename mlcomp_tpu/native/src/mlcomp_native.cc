@@ -1,0 +1,439 @@
+// mlcomp_tpu native runtime library.
+//
+// The reference delegated its native needs to external binaries and C
+// extensions (rsync/ssh for bulk file movement, worker/sync.py:38-71;
+// GPUtil/psutil for telemetry, worker/__main__.py:91-127; hashlib for the
+// code-in-DB content store, worker/storage.py:88-134). This library is the
+// framework's own native equivalent: a threaded content hasher, a threaded
+// delta tree-sync engine, and a /proc-based resource sampler, exported with
+// a plain C ABI consumed via ctypes (no pybind11 in this environment).
+//
+// Everything here is GIL-free: hashing and syncing large experiment trees
+// run on all cores while the Python worker keeps serving its queue.
+
+#include <atomic>
+#include <cerrno>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <filesystem>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <sys/statvfs.h>
+#include <unistd.h>
+
+namespace fs = std::filesystem;
+
+// ---------------------------------------------------------------------------
+// MD5 (RFC 1321). Round constants are derived at runtime from the spec's
+// floor(abs(sin(i+1)) * 2^32) definition instead of a transcribed table.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+class Md5 {
+ public:
+  void update(const unsigned char* p, size_t n) {
+    total_ += n;
+    absorb(p, n);
+  }
+
+  std::string hexdigest() {
+    uint64_t bits = total_ * 8;
+    unsigned char pad[72] = {0x80};
+    size_t padlen = (buflen_ < 56) ? (56 - buflen_) : (120 - buflen_);
+    absorb(pad, padlen);
+    unsigned char lenb[8];
+    for (int i = 0; i < 8; i++) lenb[i] = (unsigned char)(bits >> (8 * i));
+    absorb(lenb, 8);
+    unsigned char out[16];
+    uint32_t h[4] = {a_, b_, c_, d_};
+    for (int i = 0; i < 4; i++)
+      for (int j = 0; j < 4; j++)
+        out[4 * i + j] = (unsigned char)(h[i] >> (8 * j));
+    static const char* hexd = "0123456789abcdef";
+    std::string hex(32, '0');
+    for (int i = 0; i < 16; i++) {
+      hex[2 * i] = hexd[out[i] >> 4];
+      hex[2 * i + 1] = hexd[out[i] & 15];
+    }
+    return hex;
+  }
+
+ private:
+  static const uint32_t* k_table() {
+    static uint32_t k[64];
+    static std::once_flag once;
+    std::call_once(once, [] {
+      for (int i = 0; i < 64; i++)
+        k[i] = (uint32_t)(std::floor(
+            std::fabs(std::sin((double)(i + 1))) * 4294967296.0));
+    });
+    return k;
+  }
+
+  static uint32_t rotl(uint32_t x, int c) { return (x << c) | (x >> (32 - c)); }
+
+  void block(const unsigned char* p) {
+    static const int S[4][4] = {
+        {7, 12, 17, 22}, {5, 9, 14, 20}, {4, 11, 16, 23}, {6, 10, 15, 21}};
+    const uint32_t* k = k_table();
+    uint32_t m[16];
+    for (int i = 0; i < 16; i++)
+      m[i] = (uint32_t)p[4 * i] | ((uint32_t)p[4 * i + 1] << 8) |
+             ((uint32_t)p[4 * i + 2] << 16) | ((uint32_t)p[4 * i + 3] << 24);
+    uint32_t a = a_, b = b_, c = c_, d = d_;
+    for (int i = 0; i < 64; i++) {
+      uint32_t f;
+      int g;
+      if (i < 16) {
+        f = (b & c) | (~b & d);
+        g = i;
+      } else if (i < 32) {
+        f = (d & b) | (~d & c);
+        g = (5 * i + 1) % 16;
+      } else if (i < 48) {
+        f = b ^ c ^ d;
+        g = (3 * i + 5) % 16;
+      } else {
+        f = c ^ (b | ~d);
+        g = (7 * i) % 16;
+      }
+      f += a + k[i] + m[g];
+      a = d;
+      d = c;
+      c = b;
+      b += rotl(f, S[i / 16][i % 4]);
+    }
+    a_ += a;
+    b_ += b;
+    c_ += c;
+    d_ += d;
+  }
+
+  // feed bytes through the compressor without touching the length counter
+  // (finalization padding must not count toward the message length)
+  void absorb(const unsigned char* p, size_t n) {
+    if (buflen_) {
+      size_t take = std::min(n, (size_t)64 - buflen_);
+      memcpy(buf_ + buflen_, p, take);
+      buflen_ += take;
+      p += take;
+      n -= take;
+      if (buflen_ == 64) {
+        block(buf_);
+        buflen_ = 0;
+      }
+    }
+    while (n >= 64) {
+      block(p);
+      p += 64;
+      n -= 64;
+    }
+    if (n) {
+      memcpy(buf_, p, n);
+      buflen_ = n;
+    }
+  }
+
+  uint32_t a_ = 0x67452301, b_ = 0xefcdab89, c_ = 0x98badcfe, d_ = 0x10325476;
+  uint64_t total_ = 0;
+  unsigned char buf_[64];
+  size_t buflen_ = 0;
+};
+
+std::string md5_file(const std::string& path, bool* ok) {
+  int fd = open(path.c_str(), O_RDONLY);
+  if (fd < 0) {
+    *ok = false;
+    return std::string(32, '0');
+  }
+  Md5 md5;
+  std::vector<unsigned char> buf(1 << 20);
+  ssize_t n;
+  while ((n = read(fd, buf.data(), buf.size())) > 0)
+    md5.update(buf.data(), (size_t)n);
+  close(fd);
+  *ok = (n == 0);
+  return md5.hexdigest();
+}
+
+std::vector<std::string> split_lines(const char* joined) {
+  std::vector<std::string> out;
+  if (!joined) return out;
+  const char* p = joined;
+  while (*p) {
+    const char* nl = strchr(p, '\n');
+    if (!nl) {
+      out.emplace_back(p);
+      break;
+    }
+    out.emplace_back(p, nl - p);
+    p = nl + 1;
+  }
+  return out;
+}
+
+int clamp_threads(int threads, size_t work) {
+  unsigned hw = std::thread::hardware_concurrency();
+  if (threads <= 0) threads = hw ? (int)hw : 4;
+  if ((size_t)threads > work) threads = work ? (int)work : 1;
+  return threads;
+}
+
+}  // namespace
+
+extern "C" {
+
+int mt_version() { return 1; }
+
+// md5 of an in-memory buffer -> 32 hex chars + NUL into out33.
+int mt_md5_hex(const unsigned char* data, long n, char* out33) {
+  if (!data && n > 0) return 1;
+  Md5 md5;
+  if (n > 0) md5.update(data, (size_t)n);
+  std::string hex = md5.hexdigest();
+  memcpy(out33, hex.c_str(), 33);
+  return 0;
+}
+
+// Hash a newline-joined list of file paths with a thread pool. Writes
+// newline-joined 32-char digests (input order) into `out` (capacity `cap`).
+// Unreadable files hash to 32 '0's. Returns 0 on success, 2 if out too small.
+static int hash_files_impl(const char* paths_nl, char* out, long cap,
+                           int threads) {
+  std::vector<std::string> paths = split_lines(paths_nl);
+  size_t need = paths.size() ? paths.size() * 33 : 1;
+  if ((size_t)cap < need) return 2;
+  std::vector<std::string> digests(paths.size());
+  std::atomic<size_t> next{0};
+  threads = clamp_threads(threads, paths.size());
+  auto run = [&] {
+    for (size_t i; (i = next.fetch_add(1)) < paths.size();) {
+      bool ok;
+      digests[i] = md5_file(paths[i], &ok);
+      if (!ok) digests[i] = std::string(32, '0');
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 1; t < threads; t++) pool.emplace_back(run);
+  run();
+  for (auto& th : pool) th.join();
+  char* w = out;
+  for (size_t i = 0; i < digests.size(); i++) {
+    memcpy(w, digests[i].c_str(), 32);
+    w += 32;
+    *w++ = (i + 1 == digests.size()) ? '\0' : '\n';
+  }
+  if (digests.empty()) *w = '\0';
+  return 0;
+}
+
+int mt_hash_files(const char* paths_nl, char* out, long cap, int threads) {
+  try {
+    return hash_files_impl(paths_nl, out, cap, threads);
+  } catch (...) {
+    return 4;
+  }
+}
+
+// Delta-sync src tree into dst: copy files that are missing at dst or whose
+// (size, mtime) differ; recreate directories and symlinks; preserve mtimes so
+// the next pass is a no-op. stats_out[4] = {copied, skipped, bytes, errors}.
+// This is the native replacement for the reference's rsync shell-out on the
+// local/shared-filesystem paths (reference worker/sync.py:38-71).
+static int sync_tree_impl(const char* src_c, const char* dst_c, int threads,
+                          long long* stats_out) {
+  stats_out[0] = stats_out[1] = stats_out[2] = stats_out[3] = 0;
+  std::error_code ec;
+  fs::path src(src_c), dst(dst_c);
+  if (!fs::exists(src, ec)) return 1;
+
+  struct Job {
+    fs::path from, to;
+    uintmax_t size;
+    fs::file_time_type mtime;
+  };
+  std::vector<Job> jobs;
+  std::atomic<long long> copied{0}, skipped{0}, bytes{0}, errors{0};
+
+  fs::create_directories(dst, ec);
+  fs::recursive_directory_iterator it(
+      src, fs::directory_options::skip_permission_denied, ec);
+  if (ec) return 1;
+  for (auto end = fs::recursive_directory_iterator(); it != end;
+       it.increment(ec)) {
+    if (ec) {
+      errors++;
+      break;
+    }
+    const fs::path& from = it->path();
+    // lexical, not fs::relative — the latter canonicalizes and would
+    // resolve symlinks into their targets' paths
+    fs::path rel = from.lexically_relative(src);
+    if (rel.empty() || rel == ".") {
+      errors++;
+      continue;
+    }
+    fs::path to = dst / rel;
+    std::error_code ect;
+    if (it->is_symlink(ect) && !ect) {
+      fs::path target = fs::read_symlink(from, ec);
+      if (ec) {
+        errors++;
+        continue;
+      }
+      std::error_code ecs;
+      fs::path old = fs::is_symlink(to, ecs) && !ecs
+                         ? fs::read_symlink(to, ec)
+                         : fs::path();
+      if (old != target) {
+        fs::remove(to, ec);
+        fs::create_symlink(target, to, ec);
+        if (ec)
+          errors++;
+        else
+          copied++;
+      } else {
+        skipped++;
+      }
+      it.disable_recursion_pending();
+    } else if (it->is_directory(ect) && !ect) {
+      fs::create_directories(to, ec);
+      if (ec) errors++;
+    } else if (it->is_regular_file(ect) && !ect) {
+      uintmax_t size = it->file_size(ec);
+      if (ec) {
+        errors++;
+        continue;
+      }
+      fs::file_time_type mtime = it->last_write_time(ec);
+      std::error_code ec2;
+      bool same = fs::exists(to, ec2) && !ec2 &&
+                  fs::is_regular_file(to, ec2) &&
+                  fs::file_size(to, ec2) == size && !ec2 &&
+                  fs::last_write_time(to, ec2) == mtime && !ec2;
+      if (same)
+        skipped++;
+      else
+        jobs.push_back({from, to, size, mtime});
+    }
+  }
+
+  std::atomic<size_t> next{0};
+  threads = clamp_threads(threads, jobs.size());
+  auto run = [&] {
+    for (size_t i; (i = next.fetch_add(1)) < jobs.size();) {
+      std::error_code e;
+      fs::create_directories(jobs[i].to.parent_path(), e);
+      fs::copy_file(jobs[i].from, jobs[i].to,
+                    fs::copy_options::overwrite_existing, e);
+      if (e) {
+        errors++;
+        continue;
+      }
+      fs::last_write_time(jobs[i].to, jobs[i].mtime, e);
+      copied++;
+      bytes += (long long)jobs[i].size;
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 1; t < threads; t++) pool.emplace_back(run);
+  run();
+  for (auto& th : pool) th.join();
+
+  stats_out[0] = copied;
+  stats_out[1] = skipped;
+  stats_out[2] = bytes;
+  stats_out[3] = errors;
+  return errors ? 3 : 0;
+}
+
+// C++ exceptions must never unwind through the ctypes boundary (that is
+// std::terminate): every exported entry point catches everything.
+int mt_sync_tree(const char* src_c, const char* dst_c, int threads,
+                 long long* stats_out) {
+  try {
+    return sync_tree_impl(src_c, dst_c, threads, stats_out);
+  } catch (...) {
+    stats_out[0] = stats_out[1] = stats_out[2] = 0;
+    stats_out[3] = 1;
+    return 4;
+  }
+}
+
+// ---------------------------------------------------------------- telemetry
+
+// CPU busy percent since the previous call (first call primes over ~80 ms),
+// from /proc/stat — the native analogue of psutil.cpu_percent().
+double mt_cpu_percent() {
+  static std::mutex mu;
+  static unsigned long long prev_busy = 0, prev_total = 0;
+  auto sample = [](unsigned long long* busy, unsigned long long* total) {
+    FILE* fh = fopen("/proc/stat", "r");
+    if (!fh) return false;
+    unsigned long long v[8] = {0};
+    int n = fscanf(fh, "cpu %llu %llu %llu %llu %llu %llu %llu %llu", &v[0],
+                   &v[1], &v[2], &v[3], &v[4], &v[5], &v[6], &v[7]);
+    fclose(fh);
+    if (n < 4) return false;
+    *total = 0;
+    for (int i = 0; i < 8; i++) *total += v[i];
+    *busy = *total - v[3] - v[4];  // minus idle, iowait
+    return true;
+  };
+  std::lock_guard<std::mutex> lock(mu);
+  unsigned long long busy, total;
+  if (prev_total == 0) {
+    if (!sample(&prev_busy, &prev_total)) return -1.0;
+    usleep(80 * 1000);
+  }
+  if (!sample(&busy, &total) || total <= prev_total) return -1.0;
+  double pct = 100.0 * (double)(busy - prev_busy) /
+               (double)(total - prev_total);
+  prev_busy = busy;
+  prev_total = total;
+  return pct < 0 ? 0 : (pct > 100 ? 100 : pct);
+}
+
+// Memory used percent from /proc/meminfo (MemTotal vs MemAvailable).
+double mt_mem_percent() {
+  FILE* fh = fopen("/proc/meminfo", "r");
+  if (!fh) return -1.0;
+  unsigned long long total = 0, avail = 0;
+  char key[64];
+  unsigned long long val;
+  while (fscanf(fh, "%63[^:]: %llu kB\n", key, &val) == 2) {
+    if (!strcmp(key, "MemTotal")) total = val;
+    if (!strcmp(key, "MemAvailable")) avail = val;
+    if (total && avail) break;
+  }
+  fclose(fh);
+  if (!total) return -1.0;
+  return 100.0 * (double)(total - avail) / (double)total;
+}
+
+// Disk used percent for the filesystem containing `path` (df semantics).
+double mt_disk_percent(const char* path) {
+  struct statvfs st;
+  if (statvfs(path, &st) != 0) return -1.0;
+  unsigned long long used = (st.f_blocks - st.f_bfree) * st.f_frsize;
+  unsigned long long usable = used + st.f_bavail * (unsigned long long)st.f_frsize;
+  if (!usable) return -1.0;
+  return 100.0 * (double)used / (double)usable;
+}
+
+int mt_pid_exists(int pid) {
+  if (pid <= 0) return 0;
+  if (kill(pid, 0) == 0) return 1;
+  return errno == EPERM ? 1 : 0;
+}
+
+}  // extern "C"

@@ -194,11 +194,11 @@ def stop_processes_not_exist(session, logger):
     """Dead-pid reaper (reference worker/__main__.py:64-88): fail
     InProgress tasks on this host whose pid vanished (30 s grace on
     last_activity)."""
-    import psutil
+    from mlcomp_tpu import native
     provider = TaskProvider(session)
     for task in provider.by_status(TaskStatus.InProgress,
                                    computer=HOSTNAME):
-        if not task.pid or psutil.pid_exists(task.pid):
+        if not task.pid or native.pid_exists(task.pid):
             continue
         grace_ok = True
         if task.last_activity:
@@ -215,13 +215,14 @@ def stop_processes_not_exist(session, logger):
 
 def worker_usage(session, logger):
     """Resource telemetry → computer row + usage history
-    (reference worker/__main__.py:91-127)."""
-    import psutil
+    (reference worker/__main__.py:91-127; GPUtil/psutil there — here the
+    framework's own native /proc sampler, mlcomp_tpu/native)."""
+    from mlcomp_tpu import native
     provider = ComputerProvider(session)
     usage = {
-        'cpu': psutil.cpu_percent(),
-        'memory': psutil.virtual_memory().percent,
-        'disk': psutil.disk_usage(ROOT_FOLDER).percent,
+        'cpu': native.cpu_percent(),
+        'memory': native.memory_percent(),
+        'disk': native.disk_percent(ROOT_FOLDER),
         'tpu': _tpu_usage(),
     }
     provider.current_usage(HOSTNAME, usage)
@@ -296,6 +297,15 @@ def worker_supervisor(cores):
     logger = create_logger(session)
     register_computer(session, cores)
     docker_provider = DockerProvider(session)
+
+    # warm the native library before the periodic loops need it — the
+    # lazy path never blocks on g++, so build here where a one-time
+    # compile is harmless
+    try:
+        from mlcomp_tpu import native
+        native.build()
+    except Exception:
+        pass
 
     def heartbeat():
         docker_provider.heartbeat(HOSTNAME, DOCKER_IMG)

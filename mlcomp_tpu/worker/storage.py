@@ -22,7 +22,7 @@ import importlib.util
 import os
 import sys
 
-from mlcomp_tpu import DATA_FOLDER, MODEL_FOLDER, TASK_FOLDER
+from mlcomp_tpu import DATA_FOLDER, MODEL_FOLDER, TASK_FOLDER, native
 from mlcomp_tpu.db.models import Dag, DagLibrary, DagStorage, File
 from mlcomp_tpu.db.providers import (
     DagLibraryProvider, DagStorageProvider, FileProvider
@@ -91,6 +91,7 @@ class Storage:
         hashs = self.file_provider.hashs(dag.project)
         files_size = 0
         count = 0
+        uploads = []  # (rel, full) pending files
         for root, dirs, files in os.walk(folder):
             rel_root = os.path.relpath(root, folder)
             dirs[:] = [
@@ -106,7 +107,29 @@ class Storage:
                 rel = os.path.normpath(os.path.join(rel_root, f))
                 if _ignored(rel, patterns):
                     continue
-                full = os.path.join(root, f)
+                uploads.append((rel, os.path.join(root, f)))
+
+        # hash the whole tree in one GIL-free native pass (threaded C++;
+        # serial hashlib fallback) so dedup hits skip the re-read; with
+        # no prior blobs every probe would miss, so skip the pass
+        def _sig(path):
+            try:
+                st = os.stat(path)
+                return st.st_size, st.st_mtime_ns
+            except OSError:
+                return None
+        # sigs BEFORE the hash pass: a file changed during hashing then
+        # fails the sig-now comparison and falls to the re-read branch
+        sigs = [_sig(full) for _, full in uploads]
+        digests = native.hash_files([full for _, full in uploads]) \
+            if hashs else [None] * len(uploads)
+        for (rel, full), probe, sig in zip(uploads, digests, sigs):
+            # a dedup hit is only trusted if the file is provably the one
+            # the probe pass hashed (same size+mtime now)
+            if probe is not None and probe in hashs \
+                    and sig is not None and _sig(full) == sig:
+                file_id = hashs[probe]
+            else:
                 with open(full, 'rb') as fh:
                     content = fh.read()
                 md5 = hashlib.md5(content).hexdigest()
@@ -120,9 +143,9 @@ class Storage:
                     hashs[md5] = file.id
                     file_id = file.id
                     files_size += len(content)
-                self.storage_provider.add(DagStorage(
-                    dag=dag.id, path=rel, file=file_id, is_dir=False))
-                count += 1
+            self.storage_provider.add(DagStorage(
+                dag=dag.id, path=rel, file=file_id, is_dir=False))
+            count += 1
 
         if control_reqs:
             for lib, version in control_requirements(

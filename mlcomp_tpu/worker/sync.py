@@ -12,7 +12,7 @@ import os
 import shutil
 import subprocess
 
-from mlcomp_tpu import DATA_FOLDER, MODEL_FOLDER
+from mlcomp_tpu import DATA_FOLDER, MODEL_FOLDER, native
 from mlcomp_tpu.db.core import Session
 from mlcomp_tpu.db.providers import (
     ComputerProvider, ProjectProvider, TaskSyncedProvider
@@ -24,11 +24,14 @@ def _same_file_tree(a: str, b: str) -> bool:
     return os.path.realpath(a) == os.path.realpath(b)
 
 
-def _copy_tree(src: str, dst: str):
+def _copy_tree(src: str, dst: str) -> bool:
+    """Delta-copy via the native sync engine (threaded, size+mtime
+    comparison — re-running a sync is a near no-op, rsync semantics
+    without the rsync binary); shutil fallback inside native.sync_tree.
+    False when any file failed to copy — callers must not mark synced."""
     if not os.path.exists(src) or _same_file_tree(src, dst):
-        return
-    os.makedirs(dst, exist_ok=True)
-    shutil.copytree(src, dst, dirs_exist_ok=True)
+        return True
+    return native.sync_tree(src, dst)['errors'] == 0
 
 
 def _rsync_available() -> bool:
@@ -42,13 +45,14 @@ def copy_remote(session: Session, computer_from: str, path_from: str,
     (reference worker/sync.py:60-71 — scp). Local/shared-fs fast path
     first; ssh+rsync only for genuinely remote hosts."""
     if computer_from == hostname() or os.path.exists(path_from):
+        ok = True
         if os.path.isdir(path_from):
-            _copy_tree(path_from, path_to)
+            ok = _copy_tree(path_from, path_to)
         elif os.path.exists(path_from):
             if not _same_file_tree(path_from, path_to):
                 os.makedirs(os.path.dirname(path_to) or '.', exist_ok=True)
                 shutil.copy2(path_from, path_to)
-        return os.path.exists(path_to)
+        return ok and os.path.exists(path_to)
 
     computer = ComputerProvider(session).by_name(computer_from)
     if computer is None or not _rsync_available():

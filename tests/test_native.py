@@ -1,0 +1,120 @@
+"""Native (C++) runtime layer: build, correctness vs the Python
+fallbacks, and the integrations that consume it."""
+
+import hashlib
+import os
+
+import pytest
+
+from mlcomp_tpu import native
+
+
+@pytest.fixture(scope='module')
+def lib_available():
+    try:
+        native.build()  # blocking — the lazy path builds in background
+    except RuntimeError:
+        pytest.skip('no C++ toolchain — fallback paths covered elsewhere')
+    assert native.available()
+    return True
+
+
+def test_md5_matches_hashlib(lib_available):
+    # block-boundary sizes are where a hand-rolled md5 breaks
+    for n in [0, 1, 55, 56, 57, 63, 64, 65, 127, 128, 1000, 1 << 16]:
+        data = bytes((i * 131 + 17) % 256 for i in range(n))
+        assert native.md5_hex(data) == hashlib.md5(data).hexdigest(), n
+
+
+def test_hash_files_threaded(tmp_path, lib_available):
+    paths = []
+    for i in range(24):
+        p = tmp_path / f'f{i}.bin'
+        p.write_bytes(os.urandom(i * 777))
+        paths.append(str(p))
+    paths.append(str(tmp_path / 'missing.bin'))
+    got = native.hash_files(paths)
+    assert len(got) == len(paths)
+    for p, digest in zip(paths[:-1], got[:-1]):
+        with open(p, 'rb') as fh:
+            assert digest == hashlib.md5(fh.read()).hexdigest()
+    assert got[-1] is None
+
+
+def test_hash_files_empty():
+    assert native.hash_files([]) == []
+
+
+def test_sync_tree_delta(tmp_path, lib_available):
+    src, dst = tmp_path / 's', tmp_path / 't'
+    (src / 'sub').mkdir(parents=True)
+    (src / 'a.txt').write_text('hello')
+    (src / 'sub' / 'b.txt').write_text('world' * 1000)
+    os.symlink('a.txt', src / 'link')
+
+    stats = native.sync_tree(str(src), str(dst))
+    assert stats['copied'] == 3 and stats['errors'] == 0
+    assert (dst / 'sub' / 'b.txt').read_text() == 'world' * 1000
+    assert os.readlink(dst / 'link') == 'a.txt'
+
+    # second pass: everything skipped (mtimes preserved)
+    stats = native.sync_tree(str(src), str(dst))
+    assert stats['copied'] == 0 and stats['skipped'] == 3
+
+    # a changed file is re-copied; the rest stays skipped
+    (src / 'a.txt').write_text('changed')
+    stats = native.sync_tree(str(src), str(dst))
+    assert stats['copied'] == 1
+    assert (dst / 'a.txt').read_text() == 'changed'
+
+
+def test_sync_tree_dir_symlink_not_followed(tmp_path, lib_available):
+    src, dst = tmp_path / 's', tmp_path / 't'
+    (src / 'real').mkdir(parents=True)
+    (src / 'real' / 'x').write_text('x')
+    os.symlink('real', src / 'dlink')
+    native.sync_tree(str(src), str(dst))
+    assert os.path.islink(dst / 'dlink')
+    assert (dst / 'real' / 'x').read_text() == 'x'
+
+
+def test_sync_tree_missing_src(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        native.sync_tree(str(tmp_path / 'nope'), str(tmp_path / 'out'))
+
+
+def test_python_fallbacks_match(tmp_path, monkeypatch):
+    """Force the fallback path and check identical behavior."""
+    monkeypatch.setattr(native, '_lib', None)
+    monkeypatch.setattr(native, '_failed', True)
+    assert not native.available()
+
+    data = b'fallback check'
+    assert native.md5_hex(data) == hashlib.md5(data).hexdigest()
+
+    p = tmp_path / 'f.bin'
+    p.write_bytes(b'abc')
+    assert native.hash_files([str(p)]) == [hashlib.md5(b'abc').hexdigest()]
+
+    src, dst = tmp_path / 's', tmp_path / 't'
+    src.mkdir()
+    (src / 'a').write_text('a')
+    os.symlink('a', src / 'ln')
+    stats = native.sync_tree(str(src), str(dst))
+    assert stats['copied'] == 2 and stats['errors'] == 0
+    stats = native.sync_tree(str(src), str(dst))
+    assert stats['copied'] == 0 and stats['skipped'] == 2
+
+    assert native.pid_exists(os.getpid())
+    assert not native.pid_exists(2 ** 22 + 12345)
+    assert 0 <= native.memory_percent() <= 100
+    assert 0 <= native.disk_percent('/') <= 100
+
+
+def test_telemetry_sane(lib_available):
+    first = native.cpu_percent()
+    assert 0 <= first <= 100
+    assert 0 <= native.memory_percent() <= 100
+    assert 0 <= native.disk_percent('/') <= 100
+    assert native.pid_exists(os.getpid())
+    assert not native.pid_exists(2 ** 22 + 54321)
