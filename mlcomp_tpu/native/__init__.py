@@ -28,6 +28,7 @@ import os
 import shutil
 import subprocess
 import threading
+import time
 
 _SRC = os.path.join(os.path.dirname(__file__), 'src', 'mlcomp_native.cc')
 _LIB_NAME = '_mlcomp_native.so'
@@ -55,6 +56,8 @@ def build(force: bool = False) -> str:
     background build instead (see ``_native``). Returns the library
     path, or raises on compiler failure."""
     global _lib, _failed
+    if os.environ.get('MLCOMP_NO_NATIVE'):
+        raise RuntimeError('native layer disabled via MLCOMP_NO_NATIVE')
     out = _lib_path()
     with _build_lock:  # a foreground build() can race _background_build
         if force or not os.path.exists(out) \
@@ -172,17 +175,24 @@ def hash_files(paths, threads: int = 0):
             digests = out.value.decode().split('\n')
             if len(digests) == len(paths):
                 return [None if d == '0' * 32 else d for d in digests]
-    result = []
-    for p in paths:
+    # fallback keeps the parallelism: hashlib releases the GIL on
+    # update() for large buffers, so a thread pool scales here too
+    def one(p):
         try:
             h = hashlib.md5()
             with open(p, 'rb') as fh:
                 for chunk in iter(lambda: fh.read(1 << 20), b''):
                     h.update(chunk)
-            result.append(h.hexdigest())
+            return h.hexdigest()
         except OSError:
-            result.append(None)
-    return result
+            return None
+
+    if len(paths) > 4:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=min(
+                8, os.cpu_count() or 4)) as pool:
+            return list(pool.map(one, paths))
+    return [one(p) for p in paths]
 
 
 # ----------------------------------------------------------------- syncing
@@ -210,6 +220,8 @@ def _sync_tree_py(src: str, dst: str) -> dict:
     for root, dirs, files in os.walk(src):
         rel = os.path.relpath(root, src)
         troot = os.path.join(dst, rel) if rel != '.' else dst
+        if os.path.islink(troot):  # stale dest symlink would redirect
+            os.remove(troot)       # every child copy outside the tree
         os.makedirs(troot, exist_ok=True)
         for name in files + [d for d in dirs if os.path.islink(
                 os.path.join(root, d))]:
@@ -244,6 +256,19 @@ def _sync_tree_py(src: str, dst: str) -> dict:
 
 
 # --------------------------------------------------------------- telemetry
+# The fallbacks are pure Python over the same /proc + statvfs sources as
+# the C++ sampler — no psutil import anywhere in this layer.
+
+_cpu_prev = None
+
+
+def _cpu_sample():
+    with open('/proc/stat') as fh:
+        fields = [float(v) for v in fh.readline().split()[1:9]]
+    total = sum(fields)
+    busy = total - fields[3] - fields[4]  # minus idle, iowait
+    return busy, total
+
 
 def cpu_percent() -> float:
     lib = _native()
@@ -251,8 +276,20 @@ def cpu_percent() -> float:
         v = lib.mt_cpu_percent()
         if v >= 0:
             return v
-    import psutil
-    return psutil.cpu_percent()
+    global _cpu_prev
+    try:
+        if _cpu_prev is None:
+            _cpu_prev = _cpu_sample()
+            time.sleep(0.08)
+        busy, total = _cpu_sample()
+        pbusy, ptotal = _cpu_prev
+        _cpu_prev = (busy, total)
+        if total <= ptotal:
+            return 0.0
+        return min(100.0, max(0.0, 100.0 * (busy - pbusy)
+                              / (total - ptotal)))
+    except OSError:
+        return 0.0
 
 
 def memory_percent() -> float:
@@ -261,8 +298,16 @@ def memory_percent() -> float:
         v = lib.mt_mem_percent()
         if v >= 0:
             return v
-    import psutil
-    return psutil.virtual_memory().percent
+    try:
+        info = {}
+        with open('/proc/meminfo') as fh:
+            for line in fh:
+                key, _, rest = line.partition(':')
+                info[key] = float(rest.split()[0])
+        total, avail = info['MemTotal'], info['MemAvailable']
+        return 100.0 * (total - avail) / total
+    except (OSError, KeyError, IndexError, ZeroDivisionError):
+        return 0.0
 
 
 def disk_percent(path: str) -> float:
@@ -271,16 +316,28 @@ def disk_percent(path: str) -> float:
         v = lib.mt_disk_percent(path.encode())
         if v >= 0:
             return v
-    import psutil
-    return psutil.disk_usage(path).percent
+    try:
+        st = os.statvfs(path)
+        used = (st.f_blocks - st.f_bfree) * st.f_frsize
+        usable = used + st.f_bavail * st.f_frsize
+        return 100.0 * used / usable if usable else 0.0
+    except OSError:
+        return 0.0
 
 
 def pid_exists(pid: int) -> bool:
     lib = _native()
     if lib is not None:
         return bool(lib.mt_pid_exists(int(pid)))
-    import psutil
-    return psutil.pid_exists(pid)
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
 
 
 __all__ = [

@@ -306,6 +306,10 @@ static int sync_tree_impl(const char* src_c, const char* dst_c, int threads,
       }
       it.disable_recursion_pending();
     } else if (it->is_directory(ect) && !ect) {
+      // a stale symlink at the destination would redirect every child
+      // copy outside the tree — replace it with a real directory
+      std::error_code ecl;
+      if (fs::is_symlink(to, ecl) && !ecl) fs::remove(to, ec);
       fs::create_directories(to, ec);
       if (ec) errors++;
     } else if (it->is_regular_file(ect) && !ect) {
@@ -315,6 +319,10 @@ static int sync_tree_impl(const char* src_c, const char* dst_c, int threads,
         continue;
       }
       fs::file_time_type mtime = it->last_write_time(ec);
+      if (ec) {
+        errors++;
+        continue;
+      }
       std::error_code ec2;
       bool same = fs::exists(to, ec2) && !ec2 &&
                   fs::is_regular_file(to, ec2) &&

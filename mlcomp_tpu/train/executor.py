@@ -65,7 +65,7 @@ class JaxTrain(Executor):
                  stage_per_dispatch=False, log_every=50,
                  report_imgs=None, augment=None, prefetch=2,
                  device_data='auto', epoch_scan=False,
-                 checkpoint_every=1, **kwargs):
+                 checkpoint_every=1, infer_valid=None, **kwargs):
         self.model_spec = dict(model or {'name': 'mlp'})
         self.dataset_spec = dict(dataset or {})
         self.loss_name = loss
@@ -91,6 +91,11 @@ class JaxTrain(Executor):
         # compile on XLA:CPU (scan-of-conv-graph), so opt-in
         self.epoch_scan = bool(epoch_scan)
         self.checkpoint_every = int(checkpoint_every)
+        # {'out_prefix': str, 'best_only': bool} — dump validation
+        # predictions as npy after training (the flax analogue of the
+        # reference's InferBestCallback,
+        # contrib/catalyst/callbacks/inference.py:10-50)
+        self.infer_valid = dict(infer_valid) if infer_valid else None
 
     # ------------------------------------------------------------ plumbing
     def _init_distributed(self):
@@ -500,10 +505,16 @@ class JaxTrain(Executor):
 
         if self._is_main and self.model_name:
             self._export_model(ck_dir, best)
-        if self._is_main and self.report_imgs and self.session \
+        # the post-train passes run collective programs (valid forward,
+        # checkpoint gather) — EVERY rank must execute the same sequence;
+        # only rank 0 touches DB/filesystem inside each helper
+        if self.report_imgs and self.session is not None \
                 and self.task is not None:
             self._build_report_imgs(model, state, mesh, x_valid, y_valid,
                                     max(global_epoch - 1, 0))
+        if self.infer_valid:
+            self._infer_valid(model, state, mesh, ck_dir, x_valid,
+                              y_valid)
 
         wall = time.time() - t_start
         return {'stage': stage_names[-1], 'stages': stage_names,
@@ -511,25 +522,27 @@ class JaxTrain(Executor):
                 'wall_time_s': wall,
                 'samples_per_sec': images_seen / max(wall, 1e-9)}
 
-    def _build_report_imgs(self, model, state, mesh, x_valid, y_valid,
-                           epoch):
-        """UI gallery artifacts from the final state (reference wires
-        these as Catalyst callbacks, worker/executors/catalyst/f1.py;
-        here one post-train pass over the validation set)."""
-        import flax.linen as nn
-        import jax.numpy as jnp
-        from mlcomp_tpu.parallel.sharding import logical_rules
-        from mlcomp_tpu.train.loop import _apply
+    def _predict_valid(self, model, state, mesh, x_valid):
+        """Softmax predictions over the validation set, batched and
+        dp-padded — shared by the report-img pass and infer_valid (the
+        jitted forward is cached so both passes compile it once)."""
+        forward = getattr(self, '_eval_forward', None)
+        if forward is None:
+            import flax.linen as nn
+            import jax.numpy as jnp
+            from mlcomp_tpu.parallel.sharding import logical_rules
+            from mlcomp_tpu.train.loop import _apply
 
-        spec = self.report_imgs
-        kind = spec.get('type', 'classification')
-        rules = logical_rules(mesh)
+            rules = logical_rules(mesh)
 
-        @jax.jit
-        def forward(s, x):
-            with mesh, nn.logical_axis_rules(rules):
-                logits, _, _ = _apply(model, s, x, train=False)
-                return jax.nn.softmax(jnp.asarray(logits, jnp.float32))
+            @jax.jit
+            def forward(s, x):
+                with mesh, nn.logical_axis_rules(rules):
+                    logits, _, _ = _apply(model, s, x, train=False)
+                    return jax.nn.softmax(
+                        jnp.asarray(logits, jnp.float32))
+
+            self._eval_forward = forward
 
         dp = max(1, data_parallel_size(mesh))
         probs = []
@@ -541,7 +554,76 @@ class JaxTrain(Executor):
                 bx = bx[np.resize(np.arange(n_real), n_padded)]
             x, _ = place_batch((bx, None), mesh)
             probs.append(np.asarray(forward(state, x))[:n_real])
-        probs = np.concatenate(probs) if probs else np.empty((0,))
+        return np.concatenate(probs) if probs else np.empty((0,))
+
+    def _infer_valid(self, model, state, mesh, ck_dir, x_valid, y_valid):
+        """Save validation predictions as npy for downstream
+        Valid/ensemble stages (reference InferBestCallback,
+        contrib/catalyst/callbacks/inference.py:10-50: accumulate
+        outputs, save the best epoch's). ``best_only`` (default) loads
+        the best checkpoint first so the saved preds are the best
+        epoch's, not the last's."""
+        from mlcomp_tpu.train.checkpoint import restore_checkpoint
+        from mlcomp_tpu.worker.executors.base.equation import PRED_FOLDER
+
+        spec = self.infer_valid
+        prefix = spec.get('out_prefix') or self.model_name or 'valid'
+        do_best = bool(spec.get('best_only', True))
+        if do_best and jax.process_count() > 1:
+            # every process must make the SAME reload decision or their
+            # params diverge mid-collective; a rank without a local
+            # best.msgpack (non-shared fs) forces the final state
+            from jax.experimental import multihost_utils
+            have = os.path.exists(os.path.join(ck_dir, 'best.msgpack'))
+            do_best = bool(multihost_utils.process_allgather(
+                np.array(have)).all())
+        if do_best:
+            from mlcomp_tpu.parallel.distributed import (
+                host_replicated_copy,
+            )
+            from mlcomp_tpu.train.loop import place_state
+            # the gather is a collective — every rank joins it
+            host_state = host_replicated_copy(state, mesh)
+            try:
+                best_state, _ = restore_checkpoint(
+                    ck_dir, host_state, kind='best')
+            except Exception as e:  # stage drift: best saved under a
+                best_state = None   # different optimizer structure
+                if self._is_main:
+                    self.info(f'infer_valid: best checkpoint not '
+                              f'loadable ({e}); using final state')
+            if jax.process_count() > 1:
+                # the USE decision must also be unanimous: a rank whose
+                # local restore failed (corrupt file) must not keep the
+                # final state while others load best
+                from jax.experimental import multihost_utils
+                ok = multihost_utils.process_allgather(
+                    np.array(best_state is not None)).all()
+                if not ok:
+                    best_state = None
+            if best_state is not None:
+                state = place_state(best_state, mesh)
+        probs = self._predict_valid(model, state, mesh, x_valid)
+        if not self._is_main:
+            return
+        os.makedirs(PRED_FOLDER, exist_ok=True)
+        out = os.path.join(PRED_FOLDER, f'{prefix}.npy')
+        np.save(out, probs)
+        if y_valid is not None:
+            np.save(os.path.join(PRED_FOLDER, f'{prefix}_y.npy'),
+                    np.asarray(y_valid))
+        self.info(f'infer_valid: {len(probs)} predictions -> {out}')
+
+    def _build_report_imgs(self, model, state, mesh, x_valid, y_valid,
+                           epoch):
+        """UI gallery artifacts from the final state (reference wires
+        these as Catalyst callbacks, worker/executors/catalyst/f1.py;
+        here one post-train pass over the validation set)."""
+        spec = self.report_imgs
+        kind = spec.get('type', 'classification')
+        probs = self._predict_valid(model, state, mesh, x_valid)
+        if not self._is_main:
+            return
 
         common = dict(
             session=self.session, task=self.task, part='valid',

@@ -132,7 +132,14 @@ class Storage:
             else:
                 with open(full, 'rb') as fh:
                     content = fh.read()
-                md5 = hashlib.md5(content).hexdigest()
+                # the probe digest is reusable if the file provably
+                # didn't change across probe → read (saves a second
+                # hash pass over every new file)
+                if probe is not None and sig is not None \
+                        and _sig(full) == sig:
+                    md5 = probe
+                else:
+                    md5 = hashlib.md5(content).hexdigest()
                 if md5 in hashs:
                     file_id = hashs[md5]
                 else:

@@ -31,7 +31,14 @@ def _copy_tree(src: str, dst: str) -> bool:
     False when any file failed to copy — callers must not mark synced."""
     if not os.path.exists(src) or _same_file_tree(src, dst):
         return True
-    return native.sync_tree(src, dst)['errors'] == 0
+    stats = native.sync_tree(src, dst)
+    if stats['errors']:
+        import logging
+        logging.getLogger(__name__).warning(
+            'sync %s -> %s: %d file(s) failed to copy '
+            '(%d copied, %d skipped) — not marking synced',
+            src, dst, stats['errors'], stats['copied'], stats['skipped'])
+    return stats['errors'] == 0
 
 
 def _rsync_available() -> bool:

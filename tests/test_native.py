@@ -78,6 +78,25 @@ def test_sync_tree_dir_symlink_not_followed(tmp_path, lib_available):
     assert (dst / 'real' / 'x').read_text() == 'x'
 
 
+def test_sync_tree_replaces_stale_dest_dir_symlink(tmp_path,
+                                                   lib_available):
+    """A symlink at the destination where the source has a real
+    directory must be replaced, not written through (files would land
+    outside the tree)."""
+    outside = tmp_path / 'outside'
+    outside.mkdir()
+    src, dst = tmp_path / 's', tmp_path / 't'
+    (src / 'data').mkdir(parents=True)
+    (src / 'data' / 'f').write_text('new')
+    dst.mkdir()
+    os.symlink(outside, dst / 'data')
+    stats = native.sync_tree(str(src), str(dst))
+    assert stats['errors'] == 0
+    assert not os.path.islink(dst / 'data')
+    assert (dst / 'data' / 'f').read_text() == 'new'
+    assert not (outside / 'f').exists()
+
+
 def test_sync_tree_missing_src(tmp_path):
     with pytest.raises(FileNotFoundError):
         native.sync_tree(str(tmp_path / 'nope'), str(tmp_path / 'out'))

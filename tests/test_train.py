@@ -113,6 +113,29 @@ class TestJaxTrain:
         assert os.path.exists(tmp_path / 'ck' / 'last.msgpack')
         assert os.path.exists(tmp_path / 'ck' / 'best.msgpack')
 
+    def test_infer_valid_saves_best_preds(self, tmp_path, monkeypatch):
+        """infer_valid dumps best-checkpoint validation predictions
+        (reference InferBestCallback semantics: the best epoch's
+        outputs, not the last's)."""
+        monkeypatch.chdir(tmp_path)
+        result = run_executor({
+            'model': {'name': 'mlp', 'num_classes': 10, 'hidden': [64],
+                      'dtype': 'float32'},
+            'dataset': {'name': 'synthetic_images', 'n_train': 512,
+                        'n_valid': 128, 'image_size': 8, 'channels': 1},
+            'batch_size': 64,
+            'stages': [{'name': 's1', 'epochs': 2,
+                        'optimizer': {'name': 'adam', 'lr': 3e-3}}],
+            'infer_valid': {'out_prefix': 'best_mlp'},
+        }, str(tmp_path / 'ck'))
+        probs = np.load(tmp_path / 'data' / 'pred' / 'best_mlp.npy')
+        y = np.load(tmp_path / 'data' / 'pred' / 'best_mlp_y.npy')
+        assert probs.shape == (128, 10) and y.shape == (128,)
+        assert np.allclose(probs.sum(-1), 1.0, atol=1e-4)
+        # preds come from the best checkpoint -> accuracy matches score
+        acc = float((probs.argmax(-1) == y).mean())
+        assert acc == pytest.approx(result['best_score'], abs=0.02)
+
     def test_resume_skips_done_epochs(self, tmp_path):
         spec = {
             'model': {'name': 'mlp', 'num_classes': 4, 'hidden': [16],
