@@ -10,6 +10,10 @@ from mlcomp_tpu.models.pipelined import PipelinedTransformerLM
 from mlcomp_tpu.models.segmentation import (
     DeepLabV3, FPN, LinkNet, PSPNet, ResNetEncoder,
 )
+from mlcomp_tpu.models.encoders import (
+    DenseNetEncoder, EfficientNetEncoder, EncoderClassifier, VGGEncoder,
+    make_family_encoder,
+)
 from mlcomp_tpu.models.transformer import (
     TransformerConfig, TransformerLM,
 )
@@ -21,4 +25,6 @@ __all__ = [
     'TransformerConfig', 'TransformerLM', 'UNet',
     'ResNetEncoder', 'FPN', 'LinkNet', 'PSPNet', 'DeepLabV3',
     'PipelinedTransformerLM',
+    'VGGEncoder', 'DenseNetEncoder', 'EfficientNetEncoder',
+    'EncoderClassifier', 'make_family_encoder',
 ]

@@ -73,9 +73,10 @@ _ENCODERS = {
 
 
 def make_encoder(encoder: str, dtype, cifar_stem: bool = False):
-    sizes, block = _ENCODERS[encoder]
-    return ResNetEncoder(stage_sizes=sizes, block=block,
-                         cifar_stem=cifar_stem, dtype=dtype)
+    # single resolution path for all families (models/encoders.py;
+    # resnets resolve back to _ENCODERS below)
+    from mlcomp_tpu.models.encoders import make_family_encoder
+    return make_family_encoder(encoder, dtype, cifar_stem)
 
 
 def _resize_to(x, target_hw, method: str = 'bilinear'):
@@ -248,9 +249,14 @@ def _seg_factory(decoder_cls):
     return factory
 
 
+def _all_encoder_names():
+    from mlcomp_tpu.models.encoders import ENCODER_FACTORIES
+    return list(_ENCODERS) + list(ENCODER_FACTORIES)
+
+
 for _dec_name, _cls in _DECODERS.items():
     register_model(_dec_name)(_seg_factory(_cls))
-    for _enc in _ENCODERS:
+    for _enc in _all_encoder_names():
         def _alias(num_classes=2, dtype='bfloat16', cifar_stem=False,
                    _cls=_cls, _enc=_enc, **kwargs):
             return _seg_factory(_cls)(

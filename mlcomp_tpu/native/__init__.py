@@ -220,8 +220,11 @@ def _sync_tree_py(src: str, dst: str) -> dict:
     for root, dirs, files in os.walk(src):
         rel = os.path.relpath(root, src)
         troot = os.path.join(dst, rel) if rel != '.' else dst
-        if os.path.islink(troot):  # stale dest symlink would redirect
-            os.remove(troot)       # every child copy outside the tree
+        # a stale dest symlink on a SUB-directory would redirect every
+        # child copy outside the tree (the root itself is the caller's
+        # choice of destination — honored even when symlinked)
+        if rel != '.' and os.path.islink(troot):
+            os.remove(troot)
         os.makedirs(troot, exist_ok=True)
         for name in files + [d for d in dirs if os.path.islink(
                 os.path.join(root, d))]:
@@ -238,7 +241,11 @@ def _sync_tree_py(src: str, dst: str) -> dict:
                     copied += 1
                     continue
                 st = os.stat(s)
-                if os.path.exists(t):
+                if os.path.islink(t):
+                    # a stale symlink at a file path would be written
+                    # THROUGH, landing content outside the tree
+                    os.remove(t)
+                elif os.path.exists(t):
                     dt = os.stat(t)
                     if dt.st_size == st.st_size and \
                             abs(dt.st_mtime - st.st_mtime) < 1e-6:

@@ -324,6 +324,12 @@ static int sync_tree_impl(const char* src_c, const char* dst_c, int threads,
         continue;
       }
       std::error_code ec2;
+      if (fs::is_symlink(to, ec2) && !ec2) {
+        // a stale symlink at a file path would be written THROUGH,
+        // landing content outside the tree — copy jobs remove it first
+        jobs.push_back({from, to, size, mtime});
+        continue;
+      }
       bool same = fs::exists(to, ec2) && !ec2 &&
                   fs::is_regular_file(to, ec2) &&
                   fs::file_size(to, ec2) == size && !ec2 &&
@@ -341,6 +347,7 @@ static int sync_tree_impl(const char* src_c, const char* dst_c, int threads,
     for (size_t i; (i = next.fetch_add(1)) < jobs.size();) {
       std::error_code e;
       fs::create_directories(jobs[i].to.parent_path(), e);
+      if (fs::is_symlink(jobs[i].to, e) && !e) fs::remove(jobs[i].to, e);
       fs::copy_file(jobs[i].from, jobs[i].to,
                     fs::copy_options::overwrite_existing, e);
       if (e) {

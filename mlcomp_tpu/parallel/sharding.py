@@ -34,7 +34,10 @@ DEFAULT_LOGICAL_RULES = (
     ('conv_h', None),
     ('conv_w', None),
     ('conv_in', None),
-    ('conv_out', None),
+    # output channels: the one conv dim large enough to shard; the
+    # shape-aware guard in logical_to_sharding falls back to replication
+    # for kernels whose width doesn't divide by the fsdp axis
+    ('conv_out', 'fsdp'),
     ('norm', None),
 )
 
@@ -65,13 +68,42 @@ def logical_rules(mesh: Mesh, extra=()) -> list:
 
 def logical_to_sharding(tree, mesh: Mesh, extra_rules=()):
     """Map a tree of logical PartitionSpecs (e.g. from
-    ``nn.get_partition_spec``) to concrete NamedShardings on the mesh."""
+    ``nn.get_partition_spec``) to concrete NamedShardings on the mesh.
+
+    Shape-aware: a mesh axis is dropped (replicated) on any dim it does
+    not divide evenly — device_put rejects uneven NamedShardings, and a
+    rule table can't know every layer's width (e.g. conv_out → fsdp on
+    a 12-channel conv)."""
+    from flax.core import meta
+
     rules = logical_rules(mesh, extra_rules)
     specs = nn.logical_to_mesh(nn.get_partition_spec(tree), rules)
-    return jax.tree.map(
-        lambda s: NamedSharding(mesh, s),
-        specs,
-        is_leaf=lambda x: isinstance(x, P))
+    shapes = {
+        jax.tree_util.keystr(path): getattr(leaf, 'shape', None)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            meta.unbox(tree))[0]
+    }
+
+    def fit(path, spec):
+        if not isinstance(spec, P):
+            return NamedSharding(mesh, P())
+        shape = shapes.get(jax.tree_util.keystr(path))
+        if shape is None or len(shape) < len(spec):
+            return NamedSharding(mesh, spec)
+        parts = []
+        for dim, ax in zip(shape, spec):
+            if ax is None:
+                parts.append(None)
+                continue
+            axes = ax if isinstance(ax, tuple) else (ax,)
+            size = 1
+            for a in axes:
+                size *= mesh.shape[a]
+            parts.append(ax if size and dim % size == 0 else None)
+        return NamedSharding(mesh, P(*parts))
+
+    return jax.tree_util.tree_map_with_path(
+        fit, specs, is_leaf=lambda x: isinstance(x, P))
 
 
 def batch_sharding(mesh: Mesh, ndim: int, seq_dim: Optional[int] = None,

@@ -603,7 +603,14 @@ class JaxTrain(Executor):
                     best_state = None
             if best_state is not None:
                 state = place_state(best_state, mesh)
-        probs = self._predict_valid(model, state, mesh, x_valid)
+            else:
+                do_best = False
+        cached = getattr(self, '_final_state_probs', None)
+        if not do_best and cached is not None:
+            # report-img pass already inferred this exact (final) state
+            probs = cached
+        else:
+            probs = self._predict_valid(model, state, mesh, x_valid)
         if not self._is_main:
             return
         os.makedirs(PRED_FOLDER, exist_ok=True)
@@ -622,6 +629,7 @@ class JaxTrain(Executor):
         spec = self.report_imgs
         kind = spec.get('type', 'classification')
         probs = self._predict_valid(model, state, mesh, x_valid)
+        self._final_state_probs = probs  # reusable by _infer_valid
         if not self._is_main:
             return
 

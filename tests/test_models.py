@@ -51,6 +51,55 @@ def test_unet_forward():
     assert out.shape == (1, 32, 32, 3)
 
 
+@pytest.mark.parametrize('name', ['vgg13', 'densenet121', 'seresnet18',
+                                  'efficientnet_lite0'])
+def test_encoder_family_classifier(name):
+    """New encoder families (reference contrib/segmentation/encoders/:
+    vgg/densenet/senet/efficientnet) as GAP classifiers."""
+    model = create_model(name, num_classes=5, dtype='float32',
+                         cifar_stem=True)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out, _ = model.apply(variables, x, train=True,
+                         mutable=['batch_stats'])
+    assert out.shape == (2, 5)
+    assert param_count(variables['params']) > 1e6
+
+
+@pytest.mark.parametrize('name', ['fpn_vgg13', 'linknet_seresnet18',
+                                  'pspnet_densenet121',
+                                  'deeplabv3_efficientnet_lite0'])
+def test_encoder_family_decoders(name):
+    """Every decoder accepts every encoder family (shared pyramid
+    contract)."""
+    model = create_model(name, num_classes=4, dtype='float32',
+                         cifar_stem=True)
+    x = jnp.zeros((1, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 32, 32, 4)
+
+
+def test_encoder_family_fsdp_shards_convs():
+    """Family encoder CONV kernels carry logical axes, so an fsdp mesh
+    actually shards them (the zoo-wide invariant)."""
+    mesh = mesh_from_spec({'fsdp': 8})
+    model = create_model('seresnet18', num_classes=4, dtype='float32',
+                         cifar_stem=True)
+    x = jnp.zeros((8, 16, 16, 3))
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), x, train=False))
+    shardings = logical_to_sharding(variables, mesh)
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        shardings, is_leaf=lambda s: hasattr(s, 'spec'))
+    conv_specs = [s for path, s in flat
+                  if hasattr(s, 'spec')
+                  and 'conv' in jax.tree_util.keystr(path).lower()]
+    assert conv_specs, 'no conv kernels found in sharding tree'
+    assert any(any(ax is not None for ax in s.spec)
+               for s in conv_specs), 'conv kernels lost logical axes'
+
+
 def test_transformer_forward_dense():
     model = create_model('transformer_lm', vocab_size=128, d_model=64,
                          n_layers=2, n_heads=4, d_ff=128,

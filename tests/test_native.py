@@ -97,6 +97,23 @@ def test_sync_tree_replaces_stale_dest_dir_symlink(tmp_path,
     assert not (outside / 'f').exists()
 
 
+def test_sync_tree_replaces_stale_dest_file_symlink(tmp_path,
+                                                    lib_available):
+    """A symlink at a FILE path must be replaced, not written through."""
+    outside = tmp_path / 'outside.txt'
+    outside.write_text('precious')
+    src, dst = tmp_path / 's', tmp_path / 't'
+    src.mkdir()
+    (src / 'f').write_text('new content')
+    dst.mkdir()
+    os.symlink(outside, dst / 'f')
+    stats = native.sync_tree(str(src), str(dst))
+    assert stats['errors'] == 0
+    assert not os.path.islink(dst / 'f')
+    assert (dst / 'f').read_text() == 'new content'
+    assert outside.read_text() == 'precious'  # never written through
+
+
 def test_sync_tree_missing_src(tmp_path):
     with pytest.raises(FileNotFoundError):
         native.sync_tree(str(tmp_path / 'nope'), str(tmp_path / 'out'))
