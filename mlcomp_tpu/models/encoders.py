@@ -22,19 +22,12 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from mlcomp_tpu.models.base import register_model
-from mlcomp_tpu.models.resnet import conv_kernel_init
+from mlcomp_tpu.models.resnet import (
+    BasicBlock, Bottleneck, SqueezeExcite, conv_partial as _conv,
+    norm_partial as _norm,
+)
 
 ModuleDef = Any
-
-
-def _conv(dtype):
-    return partial(nn.Conv, use_bias=False, dtype=dtype,
-                   kernel_init=conv_kernel_init())
-
-
-def _norm(dtype, train):
-    return partial(nn.BatchNorm, use_running_average=not train,
-                   momentum=0.9, epsilon=1e-5, dtype=dtype)
 
 
 # ------------------------------------------------------------------- VGG
@@ -117,72 +110,11 @@ class DenseNetEncoder(nn.Module):
 
 
 # ------------------------------------------------------------- SE-ResNet
+# The senet family is the shared resnet blocks with se=True
+# (models/resnet.py): one residual/zero-init implementation to maintain.
 
-class SqueezeExcite(nn.Module):
-    """Channel attention (senet family): GAP → bottleneck MLP →
-    sigmoid gate."""
-    reduction: int = 16
-    dtype: jnp.dtype = jnp.bfloat16
-
-    @nn.compact
-    def __call__(self, x):
-        ch = x.shape[-1]
-        s = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
-        s = nn.Dense(max(ch // self.reduction, 4), dtype=self.dtype,
-                     name='fc1')(s.astype(self.dtype))
-        s = nn.relu(s)
-        s = nn.Dense(ch, dtype=self.dtype, name='fc2')(s)
-        s = nn.sigmoid(s.astype(jnp.float32)).astype(x.dtype)
-        return x * s[:, None, None, :]
-
-
-class SEBasicBlock(nn.Module):
-    filters: int
-    conv: ModuleDef
-    norm: ModuleDef
-    act: Any
-    strides: Tuple[int, int] = (1, 1)
-
-    @nn.compact
-    def __call__(self, x):
-        residual = x
-        y = self.conv(self.filters, (3, 3), self.strides)(x)
-        y = self.norm()(y)
-        y = self.act(y)
-        y = self.conv(self.filters, (3, 3))(y)
-        y = self.norm(scale_init=nn.initializers.zeros)(y)
-        y = SqueezeExcite(dtype=y.dtype, name='se')(y)
-        if residual.shape != y.shape:
-            residual = self.conv(self.filters, (1, 1), self.strides,
-                                 name='conv_proj')(residual)
-            residual = self.norm(name='norm_proj')(residual)
-        return self.act(residual + y)
-
-
-class SEBottleneck(nn.Module):
-    filters: int
-    conv: ModuleDef
-    norm: ModuleDef
-    act: Any
-    strides: Tuple[int, int] = (1, 1)
-
-    @nn.compact
-    def __call__(self, x):
-        residual = x
-        y = self.conv(self.filters, (1, 1))(x)
-        y = self.norm()(y)
-        y = self.act(y)
-        y = self.conv(self.filters, (3, 3), self.strides)(y)
-        y = self.norm()(y)
-        y = self.act(y)
-        y = self.conv(self.filters * 4, (1, 1))(y)
-        y = self.norm(scale_init=nn.initializers.zeros)(y)
-        y = SqueezeExcite(dtype=y.dtype, name='se')(y)
-        if residual.shape != y.shape:
-            residual = self.conv(self.filters * 4, (1, 1), self.strides,
-                                 name='conv_proj')(residual)
-            residual = self.norm(name='norm_proj')(residual)
-        return self.act(residual + y)
+SEBasicBlock = partial(BasicBlock, se=True)
+SEBottleneck = partial(Bottleneck, se=True)
 
 
 # -------------------------------------------------------- EfficientNet

@@ -24,12 +24,45 @@ def conv_kernel_init():
         ('conv_h', 'conv_w', 'conv_in', 'conv_out'))
 
 
+def conv_partial(dtype):
+    """The zoo-wide conv convention: no bias, logical-partitioned
+    kernels (shared by every encoder family — change here, not in
+    copies)."""
+    return partial(nn.Conv, use_bias=False, dtype=dtype,
+                   kernel_init=conv_kernel_init())
+
+
+def norm_partial(dtype, train):
+    """The zoo-wide BatchNorm convention."""
+    return partial(nn.BatchNorm, use_running_average=not train,
+                   momentum=0.9, epsilon=1e-5, dtype=dtype)
+
+
+class SqueezeExcite(nn.Module):
+    """Channel attention (senet family): GAP → bottleneck MLP →
+    sigmoid gate."""
+    reduction: int = 16
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        ch = x.shape[-1]
+        s = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        s = nn.Dense(max(ch // self.reduction, 4), dtype=self.dtype,
+                     name='fc1')(s.astype(self.dtype))
+        s = nn.relu(s)
+        s = nn.Dense(ch, dtype=self.dtype, name='fc2')(s)
+        s = nn.sigmoid(s.astype(jnp.float32)).astype(x.dtype)
+        return x * s[:, None, None, :]
+
+
 class BasicBlock(nn.Module):
     filters: int
     conv: ModuleDef
     norm: ModuleDef
     act: Callable
     strides: Tuple[int, int] = (1, 1)
+    se: bool = False     # squeeze-excite before the residual add
 
     @nn.compact
     def __call__(self, x):
@@ -39,6 +72,8 @@ class BasicBlock(nn.Module):
         y = self.act(y)
         y = self.conv(self.filters, (3, 3))(y)
         y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if self.se:
+            y = SqueezeExcite(dtype=y.dtype, name='se')(y)
         if residual.shape != y.shape:
             residual = self.conv(self.filters, (1, 1), self.strides,
                                  name='conv_proj')(residual)
@@ -52,6 +87,7 @@ class Bottleneck(nn.Module):
     norm: ModuleDef
     act: Callable
     strides: Tuple[int, int] = (1, 1)
+    se: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -64,6 +100,8 @@ class Bottleneck(nn.Module):
         y = self.act(y)
         y = self.conv(self.filters * 4, (1, 1))(y)
         y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if self.se:
+            y = SqueezeExcite(dtype=y.dtype, name='se')(y)
         if residual.shape != y.shape:
             residual = self.conv(self.filters * 4, (1, 1), self.strides,
                                  name='conv_proj')(residual)
@@ -81,10 +119,8 @@ class ResNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
-                       kernel_init=conv_kernel_init())
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        conv = conv_partial(self.dtype)
+        norm = norm_partial(self.dtype, train)
         act = nn.relu
 
         x = x.astype(self.dtype)

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from mlcomp_tpu.models.base import register_model
 from mlcomp_tpu.models.resnet import (
-    BasicBlock, Bottleneck, conv_kernel_init,
+    BasicBlock, Bottleneck, conv_kernel_init, conv_partial, norm_partial,
 )
 
 ModuleDef = Any
@@ -39,10 +39,8 @@ class ResNetEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
-                       kernel_init=conv_kernel_init())
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        conv = conv_partial(self.dtype)
+        norm = norm_partial(self.dtype, train)
         act = nn.relu
 
         x = x.astype(self.dtype)
@@ -119,8 +117,7 @@ class FPN(_SegmentationBase):
     @nn.compact
     def __call__(self, x, train: bool = False):
         input_hw = x.shape[1:3]
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        norm = norm_partial(self.dtype, train)
         feats = make_encoder(self.encoder, self.dtype,
                              self.cifar_stem)(x, train=train)
         c2, c3, c4, c5 = feats[1], feats[2], feats[3], feats[4]
@@ -150,8 +147,7 @@ class LinkNet(_SegmentationBase):
     @nn.compact
     def __call__(self, x, train: bool = False):
         input_hw = x.shape[1:3]
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        norm = norm_partial(self.dtype, train)
         feats = make_encoder(self.encoder, self.dtype,
                              self.cifar_stem)(x, train=train)
         skips = feats[1:4]            # c2, c3, c4
@@ -180,8 +176,7 @@ class PSPNet(_SegmentationBase):
     @nn.compact
     def __call__(self, x, train: bool = False):
         input_hw = x.shape[1:3]
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        norm = norm_partial(self.dtype, train)
         feats = make_encoder(self.encoder, self.dtype,
                              self.cifar_stem)(x, train=train)
         c5 = feats[4]
@@ -209,8 +204,7 @@ class DeepLabV3(_SegmentationBase):
     @nn.compact
     def __call__(self, x, train: bool = False):
         input_hw = x.shape[1:3]
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        norm = norm_partial(self.dtype, train)
         feats = make_encoder(self.encoder, self.dtype,
                              self.cifar_stem)(x, train=train)
         c5 = feats[4]

@@ -136,6 +136,23 @@ class TestJaxTrain:
         acc = float((probs.argmax(-1) == y).mean())
         assert acc == pytest.approx(result['best_score'], abs=0.02)
 
+    def test_profile_epoch_writes_device_trace(self, tmp_path):
+        """profile: {epoch: 0} captures an XProf trace for that epoch."""
+        run_executor({
+            'model': {'name': 'mlp', 'num_classes': 4, 'hidden': [16],
+                      'dtype': 'float32'},
+            'dataset': {'name': 'synthetic_images', 'n_train': 128,
+                        'n_valid': 64, 'image_size': 8, 'channels': 1,
+                        'num_classes': 4},
+            'batch_size': 32,
+            'stages': [{'name': 's1', 'epochs': 1}],
+            'profile': {'epoch': 0},
+        }, str(tmp_path / 'ck'))
+        trace_dir = tmp_path / 'ck' / 'profile'
+        assert trace_dir.exists()
+        files = [p for p in trace_dir.rglob('*') if p.is_file()]
+        assert files, 'no trace artifacts written'
+
     def test_resume_skips_done_epochs(self, tmp_path):
         spec = {
             'model': {'name': 'mlp', 'num_classes': 4, 'hidden': [16],
