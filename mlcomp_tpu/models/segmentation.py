@@ -228,6 +228,30 @@ class DeepLabV3(_SegmentationBase):
         return self.head(y, input_hw)
 
 
+class UNetDecoder(_SegmentationBase):
+    """Classic U-Net decoder over any pyramid encoder (reference
+    contrib/segmentation/unet/decoder.py): upsample, concat the skip,
+    two 3x3 conv-norm-act blocks per level."""
+    decoder_channels: Sequence[int] = (256, 128, 64, 32)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        input_hw = x.shape[1:3]
+        norm = norm_partial(self.dtype, train)
+        feats = make_encoder(self.encoder, self.dtype,
+                             self.cifar_stem)(x, train=train)
+        skips = feats[:4][::-1]       # c4, c3, c2, c1
+        y = feats[4]
+        for i, (skip, ch) in enumerate(zip(skips, self.decoder_channels)):
+            y = _resize_to(y, skip.shape[1:3])
+            y = jnp.concatenate([y, skip.astype(y.dtype)], axis=-1)
+            y = _conv_norm_act(y, ch, (3, 3), norm, self.dtype,
+                               name=f'dec{i}_a')
+            y = _conv_norm_act(y, ch, (3, 3), norm, self.dtype,
+                               name=f'dec{i}_b')
+        return self.head(y, input_hw)
+
+
 _DECODERS = {'fpn': FPN, 'linknet': LinkNet, 'pspnet': PSPNet,
              'deeplabv3': DeepLabV3}
 
@@ -258,6 +282,16 @@ for _dec_name, _cls in _DECODERS.items():
                 cifar_stem=cifar_stem, **kwargs)
         register_model(f'{_dec_name}_{_enc}')(_alias)
 
+# encoder-based U-Net: aliases only — the bare 'unet' name stays the
+# standalone models/unet.py module (config {name: unet})
+for _enc in _all_encoder_names():
+    def _unet_alias(num_classes=2, dtype='bfloat16', cifar_stem=False,
+                    _enc=_enc, **kwargs):
+        return _seg_factory(UNetDecoder)(
+            num_classes=num_classes, encoder=_enc, dtype=dtype,
+            cifar_stem=cifar_stem, **kwargs)
+    register_model(f'unet_{_enc}')(_unet_alias)
 
-__all__ = ['ResNetEncoder', 'FPN', 'LinkNet', 'PSPNet', 'DeepLabV3',
+
+__all__ = ['ResNetEncoder', 'FPN', 'LinkNet', 'PSPNet', 'DeepLabV3', 'UNetDecoder',
            'make_encoder']

@@ -9,6 +9,8 @@ and shape.
 from mlcomp_tpu.ops.flash_attention import (
     flash_attention_forward, fused_attention, reference_attention,
 )
+from mlcomp_tpu.ops.fused_ce import reference_ce, softmax_ce_per_example
 
 __all__ = ['fused_attention', 'flash_attention_forward',
-           'reference_attention']
+           'reference_attention', 'softmax_ce_per_example',
+           'reference_ce']

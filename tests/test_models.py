@@ -68,7 +68,8 @@ def test_encoder_family_classifier(name):
 
 @pytest.mark.parametrize('name', ['fpn_vgg13', 'linknet_seresnet18',
                                   'pspnet_densenet121',
-                                  'deeplabv3_efficientnet_lite0'])
+                                  'deeplabv3_efficientnet_lite0',
+                                  'unet_vgg13', 'unet_resnet34'])
 def test_encoder_family_decoders(name):
     """Every decoder accepts every encoder family (shared pyramid
     contract)."""
