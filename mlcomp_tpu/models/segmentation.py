@@ -272,25 +272,25 @@ def _all_encoder_names():
     return list(_ENCODERS) + list(ENCODER_FACTORIES)
 
 
-for _dec_name, _cls in _DECODERS.items():
-    register_model(_dec_name)(_seg_factory(_cls))
-    for _enc in _all_encoder_names():
+def _register_aliases(prefix, decoder_cls, bare_name=False):
+    """Register ``{prefix}_{encoder}`` for every encoder family (and
+    optionally the bare decoder name)."""
+    if bare_name:
+        register_model(prefix)(_seg_factory(decoder_cls))
+    for enc in _all_encoder_names():
         def _alias(num_classes=2, dtype='bfloat16', cifar_stem=False,
-                   _cls=_cls, _enc=_enc, **kwargs):
+                   _cls=decoder_cls, _enc=enc, **kwargs):
             return _seg_factory(_cls)(
                 num_classes=num_classes, encoder=_enc, dtype=dtype,
                 cifar_stem=cifar_stem, **kwargs)
-        register_model(f'{_dec_name}_{_enc}')(_alias)
+        register_model(f'{prefix}_{enc}')(_alias)
 
+
+for _dec_name, _cls in _DECODERS.items():
+    _register_aliases(_dec_name, _cls, bare_name=True)
 # encoder-based U-Net: aliases only — the bare 'unet' name stays the
 # standalone models/unet.py module (config {name: unet})
-for _enc in _all_encoder_names():
-    def _unet_alias(num_classes=2, dtype='bfloat16', cifar_stem=False,
-                    _enc=_enc, **kwargs):
-        return _seg_factory(UNetDecoder)(
-            num_classes=num_classes, encoder=_enc, dtype=dtype,
-            cifar_stem=cifar_stem, **kwargs)
-    register_model(f'unet_{_enc}')(_unet_alias)
+_register_aliases('unet', UNetDecoder)
 
 
 __all__ = ['ResNetEncoder', 'FPN', 'LinkNet', 'PSPNet', 'DeepLabV3', 'UNetDecoder',

@@ -190,8 +190,11 @@ def softmax_ce_per_example(logits, labels, block_n: int = 256,
                 f'CE shape ({n}, {v}) does not tile (need N%8==0 and '
                 f'V%128==0)')
         use_pallas = True
-    else:
+    elif impl == 'dense':
         use_pallas = False
+    else:
+        raise ValueError(f'unknown impl {impl!r}; '
+                         f"use 'auto', 'pallas', or 'dense'")
     if not use_pallas:
         return reference_ce(logits, labels)
     return _fused_ce(logits, labels, bn, bv, interpret)

@@ -14,7 +14,9 @@ Layout: ``<dir>/last.msgpack``, ``<dir>/best.msgpack``, each with
 
 import json
 import os
+import queue
 import shutil
+import threading
 import time
 from typing import Any, Optional, Tuple
 
@@ -49,6 +51,62 @@ def save_checkpoint(directory: str, state: Any, meta: dict,
         shutil.copyfile(last, best_path)
         shutil.copyfile(_meta_path(last), _meta_path(best_path))
     return last
+
+
+class AsyncCheckpointWriter:
+    """Serialise + write checkpoints on a background thread so the
+    training loop never stalls on disk (orbax-style async save; the
+    device→host gather stays in the caller — it is a collective).
+
+    Saves execute FIFO on one worker thread, so last/best ordering is
+    preserved. ``wait()`` drains the queue (call before anything reads
+    the files — export, infer_valid, stage requeue); a failed save
+    re-raises there and on the next ``submit``."""
+
+    def __init__(self):
+        # bounded: at most one queued + one in-flight host copy of the
+        # state — a slow disk backpressures submit() instead of
+        # accumulating a full state copy per epoch (the sync path held
+        # exactly one)
+        self._q = queue.Queue(maxsize=1)
+        self._err = None
+        self._thread = threading.Thread(
+            target=self._run, name='ckpt-writer', daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            try:
+                save_checkpoint(*item)
+            except Exception as e:  # surfaced on wait()/next submit()
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def _raise_pending(self):
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def submit(self, directory: str, state, meta: dict,
+               best: bool = False):
+        self._raise_pending()
+        self._q.put((directory, state, meta, best))
+
+    def wait(self):
+        self._q.join()
+        self._raise_pending()
+
+    def close(self):
+        try:
+            self.wait()
+        finally:
+            self._q.put(None)
+            self._thread.join(timeout=60)
 
 
 def load_meta(directory: str, kind: str = 'last') -> Optional[dict]:
@@ -106,4 +164,4 @@ def resume_plan(stages: list, meta: Optional[dict]) -> Tuple[list, int]:
 
 
 __all__ = ['save_checkpoint', 'restore_checkpoint', 'resume_plan',
-           'load_meta']
+           'load_meta', 'AsyncCheckpointWriter']

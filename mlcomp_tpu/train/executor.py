@@ -66,7 +66,7 @@ class JaxTrain(Executor):
                  report_imgs=None, augment=None, prefetch=2,
                  device_data='auto', epoch_scan=False,
                  checkpoint_every=1, infer_valid=None, profile=None,
-                 **kwargs):
+                 async_checkpoint=True, **kwargs):
         self.model_spec = dict(model or {'name': 'mlp'})
         self.dataset_spec = dict(dataset or {})
         self.loss_name = loss
@@ -97,6 +97,10 @@ class JaxTrain(Executor):
         # reference's InferBestCallback,
         # contrib/catalyst/callbacks/inference.py:10-50)
         self.infer_valid = dict(infer_valid) if infer_valid else None
+        # background-thread checkpoint writes: the epoch's compute
+        # overlaps serialise+disk instead of stalling on them (the
+        # device→host gather stays synchronous — it's a collective)
+        self.async_checkpoint = bool(async_checkpoint)
         # {'epoch': N | 'epochs': [..], 'dir': path} — capture an XLA
         # device trace (XProf/TensorBoard format) for the given global
         # epoch(s). The TPU-native profiler: where the reference leans
@@ -181,8 +185,28 @@ class JaxTrain(Executor):
 
     # ---------------------------------------------------------------- work
     def work(self):
+        self._ckpt_writer = None
+        try:
+            return self._work()
+        finally:
+            writer, self._ckpt_writer = self._ckpt_writer, None
+            if writer is not None:
+                try:
+                    writer.close()
+                except Exception as e:
+                    self.error(f'checkpoint writer: {e}')
+                    raise
+
+    def _drain_ckpt_writer(self):
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.wait()
+
+    def _work(self):
         t_start = time.time()
         self._is_main = self._init_distributed()
+        if self._is_main and self.async_checkpoint:
+            from mlcomp_tpu.train.checkpoint import AsyncCheckpointWriter
+            self._ckpt_writer = AsyncCheckpointWriter()
         mesh = self._mesh()
         loss_fn = loss_for_task(self.loss_name)
         self_supervised = self.loss_name == 'lm_ce'
@@ -497,12 +521,19 @@ class JaxTrain(Executor):
                     )
                     host_state = host_replicated_copy(state, mesh)
                     if self._is_main:
-                        save_checkpoint(
-                            ck_dir, host_state,
-                            {'stage': stage_name, 'stage_epoch': epoch,
-                             'epoch': global_epoch, 'score': score,
-                             'step': int(state.step)},
-                            best=is_best)
+                        meta_d = {'stage': stage_name,
+                                  'stage_epoch': epoch,
+                                  'epoch': global_epoch, 'score': score,
+                                  'step': int(state.step)}
+                        if self._ckpt_writer is not None:
+                            # serialise+write off-thread: the next
+                            # epoch's compute overlaps the disk IO
+                            self._ckpt_writer.submit(
+                                ck_dir, host_state, meta_d,
+                                best=is_best)
+                        else:
+                            save_checkpoint(ck_dir, host_state, meta_d,
+                                            best=is_best)
                 if profiling:
                     self._stop_profile(global_epoch)
                 global_epoch += 1
@@ -511,9 +542,12 @@ class JaxTrain(Executor):
                 # return for requeue: next dispatch runs the next stage.
                 # The LAST stage's dispatch falls through instead so the
                 # model export / report-img pass still runs.
+                self._drain_ckpt_writer()   # requeued stage reads last
                 return {'stage': stage_name, 'stages': stage_names,
                         'best_score': best}
 
+        # everything below reads checkpoint files — drain pending writes
+        self._drain_ckpt_writer()
         if self._is_main and self.model_name:
             self._export_model(ck_dir, best)
         # the post-train passes run collective programs (valid forward,

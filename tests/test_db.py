@@ -1,5 +1,6 @@
 """DB layer tests (parity model: reference db/tests/test_project.py:8-28)."""
 
+import os
 import datetime
 
 from mlcomp_tpu.db.enums import TaskStatus
@@ -159,3 +160,56 @@ class TestComputerAux:
         ap.create_or_update('supervisor', {'tick': 1})
         ap.create_or_update('supervisor', {'tick': 2})
         assert ap.get()['supervisor']['tick'] == 2
+
+
+class TestQueueConcurrency:
+    def test_multiprocess_claims_exactly_once(self, session):
+        """N OS processes hammering claim() on one queue: every message
+        claimed exactly once (WAL sqlite + immediate-claim UPDATE is the
+        broker's core safety property — threads can't prove it, the GIL
+        serializes them)."""
+        import json
+        import subprocess
+        import sys
+
+        import mlcomp_tpu
+        from mlcomp_tpu.db.providers import QueueProvider
+
+        qp = QueueProvider(session)
+        n_msgs, n_workers = 40, 4
+        for i in range(n_msgs):
+            qp.enqueue('conc_q', {'i': i})
+
+        script = r'''
+import json, os, sys
+from mlcomp_tpu.db.core import Session
+from mlcomp_tpu.db.providers import QueueProvider
+qp = QueueProvider(Session.create_session(key=f'w{os.getpid()}'))
+claimed = []
+misses = 0
+while misses < 5:
+    msg = qp.claim(['conc_q'], worker=f'w{os.getpid()}')
+    if msg is None:
+        misses += 1
+        continue
+    msg_id, _payload = msg
+    claimed.append(msg_id)
+    qp.complete(msg_id)
+print(json.dumps(claimed))
+'''
+        env = dict(os.environ,
+                   MLCOMP_TPU_ROOT=mlcomp_tpu.ROOT_FOLDER,
+                   JAX_PLATFORMS='cpu')
+        procs = [subprocess.Popen(
+            [sys.executable, '-c', script], stdout=subprocess.PIPE,
+            env=env, cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            for _ in range(n_workers)]
+        all_claimed = []
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            assert p.returncode == 0
+            all_claimed.extend(json.loads(out.strip().splitlines()[-1]))
+        assert len(all_claimed) == n_msgs, (
+            f'{len(all_claimed)} claims for {n_msgs} messages')
+        assert len(set(all_claimed)) == n_msgs, 'double-claim detected'

@@ -136,6 +136,45 @@ class TestJaxTrain:
         acc = float((probs.argmax(-1) == y).mean())
         assert acc == pytest.approx(result['best_score'], abs=0.02)
 
+    def test_async_checkpoint_writer_roundtrip(self, tmp_path):
+        """AsyncCheckpointWriter: FIFO saves land, wait() drains, and a
+        failed save surfaces on wait()."""
+        import numpy as np
+        from mlcomp_tpu.train.checkpoint import (
+            AsyncCheckpointWriter, load_meta,
+        )
+        w = AsyncCheckpointWriter()
+        state = {'w': np.arange(8, dtype=np.float32)}
+        for i in range(3):
+            w.submit(str(tmp_path), state, {'epoch': i}, best=(i == 1))
+        w.wait()
+        assert load_meta(str(tmp_path), 'last')['epoch'] == 2
+        assert load_meta(str(tmp_path), 'best')['epoch'] == 1
+        # unwritable directory -> the NEXT wait raises
+        w.submit(str(tmp_path / 'x' / '\0bad'), state, {'epoch': 9})
+        with pytest.raises(Exception):
+            w.wait()
+        w.close()
+
+    def test_async_checkpoint_trains_and_resumes(self, tmp_path):
+        """Default async path: checkpoints exist after work() returns
+        and a rerun resumes exactly like the sync path."""
+        spec = {
+            'model': {'name': 'mlp', 'num_classes': 4, 'hidden': [16],
+                      'dtype': 'float32'},
+            'dataset': {'name': 'synthetic_images', 'n_train': 128,
+                        'n_valid': 64, 'image_size': 8, 'channels': 1,
+                        'num_classes': 4},
+            'batch_size': 32,
+            'stages': [{'name': 's1', 'epochs': 2}],
+        }
+        ck = str(tmp_path / 'ck')
+        run_executor(spec, ck)
+        assert os.path.exists(tmp_path / 'ck' / 'last.msgpack')
+        assert os.path.exists(tmp_path / 'ck' / 'best.msgpack')
+        result = run_executor(spec, ck)
+        assert result['samples_per_sec'] == 0  # fully resumed, no work
+
     def test_profile_epoch_writes_device_trace(self, tmp_path):
         """profile: {epoch: 0} captures an XProf trace for that epoch."""
         run_executor({
