@@ -195,6 +195,181 @@ class EfficientNetEncoder(nn.Module):
         return features[-5:]
 
 
+# -------------------------------------------------------------- Xception
+
+class SeparableConv(nn.Module):
+    """Depthwise 3x3 + pointwise 1x1 (the Xception primitive).
+    ``zero_scale`` zero-inits the norm scale — the zoo-wide
+    identity-at-init convention for residual branches."""
+    features: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+    zero_scale: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        x = self.conv(x.shape[-1], (3, 3), self.strides,
+                      feature_group_count=x.shape[-1],
+                      name='depthwise')(x)
+        x = self.conv(self.features, (1, 1), name='pointwise')(x)
+        return self.norm(name='norm',
+                         scale_init=nn.initializers.zeros
+                         if self.zero_scale
+                         else nn.initializers.ones)(x)
+
+
+class XceptionBlock(nn.Module):
+    """N separable convs + optional stride-2 exit, 1x1 projected skip
+    (reference contrib/segmentation/deeplabv3/backbone/xception.py)."""
+    features: int
+    reps: int
+    conv: ModuleDef
+    norm: ModuleDef
+    stride: int = 1
+    start_with_relu: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        skip = x
+        y = x
+        for i in range(self.reps):
+            if i > 0 or self.start_with_relu:
+                y = nn.relu(y)
+            s = (self.stride, self.stride) \
+                if i == self.reps - 1 else (1, 1)
+            y = SeparableConv(self.features, conv=self.conv,
+                              norm=self.norm, strides=s,
+                              zero_scale=(i == self.reps - 1),
+                              name=f'sep{i}')(y)
+        if skip.shape != y.shape:
+            skip = self.conv(self.features, (1, 1),
+                             (self.stride, self.stride),
+                             name='conv_skip')(skip)
+            skip = self.norm(name='norm_skip')(skip)
+        return y + skip
+
+
+class XceptionEncoder(nn.Module):
+    """Aligned-Xception trunk: entry flow (3 strided blocks), middle
+    flow (residual separable blocks), exit flow."""
+    middle_reps: int = 8
+    dtype: jnp.dtype = jnp.bfloat16
+    cifar_stem: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = _conv(self.dtype)
+        norm = _norm(self.dtype, train)
+        x = x.astype(self.dtype)
+        stem_strides = (1, 1) if self.cifar_stem else (2, 2)
+        x = conv(32, (3, 3), stem_strides, name='conv_stem1')(x)
+        x = norm(name='norm_stem1')(x)
+        x = nn.relu(x)
+        x = conv(64, (3, 3), name='conv_stem2')(x)
+        x = norm(name='norm_stem2')(x)
+        x = nn.relu(x)
+        features = [x]                                    # c1
+        block = partial(XceptionBlock, conv=conv, norm=norm)
+        x = block(128, 2, stride=2, start_with_relu=False,
+                  name='entry1')(x)
+        features.append(x)                                # c2
+        x = block(256, 2, stride=2, name='entry2')(x)
+        features.append(x)                                # c3
+        x = block(728, 2, stride=2, name='entry3')(x)
+        for i in range(self.middle_reps):
+            x = block(728, 3, name=f'middle{i}')(x)
+        features.append(x)                                # c4
+        x = block(1024, 2, stride=2, name='exit')(x)
+        x = nn.relu(SeparableConv(1536, conv=conv, norm=norm,
+                                  name='exit_sep1')(x))
+        x = nn.relu(SeparableConv(2048, conv=conv, norm=norm,
+                                  name='exit_sep2')(x))
+        features.append(x)                                # c5
+        return features
+
+
+# ------------------------------------------------------------------- DPN
+
+class DPNBlock(nn.Module):
+    """Dual-path block (reference contrib/segmentation/encoders/dpn.py):
+    a grouped-bottleneck whose output splits into a residual part
+    (added) and a dense part (concatenated)."""
+    res_ch: int
+    inc_ch: int
+    groups: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        res, dense = x
+        joined = jnp.concatenate([res, dense], -1) \
+            if dense is not None else res
+        y = nn.relu(self.norm(name='norm_in')(joined))
+        mid = self.res_ch // 2
+        y = self.conv(mid, (1, 1), name='conv_a')(y)
+        y = nn.relu(self.norm(name='norm_a')(y))
+        y = self.conv(mid, (3, 3), self.strides,
+                      feature_group_count=self.groups, name='conv_b')(y)
+        y = nn.relu(self.norm(name='norm_b')(y))
+        out = self.conv(self.res_ch + self.inc_ch, (1, 1),
+                        name='conv_c')(y)
+        res_out, inc = out[..., :self.res_ch], out[..., self.res_ch:]
+        if res.shape != res_out.shape:
+            # stage boundary: project the joined paths to the new
+            # residual base; the dense path restarts per stage
+            res = self.conv(self.res_ch, (1, 1), self.strides,
+                            name='conv_proj')(joined)
+            dense = None
+        new_dense = inc if dense is None \
+            else jnp.concatenate([dense, inc], -1)
+        return res + res_out, new_dense
+
+
+class DPNEncoder(nn.Module):
+    """DPN trunk (dpn68-like): 4 stages of dual-path blocks; features
+    are the fused (residual ++ dense) maps per stage."""
+    stage_blocks: Sequence[int] = (3, 4, 12, 3)
+    stage_res: Sequence[int] = (64, 128, 256, 512)
+    stage_inc: Sequence[int] = (16, 32, 32, 64)
+    groups: int = 32
+    dtype: jnp.dtype = jnp.bfloat16
+    cifar_stem: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = _conv(self.dtype)
+        norm = _norm(self.dtype, train)
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = conv(64, (3, 3), name='conv_stem')(x)
+        else:
+            x = conv(64, (7, 7), (2, 2), name='conv_stem')(x)
+        x = nn.relu(norm(name='norm_stem')(x))
+        features = [x]                                    # c1
+        if not self.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
+        res, dense = x, None
+        last = len(self.stage_blocks) - 1
+        for si, (n, rc, ic) in enumerate(zip(
+                self.stage_blocks, self.stage_res, self.stage_inc)):
+            for bi in range(n):
+                strides = (2, 2) if si > 0 and bi == 0 else (1, 1)
+                res, dense = DPNBlock(
+                    rc, ic, groups=self.groups, conv=conv, norm=norm,
+                    strides=strides, name=f's{si}_b{bi}')((res, dense))
+            fused = jnp.concatenate([res, dense], -1)
+            if si == last:
+                # pre-activation net: without a final norm+relu, c5 is
+                # raw un-activated conv outputs (same fix as DenseNet's
+                # norm_final above)
+                fused = nn.relu(norm(name='norm_final')(fused))
+            features.append(fused)
+        return features
+
+
 # ------------------------------------------------- registry + classifier
 
 def _se_encoder(sizes, block, dtype, cifar_stem):
@@ -220,6 +395,10 @@ ENCODER_FACTORIES = {
     'seresnet50': lambda dtype, cifar_stem: _se_encoder(
         [3, 4, 6, 3], SEBottleneck, dtype, cifar_stem),
     'efficientnet_lite0': lambda dtype, cifar_stem: EfficientNetEncoder(
+        dtype=dtype, cifar_stem=cifar_stem),
+    'xception': lambda dtype, cifar_stem: XceptionEncoder(
+        dtype=dtype, cifar_stem=cifar_stem),
+    'dpn68': lambda dtype, cifar_stem: DPNEncoder(
         dtype=dtype, cifar_stem=cifar_stem),
 }
 
@@ -269,5 +448,6 @@ for _enc in ENCODER_FACTORIES:
 
 __all__ = ['VGGEncoder', 'DenseNetEncoder', 'SqueezeExcite',
            'SEBasicBlock', 'SEBottleneck', 'MBConv',
-           'EfficientNetEncoder', 'EncoderClassifier',
-           'ENCODER_FACTORIES', 'make_family_encoder']
+           'EfficientNetEncoder', 'XceptionEncoder', 'DPNEncoder',
+           'EncoderClassifier', 'ENCODER_FACTORIES',
+           'make_family_encoder']

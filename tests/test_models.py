@@ -52,7 +52,8 @@ def test_unet_forward():
 
 
 @pytest.mark.parametrize('name', ['vgg13', 'densenet121', 'seresnet18',
-                                  'efficientnet_lite0'])
+                                  'efficientnet_lite0', 'xception',
+                                  'dpn68'])
 def test_encoder_family_classifier(name):
     """New encoder families (reference contrib/segmentation/encoders/:
     vgg/densenet/senet/efficientnet) as GAP classifiers."""
@@ -69,7 +70,8 @@ def test_encoder_family_classifier(name):
 @pytest.mark.parametrize('name', ['fpn_vgg13', 'linknet_seresnet18',
                                   'pspnet_densenet121',
                                   'deeplabv3_efficientnet_lite0',
-                                  'unet_vgg13', 'unet_resnet34'])
+                                  'unet_vgg13', 'unet_resnet34',
+                                  'pspnet_xception', 'fpn_dpn68'])
 def test_encoder_family_decoders(name):
     """Every decoder accepts every encoder family (shared pyramid
     contract)."""
