@@ -1,9 +1,11 @@
 """Additional encoder families: VGG, DenseNet, SE-ResNet,
-EfficientNet-lite — in flax, NHWC, bf16-ready.
+EfficientNet-lite, Xception, DPN, Inception-ResNet-v2 — in flax, NHWC,
+bf16-ready.
 
 Parity: the reference vendors 8 torch encoder families for its
-segmentation zoo (reference contrib/segmentation/encoders/: resnet, vgg,
-densenet, senet, efficientnet, dpn, inceptionresnetv2) and a
+segmentation zoo (reference contrib/segmentation/encoders/: resnet,
+vgg, densenet, senet, efficientnet, dpn, inceptionresnetv2, plus the
+deeplab xception backbone) and a
 pretrainedmodels-backed classifier zoo (reference
 contrib/model/pretrained.py:6-59). Here each family is implemented
 natively with the framework's shared conventions: logical partitioning
@@ -370,6 +372,88 @@ class DPNEncoder(nn.Module):
         return features
 
 
+# ---------------------------------------------------- Inception-ResNet-v2
+
+class InceptionResnetBlock(nn.Module):
+    """Residual inception block (reference
+    contrib/segmentation/encoders/inceptionresnetv2.py): parallel
+    branches, concat, 1x1 back to the trunk width, scaled add."""
+    branches: Sequence[Sequence[Tuple[int, Tuple[int, int]]]]
+    conv: ModuleDef
+    norm: ModuleDef
+    scale: float = 0.17
+
+    @nn.compact
+    def __call__(self, x):
+        outs = []
+        for bi, branch in enumerate(self.branches):
+            y = x
+            for li, (ch, kernel) in enumerate(branch):
+                y = self.conv(ch, kernel, name=f'b{bi}_conv{li}')(y)
+                y = self.norm(name=f'b{bi}_norm{li}')(y)
+                y = nn.relu(y)
+            outs.append(y)
+        y = jnp.concatenate(outs, -1)
+        # trunk projection: zero-init scale keeps identity-at-init
+        y = self.conv(x.shape[-1], (1, 1), name='project')(y)
+        y = self.norm(name='norm_project',
+                      scale_init=nn.initializers.zeros)(y)
+        return nn.relu(x + self.scale * y)
+
+
+class InceptionResNetV2Encoder(nn.Module):
+    """Inception-ResNet-v2 trunk: conv stem, then 35/17/8-style
+    residual-inception stages joined by strided reductions."""
+    repeats: Sequence[int] = (10, 20, 10)
+    dtype: jnp.dtype = jnp.bfloat16
+    cifar_stem: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = _conv(self.dtype)
+        norm = _norm(self.dtype, train)
+
+        def cna(x, ch, kernel, strides=(1, 1), name=''):
+            x = conv(ch, kernel, strides, name=f'{name}_conv')(x)
+            x = norm(name=f'{name}_norm')(x)
+            return nn.relu(x)
+
+        x = x.astype(self.dtype)
+        stem_strides = (1, 1) if self.cifar_stem else (2, 2)
+        x = cna(x, 32, (3, 3), stem_strides, name='stem1')
+        x = cna(x, 32, (3, 3), name='stem2')
+        x = cna(x, 64, (3, 3), name='stem3')
+        features = [x]                                    # c1
+        if not self.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
+        x = cna(x, 80, (1, 1), name='stem4')
+        x = cna(x, 192, (3, 3), name='stem5')
+        features.append(x)                                # c2
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
+        x = cna(x, 320, (1, 1), name='mixed5b')
+        block = partial(InceptionResnetBlock, conv=conv, norm=norm)
+        for i in range(self.repeats[0]):                  # block35
+            x = block([[(32, (1, 1))],
+                       [(32, (1, 1)), (32, (3, 3))],
+                       [(32, (1, 1)), (48, (3, 3)), (64, (3, 3))]],
+                      scale=0.17, name=f'block35_{i}')(x)
+        features.append(x)                                # c3
+        x = cna(x, 1088, (3, 3), (2, 2), name='reduction_a')
+        for i in range(self.repeats[1]):                  # block17
+            x = block([[(192, (1, 1))],
+                       [(128, (1, 1)), (160, (1, 7)), (192, (7, 1))]],
+                      scale=0.10, name=f'block17_{i}')(x)
+        features.append(x)                                # c4
+        x = cna(x, 2080, (3, 3), (2, 2), name='reduction_b')
+        for i in range(self.repeats[2]):                  # block8
+            x = block([[(192, (1, 1))],
+                       [(192, (1, 1)), (224, (1, 3)), (256, (3, 1))]],
+                      scale=0.20, name=f'block8_{i}')(x)
+        x = cna(x, 1536, (1, 1), name='conv_final')
+        features.append(x)                                # c5
+        return features
+
+
 # ------------------------------------------------- registry + classifier
 
 def _se_encoder(sizes, block, dtype, cifar_stem):
@@ -400,6 +484,8 @@ ENCODER_FACTORIES = {
         dtype=dtype, cifar_stem=cifar_stem),
     'dpn68': lambda dtype, cifar_stem: DPNEncoder(
         dtype=dtype, cifar_stem=cifar_stem),
+    'inceptionresnetv2': lambda dtype, cifar_stem:
+        InceptionResNetV2Encoder(dtype=dtype, cifar_stem=cifar_stem),
 }
 
 
@@ -449,5 +535,6 @@ for _enc in ENCODER_FACTORIES:
 __all__ = ['VGGEncoder', 'DenseNetEncoder', 'SqueezeExcite',
            'SEBasicBlock', 'SEBottleneck', 'MBConv',
            'EfficientNetEncoder', 'XceptionEncoder', 'DPNEncoder',
+           'InceptionResnetBlock', 'InceptionResNetV2Encoder',
            'EncoderClassifier', 'ENCODER_FACTORIES',
            'make_family_encoder']

@@ -53,7 +53,7 @@ def test_unet_forward():
 
 @pytest.mark.parametrize('name', ['vgg13', 'densenet121', 'seresnet18',
                                   'efficientnet_lite0', 'xception',
-                                  'dpn68'])
+                                  'dpn68', 'inceptionresnetv2'])
 def test_encoder_family_classifier(name):
     """New encoder families (reference contrib/segmentation/encoders/:
     vgg/densenet/senet/efficientnet) as GAP classifiers."""
@@ -71,7 +71,8 @@ def test_encoder_family_classifier(name):
                                   'pspnet_densenet121',
                                   'deeplabv3_efficientnet_lite0',
                                   'unet_vgg13', 'unet_resnet34',
-                                  'pspnet_xception', 'fpn_dpn68'])
+                                  'pspnet_xception', 'fpn_dpn68',
+                                  'linknet_inceptionresnetv2'])
 def test_encoder_family_decoders(name):
     """Every decoder accepts every encoder family (shared pyramid
     contract)."""
