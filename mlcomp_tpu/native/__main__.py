@@ -9,7 +9,7 @@ def main():
     force = '--force' in sys.argv
     try:
         path = native.build(force=force)
-    except RuntimeError as e:
+    except (RuntimeError, OSError) as e:  # compile failure / CDLL abi
         print(f'build failed: {e}', file=sys.stderr)
         return 1
     ok = native.available()
