@@ -1,0 +1,69 @@
+"""merge_dicts_smart semantics (SURVEY.md hard part (e): the
+suffix-path merge's ambiguity rules, which grid search and --params
+depend on — reference utils/config.py:27-64)."""
+
+import pytest
+
+from mlcomp_tpu.utils.config import dict_from_list_str, merge_dicts_smart
+
+
+class TestMergeDictsSmart:
+    def test_exact_path(self):
+        t = {'a': {'b': 1}, 'c': 2}
+        out = merge_dicts_smart(t, {'a/b': 9})
+        assert out['a']['b'] == 9 and out['c'] == 2
+
+    def test_suffix_match_unique(self):
+        """A bare leaf name reaches into the one place it exists."""
+        t = {'opt': {'lr': 0.1}, 'model': {'width': 4}}
+        out = merge_dicts_smart(t, {'lr': 0.5})
+        assert out['opt']['lr'] == 0.5
+
+    def test_ambiguous_suffix_raises(self):
+        t = {'a': {'lr': 1}, 'b': {'lr': 2}}
+        with pytest.raises(ValueError, match='ambiguous'):
+            merge_dicts_smart(t, {'lr': 3})
+
+    def test_longer_suffix_disambiguates(self):
+        t = {'a': {'lr': 1}, 'b': {'lr': 2}}
+        out = merge_dicts_smart(t, {'b/lr': 3})
+        assert out['a']['lr'] == 1 and out['b']['lr'] == 3
+
+    def test_new_key_attaches_at_anchor(self):
+        """An unmatched leaf under a known interior path lands there."""
+        t = {'train': {'opt': {'lr': 0.1}}}
+        out = merge_dicts_smart(t, {'opt/momentum': 0.9})
+        assert out['train']['opt']['momentum'] == 0.9
+        assert out['train']['opt']['lr'] == 0.1
+
+    def test_new_top_level_key(self):
+        out = merge_dicts_smart({'a': 1}, {'fresh': 2})
+        assert out == {'a': 1, 'fresh': 2}
+
+    def test_nested_dict_value_expands(self):
+        """A dict-valued override merges leaf-by-leaf instead of
+        replacing the subtree (grid cells rely on this)."""
+        t = {'model': {'name': 'mlp', 'hidden': 32}}
+        out = merge_dicts_smart(t, {'model': {'name': 'resnet18'}})
+        assert out['model']['name'] == 'resnet18'
+        assert out['model']['hidden'] == 32  # untouched sibling
+
+    def test_grid_cell_style_model_name(self):
+        """The exact shape examples/encoder_grid uses."""
+        t = {'type': 'jax_train',
+             'model': {'name': 'resnet18', 'num_classes': 10}}
+        out = merge_dicts_smart(t, {'model/name': 'seresnet18'})
+        assert out['model']['name'] == 'seresnet18'
+        assert out['model']['num_classes'] == 10
+
+
+class TestDictFromListStr:
+    def test_type_coercion(self):
+        out = dict_from_list_str(
+            ['a:1', 'b:2.5', 'c:True', 'd:False', 'e:text'])
+        assert out == {'a': 1, 'b': 2.5, 'c': True, 'd': False,
+                       'e': 'text'}
+
+    def test_path_keys(self):
+        out = dict_from_list_str(['opt/lr:0.01'])
+        assert out == {'opt/lr': 0.01}

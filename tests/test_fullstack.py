@@ -18,6 +18,8 @@ import sys
 import time
 import urllib.request
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 CONFIG = """\
@@ -53,6 +55,7 @@ def _api(port, path, data=None, timeout=10):
         return json.loads(resp.read())
 
 
+@pytest.mark.slow
 def test_server_process_group_runs_dag(tmp_path):
     port = _free_port()
     env = dict(
