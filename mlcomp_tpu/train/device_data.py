@@ -61,6 +61,13 @@ def make_device_augment(augments: Sequence, image_shape):
     h, w = image_shape[0], image_shape[1]
 
     def augment(x, rng):
+        # integer pixels augmented BEFORE dequantization: 1-byte dtypes
+        # are exact in bf16 (0..255 → full MXU rate); wider integers
+        # need f32 (exact to 2^24) and get their dtype restored below
+        orig_dtype = x.dtype
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(jnp.bfloat16 if x.dtype.itemsize == 1
+                         else jnp.float32)
         for i, (name, params) in enumerate(augments):
             key = jax.random.fold_in(rng, i)
             if name == 'pad_crop':
@@ -76,16 +83,16 @@ def make_device_augment(augments: Sequence, image_shape):
                 n = x.shape[0]
                 dy = jax.random.randint(k1, (n,), 0, 2 * pad + 1)
                 dx = jax.random.randint(k2, (n,), 0, 2 * pad + 1)
-                dtype = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) \
-                    else jnp.float32
+                dtype = x.dtype
                 ry = jax.nn.one_hot(dy[:, None] + jnp.arange(h),
                                     h + 2 * pad, dtype=dtype)
                 rx = jax.nn.one_hot(dx[:, None] + jnp.arange(w),
                                     w + 2 * pad, dtype=dtype)
-                # HIGHEST precision: the one-hot selection must be an
-                # EXACT pixel copy, not a bf16-rounded matmul
-                t_sel = jnp.einsum('bqr,brwc->bqwc', ry,
-                                   xp.astype(dtype),
+                # one-hot rows have a single nonzero, so the selection
+                # is an EXACT pixel copy on exact inputs at any matmul
+                # precision; HIGHEST additionally keeps f32 [0,1]
+                # floats un-rounded on the float path
+                t_sel = jnp.einsum('bqr,brwc->bqwc', ry, xp,
                                    precision=jax.lax.Precision.HIGHEST)
                 x = jnp.einsum('bkw,bqwc->bqkc', rx, t_sel,
                                precision=jax.lax.Precision.HIGHEST
@@ -117,6 +124,9 @@ def make_device_augment(augments: Sequence, image_shape):
                 hole = ((dy >= -s) & (dy < s) & (dx_ >= -s) & (dx_ < s)
                         & pick[:, None, None])
                 x = jnp.where(hole[..., None], jnp.zeros_like(x), x)
+        if not jnp.issubdtype(orig_dtype, jnp.floating) \
+                and orig_dtype.itemsize > 1:
+            x = x.astype(orig_dtype)   # f32 held the ints exactly
         return x
 
     return augment

@@ -383,3 +383,26 @@ class TestCheckpointCadence:
         # re-run with more epochs: resumes past the 2 completed ones
         result = run(4)
         assert result['best_score'] is not None
+
+
+def test_augment_wide_integer_pixels_exact():
+    """uint16 pixel data (not uint8-packable) survives augmentation
+    bit-exactly with its dtype preserved — the crop runs in f32, not
+    the lossy bf16 fast path reserved for 1-byte dtypes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mlcomp_tpu.train.device_data import make_device_augment
+
+    x = jnp.asarray(np.random.RandomState(0).randint(
+        0, 65536, (4, 8, 8, 1)), jnp.uint16)
+    aug = make_device_augment([('hflip', {'p': 0.0})], (8, 8))
+    out = aug(x, jax.random.PRNGKey(0))
+    assert out.dtype == jnp.uint16
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    # pad_crop with zero displacement range is also an exact copy
+    aug2 = make_device_augment([('pad_crop', {'pad': 0})], (8, 8))
+    out2 = aug2(x, jax.random.PRNGKey(1))
+    assert out2.dtype == jnp.uint16
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(x))
