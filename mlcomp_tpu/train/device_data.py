@@ -62,20 +62,16 @@ def make_device_augment(augments: Sequence, image_shape):
 
     def augment(x, rng):
         # integer pixels augmented BEFORE dequantization: 1-byte dtypes
-        # are exact in bf16 (0..255 → full MXU rate); wider integers
-        # need f32 (exact to 2^24) and get their dtype restored below
-        orig_dtype = x.dtype
-        if not jnp.issubdtype(x.dtype, jnp.floating):
-            x = x.astype(jnp.bfloat16 if x.dtype.itemsize == 1
-                         else jnp.float32)
+        # ride bf16 (0..255 exact → full MXU rate). Wider integer
+        # dtypes stay in their native dtype throughout — flips/cutout
+        # are dtype-agnostic and pad_crop takes an exact gather path
+        # (no float dtype can hold e.g. int32 > 2^24 exactly)
+        if not jnp.issubdtype(x.dtype, jnp.floating) \
+                and x.dtype.itemsize == 1:
+            x = x.astype(jnp.bfloat16)
         for i, (name, params) in enumerate(augments):
             key = jax.random.fold_in(rng, i)
             if name == 'pad_crop':
-                # crop expressed as one-hot row/col selection MATMULS:
-                # the natural gather formulation lowers to a slow
-                # general gather on TPU (+4.3 ms/step measured on the
-                # ResNet bench); two batched einsums ride the MXU and
-                # make the crop free (25.3k -> 32.0k img/s)
                 pad = int(params.get('pad', 4))
                 xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
                              mode='reflect')
@@ -83,20 +79,36 @@ def make_device_augment(augments: Sequence, image_shape):
                 n = x.shape[0]
                 dy = jax.random.randint(k1, (n,), 0, 2 * pad + 1)
                 dx = jax.random.randint(k2, (n,), 0, 2 * pad + 1)
-                dtype = x.dtype
-                ry = jax.nn.one_hot(dy[:, None] + jnp.arange(h),
-                                    h + 2 * pad, dtype=dtype)
-                rx = jax.nn.one_hot(dx[:, None] + jnp.arange(w),
-                                    w + 2 * pad, dtype=dtype)
-                # one-hot rows have a single nonzero, so the selection
-                # is an EXACT pixel copy on exact inputs at any matmul
-                # precision; HIGHEST additionally keeps f32 [0,1]
-                # floats un-rounded on the float path
-                t_sel = jnp.einsum('bqr,brwc->bqwc', ry, xp,
-                                   precision=jax.lax.Precision.HIGHEST)
-                x = jnp.einsum('bkw,bqwc->bqkc', rx, t_sel,
-                               precision=jax.lax.Precision.HIGHEST
-                               ).astype(x.dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    # crop expressed as one-hot row/col selection
+                    # MATMULS: the natural gather lowers to a slow
+                    # general gather on TPU (+4.3 ms/step measured on
+                    # the ResNet bench); two batched einsums ride the
+                    # MXU and make the crop free (25.3k -> 32.0k
+                    # img/s). One-hot rows have a single nonzero, so
+                    # the selection is an EXACT pixel copy at any
+                    # matmul precision; HIGHEST additionally keeps f32
+                    # [0,1] floats un-rounded
+                    dtype = x.dtype
+                    ry = jax.nn.one_hot(dy[:, None] + jnp.arange(h),
+                                        h + 2 * pad, dtype=dtype)
+                    rx = jax.nn.one_hot(dx[:, None] + jnp.arange(w),
+                                        w + 2 * pad, dtype=dtype)
+                    t_sel = jnp.einsum(
+                        'bqr,brwc->bqwc', ry, xp,
+                        precision=jax.lax.Precision.HIGHEST)
+                    x = jnp.einsum(
+                        'bkw,bqwc->bqkc', rx, t_sel,
+                        precision=jax.lax.Precision.HIGHEST)
+                else:
+                    # wide integer dtypes: exact gather crop
+                    # (correctness over MXU speed on this rare path)
+                    rows = dy[:, None] + jnp.arange(h)
+                    cols = dx[:, None] + jnp.arange(w)
+                    xg = jnp.take_along_axis(
+                        xp, rows[:, :, None, None], axis=1)
+                    x = jnp.take_along_axis(
+                        xg, cols[:, None, :, None], axis=2)
             elif name == 'hflip':
                 p = float(params.get('p', 0.5))
                 flip = jax.random.bernoulli(key, p, (x.shape[0],))
@@ -124,9 +136,6 @@ def make_device_augment(augments: Sequence, image_shape):
                 hole = ((dy >= -s) & (dy < s) & (dx_ >= -s) & (dx_ < s)
                         & pick[:, None, None])
                 x = jnp.where(hole[..., None], jnp.zeros_like(x), x)
-        if not jnp.issubdtype(orig_dtype, jnp.floating) \
-                and orig_dtype.itemsize > 1:
-            x = x.astype(orig_dtype)   # f32 held the ints exactly
         return x
 
     return augment

@@ -361,3 +361,52 @@ class TestShutdown:
     def test_shutdown(self, api):
         res = api('/api/shutdown')
         assert res['success']
+
+
+class TestRobustness:
+    """Malformed input must come back as structured JSON errors — and
+    the server must keep serving afterwards (session-heal parity,
+    reference app.py:91-131)."""
+
+    def test_invalid_json_is_400(self, api):
+        req = urllib.request.Request(
+            api.base + '/api/tasks', data=b'{not json',
+            headers={'Content-Type': 'application/json',
+                     'Authorization': TOKEN})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError('expected HTTP error')
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert json.loads(e.read())['reason'] == 'invalid json'
+        # server still alive
+        assert 'data' in api('/api/tasks', {})
+
+    def test_unknown_ids_do_not_wedge(self, api):
+        for path, payload in [
+            ('/api/graph', {'id': 99999}),
+            ('/api/config', {'id': 99999}),
+            ('/api/task/info', {'id': 99999}),
+            ('/api/report', {'id': 99999}),
+        ]:
+            try:
+                out = api(path, payload)
+                assert isinstance(out, (dict, list))
+            except urllib.error.HTTPError as e:
+                assert 400 <= e.code < 600
+                json.loads(e.read())  # structured body, not a crash
+        assert 'data' in api('/api/tasks', {})
+
+    def test_wrong_types_do_not_wedge(self, api):
+        for path, payload in [
+            ('/api/tasks', {'dag': 'not-an-int'}),
+            ('/api/logs', {'task': {'nested': 'dict'}}),
+            ('/api/task/stop', {'id': None}),
+        ]:
+            try:
+                out = api(path, payload)
+                assert isinstance(out, (dict, list))
+            except urllib.error.HTTPError as e:
+                assert 400 <= e.code < 600
+                json.loads(e.read())
+        assert 'data' in api('/api/tasks', {})

@@ -406,3 +406,13 @@ def test_augment_wide_integer_pixels_exact():
     out2 = aug2(x, jax.random.PRNGKey(1))
     assert out2.dtype == jnp.uint16
     np.testing.assert_array_equal(np.asarray(out2), np.asarray(x))
+
+    # int32 beyond f32's 2^24 integer range: no float dtype could hold
+    # these — the gather crop and dtype-agnostic flips must stay exact
+    big = 2 ** 24 + 1
+    xi = jnp.full((2, 8, 8, 1), big, jnp.int32)
+    for spec in ([('hflip', {'p': 0.0})], [('pad_crop', {'pad': 2})],
+                 [('cutout', {'size': 2, 'p': 0.0})]):
+        oi = make_device_augment(spec, (8, 8))(xi, jax.random.PRNGKey(2))
+        assert oi.dtype == jnp.int32
+        assert int(oi.max()) == big and int(oi.min()) == big, spec
