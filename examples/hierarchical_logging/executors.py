@@ -1,0 +1,31 @@
+"""Hierarchical step-tree demo (parity: reference
+examples/hierarchical_logging/executors.py:4-20).
+
+Each ``self.step.start(level, name)`` opens a step at that depth;
+opening a step at level N auto-closes anything at level >= N, and every
+log line attaches to the innermost open step. The UI's task detail and
+``python -m mlcomp_tpu`` describe render the resulting tree with
+per-step durations and log counts.
+"""
+
+import time
+
+from mlcomp_tpu.worker.executors import Executor
+
+
+@Executor.register
+class StepTreeDemo(Executor):
+    def __init__(self, stages: int = 2, substeps: int = 3, **kwargs):
+        super().__init__(**kwargs)
+        self.stages = int(stages)
+        self.substeps = int(substeps)
+
+    def work(self):
+        for s in range(self.stages):
+            self.step.start(1, f'stage {s}', s)
+            self.info(f'stage {s} begins')
+            for i in range(self.substeps):
+                self.step.start(2, f'substep {i}', i)
+                time.sleep(0.01)
+                self.info(f'work item {i} done')
+        return {'stages': self.stages, 'substeps': self.substeps}

@@ -386,9 +386,10 @@ class TestCheckpointCadence:
 
 
 def test_augment_wide_integer_pixels_exact():
-    """uint16 pixel data (not uint8-packable) survives augmentation
-    bit-exactly with its dtype preserved — the crop runs in f32, not
-    the lossy bf16 fast path reserved for 1-byte dtypes."""
+    """Integer pixel data wider than 1 byte survives augmentation
+    bit-exactly with its dtype preserved — the crop takes the native-
+    dtype gather path (no float dtype could hold int32 > 2^24), not
+    the bf16 MXU fast path reserved for 1-byte dtypes."""
     import jax
     import jax.numpy as jnp
     import numpy as np
