@@ -177,7 +177,12 @@ def softmax_ce_per_example(logits, labels, block_n: int = 256,
     """Per-example softmax CE over [N, V] logits and [N] int labels,
     f32 losses. ``impl``: 'auto' (dense — measured faster on TPU, see
     module docstring), 'pallas' (the kernel; tests pass it with
-    interpret=True), or 'dense'."""
+    interpret=True), or 'dense'.
+
+    Labels outside [0, V) are clamped on both paths (matching
+    ``jnp.take_along_axis``'s in-jit clamp semantics); there is no
+    ignore-index convention — mask such rows in the caller's loss
+    weighting instead."""
     n, v = logits.shape
     bn = _fit(n, block_n, 8)
     bv = _fit(v, block_v, 128)
@@ -195,6 +200,10 @@ def softmax_ce_per_example(logits, labels, block_n: int = 256,
     else:
         raise ValueError(f'unknown impl {impl!r}; '
                          f"use 'auto', 'pallas', or 'dense'")
+    # clamp BEFORE dispatch so both paths agree on out-of-range labels:
+    # unclamped, take_along_axis wraps negatives / NaN-fills >= V while
+    # the kernel's one-hot pick contributes 0 — three different answers
+    labels = jnp.clip(labels.astype(jnp.int32), 0, v - 1)
     if not use_pallas:
         return reference_ce(logits, labels)
     return _fused_ce(logits, labels, bn, bv, interpret)

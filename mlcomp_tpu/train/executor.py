@@ -186,16 +186,31 @@ class JaxTrain(Executor):
     # ---------------------------------------------------------------- work
     def work(self):
         self._ckpt_writer = None
+        self._profile_open = False
+        ok = False
         try:
-            return self._work()
+            result = self._work()
+            ok = True
+            return result
         finally:
+            if self._profile_open:
+                # an exception mid-epoch skipped _stop_profile; close the
+                # trace so a restarted executor can start a new one
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                self._profile_open = False
             writer, self._ckpt_writer = self._ckpt_writer, None
             if writer is not None:
                 try:
                     writer.close()
                 except Exception as e:
                     self.error(f'checkpoint writer: {e}')
-                    raise
+                    # on the failure path keep the original training
+                    # exception; the writer error is logged above
+                    if ok:
+                        raise
 
     def _drain_ckpt_writer(self):
         if self._ckpt_writer is not None:
@@ -586,9 +601,11 @@ class JaxTrain(Executor):
             self.info(f'profiler: could not start trace ({e})')
             return False
         self._profile_dir = out
+        self._profile_open = True
         return True
 
     def _stop_profile(self, global_epoch):
+        self._profile_open = False
         try:
             jax.profiler.stop_trace()
             self.info(f'profiler: epoch {global_epoch} device trace -> '

@@ -132,14 +132,12 @@ class Storage:
             else:
                 with open(full, 'rb') as fh:
                     content = fh.read()
-                # the probe digest is reusable if the file provably
-                # didn't change across probe → read (saves a second
-                # hash pass over every new file)
-                if probe is not None and sig is not None \
-                        and _sig(full) == sig:
-                    md5 = probe
-                else:
-                    md5 = hashlib.md5(content).hexdigest()
+                # always digest the bytes actually read: trusting the
+                # probe digest on an unchanged (size, mtime) signature is
+                # a TOCTOU on coarse-mtime filesystems — a same-size
+                # rewrite between the hash pass and this read would store
+                # new content under the stale digest
+                md5 = hashlib.md5(content).hexdigest()
                 if md5 in hashs:
                     file_id = hashs[md5]
                 else:

@@ -245,3 +245,21 @@ class TestFusedCE:
                                    atol=1e-5, rtol=1e-5)
         with _pytest.raises(ValueError):
             softmax_ce_per_example(logits, labels, impl='pallas')
+
+    def test_out_of_range_labels_clamp_on_both_paths(self):
+        """Labels outside [0, V) are clamped identically on the dense
+        and pallas paths (unclamped, take_along_axis wraps negatives
+        and NaN-fills >= V while the kernel contributes 0)."""
+        import jax.numpy as jnp
+        import numpy as np
+        from mlcomp_tpu.ops.fused_ce import softmax_ce_per_example
+        logits, _ = self._case(n=16, v=128)
+        labels = jnp.asarray([-100, -1, 128, 500] * 4, jnp.int32)
+        dense = softmax_ce_per_example(logits, labels, impl='dense')
+        pallas = softmax_ce_per_example(logits, labels, block_n=8,
+                                        block_v=128, impl='pallas',
+                                        interpret=True)
+        assert np.isfinite(np.asarray(dense)).all()
+        np.testing.assert_allclose(np.asarray(pallas),
+                                   np.asarray(dense),
+                                   atol=1e-5, rtol=1e-5)
