@@ -228,12 +228,25 @@ class TransformerLM(nn.Module):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
 
-        embed = nn.Embed(
-            cfg.vocab_size, cfg.d_model, dtype=dtype,
-            embedding_init=nn.with_logical_partitioning(
+        table = self.param(
+            'embed', nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.02), ('vocab', 'embed')),
-            name='embed')
-        x = embed(tokens)
+            (cfg.vocab_size, cfg.d_model))
+        if self.mesh is not None \
+                and self.mesh.shape.get('fsdp', 1) > 1:
+            # one-hot matmul decode (the t5x/maxtext TPU idiom): with the
+            # table fsdp-sharded on 'embed', a gather's backward is a
+            # scatter-add whose batch-sharded cotangent XLA can only
+            # reshard to the table's spec by involuntary full
+            # rematerialization (replicate-then-repartition every step,
+            # spmd_partitioner.cc warning). As matmuls, both directions
+            # partition like any dot: all-gather the table shard forward,
+            # psum the gradient backward — and the one-hot contraction
+            # rides the MXU
+            one_hot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=dtype)
+            x = one_hot @ table.astype(dtype)
+        else:
+            x = jnp.take(table, tokens, axis=0).astype(dtype)
         pos = self.param(
             'pos_embed',
             nn.with_logical_partitioning(

@@ -179,9 +179,10 @@ def softmax_ce_per_example(logits, labels, block_n: int = 256,
     module docstring), 'pallas' (the kernel; tests pass it with
     interpret=True), or 'dense'.
 
-    Labels outside [0, V) are clamped on both paths (matching
-    ``jnp.take_along_axis``'s in-jit clamp semantics); there is no
-    ignore-index convention — mask such rows in the caller's loss
+    Labels outside [0, V) are clamped to the nearest valid index on
+    both paths (unclamped they would diverge three ways: take_along_axis
+    wraps negatives and NaN-fills >= V, the kernel contributes 0); there
+    is no ignore-index convention — mask such rows in the caller's loss
     weighting instead."""
     n, v = logits.shape
     bn = _fit(n, block_n, 8)

@@ -125,7 +125,11 @@ class Storage:
             if hashs else [None] * len(uploads)
         for (rel, full), probe, sig in zip(uploads, digests, sigs):
             # a dedup hit is only trusted if the file is provably the one
-            # the probe pass hashed (same size+mtime now)
+            # the probe pass hashed (same size+mtime now). If a same-size
+            # rewrite slips inside one mtime tick, this links the blob the
+            # probe actually read — an internally consistent snapshot a
+            # few ms stale, not a digest/content mismatch (uploading a
+            # mutating tree is inherently a racy snapshot)
             if probe is not None and probe in hashs \
                     and sig is not None and _sig(full) == sig:
                 file_id = hashs[probe]

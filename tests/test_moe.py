@@ -116,3 +116,56 @@ class TestExpertParallel:
 
         np.testing.assert_allclose(run({'dp': 2, 'ep': 4}),
                                    run({'dp': 8}), rtol=2e-4)
+
+    def test_fsdp_ep_step_shardings_consistent(self):
+        """The jitted train step's expected input shardings equal the
+        state's actual placements on an fsdp+ep mesh, and compiling it
+        emits no SPMD involuntary-rematerialization fallback (the
+        round-2 dryrun regression: the fsdp-sharded embedding table's
+        scatter-add backward — fixed by one-hot-matmul decode)."""
+        import io
+        import logging
+        import jax
+        from mlcomp_tpu.parallel import mesh_from_spec
+        from mlcomp_tpu.train import (
+            create_train_state, loss_for_task, make_optimizer,
+            make_train_step, place_batch,
+        )
+        mesh = mesh_from_spec({'dp': 2, 'fsdp': 2, 'ep': 2})
+        model = _model(n_experts=2, mesh=mesh)
+        opt, _ = make_optimizer({'name': 'adamw', 'lr': 1e-3}, 10)
+        tokens = np.random.RandomState(0).randint(
+            0, 128, (8, 32)).astype(np.int32)
+        state = create_train_state(model, opt, tokens,
+                                   jax.random.PRNGKey(0), mesh=mesh,
+                                   with_dropout_rng=True)
+        step = make_train_step(model, opt, loss_for_task('lm_ce'),
+                               mesh=mesh, self_supervised=True)
+        x, _ = place_batch((tokens, None), mesh)
+
+        # XLA logs the spmd_partitioner fallback through absl/C++ stderr;
+        # capture it at the fd level around the compile
+        import os
+        import tempfile
+        stderr_fd = os.dup(2)
+        with tempfile.TemporaryFile() as cap:
+            os.dup2(cap.fileno(), 2)
+            try:
+                compiled = step.lower(state, x, None).compile()
+            finally:
+                os.dup2(stderr_fd, 2)
+                os.close(stderr_fd)
+            cap.seek(0)
+            err = cap.read().decode(errors='replace')
+        assert 'Involuntary full rematerialization' not in err, err
+
+        expected = jax.tree_util.tree_flatten(
+            compiled.input_shardings[0])[0]
+        actual = jax.tree_util.tree_flatten_with_path((state, x, None))[0]
+        assert len(expected) == len(actual)
+        mismatches = []
+        for (path, leaf), exp in zip(actual, expected):
+            if not leaf.sharding.is_equivalent_to(exp, leaf.ndim):
+                mismatches.append((jax.tree_util.keystr(path),
+                                   leaf.sharding, exp))
+        assert not mismatches, mismatches
