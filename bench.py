@@ -20,7 +20,15 @@ and MFU is computed from XLA's own cost analysis of the compiled step.
 
 Real CIFAR-10 is used when an npz is present (DATA_FOLDER/cifar10.npz or
 $CIFAR10_NPZ); otherwise a synthetic set with identical shapes runs the
-same code path (zero-egress environment).
+same code path (zero-egress environment). On any data-equipped machine
+the one-command flow is::
+
+    python scripts/cifar10_to_npz.py /path/to/cifar-10-python.tar.gz
+    python bench.py                       # -> "real_cifar10": true
+
+and the 94%-accuracy north-star run is
+``python -m mlcomp_tpu execute examples/cifar10/config.yml`` (the DAG's
+valid task writes the accuracy to task.score).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """

@@ -243,7 +243,11 @@ class TransformerLM(nn.Module):
             # partition like any dot: all-gather the table shard forward,
             # psum the gradient backward — and the one-hot contraction
             # rides the MXU
-            one_hot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=dtype)
+            # clamp first: out-of-range ids would one-hot to all-zero
+            # rows here but clamp to an edge row on the gather path —
+            # keep both branches numerically identical
+            safe = jnp.clip(tokens, 0, cfg.vocab_size - 1)
+            one_hot = jax.nn.one_hot(safe, cfg.vocab_size, dtype=dtype)
             x = one_hot @ table.astype(dtype)
         else:
             x = jnp.take(table, tokens, axis=0).astype(dtype)

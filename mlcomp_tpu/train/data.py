@@ -31,12 +31,32 @@ def register_dataset(name: str):
     return deco
 
 
+#: spec keys that name files/folders and get the data/ fallback below
+_PATH_KEYS = ('path', 'fold_path', 'fold_csv', 'img_folder',
+              'mask_folder')
+
+
+def resolve_data_paths(spec: Dict) -> Dict:
+    """Resolve bare relative filenames in a dataset spec against the
+    ``data/`` symlink executors get in their task folder (one place for
+    every loader, instead of per-dataset fallbacks)."""
+    out = dict(spec)
+    for key in _PATH_KEYS:
+        v = out.get(key)
+        if v and isinstance(v, str) and not os.path.isabs(v) \
+                and not os.path.exists(v):
+            candidate = os.path.join('data', v)
+            if os.path.exists(candidate):
+                out[key] = candidate
+    return out
+
+
 def create_dataset(name: str, **kwargs) -> Dict[str, np.ndarray]:
     key = name.lower()
     if key not in _DATASETS:
         raise KeyError(
             f'unknown dataset {name!r}; registered: {sorted(_DATASETS)}')
-    return _DATASETS[key](**kwargs)
+    return _DATASETS[key](**resolve_data_paths(kwargs))
 
 
 # --------------------------------------------------------------- builtins
@@ -60,6 +80,42 @@ def _npz(path: str, fold_path: Optional[str] = None, fold: int = 0,
         mask[int(n * 0.8):] = True
     return {'x_train': x[~mask], 'y_train': y[~mask],
             'x_valid': x[mask], 'y_valid': y[mask]}
+
+
+@register_dataset('digits')
+def _digits(fold_csv: Optional[str] = None, fold_number: int = 0,
+            valid_fraction: float = 0.2, seed: int = 0, **_):
+    """REAL images: sklearn's handwritten digits (1,797 8x8 grayscale
+    scans, the classic UCI set) — the offline stand-in for the
+    reference's digit-recognizer example
+    (reference examples/digit-recognizer/Readme.md) in a zero-egress
+    build image. Pixels scale from [0,16] to [0,1]; output is NHWC
+    [N,8,8,1] so the same conv/mlp models run unchanged.
+
+    ``fold_csv``/``fold_number`` consume a Split-executor fold file
+    (rows aligned with load_digits order, fold==k is validation);
+    without one, a seeded random ``valid_fraction`` split applies.
+    """
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    x = (d.images.astype(np.float32) / 16.0)[..., None]
+    y = d.target.astype(np.int32)
+    if fold_csv:
+        import pandas as pd
+        path = fold_csv          # create_dataset resolved data/ already
+        folds = pd.read_csv(path)['fold'].to_numpy()
+        if len(folds) != len(y):
+            raise ValueError(
+                f'fold_csv {path!r} has {len(folds)} rows; expected '
+                f'{len(y)} (load_digits order)')
+        mask = folds == int(fold_number)
+    else:
+        rng = np.random.RandomState(seed)
+        mask = np.zeros(len(y), bool)
+        mask[rng.permutation(len(y))[:int(len(y) * valid_fraction)]] = True
+    return {'x_train': x[~mask], 'y_train': y[~mask],
+            'x_valid': x[mask], 'y_valid': y[mask],
+            'source': 'sklearn.load_digits'}
 
 
 @register_dataset('synthetic_images')
@@ -219,5 +275,6 @@ def place_batch(batch, mesh, seq_dim: Optional[int] = None):
     return x, y
 
 
-__all__ = ['register_dataset', 'create_dataset', 'iterate_batches',
+__all__ = ['register_dataset', 'create_dataset', 'resolve_data_paths',
+           'iterate_batches',
            'prefetch_batches', 'place_batch']

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Convert the standard CIFAR-10 python distribution to the framework's
+npz contract (mlcomp_tpu/train/data.py 'cifar10' dataset: x_train
+[50000,32,32,3] uint8, y_train [50000], x_test, y_test).
+
+One-command flow on any data-equipped machine::
+
+    python scripts/cifar10_to_npz.py /path/to/cifar-10-python.tar.gz
+    # or an extracted cifar-10-batches-py/ directory
+    python bench.py            # now reports "real_cifar10": true
+
+The output lands at ``$MLCOMP_TPU_ROOT/data/cifar10.npz`` (the default
+probe location) unless ``--out`` says otherwise; ``$CIFAR10_NPZ`` and a
+``dataset: {path: ...}`` spec are also honored by the loader. The source
+archive is the canonical ``cifar-10-python.tar.gz``
+(https://www.cs.toronto.edu/~kriz/cifar.html, md5
+c58f30108f718f92721af3b95e74349a) — this build image has no egress, so
+fetch it on a connected machine and copy it in.
+"""
+
+import argparse
+import os
+import pickle
+import sys
+import tarfile
+
+import numpy as np
+
+TRAIN_BATCHES = [f'data_batch_{i}' for i in range(1, 6)]
+TEST_BATCH = 'test_batch'
+
+
+def _batch_arrays(raw: dict):
+    """One CIFAR batch dict -> (x [N,32,32,3] uint8, y [N] int32)."""
+    data = raw[b'data'] if b'data' in raw else raw['data']
+    labels = raw.get(b'labels', raw.get('labels'))
+    x = np.asarray(data, np.uint8).reshape(-1, 3, 32, 32)
+    x = x.transpose(0, 2, 3, 1)          # CHW -> HWC (NHWC for TPU)
+    return x, np.asarray(labels, np.int32)
+
+
+def _load_pickle(fh):
+    return pickle.load(fh, encoding='bytes')
+
+
+def read_batches(source: str):
+    """Yield (name, batch_dict) from a tar.gz or an extracted folder."""
+    if os.path.isdir(source):
+        for name in TRAIN_BATCHES + [TEST_BATCH]:
+            path = os.path.join(source, name)
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f'{name} not found under {source} — expected an '
+                    f'extracted cifar-10-batches-py directory')
+            with open(path, 'rb') as fh:
+                yield name, _load_pickle(fh)
+        return
+    with tarfile.open(source, 'r:*') as tar:
+        members = {os.path.basename(m.name): m for m in tar.getmembers()
+                   if m.isfile()}
+        for name in TRAIN_BATCHES + [TEST_BATCH]:
+            if name not in members:
+                raise FileNotFoundError(
+                    f'{name} not found in {source} — is this '
+                    f'cifar-10-python.tar.gz?')
+            yield name, _load_pickle(tar.extractfile(members[name]))
+
+
+def convert(source: str, out: str,
+            expect=(50000, 10000)) -> dict:
+    xs, ys = [], []
+    x_test = y_test = None
+    for name, raw in read_batches(source):
+        x, y = _batch_arrays(raw)
+        if name == TEST_BATCH:
+            x_test, y_test = x, y
+        else:
+            xs.append(x)
+            ys.append(y)
+    x_train = np.concatenate(xs)
+    y_train = np.concatenate(ys)
+    if x_train.shape != (expect[0], 32, 32, 3) or x_test.shape != \
+            (expect[1], 32, 32, 3):
+        raise ValueError(
+            f'unexpected shapes {x_train.shape} / {x_test.shape} — '
+            f'corrupt source?')
+    os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+    np.savez_compressed(out, x_train=x_train, y_train=y_train,
+                        x_test=x_test, y_test=y_test)
+    return {'out': out, 'train': len(y_train), 'test': len(y_test),
+            'classes': int(np.unique(y_train).size)}
+
+
+def default_out() -> str:
+    root = os.environ.get('MLCOMP_TPU_ROOT',
+                          os.path.expanduser('~/mlcomp_tpu'))
+    return os.path.join(root, 'data', 'cifar10.npz')
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('source', help='cifar-10-python.tar.gz or extracted '
+                                   'cifar-10-batches-py/ directory')
+    ap.add_argument('--out', default=None,
+                    help=f'output npz (default: {default_out()})')
+    args = ap.parse_args(argv)
+    info = convert(args.source, args.out or default_out())
+    print(f"wrote {info['out']}: {info['train']} train / "
+          f"{info['test']} test images, {info['classes']} classes")
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

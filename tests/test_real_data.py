@@ -1,0 +1,157 @@
+"""The north star, falsifiable (VERDICT r2 next-#1): REAL images learned
+end-to-end through the DAG machinery — sklearn's handwritten-digit scans
+(the offline stand-in for the reference's digit-recognizer Kaggle
+example, reference examples/digit-recognizer/Readme.md), driven
+split -> jax_train -> infer_classify -> valid_classify to >=95% valid
+accuracy, scores landing on the task and Model rows."""
+
+import os
+
+import numpy as np
+import pytest
+
+EXAMPLE = os.path.join(os.path.dirname(__file__), '..', 'examples',
+                       'digits')
+
+
+class TestDigitsDataset:
+    def test_real_images(self):
+        from mlcomp_tpu.train.data import create_dataset
+        data = create_dataset('digits')
+        x = np.concatenate([data['x_train'], data['x_valid']])
+        y = np.concatenate([data['y_train'], data['y_valid']])
+        assert len(x) == 1797                      # the real UCI set
+        assert x.shape[1:] == (8, 8, 1)
+        assert set(np.unique(y)) == set(range(10))
+        assert 0.0 <= x.min() and x.max() <= 1.0
+        # real scans, not prototypes+noise: same-class samples differ
+        sevens = x[y == 7]
+        assert np.abs(sevens[0] - sevens[1]).max() > 0.1
+        assert data['source'] == 'sklearn.load_digits'
+
+    def test_fold_csv_split(self, tmp_path):
+        import pandas as pd
+        from mlcomp_tpu.train.data import create_dataset
+        folds = np.arange(1797) % 5
+        p = tmp_path / 'fold.csv'
+        pd.DataFrame({'fold': folds}).to_csv(p, index=False)
+        data = create_dataset('digits', fold_csv=str(p), fold_number=2)
+        assert len(data['x_valid']) == int((folds == 2).sum())
+        assert len(data['x_train']) == 1797 - len(data['x_valid'])
+
+    def test_fold_csv_row_mismatch_raises(self, tmp_path):
+        import pandas as pd
+        from mlcomp_tpu.train.data import create_dataset
+        p = tmp_path / 'fold.csv'
+        pd.DataFrame({'fold': [0, 1, 2]}).to_csv(p, index=False)
+        with pytest.raises(ValueError, match='expected 1797'):
+            create_dataset('digits', fold_csv=str(p))
+
+
+class TestRealDataLearning:
+    def test_digits_dag_to_95_percent(self, session):
+        """The full example DAG on real data: every task Success, valid
+        accuracy >= 0.95 written to task.score and model.score_local,
+        gallery ReportImg rows produced."""
+        from mlcomp_tpu.db.enums import TaskStatus
+        from mlcomp_tpu.db.providers import (
+            ModelProvider, ReportImgProvider, TaskProvider,
+        )
+        from mlcomp_tpu.server.create_dags.standard import dag_standard
+        from mlcomp_tpu.utils.io import yaml_load
+        from mlcomp_tpu.worker.tasks import execute_by_id
+
+        config = yaml_load(file=os.path.join(EXAMPLE, 'config.yml'))
+        dag, tasks = dag_standard(session, config, upload_folder=EXAMPLE)
+        tp = TaskProvider(session)
+        for name in ('prepare', 'split', 'train', 'infer', 'valid'):
+            for tid in tasks[name]:
+                execute_by_id(tid, exit=False, session=session)
+                assert tp.by_id(tid).status == int(TaskStatus.Success), \
+                    f'task {name} did not succeed'
+
+        valid_task = tp.by_id(tasks['valid'][0])
+        assert valid_task.score is not None
+        assert valid_task.score >= 0.95, (
+            f'real-data valid accuracy {valid_task.score:.4f} < 0.95')
+
+        model = ModelProvider(session).by_name('digits_mlp')
+        assert model is not None
+        assert model.score_local >= 0.95
+
+        train_task = tp.by_id(tasks['train'][0])
+        imgs = ReportImgProvider(session).get({'task': train_task.id})
+        assert imgs['total'] > 0, 'no gallery ReportImg rows from training'
+
+
+class TestCifar10Converter:
+    """scripts/cifar10_to_npz.py: standard CIFAR python pickles ->
+    the train/data.py 'cifar10' npz contract."""
+
+    def _fake_cifar(self, root, n_per_batch=4):
+        import pickle
+        rng = np.random.RandomState(0)
+        folder = os.path.join(root, 'cifar-10-batches-py')
+        os.makedirs(folder, exist_ok=True)
+        truth = {}
+        for name in [f'data_batch_{i}' for i in range(1, 6)] + \
+                ['test_batch']:
+            data = rng.randint(0, 256, (n_per_batch, 3072), dtype=np.uint8)
+            labels = rng.randint(0, 10, n_per_batch).tolist()
+            truth[name] = (data, labels)
+            with open(os.path.join(folder, name), 'wb') as fh:
+                pickle.dump({b'data': data, b'labels': labels}, fh)
+        return folder, truth
+
+    def test_folder_and_tar_roundtrip(self, tmp_path):
+        import sys
+        import tarfile
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..',
+                                        'scripts'))
+        import cifar10_to_npz as conv
+        folder, truth = self._fake_cifar(str(tmp_path))
+        out = str(tmp_path / 'cifar10.npz')
+        info = conv.convert(folder, out, expect=(20, 4))
+        assert info['train'] == 20 and info['test'] == 4
+        data = np.load(out)
+        assert data['x_train'].shape == (20, 32, 32, 3)
+        assert data['x_train'].dtype == np.uint8
+        # pixel fidelity: CHW->HWC transpose of batch 1 row 0
+        want = truth['data_batch_1'][0][0].reshape(3, 32, 32)
+        np.testing.assert_array_equal(data['x_train'][0],
+                                      want.transpose(1, 2, 0))
+        # tar path produces identical output
+        tar = str(tmp_path / 'cifar-10-python.tar.gz')
+        with tarfile.open(tar, 'w:gz') as t:
+            t.add(folder, arcname='cifar-10-batches-py')
+        out2 = str(tmp_path / 'cifar10_tar.npz')
+        conv.convert(tar, out2, expect=(20, 4))
+        data2 = np.load(out2)
+        np.testing.assert_array_equal(data['x_train'], data2['x_train'])
+        np.testing.assert_array_equal(data['y_test'], data2['y_test'])
+
+    def test_loader_consumes_converter_output(self, tmp_path):
+        """The npz feeds the 'cifar10' dataset loader (real path)."""
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..',
+                                        'scripts'))
+        import cifar10_to_npz as conv
+        from mlcomp_tpu.train.data import create_dataset
+        folder, _ = self._fake_cifar(str(tmp_path))
+        out = str(tmp_path / 'cifar10.npz')
+        conv.convert(folder, out, expect=(20, 4))
+        data = create_dataset('cifar10', path=out)
+        assert data['source'] == out
+        assert data['x_train'].shape == (20, 32, 32, 3)
+        assert data['x_train'].dtype == np.float32
+        assert data['x_train'].max() <= 1.0
+
+    def test_missing_batch_raises(self, tmp_path):
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..',
+                                        'scripts'))
+        import cifar10_to_npz as conv
+        folder, _ = self._fake_cifar(str(tmp_path))
+        os.remove(os.path.join(folder, 'data_batch_3'))
+        with pytest.raises(FileNotFoundError, match='data_batch_3'):
+            conv.convert(folder, str(tmp_path / 'o.npz'), expect=(16, 4))
