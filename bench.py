@@ -51,6 +51,114 @@ def _step_flops(train_step, state, x, y):
         return None
 
 
+def bench_lm(peak_tflops: float) -> dict:
+    """Flagship transformer_lm: long-context training step with the
+    Pallas flash-attention kernel (fwd+bwd, ops/flash_attention.py) vs
+    the dense-XLA attention, tokens/sec + MFU at T=8192 bf16.
+
+    MFU uses the same analytic accounting for both paths (6P + 6*L*T*d
+    FLOPs per token: the PaLM convention with the causal half applied
+    to the attention term) so the flash/dense ratio is apples-to-apples
+    — XLA's cost analysis cannot see inside the Pallas custom call.
+    """
+    import jax
+    import numpy as np
+
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.models.base import param_count
+    from mlcomp_tpu.parallel import mesh_from_spec
+    from mlcomp_tpu.train import (
+        create_train_state, loss_for_task, make_optimizer,
+        make_train_step,
+    )
+    from mlcomp_tpu.train.data import place_batch
+
+    seq_len = int(os.environ.get('BENCH_LM_SEQ', '8192'))
+    d_model = int(os.environ.get('BENCH_LM_DMODEL', '1024'))
+    n_layers = int(os.environ.get('BENCH_LM_LAYERS', '8'))
+    steps = int(os.environ.get('BENCH_LM_STEPS', '10'))
+    vocab = 32768
+    warmup = 3
+
+    mesh = mesh_from_spec({'dp': -1})
+    n_devices = len(mesh.devices.flat)
+    batch = n_devices
+    tokens = np.random.RandomState(0).randint(
+        0, vocab, (batch, seq_len)).astype(np.int32)
+    optimizer, _ = make_optimizer({'name': 'adamw', 'lr': 3e-4}, 1000)
+    loss_fn = loss_for_task('lm_ce')
+
+    def measure(attn_impl, remat=False):
+        model = create_model(
+            'transformer_lm', mesh=mesh, vocab_size=vocab,
+            d_model=d_model, n_layers=n_layers, n_heads=d_model // 64,
+            d_ff=4 * d_model, max_seq_len=seq_len, dtype='bfloat16',
+            attn_impl=attn_impl, remat=remat)
+        state = create_train_state(
+            model, optimizer, tokens, jax.random.PRNGKey(0), mesh=mesh)
+        n_params = param_count(state.params)
+        step = make_train_step(model, optimizer, loss_fn, mesh=mesh,
+                               self_supervised=True)
+        x, _ = place_batch((tokens, None), mesh)
+        for _ in range(warmup):
+            state, metrics = step(state, x, None)
+        float(metrics['loss'])        # value fetch = real barrier
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, x, None)
+        float(metrics['loss'])
+        dt = time.perf_counter() - t0
+        tok_s = batch * seq_len * steps / dt
+        flops_per_token = 6 * n_params + 6 * n_layers * seq_len * d_model
+        mfu = (tok_s * flops_per_token /
+               (peak_tflops * 1e12 * n_devices))
+        return tok_s, mfu, n_params
+
+    # 'pallas' (not 'auto') so a silent fall-back to dense can never be
+    # mislabeled a flash measurement — untileable shapes fail loudly
+    # (BENCH_LM_FLASH_IMPL=interpret lets CPU smoke-runs exercise this)
+    flash_impl = os.environ.get('BENCH_LM_FLASH_IMPL', 'pallas')
+    flash_tok_s, flash_mfu, n_params = measure(flash_impl)
+    result = {
+        'lm_tokens_per_sec': round(flash_tok_s, 1),
+        'lm_mfu': round(flash_mfu, 4),
+        'lm_config': f'{n_params / 1e6:.0f}M params, T={seq_len}, '
+                     f'bf16, flash attention fwd+bwd',
+    }
+
+    # dense baseline. Plain dense materializes [B,H,T,T] attention —
+    # at the flagship config that alone is ~2 GB bf16 fwd + several
+    # f32 copies in bwd and the whole graph needs ~33 GB on a 16 GB
+    # chip. Skip the doomed plain compile when the estimate cannot fit
+    # and go straight to dense+remat (the thing one would actually run
+    # without the kernel); flash numbers above survive any dense
+    # failure.
+    try:
+        hbm = jax.devices()[0].memory_stats()['bytes_limit']
+    except Exception:
+        hbm = 16e9
+    attn_bytes = batch * (d_model // 64) * seq_len * seq_len * 2
+    dense_mode = 'plain'
+    try:
+        if 8 * attn_bytes > hbm:     # fwd+bwd copies, f32 upcasts
+            raise MemoryError('plain dense cannot fit')
+        dense_tok_s, dense_mfu, _ = measure('dense')
+    except Exception:
+        dense_mode = 'remat'
+        try:
+            dense_tok_s, dense_mfu, _ = measure('dense', remat=True)
+        except Exception as e:
+            result['lm_dense_error'] = f'{type(e).__name__}: {e}'[:200]
+            return result
+    result.update({
+        'lm_dense_tokens_per_sec': round(dense_tok_s, 1),
+        'lm_dense_mfu': round(dense_mfu, 4),
+        'lm_dense_mode': dense_mode,
+        'lm_flash_speedup': round(flash_tok_s / dense_tok_s, 3),
+    })
+    return result
+
+
 def main():
     import jax
     import numpy as np
@@ -180,7 +288,7 @@ def main():
         pass
     vs_baseline = (epoch_ips / baseline) if baseline else 1.0
 
-    print(json.dumps({
+    result = {
         'metric': 'cifar10_resnet18_epoch_throughput',
         'value': round(epoch_ips, 1),
         'unit': f'images/sec ({n_devices} device(s), bf16, '
@@ -192,7 +300,25 @@ def main():
         'mfu': round(mfu, 4) if mfu is not None else None,
         'mfu_peak_tflops_assumed': peak_tflops,
         'real_cifar10': data.get('source') != 'synthetic',
-    }))
+    }
+
+    # second workload: the flagship long-context LM (skippable, and
+    # skipped automatically on CPU where a T=8192 dense step is
+    # impractical — the driver's bench runs on the real chip)
+    want_lm = os.environ.get('BENCH_LM')
+    run_lm = (jax.default_backend() != 'cpu') if want_lm is None \
+        else want_lm == '1'
+    if run_lm:
+        # free the CIFAR workload's device buffers (dataset, state,
+        # donated-step aliases) so the LM model compiles/runs against a
+        # clean HBM
+        del state, x_all, y_all, x, y, run_epoch
+        try:
+            result.update(bench_lm(peak_tflops))
+        except Exception as e:     # never lose the primary metric
+            result['lm_error'] = f'{type(e).__name__}: {e}'[:300]
+
+    print(json.dumps(result))
 
 
 if __name__ == '__main__':
