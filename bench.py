@@ -137,7 +137,9 @@ def bench_lm(peak_tflops: float) -> dict:
         hbm = jax.devices()[0].memory_stats()['bytes_limit']
     except Exception:
         hbm = 16e9
-    attn_bytes = batch * (d_model // 64) * seq_len * seq_len * 2
+    # per-DEVICE bytes: the batch is dp-sharded across n_devices
+    attn_bytes = (batch // n_devices) * (d_model // 64) \
+        * seq_len * seq_len * 2
     dense_mode = 'plain'
     try:
         if 8 * attn_bytes > hbm:     # fwd+bwd copies, f32 upcasts

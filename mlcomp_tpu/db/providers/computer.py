@@ -52,14 +52,21 @@ class ComputerProvider(BaseDataProvider):
         self.add(ComputerUsage(
             computer=name, usage=json.dumps(usage), time=time or now()))
 
-    def usage_history(self, computer: str, min_time=None):
+    def usage_history(self, computer: str, min_time=None, limit=None):
         sql = 'SELECT * FROM computer_usage WHERE computer=?'
         params = [computer]
         if min_time:
             sql += ' AND time>=?'
             params.append(min_time)
-        sql += ' ORDER BY time'
-        rows = self.session.query(sql, params)
+        if limit:
+            # newest N only — dashboards poll this; loading the whole
+            # history to slice the tail pins the server on big tables
+            sql += ' ORDER BY time DESC LIMIT ?'
+            params.append(int(limit))
+            rows = list(reversed(self.session.query(sql, params)))
+        else:
+            sql += ' ORDER BY time'
+            rows = self.session.query(sql, params)
         mean = []
         for r in rows:
             try:

@@ -83,7 +83,41 @@ class ReportLayoutProvider(BaseDataProvider):
                 merged['metric'] = data['metric']
         return merged
 
+    @staticmethod
+    def check_layout(content: str) -> dict:
+        """Validate layout yaml structure (reference
+        db/report_info/info.py:28-75 ``_check_layout``): a mapping with
+        optional ``items`` (name -> {type, ...}), ``layout`` (list of
+        panels with ``type``), ``metric`` and ``extend``."""
+        data = yaml_load(content)
+        if not isinstance(data, dict):
+            raise ValueError('layout must be a yaml mapping')
+        unknown = set(data) - {'items', 'layout', 'metric', 'extend'}
+        if unknown:
+            raise ValueError(f'unknown layout keys: {sorted(unknown)}')
+        items = data.get('items') or {}
+        if not isinstance(items, dict):
+            raise ValueError('items must be a mapping')
+        for name, spec in items.items():
+            if not isinstance(spec, dict) or 'type' not in spec:
+                raise ValueError(f'item {name!r} needs a type')
+        panels = data.get('layout') or []
+        if not isinstance(panels, list):
+            raise ValueError('layout must be a list of panels')
+        for panel in panels:
+            if not isinstance(panel, dict) or 'type' not in panel:
+                raise ValueError('every layout entry needs a type')
+            for item in panel.get('items') or []:
+                # an item may carry its own type OR reference a typed
+                # entry in items{} via source (the renderer supports both)
+                if not isinstance(item, dict) or \
+                        ('type' not in item and 'source' not in item):
+                    raise ValueError(
+                        'every panel item needs a type or source')
+        return data
+
     def add_layout(self, name: str, content: str):
+        self.check_layout(content)
         self.add(ReportLayout(
             name=name, content=content, last_modified=now()))
 
@@ -91,7 +125,7 @@ class ReportLayoutProvider(BaseDataProvider):
         layout = self.by_name(name)
         if layout is None:
             return False
-        yaml_load(content)  # validate
+        self.check_layout(content)
         layout.content = content
         layout.last_modified = now()
         if new_name:
@@ -184,6 +218,10 @@ class ReportImgProvider(BaseDataProvider):
             if filter.get(key) is not None:
                 where.append(f'"{key}"=?')
                 params.append(filter[key])
+        if filter.get('tasks'):
+            tasks = list(filter['tasks'])
+            where.append(f'task IN ({",".join("?" * len(tasks))})')
+            params += tasks
         if filter.get('group'):
             where.append('"group"=?')
             params.append(filter['group'])
@@ -226,6 +264,10 @@ class ReportImgProvider(BaseDataProvider):
             if filter.get(key) is not None:
                 where.append(f'"{key}"=?')
                 params.append(filter[key])
+        if filter.get('tasks'):
+            tasks = list(filter['tasks'])
+            where.append(f'task IN ({",".join("?" * len(tasks))})')
+            params += tasks
         if filter.get('group'):
             where.append('"group"=?')
             params.append(filter['group'])

@@ -93,7 +93,16 @@ def api_token(data, s):
 
 
 def api_computers(data, s):
-    return ComputerProvider(s).get(data, _paginator(data))
+    provider = ComputerProvider(s)
+    res = provider.get(data, _paginator(data))
+    if data.get('usage_history'):
+        # per-computer resource history for the UI's sparkline charts
+        # (reference db/providers/computer.py:25-99)
+        n = int(data.get('usage_history_count', 120))
+        for item in res['data']:
+            item['usage_history'] = provider.usage_history(
+                item['name'], limit=n)['mean']
+    return res
 
 
 def api_projects(data, s):
@@ -196,7 +205,9 @@ def api_model_add(data, s):
     except ImportError:
         raise ApiError('model ops not available in this build', status=501)
     dag = dag_model_add(s, data)
-    return {'success': True, 'dag': dag.id}
+    # task-less calls register the Model row only — no ModelAdd dag
+    return {'success': True,
+            'dag': dag.id if dag is not None else None}
 
 
 def api_model_start_end(data, s):

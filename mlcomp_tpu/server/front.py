@@ -1,19 +1,31 @@
-"""Built-in single-file HTML dashboard — the UI stand-in.
+"""Built-in single-file HTML dashboard — the UI.
 
 The reference ships a ~9k-line Angular 7 SPA (reference
 mlcomp/server/front/: paginated tables for projects/computers/dags/tasks/
 models/logs/reports, a vis.js DAG graph, plotly metric series, a code
-browser, resource dashboards). Rebuilding Angular is out of scope and
-off-idiom here; instead the server serves one dependency-free HTML page
-(vanilla JS + inline SVG) covering the same read paths and the main
-actions:
+browser, image galleries, a report-layout system, resource dashboards,
+model dialogs). Rebuilding Angular is out of scope and off-idiom here;
+instead the server serves one dependency-free HTML page (vanilla JS +
+inline SVG) covering the same surfaces:
 
-- tabs: Dags / Tasks / Computers / Models / Logs / Reports / Supervisor
-  (reference app-routing.module.ts:13-62)
-- DAG detail: layered SVG graph with per-status colors (vis.js parity,
-  front/src/app/dag/dag-detail/graph/), config viewer, code browser
+- tabs: Projects / Dags / Tasks / Computers / Models / Logs / Reports /
+  Layouts / Supervisor (reference app-routing.module.ts:13-62)
+- paginated + filtered tables everywhere the providers paginate
+- projects CRUD (reference front/src/app/project/)
+- DAG detail: layered SVG graph with per-status colors, config viewer,
+  code browser, code zip download
 - task detail: step tree + logs (front/src/app/task/)
-- report detail: metric series as SVG line charts (plotly parity)
+- report detail: LAYOUT-DRIVEN rendering (reference
+  db/report_info/info.py:28-129 consumed by the SPA's report renderer):
+  panels of metric series, img_classify gallery with confusion-matrix
+  cell filtering + y/y_pred selects, img_segment gallery; per-report
+  layout switcher (update_layout_start/end)
+- layout editor tab: textarea CRUD over report_layout rows
+  (reference app.py:234-251)
+- model dialogs: add-from-task, start-pipe with versioned equations
+  (reference front/src/app/model/)
+- computers: live usage + usage-history sparklines
+  (reference db/providers/computer.py:25-99)
 - actions: stop task, stop/start/remove dag (restart-with-resume)
 - token login stored in localStorage; auto-refresh every 5 s
 
@@ -30,9 +42,9 @@ _DASHBOARD = r"""<!doctype html>
 body { margin:0; background:var(--bg); color:var(--text);
   font:14px/1.45 system-ui,sans-serif; }
 header { display:flex; gap:4px; align-items:center; padding:8px 14px;
-  background:var(--panel); position:sticky; top:0; }
+  background:var(--panel); position:sticky; top:0; z-index:5; }
 header h1 { font-size:16px; margin:0 18px 0 0; color:var(--acc); }
-nav button { background:none; border:none; color:var(--dim); padding:6px 12px;
+nav button { background:none; border:none; color:var(--dim); padding:6px 10px;
   cursor:pointer; font:inherit; border-radius:6px; }
 nav button.active { background:var(--bg); color:var(--text); }
 main { padding:14px; }
@@ -60,35 +72,86 @@ pre { background:var(--panel); padding:12px; border-radius:8px;
 svg text { fill:var(--text); font-size:11px; }
 #login { max-width:320px; margin:18vh auto; background:var(--panel);
   padding:24px; border-radius:12px; }
-input { background:var(--bg); border:1px solid #30383b; color:var(--text);
-  padding:7px 10px; border-radius:6px; width:100%; font:inherit; }
-.charts { display:grid; grid-template-columns:repeat(auto-fill,minmax(380px,1fr));
-  gap:12px; }
+input,select,textarea { background:var(--bg); border:1px solid #30383b;
+  color:var(--text); padding:6px 10px; border-radius:6px; font:inherit; }
+input { width:100%; }
+.fl { width:auto; max-width:160px; margin-right:6px; }
+textarea { width:100%; min-height:300px; font:12px/1.4 monospace; }
+.charts { display:grid;
+  grid-template-columns:repeat(auto-fill,minmax(380px,1fr)); gap:12px; }
 .tree { margin-left:16px; }
 a { color:var(--acc); }
+.pager { display:flex; gap:8px; align-items:center; margin:8px 0; }
+.gallery { display:grid;
+  grid-template-columns:repeat(auto-fill,minmax(120px,1fr)); gap:8px; }
+.gallery figure { margin:0; background:var(--panel); border-radius:8px;
+  padding:6px; text-align:center; }
+.gallery img { max-width:100%; border-radius:4px; image-rendering:pixelated; }
+.gallery figcaption { font-size:11px; color:var(--dim); }
+.cm td { padding:2px 6px; text-align:right; cursor:pointer;
+  border:1px solid #232c36; }
+.cm td.diag { color:var(--ok); }
+.cm td.hot { color:var(--bad); }
+.cm th { padding:2px 6px; text-align:right; }
+.panel { margin-bottom:14px; }
+.panel > h3 { cursor:pointer; user-select:none; }
+dialog { background:var(--panel); color:var(--text); border:1px solid
+  #303b46; border-radius:10px; min-width:420px; }
+dialog::backdrop { background:#000a; }
+.formrow { margin:8px 0; }
+.formrow label { display:block; color:var(--dim); font-size:12px; }
 </style></head><body>
 <header><h1>mlcomp_tpu</h1><nav id="nav"></nav>
  <span style="flex:1"></span><span id="clock" class="dim"></span></header>
 <main id="main"></main>
+<dialog id="dlg"></dialog>
 <script>
 'use strict';
-const TABS = ['dags','tasks','computers','models','logs','reports','supervisor'];
+const TABS = ['projects','dags','tasks','computers','models','logs',
+  'reports','layouts','supervisor'];
 let tab = location.hash.replace('#','') || 'dags';
+if (!TABS.includes(tab)) tab = 'dags';
 let detail = null;          // {kind:'dag'|'task'|'report', id}
 let token = localStorage.getItem('token') || '';
+const PAGE = 25;
+const pg = {};              // per-key page number
+const flt = {};             // per-key filter object
+const galleryState = {};    // per-gallery {page, y, y_pred, part}
 
 async function api(path, data) {
+  data = data || {};
+  if (!data.paginator)
+    data.paginator = {page_number:0, page_size:100};
   const r = await fetch('/api/' + path, {method:'POST',
     headers:{'Content-Type':'application/json','Authorization':token},
-    body: JSON.stringify(data || {paginator:{page_number:0,page_size:100}})});
+    body: JSON.stringify(data)});
   if (r.status === 401) { token=''; render(); throw new Error('auth'); }
   return r.json();
 }
 function h(html) { const t=document.createElement('template');
   t.innerHTML=html.trim(); return t.content; }
-function esc(s) { return String(s==null?'':s).replace(/[&<>"]/g,
-  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;'}[c])); }
+function esc(s) { return String(s==null?'':s).replace(/[&<>"']/g,
+  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',
+       "'":'&#39;'}[c])); }
 function badge(s) { return `<span class="status s-${s}">${s}</span>`; }
+function paginator(key) {
+  return {page_number: pg[key]||0, page_size: PAGE};
+}
+function pagerHtml(key, total) {
+  const p = pg[key]||0, pages = Math.max(1, Math.ceil(total/PAGE));
+  return `<div class="pager">
+    <button class="btn" ${p?'':'disabled'}
+      onclick="pg['${key}']=${p-1};render()">&larr;</button>
+    <span class="dim">page ${p+1}/${pages} &middot; ${total} rows</span>
+    <button class="btn" ${p+1<pages?'':'disabled'}
+      onclick="pg['${key}']=${p+1};render()">&rarr;</button></div>`;
+}
+function filterInput(key, field, placeholder) {
+  const v = (flt[key]||{})[field]||'';
+  return `<input class="fl" placeholder="${placeholder}" value="${esc(v)}"
+    onchange="(flt['${key}'] ||= {})['${field}']=this.value;
+              pg['${key}']=0;render()">`;
+}
 
 function nav() {
   document.getElementById('nav').innerHTML = TABS.map(t =>
@@ -98,9 +161,80 @@ function nav() {
 function go(t) { tab=t; detail=null; location.hash=t; render(); }
 function open_(kind,id) { detail={kind,id}; render(); }
 
+// --------------------------------------------------------------- dialogs
+function dialog(title, bodyHtml, onOk) {
+  const d = document.getElementById('dlg');
+  d.innerHTML = `<h3>${esc(title)}</h3>${bodyHtml}
+    <div style="margin-top:12px;text-align:right">
+    <button class="btn" onclick="dlgCancel()">cancel</button>
+    <button class="btn" id="dlgok">ok</button></div>`;
+  d.querySelector('#dlgok').onclick = async () => {
+    try { await onOk(d); d.close(); render(); }
+    catch (e) { alert(e.message||e); }
+  };
+  d.showModal();
+}
+function dlgCancel() { document.getElementById('dlg').close(); }
+function fval(d, id) { return d.querySelector('#'+id).value.trim(); }
+
 // ------------------------------------------------------------ tab views
+async function viewProjects(el) {
+  const res = await api('projects',
+    {...(flt.projects||{}), paginator: paginator('projects')});
+  el.appendChild(h(`<div class="pager">
+    ${filterInput('projects','name','name filter')}
+    <button class="btn" onclick="projectAdd()">+ project</button></div>`));
+  el.appendChild(h(`<table><tr><th>id</th><th>name</th><th>dags</th>
+    <th>task statuses</th><th>classes</th><th>last activity</th><th></th></tr>`
+    + res.data.map(p => `<tr>
+      <td>${p.id}</td><td>${esc(p.name)}</td><td>${p.dag_count}</td>
+      <td>${Object.entries(p.task_statuses||{}).map(([s,c]) =>
+          badge(statusName(+s))+'&times;'+c).join(' ')}</td>
+      <td class="dim">${esc((p.class_names||'').slice(0,40))}</td>
+      <td class="dim">${esc(p.last_activity||'')}</td>
+      <td><button class="btn" onclick="projectEdit(${p.id},
+          this.dataset.n)" data-n="${esc(p.name)}">edit</button>
+        <button class="btn" onclick="projectRemove(${p.id})">remove</button>
+      </td></tr>`).join('') + '</table>'));
+  el.appendChild(h(pagerHtml('projects', res.total)));
+}
+function projectAdd() {
+  dialog('add project', `
+    <div class="formrow"><label>name</label><input id="pname"></div>
+    <div class="formrow"><label>class names (yaml list, optional)</label>
+      <input id="pclasses" placeholder="[cat, dog]"></div>
+    <div class="formrow"><label>ignore folders (optional)</label>
+      <input id="pignore" placeholder="[data, models]"></div>`,
+    async d => {
+      const name = fval(d,'pname');
+      if (!name) throw new Error('name required');
+      await api('project/add', {name, class_names: fval(d,'pclasses'),
+        ignore_folders: fval(d,'pignore')});
+    });
+}
+function projectEdit(id, name) {
+  dialog('edit project '+id, `
+    <div class="formrow"><label>name</label>
+      <input id="pname" value="${esc(name)}"></div>
+    <div class="formrow"><label>class names (yaml, blank = keep)</label>
+      <input id="pclasses"></div>`,
+    async d => {
+      const payload = {id, name: fval(d,'pname')};
+      if (fval(d,'pclasses')) payload.class_names = fval(d,'pclasses');
+      await api('project/edit', payload);
+    });
+}
+async function projectRemove(id) {
+  if (!confirm('remove project '+id+'?')) return;
+  await api('project/remove',{id}); render();
+}
+
 async function viewDags(el) {
-  const res = await api('dags');
+  const res = await api('dags',
+    {...(flt.dags||{}), paginator: paginator('dags')});
+  el.appendChild(h(`<div class="pager">
+    ${filterInput('dags','name','name filter')}
+    ${filterInput('dags','project','project id')}</div>`));
   el.appendChild(h(`<table><tr><th>id</th><th>name</th><th>project</th>
     <th>tasks</th><th>statuses</th><th>created</th><th></th></tr>` +
     res.data.map(d => `<tr class="row" onclick="open_('dag',${d.id})">
@@ -116,6 +250,7 @@ async function viewDags(el) {
         <button class="btn" onclick="event.stopPropagation();
         dagAction(${d.id},'remove')">remove</button></td></tr>`).join('')
     + '</table>'));
+  el.appendChild(h(pagerHtml('dags', res.total)));
 }
 async function dagAction(id, action) {
   if (action==='remove' && !confirm('remove dag '+id+'?')) return;
@@ -123,8 +258,26 @@ async function dagAction(id, action) {
 }
 async function taskStop(id) { await api('task/stop',{id}); render(); }
 
+const STATUS = ['NotRan','Queued','InProgress','Failed','Stopped',
+  'Skipped','Success'];
+function statusName(v) { return typeof v==='number' ? STATUS[v] : v; }
+
 async function viewTasks(el) {
-  const res = await api('tasks');
+  const f = {...(flt.tasks||{})};
+  if (f.status !== undefined && f.status !== '')
+    f.status = [+f.status];
+  else delete f.status;
+  const res = await api('tasks', {...f, paginator: paginator('tasks')});
+  el.appendChild(h(`<div class="pager">
+    ${filterInput('tasks','name','name filter')}
+    ${filterInput('tasks','dag','dag id')}
+    <select class="fl" onchange="(flt.tasks ||= {}).status=this.value;
+        pg.tasks=0;render()">
+      <option value="">any status</option>
+      ${STATUS.map((s,i)=>`<option value="${i}"
+        ${(flt.tasks||{}).status==String(i)?'selected':''}>${s}</option>`)
+        .join('')}
+    </select></div>`));
   el.appendChild(h(`<table><tr><th>id</th><th>name</th><th>dag</th>
     <th>status</th><th>computer</th><th>step</th><th>score</th><th></th></tr>`
     + res.data.map(t => `<tr class="row" onclick="open_('task',${t.id})">
@@ -134,40 +287,128 @@ async function viewTasks(el) {
       <td class="dim">${esc(t.current_step||'')}</td>
       <td>${t.score==null?'':t.score.toFixed(4)}</td>
       <td><button class="btn" onclick="event.stopPropagation();
-        taskStop(${t.id})">stop</button></td></tr>`).join('')
+        taskStop(${t.id})">stop</button>
+        <button class="btn" onclick="event.stopPropagation();
+        modelAddDialog(${t.id})">model</button></td></tr>`).join('')
     + '</table>'));
+  el.appendChild(h(pagerHtml('tasks', res.total)));
 }
-const STATUS = ['NotRan','Queued','InProgress','Failed','Stopped',
-  'Skipped','Success'];
-function statusName(v) { return typeof v==='number' ? STATUS[v] : v; }
 
+function sparkline(points, key, w, hgt, color) {
+  const vals = points.map(p=>p[key]).filter(v=>v!=null);
+  if (vals.length < 2) return '';
+  // fixed 0..100% scale so the three series share an axis and the
+  // "(% of 100)" caption is true
+  const step = w/(vals.length-1);
+  const d = vals.map((v,i)=>(i?'L':'M')+(i*step).toFixed(1)+','
+    +(hgt-Math.min(v,100)/100*hgt).toFixed(1)).join(' ');
+  return `<path d="${d}" fill="none" stroke="${color}" stroke-width="1.2"/>`;
+}
 async function viewComputers(el) {
-  const res = await api('computers');
+  const res = await api('computers', {usage_history: true});
   el.appendChild(h('<div class="cards">' + res.data.map(c => {
     const u = c.usage || {};
+    const hist = c.usage_history || [];
+    const spark = hist.length < 2 ? '<span class="dim">no history</span>' :
+      `<svg width="260" height="40" style="margin-top:6px">
+        ${sparkline(hist,'cpu',260,40,'#4da3ff')}
+        ${sparkline(hist,'memory',260,40,'#41c07c')}
+        ${sparkline(hist,'tpu_hbm',260,40,'#d9a13c')}</svg>
+       <div class="dim" style="font-size:11px">
+         <span style="color:#4da3ff">cpu</span> &middot;
+         <span style="color:#41c07c">mem</span> &middot;
+         <span style="color:#d9a13c">hbm</span> (% of 100, last
+         ${hist.length} samples)</div>`;
     return `<div class="card"><h3>${esc(c.name)}</h3>
       <div class="dim">${c.cores||0} TPU cores &middot; ${c.cpu||0} cpu
        &middot; ${(c.memory||0).toFixed ? (c.memory||0).toFixed(1):c.memory} GB</div>
       <div>cpu ${u.cpu!=null?u.cpu.toFixed(0)+'%':'—'}
         &middot; mem ${u.memory!=null?u.memory.toFixed(0)+'%':'—'}
         &middot; hbm ${u.tpu_hbm!=null?u.tpu_hbm.toFixed(0)+'%':'—'}</div>
+      ${spark}
       <div class="dim">last activity: ${esc(c.last_activity||'')}</div>
       </div>`; }).join('') + '</div>'));
 }
 
 async function viewModels(el) {
-  const res = await api('models');
+  const res = await api('models',
+    {...(flt.models||{}), paginator: paginator('models')});
+  el.appendChild(h(`<div class="pager">
+    ${filterInput('models','name','name filter')}
+    <button class="btn" onclick="modelAddDialog()">+ model</button></div>`));
   el.appendChild(h(`<table><tr><th>id</th><th>name</th><th>project</th>
-    <th>score local</th><th>score public</th><th>created</th></tr>` +
+    <th>score local</th><th>score public</th><th>created</th><th></th></tr>` +
     res.data.map(m => `<tr><td>${m.id}</td><td>${esc(m.name)}</td>
       <td>${m.project}</td><td>${m.score_local==null?'':m.score_local}</td>
       <td>${m.score_public==null?'':m.score_public}</td>
-      <td class="dim">${esc(m.created||'')}</td></tr>`).join('')
-    + '</table>'));
+      <td class="dim">${esc(m.created||'')}</td>
+      <td><button class="btn" onclick="modelStartDialog(${m.id})">start</button>
+        <button class="btn" onclick="modelRemove(${m.id})">remove</button>
+      </td></tr>`).join('') + '</table>'));
+  el.appendChild(h(pagerHtml('models', res.total)));
+}
+function modelAddDialog(taskId) {
+  dialog('add model' + (taskId ? ' from task '+taskId : ''), `
+    <div class="formrow"><label>model name</label><input id="mname"></div>
+    <div class="formrow"><label>task id (blank = register name only)</label>
+      <input id="mtask" value="${taskId||''}"></div>
+    <div class="formrow"><label>project id (blank = task's project)</label>
+      <input id="mproject"></div>
+    <div class="formrow"><label>checkpoint file (blank = best)</label>
+      <input id="mfile"></div>`,
+    async d => {
+      const name = fval(d,'mname');
+      if (!name) throw new Error('name required');
+      const payload = {name};
+      if (fval(d,'mtask')) payload.task = +fval(d,'mtask');
+      if (fval(d,'mproject')) payload.project = +fval(d,'mproject');
+      if (fval(d,'mfile')) payload.file = fval(d,'mfile');
+      if (!payload.task && !payload.project)
+        throw new Error('task or project required');
+      await api('model/add', payload);
+    });
+}
+async function modelStartDialog(id) {
+  const info = await api('model/start_begin', {model_id: id});
+  if (!info.model) { alert('model not found'); return; }
+  const pipes = info.pipes||[], versions = info.versions||[];
+  dialog('start pipe for '+esc(info.model.name), `
+    <div class="formrow"><label>pipe</label>
+      <select id="spipe" style="width:100%">${pipes.map(p =>
+        `<option>${esc(p.name)}</option>`).join('')}</select>
+      ${pipes.length?'':'<span class="dim">no pipe dags in project</span>'}
+    </div>
+    <div class="formrow"><label>equations version</label>
+      <select id="sver" style="width:100%"
+        onchange="document.getElementById('seq').value=this.selectedIndex>=0
+          ? this.options[this.selectedIndex].dataset.eq : ''">
+        ${versions.map(v => `<option data-eq="${esc(v.equations)}">
+          ${esc(v.name)}</option>`).join('')}
+        <option data-eq="" ${versions.length?'':'selected'}>new</option>
+      </select></div>
+    <div class="formrow"><label>equations (yaml)</label>
+      <textarea id="seq" style="min-height:120px">${
+        esc(versions.length?versions[0].equations:'')}</textarea></div>`,
+    async d => {
+      if (!pipes.length) throw new Error('no pipes available');
+      await api('model/start_end', {
+        model_id: id, pipe: fval(d,'spipe'),
+        equations: d.querySelector('#seq').value});
+    });
+}
+async function modelRemove(id) {
+  if (!confirm('remove model '+id+'?')) return;
+  await api('model/remove',{id}); render();
 }
 
 async function viewLogs(el) {
-  const res = await api('logs');
+  const f = {...(flt.logs||{})};
+  if (f.task) f.task = +f.task; else delete f.task;
+  if (!f.message) delete f.message;
+  const res = await api('logs', {...f, paginator: paginator('logs')});
+  el.appendChild(h(`<div class="pager">
+    ${filterInput('logs','task','task id')}
+    ${filterInput('logs','message','message contains')}</div>`));
   el.appendChild(h(`<table><tr><th>time</th><th>level</th><th>component</th>
     <th>computer</th><th>task</th><th>message</th></tr>` +
     res.data.map(l => `<tr><td class="dim">${esc(l.time)}</td>
@@ -175,10 +416,14 @@ async function viewLogs(el) {
       <td>${esc(l.computer||'')}</td><td>${l.task||''}</td>
       <td><pre style="margin:0;max-height:120px">${esc(l.message)}</pre></td>
       </tr>`).join('') + '</table>'));
+  el.appendChild(h(pagerHtml('logs', res.total)));
 }
 
 async function viewReports(el) {
-  const res = await api('reports');
+  const res = await api('reports',
+    {paginator: paginator('reports')});
+  el.appendChild(h(`<div class="pager">
+    <button class="btn" onclick="reportAdd()">+ report</button></div>`));
   el.appendChild(h(`<table><tr><th>id</th><th>name</th><th>tasks</th>
     <th>layout</th><th>time</th></tr>` +
     res.data.map(r => `<tr class="row" onclick="open_('report',${r.id})">
@@ -186,11 +431,105 @@ async function viewReports(el) {
       <td>${esc(r.layout||'')}</td>
       <td class="dim">${esc(r.time||'')}</td></tr>`).join('')
     + '</table>'));
+  el.appendChild(h(pagerHtml('reports', res.total)));
+}
+
+async function reportAdd() {
+  const info = await api('report/add_start');
+  dialog('add report', `
+    <div class="formrow"><label>name</label><input id="rname"></div>
+    <div class="formrow"><label>project</label>
+      <select id="rproject" style="width:100%">${(info.projects||[]).map(p =>
+        `<option value="${p.id}">${esc(p.name)}</option>`).join('')}
+      </select></div>
+    <div class="formrow"><label>layout</label>
+      <select id="rlay" style="width:100%">${(info.layouts||[]).map(l =>
+        `<option>${esc(l)}</option>`).join('')}</select></div>`,
+    async d => {
+      const name = fval(d,'rname');
+      if (!name) throw new Error('name required');
+      await api('report/add_end', {name,
+        project: +fval(d,'rproject'), layout: fval(d,'rlay')});
+    });
+}
+
+let layoutNames = [];   // onclick handlers use indices, never raw names
+async function viewLayouts(el) {
+  const res = await api('layouts');
+  layoutNames = res.data.map(l => l.name);
+  const cur = flt._layoutSel;
+  el.appendChild(h(`<div class="pager">
+    <button class="btn" onclick="layoutAdd()">+ layout</button></div>`));
+  el.appendChild(h('<div style="display:flex;gap:14px">'
+    + '<table style="width:280px">'
+    + '<tr><th>name</th><th>modified</th><th></th></tr>'
+    + res.data.map((l,i) => `<tr class="row"
+        onclick="flt._layoutSel=layoutNames[${i}];render()">
+        <td>${l.name===cur?'<b>'+esc(l.name)+'</b>':esc(l.name)}</td>
+        <td class="dim">${esc(l.last_modified||'')}</td>
+        <td><button class="btn" onclick="event.stopPropagation();
+          layoutRemove(layoutNames[${i}])">x</button></td></tr>`).join('')
+    + '</table><div style="flex:1" id="layed"></div></div>'));
+  const sel = res.data.find(l => l.name === cur);
+  if (sel) {
+    const led = el.querySelector('#layed');
+    led.innerHTML = `
+      <h3>${esc(sel.name)}</h3>
+      <textarea id="laysrc"></textarea><br>
+      <button class="btn" onclick="layoutSave(flt._layoutSel)">save</button>
+      <span class="dim" id="laymsg"></span>`;
+    led.querySelector('#laysrc').value = sel.content;
+  }
+}
+function layoutAdd() {
+  dialog('add layout', `
+    <div class="formrow"><label>name</label><input id="lname"></div>
+    <div class="formrow"><label>yaml</label>
+      <textarea id="lsrc">items: {}\nlayout: []</textarea></div>`,
+    async d => {
+      const name = fval(d,'lname');
+      if (!name) throw new Error('name required');
+      await api('layout/add', {name, content: d.querySelector('#lsrc').value});
+      flt._layoutSel = name;
+    });
+}
+async function layoutSave(name) {
+  const content = document.getElementById('laysrc').value;
+  try {
+    await api('layout/edit', {name, content});
+    document.getElementById('laymsg').textContent = 'saved';
+  } catch (e) { alert(e.message||e); }
+}
+async function layoutRemove(name) {
+  if (!confirm('remove layout '+name+'?')) return;
+  await api('layout/remove',{name});
+  if (flt._layoutSel===name) delete flt._layoutSel;
+  render();
 }
 
 async function viewSupervisor(el) {
   const res = await api('auxiliary');
+  el.appendChild(h(`<div class="pager"><button class="btn"
+    onclick="if(confirm('stop worker daemons on this host?'))
+      api('stop').then(render)">stop workers</button></div>`));
   el.appendChild(h('<pre>'+esc(JSON.stringify(res,null,2))+'</pre>'));
+}
+
+async function toggleReportDialog(kind, id) {
+  // attach/detach a dag's train tasks (or one task) to a report
+  const res = await api('reports', {paginator:{page_number:0,page_size:100}});
+  dialog('toggle report for '+kind+' '+id, `
+    <div class="formrow"><label>report</label>
+      <select id="trep" style="width:100%">${res.data.map(r =>
+        `<option value="${r.id}">${r.id}: ${esc(r.name)}</option>`).join('')}
+      </select></div>
+    <div class="formrow"><label><input type="checkbox" id="trem"
+      style="width:auto"> remove (detach)</label></div>`,
+    async d => {
+      await api(kind+'/toogle_report', {id,
+        report: +fval(d,'trep'),
+        remove: d.querySelector('#trem').checked});
+    });
 }
 
 // ---------------------------------------------------------- detail views
@@ -233,7 +572,17 @@ async function viewDagDetail(el, id) {
   const [g, cfg, code] = await Promise.all([
     api('graph',{id}), api('config',{id}), api('code',{id})]);
   el.appendChild(h(`<p><a href="#" onclick="detail=null;render();return false">
-    &larr; back</a> &nbsp; <b>dag ${id}</b></p>`));
+    &larr; back</a> &nbsp; <b>dag ${id}</b> &nbsp;
+    <a href="/api/code_download?id=${id}&token=${encodeURIComponent(token)}"
+      >code.zip</a> &nbsp;
+    <button class="btn" onclick="toggleReportDialog('dag',${id})"
+      >toggle report</button>
+    <button class="btn" onclick="if(confirm('delete report images of '+
+      'dag ${id}?')) api('remove_imgs',{dag:${id}}).then(render)"
+      >remove imgs</button>
+    <button class="btn" onclick="if(confirm('delete stored code files '+
+      'of dag ${id}?')) api('remove_files',{dag:${id}}).then(render)"
+      >remove files</button></p>`));
   el.appendChild(h('<div class="card" style="overflow:auto">' +
     layerGraph(g.nodes, g.edges) + '</div>'));
   el.appendChild(h('<h3>config</h3><pre>'+esc(cfg.data)+'</pre>'));
@@ -254,7 +603,9 @@ async function viewTaskDetail(el, id) {
     api('task/info',{id}), api('task/steps',{id}),
     api('logs',{task:id, paginator:{page_number:0,page_size:50}})]);
   el.appendChild(h(`<p><a href="#" onclick="detail=null;render();return false">
-    &larr; back</a> &nbsp; <b>task ${id}</b></p>`));
+    &larr; back</a> &nbsp; <b>task ${id}</b> &nbsp;
+    <button class="btn" onclick="toggleReportDialog('task',${id})"
+      >toggle report</button></p>`));
   el.appendChild(h('<pre>'+esc(JSON.stringify(info,null,2))+'</pre>'));
   const tree = (nodes) => '<div class="tree">' + nodes.map(s =>
     `<div>&#9656; ${esc(s.name)} <span class="dim">${esc(s.started||'')}
@@ -288,18 +639,140 @@ function lineChart(name, part, points) {
   return '<div class="card">'+svg+'</svg></div>';
 }
 
+// ------------------------------------------------- layout-driven report
+function gKey(reportId, source) { return reportId + ':' + source; }
+// gallery keys embed layout item names (user data) — onclick handlers
+// reference galleries by numeric index so no user string is ever
+// interpolated into generated JS
+const gKeys = [];
+function gState(key) {
+  return galleryState[key] ||= {page: 0, y: '', y_pred: ''};
+}
+function gStateI(i) { return gState(gKeys[i]); }
+async function galleryHtml(kind, key, taskIds) {
+  let gi = gKeys.indexOf(key);
+  if (gi < 0) { gKeys.push(key); gi = gKeys.length - 1; }
+  const st = gState(key);
+  const filter = {paginator: {page_number: st.page, page_size: 16}};
+  // only this report's tasks — the table holds every dag's images
+  if (taskIds && taskIds.length) filter.tasks = taskIds;
+  if (st.y !== '') filter.y = +st.y;
+  if (st.y_pred !== '') filter.y_pred = +st.y_pred;
+  const res = await api(kind, filter);
+  let html = '';
+  if (kind === 'img_classify' && res.confusion && res.confusion.n) {
+    const m = res.confusion.matrix, n = res.confusion.n;
+    const max = Math.max(1, ...m.flat());
+    html += '<div style="display:flex;gap:18px;flex-wrap:wrap">';
+    html += '<div><div class="dim">confusion (y &rarr; y_pred), click to filter</div>'
+      + '<table class="cm"><tr><th></th>'
+      + Array.from({length:n},(_,j)=>`<th>${j}</th>`).join('') + '</tr>'
+      + m.map((row,i)=>`<tr><th>${i}</th>` + row.map((c,j)=>
+        `<td class="${i===j?'diag':(c>max*0.15?'hot':'')}"
+          style="background:rgba(77,163,255,${(c/max*0.55).toFixed(3)})"
+          onclick="Object.assign(gStateI(${gi}),
+            {y:${i},y_pred:${j},page:0});render()">${c||''}</td>`).join('')
+        + '</tr>').join('') + '</table></div>';
+    html += '<div style="flex:1">';
+  }
+  html += `<div class="pager">
+    <input class="fl" style="max-width:70px" placeholder="y"
+      value="${st.y}" onchange="Object.assign(gStateI(${gi}),
+        {y:this.value,page:0});render()">
+    <input class="fl" style="max-width:70px" placeholder="y_pred"
+      value="${st.y_pred}" onchange="Object.assign(gStateI(${gi}),
+        {y_pred:this.value,page:0});render()">
+    <button class="btn" onclick="Object.assign(gStateI(${gi}),
+      {y:'',y_pred:'',page:0});render()">clear</button>
+    <button class="btn" ${st.page?'':'disabled'}
+      onclick="gStateI(${gi}).page--;render()">&larr;</button>
+    <span class="dim">${res.total} imgs</span>
+    <button class="btn" ${(st.page+1)*16<res.total?'':'disabled'}
+      onclick="gStateI(${gi}).page++;render()">&rarr;</button></div>`;
+  html += '<div class="gallery">' + res.data.map(im => `
+    <figure><img src="data:image/jpeg;base64,${im.img}">
+      <figcaption>${im.y!=null?'y='+im.y:''}
+        ${im.y_pred!=null?' &rarr; '+im.y_pred:''}
+        ${im.score!=null?' ('+(+im.score).toFixed(3)+')':''}
+        <br>${esc(im.part||'')} ep${im.epoch==null?'':im.epoch}
+      </figcaption></figure>`).join('') + '</div>';
+  if (kind === 'img_classify' && res.confusion && res.confusion.n)
+    html += '</div></div>';
+  return html;
+}
+
 async function viewReportDetail(el, id) {
   const res = await api('report',{id});
   el.appendChild(h(`<p><a href="#" onclick="detail=null;render();return false">
-    &larr; back</a> &nbsp; <b>report ${id}</b></p>`));
-  el.appendChild(h('<div class="charts">' + res.series.map(s =>
-    lineChart(s.name, s.part, s.data)).join('') + '</div>'));
+    &larr; back</a> &nbsp; <b>report ${id}</b> &nbsp;
+    <button class="btn" onclick="reportLayoutDialog(${id})">layout</button></p>`));
+  const layout = res.layout || {};
+  const items = layout.items || {};
+  const panels = layout.layout || [];
+  const bySeries = {};   // series name -> [{part, data}]
+  (res.series||[]).forEach(s =>
+    (bySeries[s.name] ||= []).push(s));
+  if (!panels.length) {
+    // no layout: flat dump fallback (pre-layout behavior)
+    el.appendChild(h('<div class="charts">' + (res.series||[]).map(s =>
+      lineChart(s.name, s.part, s.data)).join('') + '</div>'));
+    return;
+  }
+  for (const [pi, panel] of panels.entries()) {
+    const k = '_p' + id + '_' + pi;
+    const collapsed = flt[k] !== undefined ? flt[k]
+      : panel.expanded === false;
+    const pel = h(`<div class="panel card">
+      <h3 onclick="flt['${k}'] = ${!collapsed}; render()">
+        ${collapsed ? '&#9656;' : '&#9662;'}
+        ${esc(panel.title||'panel')}</h3>
+      <div class="body"></div></div>`);
+    const body = pel.querySelector('.body');
+    el.appendChild(pel);
+    if (collapsed) continue;
+    const charts = document.createElement('div');
+    charts.className = 'charts';
+    body.appendChild(charts);
+    for (const item of (panel.items||[])) {
+      const src = item.source || item.key;
+      const spec = items[src] || {};
+      const type = item.type || spec.type;
+      if (type === 'series') {
+        const name = spec.key || src;
+        (bySeries[name]||[]).forEach(s =>
+          charts.appendChild(h(lineChart(name, s.part, s.data))));
+        if (!(bySeries[name]||[]).length)
+          charts.appendChild(h(
+            `<div class="card dim">no series '${esc(name)}'</div>`));
+      } else if (type === 'img_classify' || type === 'img_segment') {
+        const div = document.createElement('div');
+        div.style.gridColumn = '1 / -1';
+        div.innerHTML = await galleryHtml(
+          type, gKey(id, src), res.tasks||[]);
+        charts.appendChild(div);
+      }
+    }
+  }
+}
+async function reportLayoutDialog(id) {
+  const info = await api('report/update_layout_start', {id});
+  dialog('report layout', `
+    <div class="formrow"><label>layout
+      (current: ${esc(info.current||'none')})</label>
+      <select id="rlayout" style="width:100%">${(info.layouts||[]).map(l =>
+        `<option ${l===info.current?'selected':''}>${esc(l)}</option>`)
+        .join('')}</select></div>
+    <div class="dim">edit layout yaml in the layouts tab</div>`,
+    async d => {
+      await api('report/update_layout_end',
+        {id, layout: fval(d,'rlayout')});
+    });
 }
 
 // --------------------------------------------------------------- render
-const VIEWS = {dags:viewDags, tasks:viewTasks, computers:viewComputers,
-  models:viewModels, logs:viewLogs, reports:viewReports,
-  supervisor:viewSupervisor};
+const VIEWS = {projects:viewProjects, dags:viewDags, tasks:viewTasks,
+  computers:viewComputers, models:viewModels, logs:viewLogs,
+  reports:viewReports, layouts:viewLayouts, supervisor:viewSupervisor};
 
 async function render() {
   nav();
@@ -331,7 +804,8 @@ async function login() {
 }
 setInterval(() => { document.getElementById('clock').textContent =
   new Date().toLocaleTimeString(); }, 1000);
-setInterval(() => { if (token && !detail) render(); }, 5000);
+setInterval(() => { if (token && !detail
+  && !document.getElementById('dlg').open) render(); }, 5000);
 render();
 </script></body></html>
 """
