@@ -121,6 +121,10 @@ def _load_env(path):
 _ENV = _load_env(_ENV_FILE)
 
 TOKEN = _ENV.get('TOKEN', 'token')
+# per-computer worker-class credential (issued by `server issue-token`);
+# when set, RemoteSession authenticates with it instead of the
+# full-control server TOKEN — see db/models/auth.py
+WORKER_TOKEN = _ENV.get('WORKER_TOKEN', '')
 DB_TYPE = _ENV.get('DB_TYPE', 'SQLITE')
 
 if DB_TYPE == 'SQLITE':
@@ -170,7 +174,8 @@ if os.environ.get('JAX_PLATFORMS') == 'cpu':
 __all__ = [
     '__version__', 'ROOT_FOLDER', 'DATA_FOLDER', 'MODEL_FOLDER',
     'TASK_FOLDER', 'LOG_FOLDER', 'CONFIG_FOLDER', 'DB_FOLDER', 'TMP_FOLDER',
-    'TOKEN', 'DB_TYPE', 'SA_CONNECTION_STRING', 'MASTER_PORT_RANGE',
+    'TOKEN', 'WORKER_TOKEN', 'DB_TYPE', 'SA_CONNECTION_STRING',
+    'MASTER_PORT_RANGE',
     'QUEUE_POLL_INTERVAL', 'FILE_SYNC_INTERVAL', 'WORKER_USAGE_INTERVAL',
     'WEB_HOST', 'WEB_PORT', 'IP', 'PORT', 'SYNC_WITH_THIS_COMPUTER',
     'CAN_PROCESS_TASKS', 'DOCKER_IMG', 'DOCKER_MAIN',

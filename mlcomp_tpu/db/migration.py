@@ -165,12 +165,27 @@ def _v2_data(session: Session):
                 (name, content, now()))
 
 
-MIGRATIONS = [_v1_init, _v2_data]
+def _v3_auth(session: Session):
+    """worker_token + db_audit tables (tiered /api/db credential)."""
+    from mlcomp_tpu.db.models import DbAudit, WorkerToken
+    for model in (WorkerToken, DbAudit):
+        for stmt in model.create_table_ddl():   # IF NOT EXISTS — safe
+            session.execute(stmt)
+
+
+MIGRATIONS = [_v1_init, _v2_data, _v3_auth]
 
 
 def migrate(session: Session = None):
-    """Apply pending migrations (reference migration/manage.py:9-17)."""
+    """Apply pending migrations (reference migration/manage.py:9-17).
+
+    Remote (server-proxied) sessions never migrate: the server owns its
+    schema, and DDL through the proxy is denied for worker-class tokens.
+    """
+    from mlcomp_tpu.db.remote import RemoteSession
     session = session or Session.create_session(key='migration')
+    if isinstance(session, RemoteSession):
+        return len(MIGRATIONS)
     session.execute(
         'CREATE TABLE IF NOT EXISTS migration_version (version INTEGER)')
     row = session.query_one('SELECT MAX(version) AS v FROM migration_version')

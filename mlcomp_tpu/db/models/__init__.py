@@ -14,11 +14,13 @@ from mlcomp_tpu.db.models.report import (
 from mlcomp_tpu.db.models.model import Model
 from mlcomp_tpu.db.models.auxiliary import Auxiliary
 from mlcomp_tpu.db.models.queue import QueueMessage
+from mlcomp_tpu.db.models.auth import DbAudit, WorkerToken
 
 ALL_MODELS = [
     Project, Report, ReportLayout, Dag, Task, TaskDependence, TaskSynced,
     Computer, ComputerUsage, Docker, File, DagStorage, DagLibrary, Log, Step,
     ReportImg, ReportSeries, ReportTasks, Model, Auxiliary, QueueMessage,
+    WorkerToken, DbAudit,
 ]
 
 __all__ = [m.__name__ for m in ALL_MODELS] + ['ALL_MODELS']

@@ -19,8 +19,12 @@ from mlcomp_tpu.db.providers.model import ModelProvider
 from mlcomp_tpu.db.providers.auxiliary import AuxiliaryProvider
 from mlcomp_tpu.db.providers.task_synced import TaskSyncedProvider
 from mlcomp_tpu.db.providers.queue import QueueProvider
+from mlcomp_tpu.db.providers.auth import (
+    DbAuditProvider, WorkerTokenProvider
+)
 
 __all__ = [
+    'WorkerTokenProvider', 'DbAuditProvider',
     'BaseDataProvider', 'ProjectProvider', 'DagProvider', 'TaskProvider',
     'ComputerProvider', 'DockerProvider', 'FileProvider',
     'DagStorageProvider', 'DagLibraryProvider', 'LogProvider',

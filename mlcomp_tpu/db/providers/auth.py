@@ -1,0 +1,117 @@
+"""Worker-token issuance/validation and the /api/db audit log
+(see db/models/auth.py for the threat model)."""
+
+import re
+import secrets
+
+from mlcomp_tpu.db.models import ALL_MODELS, DbAudit, WorkerToken
+from mlcomp_tpu.db.providers.base import BaseDataProvider
+from mlcomp_tpu.utils.misc import now
+
+#: tables a worker-class token may touch: the framework's own control
+#: tables MINUS the auth/audit tables themselves (a worker that could
+#: read worker_token would hold every machine's credential; one that
+#: could write db_audit could erase its trail) and sqlite_*/
+#: migration_version.
+CONTROL_TABLES = frozenset(
+    m.__tablename__ for m in ALL_MODELS) - {'worker_token', 'db_audit'}
+
+#: statement kinds a worker may run (DML only — no DDL/ATTACH/PRAGMA)
+_ALLOWED_OPS = ('SELECT', 'INSERT', 'UPDATE', 'DELETE')
+
+#: identifiers that name the tables a statement touches
+_TABLE_REF = re.compile(
+    r'\b(?:FROM|INTO|UPDATE|JOIN|TABLE)\s+["`]?([A-Za-z_]\w*)',
+    re.IGNORECASE)
+#: comma-separated FROM lists (`FROM a, b`) — the second name escapes
+#: _TABLE_REF, so each segment is scanned separately
+_FROM_LIST = re.compile(r'\bFROM\s+([^();]+)', re.IGNORECASE)
+_IDENT = re.compile(r'\s*["`]?([A-Za-z_]\w*)')
+
+
+def check_worker_sql(sql: str):
+    """Raise PermissionError unless ``sql`` is a single DML statement
+    touching only control tables. This is the whole privilege boundary
+    for worker-class tokens, so it denies by default: unknown statement
+    kinds, unknown table references, and multi-statement strings are
+    all rejected."""
+    text = sql.strip()
+    # comments and bracket-quoted identifiers could splice or hide
+    # table names from the regexes below (SQLite treats /**/ as
+    # whitespace and [x] as an identifier); framework-generated SQL
+    # never uses either, so deny outright
+    for needle, why in (('--', 'comments'), ('/*', 'comments'),
+                        ('[', 'bracket identifiers')):
+        if needle in text:
+            raise PermissionError(f'{why} are not allowed')
+    first = text.split(None, 1)[0].upper() if text else ''
+    if first not in _ALLOWED_OPS:
+        raise PermissionError(
+            f'worker tokens may only run {"/".join(_ALLOWED_OPS)} '
+            f'(got {first or "empty"!r})')
+    body = text.rstrip().rstrip(';')
+    if ';' in body:
+        raise PermissionError('multi-statement strings are not allowed')
+    if 'sqlite_' in body.lower():
+        raise PermissionError('system tables are not allowed')
+    tables = {m.group(1).lower() for m in _TABLE_REF.finditer(body)}
+    for seg in _FROM_LIST.finditer(body):
+        for part in seg.group(1).split(','):
+            tok = _IDENT.match(part)
+            if tok:
+                tables.add(tok.group(1).lower())
+    # every aliased subquery also matches FROM ( — those yield no name.
+    # WITH ... AS would hide a table name from this regex only inside
+    # another FROM/JOIN, which the regex also scans.
+    unknown = tables - CONTROL_TABLES
+    if unknown:
+        raise PermissionError(
+            f'worker tokens may not touch {sorted(unknown)}')
+
+
+class WorkerTokenProvider(BaseDataProvider):
+    model = WorkerToken
+
+    def issue(self, computer: str) -> str:
+        """Mint a fresh token for ``computer`` and revoke its previous
+        ones (rotation on re-issue)."""
+        self.session.execute(
+            'UPDATE worker_token SET revoked=1 WHERE computer=?',
+            (computer,))
+        token = secrets.token_hex(24)
+        self.add(WorkerToken(token=token, computer=computer,
+                             created=now()))
+        return token
+
+    def by_token(self, token: str):
+        if not token:
+            return None
+        row = self.session.query_one(
+            'SELECT * FROM worker_token WHERE token=? AND revoked=0',
+            (token,))
+        return WorkerToken.from_row(row) if row else None
+
+    def revoke(self, computer: str) -> int:
+        res = self.session.execute(
+            'UPDATE worker_token SET revoked=1 '
+            'WHERE computer=? AND revoked=0', (computer,))
+        return res.rowcount
+
+
+class DbAuditProvider(BaseDataProvider):
+    model = DbAudit
+
+    MAX_SQL = 4096
+
+    def record(self, role: str, computer: str, op: str, sql: str):
+        self.add(DbAudit(role=role, computer=computer, op=op,
+                         sql=sql[:self.MAX_SQL], time=now()))
+
+    def tail(self, limit: int = 100):
+        rows = self.session.query(
+            'SELECT * FROM db_audit ORDER BY id DESC LIMIT ?', (limit,))
+        return [DbAudit.from_row(r) for r in rows]
+
+
+__all__ = ['WorkerTokenProvider', 'DbAuditProvider', 'check_worker_sql',
+           'CONTROL_TABLES']

@@ -62,8 +62,10 @@ class RemoteSession:
         self.connection_string = url
         self.base = url.rstrip('/')
         if token is None:
-            from mlcomp_tpu import TOKEN
-            token = TOKEN
+            # prefer the per-computer worker credential (DML-only,
+            # audited) over the full-control server token
+            from mlcomp_tpu import TOKEN, WORKER_TOKEN
+            token = WORKER_TOKEN or TOKEN
         self.token = token
         self.timeout = timeout
 

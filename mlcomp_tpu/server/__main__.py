@@ -61,6 +61,29 @@ def start(n_workers, in_process):
                f'(http://{WEB_HOST}:{WEB_PORT})')
 
 
+@main.command(name='issue-token')
+@click.argument('computer')
+@click.option('--revoke', is_flag=True,
+              help='revoke instead of issue (rotation also auto-revokes)')
+def issue_token(computer, revoke):
+    """Mint (or revoke) a worker-class DB token for COMPUTER.
+
+    Worker tokens are confined to DML on the framework's control tables
+    through /api/db (db/providers/auth.py); put the printed value in the
+    worker machine's configs/.env as WORKER_TOKEN.
+    """
+    from mlcomp_tpu.db.migration import migrate
+    from mlcomp_tpu.db.providers import WorkerTokenProvider
+    session = Session.create_session(key='issue_token')
+    migrate(session)
+    provider = WorkerTokenProvider(session)
+    if revoke:
+        print(f'revoked {provider.revoke(computer)} token(s) '
+              f'for {computer}')
+    else:
+        print(f'WORKER_TOKEN={provider.issue(computer)}')
+
+
 @main.command()
 def stop():
     """Stop daemons started by ``start`` (best effort, by cmdline)."""

@@ -478,16 +478,38 @@ def api_remove_files(data, s):
 
 def api_db(data, s):
     """DB statement proxy for remote workers (db/remote.py RemoteSession)
-    — the multi-computer control plane. Token-authed; the wire trust
-    model matches the reference's shared-postgres deployment (any
-    authed machine can issue any statement). Because that makes the
-    token a full-control credential, non-loopback clients are refused
-    while the shipped default token is still in place (gate in
+    — the multi-computer control plane. Two credential tiers
+    (db/models/auth.py): the SERVER token has full SQL control
+    (reference shared-postgres superuser parity); WORKER tokens —
+    issued per computer via ``server issue-token`` / /api/worker_token —
+    pass ``check_worker_sql``: single DML statements on the framework's
+    own tables only, no DDL/ATTACH/PRAGMA. Every write is recorded in
+    ``db_audit`` whoever sent it. Non-loopback clients are additionally
+    refused while the shipped default token is in place (gate in
     ApiHandler._dispatch)."""
+    from mlcomp_tpu.db.providers.auth import (
+        DbAuditProvider, check_worker_sql,
+    )
     from mlcomp_tpu.db.remote import decode_value, encode_row
+    role = data.get('_role', 'server')
+    computer = data.get('_computer')
     op = data.get('op')
     sql = data.get('sql', '')
     params = [decode_value(p) for p in data.get('params', [])]
+    is_select = sql.lstrip()[:6].upper() == 'SELECT'
+    if role == 'worker':
+        try:
+            check_worker_sql(sql)
+            if op in ('query', 'query_one') and not is_select:
+                # Session.query executes whatever it is given — a DML
+                # statement smuggled through the query op would run
+                # unaudited below
+                raise PermissionError('query ops must be SELECT')
+        except PermissionError as e:
+            raise ApiError(str(e), status=403)
+    if op in ('execute', 'executemany') or not is_select:
+        # audit every statement that can write, whichever op carried it
+        DbAuditProvider(s).record(role, computer, op, sql)
     if op == 'execute':
         result = s.execute(sql, params)
         return {'success': True,
@@ -505,6 +527,31 @@ def api_db(data, s):
             rows = rows[:1]
         return {'success': True, 'rows': [encode_row(r) for r in rows]}
     raise ApiError(f'unknown db op {op!r}')
+
+
+def api_worker_token(data, s):
+    """Issue (or revoke) a per-computer worker-class token. Requires the
+    SERVER token (needs_auth + the worker-token/route restriction in
+    _dispatch keeps worker tokens out)."""
+    from mlcomp_tpu.db.providers import WorkerTokenProvider
+    computer = data.get('computer')
+    if not computer:
+        raise ApiError('computer required', status=400)
+    provider = WorkerTokenProvider(s)
+    if data.get('revoke'):
+        return {'success': True, 'revoked': provider.revoke(computer)}
+    return {'success': True, 'computer': computer,
+            'token': provider.issue(computer)}
+
+
+def api_db_audit(data, s):
+    from mlcomp_tpu.db.providers import DbAuditProvider
+    try:
+        limit = max(1, min(1000, int(data.get('limit', 100))))
+    except (TypeError, ValueError):
+        raise ApiError('limit must be an integer', status=400)
+    rows = DbAuditProvider(s).tail(limit)
+    return {'data': [r.to_dict() for r in rows]}
 
 
 def api_stop(data, s):
@@ -585,6 +632,8 @@ _ROUTES = {
     '/api/remove_files': (api_remove_files, True),
     '/api/stop': (api_stop, True),
     '/api/db': (api_db, True),
+    '/api/worker_token': (api_worker_token, True),
+    '/api/db_audit': (api_db_audit, True),
 }
 
 
@@ -629,16 +678,42 @@ class ApiHandler(BaseHTTPRequestHandler):
     def _authorized(self):
         return self.headers.get('Authorization', '').strip() == TOKEN
 
+    def _auth_role(self):
+        """('server', None) | ('worker', computer) | (None, None).
+
+        Worker-class tokens (db/models/auth.py) authenticate ONLY the
+        /api/db route, where statement inspection confines them to DML
+        on control tables."""
+        supplied = self.headers.get('Authorization', '').strip()
+        if supplied == TOKEN:
+            return 'server', None
+        if supplied:
+            from mlcomp_tpu.db.providers import WorkerTokenProvider
+            try:
+                row = WorkerTokenProvider(_session()).by_token(supplied)
+            except Exception:
+                row = None
+            if row is not None:
+                return 'worker', row.computer
+        return None, None
+
     def _dispatch(self, path, data):
         route = _ROUTES.get(path)
         if route is None:
             self._send_json({'success': False, 'reason': 'not found'}, 404)
             return
         handler, needs_auth = route
-        if needs_auth and not self._authorized():
-            self._send_json(
-                {'success': False, 'reason': 'unauthorized'}, 401)
-            return
+        role, worker_computer = (None, None)
+        if needs_auth:
+            role, worker_computer = self._auth_role()
+            if role is None or (role == 'worker' and path != '/api/db'):
+                self._send_json(
+                    {'success': False, 'reason': 'unauthorized'}, 401)
+                return
+        if path == '/api/db':
+            data = dict(data)
+            data['_role'] = role
+            data['_computer'] = worker_computer
         if path == '/api/db' and TOKEN == 'token' \
                 and self.client_address[0] not in ('127.0.0.1', '::1'):
             # the DB proxy is a full-control credential; refuse to serve

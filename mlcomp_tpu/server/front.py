@@ -304,9 +304,19 @@ function sparkline(points, key, w, hgt, color) {
     +(hgt-Math.min(v,100)/100*hgt).toFixed(1)).join(' ');
   return `<path d="${d}" fill="none" stroke="${color}" stroke-width="1.2"/>`;
 }
+let computerNames = [];
+async function issueWorkerToken(i) {
+  const name = computerNames[i];
+  if (!confirm('issue a worker token for '+name+
+               '? (rotates any previous one)')) return;
+  const res = await api('worker_token', {computer: name});
+  prompt('WORKER_TOKEN for '+name+' (copy now — not shown again):',
+         res.token);
+}
 async function viewComputers(el) {
   const res = await api('computers', {usage_history: true});
-  el.appendChild(h('<div class="cards">' + res.data.map(c => {
+  computerNames = res.data.map(c => c.name);
+  el.appendChild(h('<div class="cards">' + res.data.map((c, ci) => {
     const u = c.usage || {};
     const hist = c.usage_history || [];
     const spark = hist.length < 2 ? '<span class="dim">no history</span>' :
@@ -327,6 +337,8 @@ async function viewComputers(el) {
         &middot; hbm ${u.tpu_hbm!=null?u.tpu_hbm.toFixed(0)+'%':'—'}</div>
       ${spark}
       <div class="dim">last activity: ${esc(c.last_activity||'')}</div>
+      <button class="btn" style="margin-top:6px"
+        onclick="issueWorkerToken(${ci})">issue worker token</button>
       </div>`; }).join('') + '</div>'));
 }
 
@@ -509,10 +521,22 @@ async function layoutRemove(name) {
 
 async function viewSupervisor(el) {
   const res = await api('auxiliary');
+  // db_audit needs auth while auxiliary does not — don't let a 401
+  // take the whole tab down
+  let audit = {data: []};
+  try { audit = await api('db_audit', {limit: 50}); } catch (e) {}
   el.appendChild(h(`<div class="pager"><button class="btn"
     onclick="if(confirm('stop worker daemons on this host?'))
       api('stop').then(render)">stop workers</button></div>`));
   el.appendChild(h('<pre>'+esc(JSON.stringify(res,null,2))+'</pre>'));
+  el.appendChild(h('<h3>db audit (proxied writes, newest first)</h3>'
+    + '<table><tr><th>time</th><th>role</th><th>computer</th>'
+    + '<th>op</th><th>sql</th></tr>'
+    + (audit.data||[]).map(a => `<tr><td class="dim">${esc(a.time)}</td>
+      <td>${esc(a.role)}</td><td>${esc(a.computer||'')}</td>
+      <td>${esc(a.op)}</td>
+      <td><pre style="margin:0;max-height:80px">${esc(a.sql)}</pre></td>
+      </tr>`).join('') + '</table>'));
 }
 
 async function toggleReportDialog(kind, id) {

@@ -1,0 +1,182 @@
+"""Tiered /api/db credential (VERDICT r2 next-#7).
+
+Worker-class tokens are per-computer, issued by the server, confined by
+statement inspection to single DML statements on the framework's own
+tables, and every proxied write lands in the db_audit table. The server
+token keeps full SQL control (reference shared-postgres parity).
+"""
+
+import urllib.error
+
+import pytest
+
+from mlcomp_tpu import TOKEN
+from mlcomp_tpu.db.providers.auth import (
+    CONTROL_TABLES, check_worker_sql,
+)
+
+from tests.test_api import api  # noqa: F401  (live-server fixture)
+
+
+class TestStatementInspection:
+    @pytest.mark.parametrize('sql', [
+        'SELECT * FROM task WHERE id=?',
+        'INSERT INTO log (message) VALUES (?)',
+        'UPDATE task SET status=? WHERE id=?',
+        'DELETE FROM queue_message WHERE id=?',
+        'SELECT t.*, d.name FROM task t JOIN dag d ON t.dag=d.id',
+        'INSERT OR REPLACE INTO computer (name) VALUES (?)',
+        'SELECT COUNT(*) FROM (SELECT id FROM step) s',
+    ])
+    def test_allowed(self, sql):
+        check_worker_sql(sql)
+
+    @pytest.mark.parametrize('sql,why', [
+        ('DROP TABLE task', 'DDL'),
+        ('CREATE TABLE evil (x)', 'DDL'),
+        ('ALTER TABLE task ADD COLUMN evil', 'DDL'),
+        ('PRAGMA writable_schema=1', 'pragma'),
+        ('ATTACH DATABASE ? AS other', 'attach'),
+        ('VACUUM', 'vacuum'),
+        ('SELECT * FROM sqlite_master', 'system table'),
+        ('SELECT * FROM migration_version', 'non-control table'),
+        ('DELETE FROM task; DROP TABLE dag', 'multi-statement'),
+        ('/* x */ DROP TABLE task', 'comment smuggling'),
+        ('', 'empty'),
+        ('INSERT INTO task SELECT * FROM sqlite_temp_master',
+         'unknown table in subquery'),
+        ('SELECT * FROM worker_token', 'credential theft'),
+        ('UPDATE worker_token SET revoked=0', 'un-revocation'),
+        ('INSERT INTO worker_token (token) VALUES (?)',
+         'credential minting'),
+        ('DELETE FROM db_audit', 'trail erasure'),
+        ('SELECT * FROM task, migration_version', 'comma-join bypass'),
+        ('DELETE FROM [migration_version]', 'bracket identifier'),
+        ('DELETE FROM/**/migration_version', 'comment splice'),
+        ('SELECT * FROM task -- x', 'trailing comment'),
+    ])
+    def test_denied(self, sql, why):
+        with pytest.raises(PermissionError):
+            check_worker_sql(sql)
+
+    def test_control_tables_cover_schema_minus_auth(self):
+        assert {'task', 'dag', 'log', 'step', 'queue_message',
+                'computer'} <= CONTROL_TABLES
+        assert not {'worker_token', 'db_audit'} & CONTROL_TABLES
+
+
+def _issue(api, computer='workerbox'):
+    res = api('/api/worker_token', {'computer': computer})
+    assert res['success'] and len(res['token']) >= 32
+    return res['token']
+
+
+def _db(api, token, payload):
+    return api('/api/db', payload, token=token)
+
+
+class TestTieredProxy:
+    def test_worker_token_dml_allowed_and_audited(self, api):
+        wt = _issue(api)
+        r = _db(api, wt, {'op': 'execute',
+                          'sql': 'INSERT INTO log (message, level) '
+                                 'VALUES (?, ?)',
+                          'params': ['hello', 20]})
+        assert r['success'] and r['lastrowid']
+        r = _db(api, wt, {'op': 'query',
+                          'sql': 'SELECT message FROM log', 'params': []})
+        assert any(row['message'] == 'hello' for row in r['rows'])
+        audit = api('/api/db_audit', {'limit': 10})
+        rows = audit['data']
+        assert rows[0]['role'] == 'worker'
+        assert rows[0]['computer'] == 'workerbox'
+        assert rows[0]['sql'].startswith('INSERT INTO log')
+
+    def test_worker_token_cannot_drop_table(self, api):
+        wt = _issue(api)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _db(api, wt, {'op': 'execute', 'sql': 'DROP TABLE task',
+                          'params': []})
+        assert e.value.code == 403
+        # table still exists
+        r = _db(api, TOKEN, {'op': 'query',
+                             'sql': 'SELECT COUNT(*) AS c FROM task',
+                             'params': []})
+        assert r['success']
+
+    def test_server_token_keeps_full_control(self, api):
+        _db(api, TOKEN, {'op': 'execute',
+                         'sql': 'CREATE TABLE scratch (x INTEGER)',
+                         'params': []})
+        _db(api, TOKEN, {'op': 'execute', 'sql': 'DROP TABLE scratch',
+                         'params': []})
+        audit = api('/api/db_audit', {'limit': 5})
+        assert audit['data'][0]['role'] == 'server'
+
+    def test_worker_token_rejected_on_other_routes(self, api):
+        wt = _issue(api)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/api/tasks', {}, token=wt)
+        assert e.value.code == 401
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/api/worker_token', {'computer': 'x'}, token=wt)
+        assert e.value.code == 401
+
+    def test_revocation_and_rotation(self, api):
+        first = _issue(api, 'rotbox')
+        second = _issue(api, 'rotbox')       # rotation revokes `first`
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _db(api, first, {'op': 'query', 'sql': 'SELECT 1 AS one',
+                             'params': []})
+        assert e.value.code == 401
+        r = _db(api, second, {'op': 'query',
+                              'sql': 'SELECT COUNT(*) AS c FROM task',
+                              'params': []})
+        assert r['success']
+        api('/api/worker_token', {'computer': 'rotbox', 'revoke': True})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _db(api, second, {'op': 'query',
+                              'sql': 'SELECT COUNT(*) AS c FROM task',
+                              'params': []})
+        assert e.value.code == 401
+
+    def test_worker_cannot_smuggle_dml_through_query_op(self, api):
+        wt = _issue(api)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _db(api, wt, {'op': 'query', 'sql': 'DELETE FROM task',
+                          'params': []})
+        assert e.value.code == 403
+
+    def test_server_query_op_writes_are_audited(self, api):
+        _db(api, TOKEN, {'op': 'query',
+                         'sql': 'DELETE FROM log WHERE id=-1',
+                         'params': []})
+        audit = api('/api/db_audit', {'limit': 5})
+        assert audit['data'][0]['sql'].startswith('DELETE FROM log')
+        assert audit['data'][0]['op'] == 'query'
+
+    def test_audit_limit_validated(self, api):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/api/db_audit', {'limit': 'abc'})
+        assert e.value.code == 400
+        api('/api/db_audit', {'limit': -5})      # clamped, not unlimited
+
+    def test_remote_session_with_worker_token(self, api):
+        """A RemoteSession authed with a worker token drives the normal
+        provider layer (the DB_TYPE=SERVER worker path)."""
+        from mlcomp_tpu.db.models import Computer
+        from mlcomp_tpu.db.providers import ComputerProvider
+        from mlcomp_tpu.db.remote import RemoteSession
+        wt = _issue(api, 'remotebox')
+        rs = RemoteSession(api.base, key='worker_auth_test', token=wt)
+        provider = ComputerProvider(rs)
+        provider.create_or_update(
+            Computer(name='remotebox', cores=8, cpu=4, memory=8), 'name')
+        assert provider.by_name('remotebox').cores == 8
+
+    def test_migrate_is_noop_on_remote_session(self, api):
+        from mlcomp_tpu.db.migration import migrate
+        from mlcomp_tpu.db.remote import RemoteSession
+        wt = _issue(api, 'migbox')
+        rs = RemoteSession(api.base, key='worker_mig_test', token=wt)
+        migrate(rs)        # must not attempt DDL through the proxy
