@@ -1,8 +1,22 @@
 """Worker-token issuance/validation and the /api/db audit log
-(see db/models/auth.py for the threat model)."""
+(see db/models/auth.py for the threat model).
+
+Enforcement is two layers:
+
+1. ``check_worker_sql`` — a cheap regex pre-filter producing friendly
+   403 messages for the obvious cases (DDL keywords, known-bad tables).
+   It is NOT the security boundary: SQLite accepts identifier spellings
+   ('worker_token', [worker_token], comment-spliced) no regex survey of
+   the text can enumerate.
+2. ``confined_worker_session`` — the actual boundary: a dedicated
+   sqlite connection with a **sqlite3 authorizer** permanently
+   installed, so the real parser's resolution of every table/action is
+   what gets vetted. Quoting games never reach the data.
+"""
 
 import re
 import secrets
+import sqlite3
 
 from mlcomp_tpu.db.models import ALL_MODELS, DbAudit, WorkerToken
 from mlcomp_tpu.db.providers.base import BaseDataProvider
@@ -69,6 +83,44 @@ def check_worker_sql(sql: str):
             f'worker tokens may not touch {sorted(unknown)}')
 
 
+#: authorizer actions a worker statement may perform. Table-scoped
+#: actions check the (parser-resolved) table name against the allowlist;
+#: the rest are the plumbing every DML statement needs.
+_TABLE_ACTIONS = {
+    sqlite3.SQLITE_READ, sqlite3.SQLITE_INSERT, sqlite3.SQLITE_UPDATE,
+    sqlite3.SQLITE_DELETE,
+}
+_PLAIN_ACTIONS = {
+    sqlite3.SQLITE_SELECT, sqlite3.SQLITE_TRANSACTION,
+    sqlite3.SQLITE_FUNCTION, sqlite3.SQLITE_RECURSIVE,
+}
+
+
+def _worker_authorizer(action, arg1, arg2, dbname, trigger):
+    if action in _PLAIN_ACTIONS:
+        return sqlite3.SQLITE_OK
+    if action in _TABLE_ACTIONS:
+        if (arg1 or '').lower() in CONTROL_TABLES:
+            return sqlite3.SQLITE_OK
+        return sqlite3.SQLITE_DENY
+    return sqlite3.SQLITE_DENY            # DDL/ATTACH/PRAGMA/...
+
+
+def confined_worker_session():
+    """The session every worker-tier /api/db statement executes on: its
+    OWN sqlite connection with the authorizer installed for the
+    connection's whole life (no toggling — a shared connection with a
+    temporarily-set authorizer would race concurrent server-role
+    statements on other threads)."""
+    from mlcomp_tpu.db.core import Session
+    s = Session.create_session(key='api_db_worker')
+    conn = getattr(s, '_conn', None)
+    if conn is not None and not getattr(s, '_worker_confined', False):
+        conn.set_authorizer(_worker_authorizer)
+        s._worker_confined = True
+    return s
+
+
 class WorkerTokenProvider(BaseDataProvider):
     model = WorkerToken
 
@@ -114,4 +166,4 @@ class DbAuditProvider(BaseDataProvider):
 
 
 __all__ = ['WorkerTokenProvider', 'DbAuditProvider', 'check_worker_sql',
-           'CONTROL_TABLES']
+           'confined_worker_session', 'CONTROL_TABLES']

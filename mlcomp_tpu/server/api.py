@@ -476,6 +476,15 @@ def api_remove_files(data, s):
     return {'success': True}
 
 
+#: routes refused off-host while the shipped default token is in place
+_GATED_ROUTES = ('/api/db', '/api/worker_token', '/api/db_audit')
+
+
+def default_token_gate_blocks(path: str, client_ip: str) -> bool:
+    return path in _GATED_ROUTES and TOKEN == 'token' \
+        and client_ip not in ('127.0.0.1', '::1')
+
+
 def api_db(data, s):
     """DB statement proxy for remote workers (db/remote.py RemoteSession)
     — the multi-computer control plane. Two credential tiers
@@ -488,10 +497,12 @@ def api_db(data, s):
     refused while the shipped default token is in place (gate in
     ApiHandler._dispatch)."""
     from mlcomp_tpu.db.providers.auth import (
-        DbAuditProvider, check_worker_sql,
+        DbAuditProvider, check_worker_sql, confined_worker_session,
     )
     from mlcomp_tpu.db.remote import decode_value, encode_row
-    role = data.get('_role', 'server')
+    # fail CLOSED: only the _dispatch injection grants 'server'; any
+    # other caller gets worker confinement
+    role = data.get('_role') or 'worker'
     computer = data.get('_computer')
     op = data.get('op')
     sql = data.get('sql', '')
@@ -499,7 +510,7 @@ def api_db(data, s):
     is_select = sql.lstrip()[:6].upper() == 'SELECT'
     if role == 'worker':
         try:
-            check_worker_sql(sql)
+            check_worker_sql(sql)       # pre-filter: friendly messages
             if op in ('query', 'query_one') and not is_select:
                 # Session.query executes whatever it is given — a DML
                 # statement smuggled through the query op would run
@@ -507,25 +518,38 @@ def api_db(data, s):
                 raise PermissionError('query ops must be SELECT')
         except PermissionError as e:
             raise ApiError(str(e), status=403)
+        # the actual boundary: execute on the authorizer-confined
+        # connection — the real parser vets every table/action, so
+        # identifier-quoting tricks the regex pre-filter can't see
+        # are denied at resolution time
+        s = confined_worker_session()
     if op in ('execute', 'executemany') or not is_select:
         # audit every statement that can write, whichever op carried it
-        DbAuditProvider(s).record(role, computer, op, sql)
-    if op == 'execute':
-        result = s.execute(sql, params)
-        return {'success': True,
-                'rows': [encode_row(r) for r in result.fetchall()],
-                'lastrowid': result.lastrowid,
-                'rowcount': result.rowcount}
-    if op == 'executemany':
-        seq = [[decode_value(p) for p in row]
-               for row in data.get('params_seq', [])]
-        s.executemany(sql, seq)
-        return {'success': True}
-    if op in ('query', 'query_one'):
-        rows = s.query(sql, params)
-        if op == 'query_one':
-            rows = rows[:1]
-        return {'success': True, 'rows': [encode_row(r) for r in rows]}
+        DbAuditProvider(_session()).record(role, computer, op, sql)
+    try:
+        if op == 'execute':
+            result = s.execute(sql, params)
+            return {'success': True,
+                    'rows': [encode_row(r) for r in result.fetchall()],
+                    'lastrowid': result.lastrowid,
+                    'rowcount': result.rowcount}
+        if op == 'executemany':
+            seq = [[decode_value(p) for p in row]
+                   for row in data.get('params_seq', [])]
+            s.executemany(sql, seq)
+            return {'success': True}
+        if op in ('query', 'query_one'):
+            rows = s.query(sql, params)
+            if op == 'query_one':
+                rows = rows[:1]
+            return {'success': True,
+                    'rows': [encode_row(r) for r in rows]}
+    except sqlite3.DatabaseError as e:
+        msg = str(e).lower()
+        if role == 'worker' and ('not authorized' in msg
+                                 or 'prohibited' in msg):
+            raise ApiError(f'denied by authorizer: {e}', status=403)
+        raise
     raise ApiError(f'unknown db op {op!r}')
 
 
@@ -714,10 +738,10 @@ class ApiHandler(BaseHTTPRequestHandler):
             data = dict(data)
             data['_role'] = role
             data['_computer'] = worker_computer
-        if path == '/api/db' and TOKEN == 'token' \
-                and self.client_address[0] not in ('127.0.0.1', '::1'):
-            # the DB proxy is a full-control credential; refuse to serve
-            # it off-host while the shipped default token is in place
+        if default_token_gate_blocks(path, self.client_address[0]):
+            # the DB proxy and the credential/audit routes are
+            # full-control surfaces; refuse to serve them off-host
+            # while the shipped default token is in place
             self._send_json(
                 {'success': False,
                  'reason': 'set a real TOKEN in configs/.env before '

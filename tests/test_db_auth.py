@@ -140,6 +140,39 @@ class TestTieredProxy:
                               'params': []})
         assert e.value.code == 401
 
+    @pytest.mark.parametrize('sql', [
+        # identifier spellings the regex pre-filter cannot see — the
+        # sqlite3 authorizer on the confined connection must catch them
+        "SELECT * FROM 'worker_token'",
+        "UPDATE 'worker_token' SET revoked=0",
+        "INSERT INTO 'worker_token' (token, computer, created, revoked)"
+        " VALUES ('evil', 'x', '2020-01-01', 0)",
+        "DELETE FROM 'db_audit'",
+        'SELECT * FROM (SELECT 1) t, worker_token w',
+        'SELECT * FROM (SELECT 1) t, "migration_version" m',
+    ])
+    def test_quoting_bypasses_hit_the_authorizer(self, api, sql):
+        wt = _issue(api, 'bypassbox')
+        op = 'query' if sql.upper().startswith('SELECT') else 'execute'
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _db(api, wt, {'op': op, 'sql': sql, 'params': []})
+        assert e.value.code == 403
+        # and nothing leaked/changed: token still valid, audit intact
+        r = _db(api, wt, {'op': 'query',
+                          'sql': 'SELECT COUNT(*) AS c FROM task',
+                          'params': []})
+        assert r['success']
+
+    def test_default_token_gate_covers_credential_routes(self):
+        """Off-host clients must not reach worker_token/db_audit on the
+        shipped default token (same gate as /api/db); loopback and
+        ungated routes stay served."""
+        from mlcomp_tpu.server.api import default_token_gate_blocks
+        for path in ('/api/db', '/api/worker_token', '/api/db_audit'):
+            assert default_token_gate_blocks(path, '10.0.0.5')
+            assert not default_token_gate_blocks(path, '127.0.0.1')
+        assert not default_token_gate_blocks('/api/tasks', '10.0.0.5')
+
     def test_worker_cannot_smuggle_dml_through_query_op(self, api):
         wt = _issue(api)
         with pytest.raises(urllib.error.HTTPError) as e:

@@ -253,3 +253,159 @@ class TestStagePerDispatchRequeue:
         export = os.path.join(MODEL_FOLDER, 'p_stagereq',
                               'staged_model.msgpack')
         assert os.path.exists(export)
+
+
+class TestChaos:
+    """Fault injection the reference never had (SURVEY §4: 'no fault
+    injection anywhere') — VERDICT r2 next-#10.
+
+    A worker machine dying mid-task and the control-plane API dying
+    under a remote worker are the two failure modes the recovery
+    machinery (reaper + restart-with-resume, session-heal retry loop)
+    exists for; these tests kill real processes and assert the recovery
+    actually lands.
+    """
+
+    def test_sigkill_worker_mid_task_reaper_requeue_success(
+            self, session, monkeypatch, tmp_path):
+        """SIGKILL a real worker process (and its run-task child) mid-
+        task -> reaper fails the orphaned task -> dag restart requeues
+        it with resume info -> second attempt succeeds."""
+        import signal
+        import subprocess
+        import sys
+
+        import mlcomp_tpu
+        import mlcomp_tpu.worker.__main__ as wmain
+        from mlcomp_tpu.server.api import api_dag_start
+
+        folder = tmp_path / 'exp'
+        folder.mkdir()
+        (folder / 'executors.py').write_text(
+            'import os, time\n'
+            'from mlcomp_tpu.worker.executors import Executor\n'
+            '@Executor.register\n'
+            'class CrashyThenFine(Executor):\n'
+            '    def __init__(self, **kw):\n'
+            '        pass\n'
+            '    def work(self):\n'
+            '        marker = os.path.join("data", "attempted")\n'
+            '        if os.path.exists(marker):\n'
+            '            return {"attempt": 2, "resumed": True}\n'
+            '        open(marker, "w").write("1")\n'
+            '        time.sleep(120)\n')
+        config = {
+            'info': {'name': 'chaos_dag', 'project': 'p_chaos'},
+            'executors': {'crashy': {'type': 'crashy_then_fine'}},
+        }
+        monkeypatch.setenv('MLCOMP_TPU_KEEP_ROOT', '1')
+        monkeypatch.setenv('MLCOMP_TPU_ROOT', mlcomp_tpu.ROOT_FOLDER)
+        dag, tasks = _dispatch(session, monkeypatch, config, str(folder))
+        tid = tasks['crashy'][0]
+        tp = TaskProvider(session)
+
+        env = dict(os.environ, MLCOMP_HOSTNAME='host1',
+                   JAX_PLATFORMS='cpu')
+        worker = subprocess.Popen(
+            [sys.executable, '-m', 'mlcomp_tpu.worker', 'worker', '0'],
+            env=env, start_new_session=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            # wait until the executor is genuinely MID-task: InProgress,
+            # pid recorded, and the attempt marker written (killing
+            # earlier would make attempt 2 re-run the sleep branch)
+            marker = os.path.join(mlcomp_tpu.DATA_FOLDER, 'p_chaos',
+                                  'attempted')
+            deadline = time.time() + 60
+            task = None
+            while time.time() < deadline:
+                task = tp.by_id(tid)
+                if task.status == int(TaskStatus.InProgress) \
+                        and task.pid and os.path.exists(marker):
+                    break
+                time.sleep(0.3)
+            assert task is not None and task.pid \
+                and os.path.exists(marker), \
+                f'task never started: status={task and task.status}'
+
+            # machine dies: SIGKILL the worker's whole process group
+            # (worker + its run-task child share it)
+            os.killpg(os.getpgid(worker.pid), signal.SIGKILL)
+            worker.wait(timeout=10)
+            deadline = time.time() + 10
+            from mlcomp_tpu import native
+            while time.time() < deadline and native.pid_exists(task.pid):
+                time.sleep(0.2)
+            assert not native.pid_exists(task.pid)
+
+            # task is orphaned InProgress; age it past the 30 s grace
+            session.execute(
+                'UPDATE task SET last_activity=? WHERE id=?',
+                (now() - datetime.timedelta(seconds=90), tid))
+            wmain.stop_processes_not_exist(session, create_logger(session))
+            assert tp.by_id(tid).status == int(TaskStatus.Failed)
+
+            # operator hits restart: Failed -> NotRan with resume info
+            res = api_dag_start({'id': dag.id}, session)
+            assert tid in res['restarted']
+            restarted = tp.by_id(tid)
+            assert restarted.status == int(TaskStatus.NotRan)
+            from mlcomp_tpu.utils.io import yaml_load
+            info = yaml_load(restarted.additional_info)
+            assert info['resume']['master_task_id'] == tid
+
+            # supervisor requeues; a fresh consume runs attempt 2
+            SupervisorBuilder(session=session).build()
+            logger = create_logger(session)
+            qp = QueueProvider(session)
+            consumed = wmain._consume_one(session, qp, logger, 0,
+                                          in_process=True)
+            assert consumed
+            final = tp.by_id(tid)
+            assert final.status == int(TaskStatus.Success), final.result
+            assert '"resumed": true' in final.result
+        finally:
+            if worker.poll() is None:
+                try:
+                    os.killpg(os.getpgid(worker.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+    def test_api_death_under_remote_session_clean_fail_and_recover(
+            self, session):
+        """Kill the API server under a RemoteSession worker: in-flight
+        use fails with a clean error (the worker loop's heal path
+        catches it), and the same RemoteSession works again once the
+        server is back — stateless HTTP, nothing to rebuild."""
+        import urllib.error
+
+        from mlcomp_tpu import TOKEN
+        from mlcomp_tpu.db.models import Computer
+        from mlcomp_tpu.db.providers import ComputerProvider
+        from mlcomp_tpu.db.remote import RemoteSession
+        from mlcomp_tpu.server.api import ApiServer
+
+        server = ApiServer(host='127.0.0.1', port=0).start_background()
+        port = server.port
+        rs = RemoteSession(f'http://127.0.0.1:{port}',
+                           key='chaos_remote', token=TOKEN)
+        provider = ComputerProvider(rs)
+        provider.create_or_update(
+            Computer(name='chaosbox', cores=1, cpu=1, memory=1), 'name')
+        assert provider.by_name('chaosbox') is not None
+
+        server.shutdown()                      # control plane dies
+        import pytest as _pytest
+        with _pytest.raises((urllib.error.URLError, ConnectionError,
+                             OSError)):
+            provider.by_name('chaosbox')       # clean failure, no hang
+
+        # server comes back on the same address; the session recovers
+        # without any reconstruction (what worker()'s heal loop does)
+        server2 = ApiServer(host='127.0.0.1', port=port)
+        server2.start_background()
+        try:
+            row = provider.by_name('chaosbox')
+            assert row is not None and row.cores == 1
+        finally:
+            server2.shutdown()
