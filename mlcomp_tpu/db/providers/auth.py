@@ -115,7 +115,14 @@ def confined_worker_session():
     from mlcomp_tpu.db.core import Session
     s = Session.create_session(key='api_db_worker')
     conn = getattr(s, '_conn', None)
-    if conn is not None and not getattr(s, '_worker_confined', False):
+    if conn is None:
+        # fail CLOSED: a server whose own DB is remote (chained http
+        # proxying) has no raw connection to confine — the regex
+        # pre-filter alone is not a security boundary
+        raise RuntimeError(
+            'worker-tier statements need a local sqlite connection to '
+            'confine; this server has a proxied DB')
+    if not getattr(s, '_worker_confined', False):
         conn.set_authorizer(_worker_authorizer)
         s._worker_confined = True
     return s

@@ -522,7 +522,10 @@ def api_db(data, s):
         # connection — the real parser vets every table/action, so
         # identifier-quoting tricks the regex pre-filter can't see
         # are denied at resolution time
-        s = confined_worker_session()
+        try:
+            s = confined_worker_session()
+        except RuntimeError as e:       # proxied DB: cannot confine
+            raise ApiError(str(e), status=501)
     if op in ('execute', 'executemany') or not is_select:
         # audit every statement that can write, whichever op carried it
         DbAuditProvider(_session()).record(role, computer, op, sql)
@@ -544,11 +547,18 @@ def api_db(data, s):
                 rows = rows[:1]
             return {'success': True,
                     'rows': [encode_row(r) for r in rows]}
-    except sqlite3.DatabaseError as e:
+    except sqlite3.Error as e:
         msg = str(e).lower()
-        if role == 'worker' and ('not authorized' in msg
-                                 or 'prohibited' in msg):
-            raise ApiError(f'denied by authorizer: {e}', status=403)
+        if role == 'worker':
+            if 'not authorized' in msg or 'prohibited' in msg:
+                raise ApiError(f'denied by authorizer: {e}', status=403)
+            # a genuine DB error on the CONFINED connection: heal that
+            # session, not the shared one (_dispatch's sqlite3.Error
+            # handler would recreate the healthy server_api connection
+            # under concurrently-serving threads)
+            from mlcomp_tpu.db.core import Session
+            Session.cleanup('api_db_worker')
+            raise ApiError(f'worker db error: {e}', status=500)
         raise
     raise ApiError(f'unknown db op {op!r}')
 
