@@ -27,6 +27,8 @@ _DEFAULT_ENV = """\
 # Every variable here is exported into the process environment on import.
 ROOT_FOLDER=
 TOKEN=token
+WORKER_TOKEN=
+INSTALL_LIBRARIES=False
 DB_TYPE=SQLITE
 POSTGRES_DB=mlcomp_tpu
 POSTGRES_USER=mlcomp_tpu
@@ -124,7 +126,18 @@ TOKEN = _ENV.get('TOKEN', 'token')
 # per-computer worker-class credential (issued by `server issue-token`);
 # when set, RemoteSession authenticates with it instead of the
 # full-control server TOKEN — see db/models/auth.py
-WORKER_TOKEN = _ENV.get('WORKER_TOKEN', '')
+# os.environ first: _ENV only reflects the environment for keys the
+# MATERIALIZED .env file mentions — a machine whose configs/.env
+# predates a key would silently ignore the exported variable
+WORKER_TOKEN = os.environ.get('WORKER_TOKEN',
+                              _ENV.get('WORKER_TOKEN', ''))
+# opt-in pip install of DagLibrary-recorded versions at task download
+# (reference worker/storage.py:206-215); default off — zero-egress
+# images and pinned environments should not mutate themselves
+INSTALL_LIBRARIES = os.environ.get(
+    'INSTALL_LIBRARIES',
+    _ENV.get('INSTALL_LIBRARIES', 'False')).lower() in ('1', 'true',
+                                                        'yes')
 DB_TYPE = _ENV.get('DB_TYPE', 'SQLITE')
 
 if DB_TYPE == 'SQLITE':
@@ -174,8 +187,8 @@ if os.environ.get('JAX_PLATFORMS') == 'cpu':
 __all__ = [
     '__version__', 'ROOT_FOLDER', 'DATA_FOLDER', 'MODEL_FOLDER',
     'TASK_FOLDER', 'LOG_FOLDER', 'CONFIG_FOLDER', 'DB_FOLDER', 'TMP_FOLDER',
-    'TOKEN', 'WORKER_TOKEN', 'DB_TYPE', 'SA_CONNECTION_STRING',
-    'MASTER_PORT_RANGE',
+    'TOKEN', 'WORKER_TOKEN', 'INSTALL_LIBRARIES', 'DB_TYPE',
+    'SA_CONNECTION_STRING', 'MASTER_PORT_RANGE',
     'QUEUE_POLL_INTERVAL', 'FILE_SYNC_INTERVAL', 'WORKER_USAGE_INTERVAL',
     'WEB_HOST', 'WEB_PORT', 'IP', 'PORT', 'SYNC_WITH_THIS_COMPUTER',
     'CAN_PROCESS_TASKS', 'DOCKER_IMG', 'DOCKER_MAIN',

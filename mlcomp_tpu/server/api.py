@@ -552,12 +552,15 @@ def api_db(data, s):
         if role == 'worker':
             if 'not authorized' in msg or 'prohibited' in msg:
                 raise ApiError(f'denied by authorizer: {e}', status=403)
-            # a genuine DB error on the CONFINED connection: heal that
-            # session, not the shared one (_dispatch's sqlite3.Error
-            # handler would recreate the healthy server_api connection
-            # under concurrently-serving threads)
-            from mlcomp_tpu.db.core import Session
-            Session.cleanup('api_db_worker')
+            # heal the CONFINED session, not the shared one — but only
+            # for connection-level failures (locked/closed/corrupt).
+            # IntegrityError/ProgrammingError are per-statement faults
+            # any worker could trigger at will; closing the shared
+            # confined connection for those would flap it under
+            # concurrent worker requests
+            if isinstance(e, sqlite3.OperationalError):
+                from mlcomp_tpu.db.core import Session
+                Session.cleanup('api_db_worker')
             raise ApiError(f'worker db error: {e}', status=500)
         raise
     raise ApiError(f'unknown db op {op!r}')

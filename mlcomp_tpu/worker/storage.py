@@ -190,6 +190,52 @@ class Storage:
         link_project_folders(folder, project.name if project else 'default')
         return folder
 
+    # ------------------------------------------------------------ libraries
+    def install_libraries(self, dag_id: int) -> list:
+        """Install the DagLibrary-recorded versions that differ from the
+        running environment (reference worker/storage.py:206-215).
+        Returns the ``lib==version`` specs actually installed; the
+        caller requeues the task once so a fresh process imports them.
+        Only runs when INSTALL_LIBRARIES is enabled (opt-in)."""
+        import re
+        import subprocess
+        import sys
+        from importlib import metadata
+
+        from mlcomp_tpu.db.providers import DagLibraryProvider
+
+        # dag_library rows are writable by worker-tier tokens — validate
+        # before they become pip argv, or a row like
+        # library='--index-url=http://evil' is option injection
+        name_re = re.compile(
+            r'^[A-Za-z0-9]([A-Za-z0-9._-]*[A-Za-z0-9])?$')   # PEP 508
+        version_re = re.compile(r'^[A-Za-z0-9._!+*]+$')      # PEP 440-ish
+        needed = []
+        for library, version in DagLibraryProvider(self.session).dag(
+                dag_id):
+            if not version:
+                continue
+            if not name_re.match(library) or not version_re.match(version):
+                raise ValueError(
+                    f'refusing suspicious dag_library row '
+                    f'{library!r}=={version!r}')
+            try:
+                have = metadata.version(library)
+            except metadata.PackageNotFoundError:
+                have = None
+            if have != version:
+                needed.append(f'{library}=={version}')
+        if not needed:
+            return []
+        cmd = [sys.executable, '-m', 'pip', 'install', *needed]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f'pip install {" ".join(needed)} failed:\n'
+                f'{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}')
+        return needed
+
     # ------------------------------------------------------------- importing
     def import_executor(self, folder: str, executor_type: str):
         """Find and import the executor class for `executor_type`.

@@ -165,19 +165,10 @@ class ExecuteBuilder:
             if 0 <= idx < len(stages) - 1:
                 info = self.additional_info()
                 info['stage'] = stages[idx + 1]
-                from mlcomp_tpu.utils.io import yaml_dump
-                self.task.additional_info = yaml_dump(info)
-                self.provider.update(self.task, ['additional_info'])
+                self._save_info(info)
                 self.provider.change_status(self.task, TaskStatus.Queued)
                 if self.task.queue_id is not None:
-                    queue = self.personal_queue()
-                    msg_id = self.queue_provider.enqueue(queue, {
-                        'action': 'execute', 'task_id': self.task.id})
-                    # point the task at the NEW message so kill/revoke
-                    # targets the pending stage, not the consumed one
-                    self.task.queue_id = msg_id
-                    self.provider.update(self.task, ['queue_id'])
-                    return 'requeued'
+                    return self._requeue()
                 # debug mode: loop stages in-process
                 return self.build()
         self.provider.change_status(self.task, TaskStatus.Success)
@@ -189,6 +180,63 @@ class ExecuteBuilder:
         from mlcomp_tpu.utils.misc import hostname
         return f'{hostname()}_{docker}_{self.worker_index}'
 
+    def _save_info(self, info: dict):
+        from mlcomp_tpu.utils.io import yaml_dump
+        self.task.additional_info = yaml_dump(info)
+        self.provider.update(self.task, ['additional_info'])
+
+    def _requeue(self) -> str:
+        """Re-enqueue this task on THIS worker's personal queue and point
+        the task at the NEW message so kill/revoke targets the pending
+        dispatch, not the consumed one."""
+        msg_id = self.queue_provider.enqueue(self.personal_queue(), {
+            'action': 'execute', 'task_id': self.task.id})
+        self.task.queue_id = msg_id
+        self.provider.update(self.task, ['queue_id'])
+        return 'requeued'
+
+    def install_libraries(self):
+        """Opt-in: install recorded DagLibrary versions and requeue ONCE
+        so a fresh process imports them (reference
+        worker/storage.py:206-215 + requeue at worker/tasks.py:170-183).
+        Returns 'requeued' when the task was re-enqueued."""
+        from mlcomp_tpu import INSTALL_LIBRARIES
+        if not INSTALL_LIBRARIES:
+            return None
+        info = self.additional_info()
+        if info.get('libraries_installed'):
+            return None                 # the one allowed requeue is spent
+        if info.get('distr_info'):
+            # requeueing one process of a multi-host job would leave its
+            # peers blocked at the coordinator until the join timeout —
+            # provision distributed hosts up front instead
+            self.logger.warning(
+                f'task {self.task.id}: INSTALL_LIBRARIES skipped for a '
+                f'distributed service task', ComponentType.Worker, None,
+                self.task.id)
+            return None
+        installed = self.storage.install_libraries(self.dag.id)
+        if not installed:
+            return None
+        self.logger.info(
+            f'task {self.task.id}: installed {installed}; requeueing '
+            f'for a fresh interpreter', ComponentType.Worker, None,
+            self.task.id)
+        if self.task.queue_id is not None:
+            info['libraries_installed'] = True
+            self._save_info(info)
+            self.provider.change_status(self.task, TaskStatus.Queued)
+            return self._requeue()
+        # debug/in-process mode: no fresh interpreter to requeue into —
+        # modules ALREADY imported keep their old version in this
+        # process; don't spend the flag (a later queued dispatch still
+        # gets its fresh-interpreter pass)
+        self.logger.warning(
+            f'task {self.task.id}: running in-process after install; '
+            f'already-imported modules keep their previous versions',
+            ComponentType.Worker, None, self.task.id)
+        return None
+
     # ----------------------------------------------------------------- main
     def build(self):
         try:
@@ -196,6 +244,9 @@ class ExecuteBuilder:
             self.check_status()
             self.mark_in_progress()
             folder = self.download()
+            requeued = self.install_libraries()
+            if requeued:
+                return requeued
             self.pin_cores()
             self.init_distributed()
             self.create_executor(folder)

@@ -1,0 +1,194 @@
+"""Library auto-install parity behind INSTALL_LIBRARIES
+(VERDICT r2 next-#8; reference worker/storage.py:206-215): recorded
+DagLibrary versions are pip-installed at task download and the task is
+requeued ONCE for a fresh interpreter. Tested against a handcrafted
+wheel served from a local --find-links dir (zero egress)."""
+
+import os
+import subprocess
+import sys
+import zipfile
+
+import pytest
+
+LIB = 'mlcomp-tpu-testwheel'
+MOD = 'mlcomp_tpu_testwheel'
+VERSION = '0.0.1'
+
+
+def make_wheel(folder) -> str:
+    """A minimal PEP-427 wheel pip will install without network."""
+    name = f'{MOD}-{VERSION}-py3-none-any.whl'
+    path = os.path.join(str(folder), name)
+    dist = f'{MOD}-{VERSION}.dist-info'
+    meta = (f'Metadata-Version: 2.1\nName: {LIB}\n'
+            f'Version: {VERSION}\n')
+    wheel = ('Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib: '
+             'true\nTag: py3-none-any\n')
+    record = (f'{MOD}/__init__.py,,\n{dist}/METADATA,,\n'
+              f'{dist}/WHEEL,,\n{dist}/RECORD,,\n')
+    with zipfile.ZipFile(path, 'w') as zf:
+        zf.writestr(f'{MOD}/__init__.py',
+                    f"__version__ = '{VERSION}'\n")
+        zf.writestr(f'{dist}/METADATA', meta)
+        zf.writestr(f'{dist}/WHEEL', wheel)
+        zf.writestr(f'{dist}/RECORD', record)
+    return path
+
+
+def _uninstall():
+    subprocess.run([sys.executable, '-m', 'pip', 'uninstall', '-y', LIB],
+                   capture_output=True)
+    # a prior in-process import would otherwise survive the uninstall
+    sys.modules.pop(MOD, None)
+    import importlib
+    importlib.invalidate_caches()
+
+
+@pytest.fixture()
+def wheelhouse(tmp_path, monkeypatch):
+    make_wheel(tmp_path)
+    # pip reads these env vars — the 'local wheel index'
+    monkeypatch.setenv('PIP_NO_INDEX', '1')
+    monkeypatch.setenv('PIP_FIND_LINKS', str(tmp_path))
+    _uninstall()
+    yield str(tmp_path)
+    _uninstall()
+
+
+def _record_library(session, dag_id, version=VERSION):
+    from mlcomp_tpu.db.models import DagLibrary
+    session.add(DagLibrary(dag=dag_id, library=LIB, version=version))
+
+
+def _dag(session, tmp_path):
+    from mlcomp_tpu.server.create_dags.standard import dag_standard
+    folder = tmp_path / 'exp'
+    folder.mkdir(exist_ok=True)
+    (folder / 'executors.py').write_text(
+        'from mlcomp_tpu.worker.executors import Executor\n'
+        '@Executor.register\n'
+        f'class NeedsLib(Executor):\n'
+        '    def __init__(self, **kw):\n'
+        '        pass\n'
+        '    def work(self):\n'
+        f'        import {MOD}\n'
+        f'        return {{"lib_version": {MOD}.__version__}}\n')
+    config = {
+        'info': {'name': 'lib_dag', 'project': 'p_libs'},
+        'executors': {'needs': {'type': 'needs_lib'}},
+    }
+    return dag_standard(session, config, upload_folder=str(folder))
+
+
+class TestInstallLibraries:
+    def test_storage_installs_recorded_versions(self, session,
+                                                wheelhouse, tmp_path):
+        from importlib import metadata
+        from mlcomp_tpu.worker.storage import Storage
+        dag, _ = _dag(session, tmp_path)
+        _record_library(session, dag.id)
+        installed = Storage(session).install_libraries(dag.id)
+        assert installed == [f'{LIB}=={VERSION}']
+        assert metadata.version(LIB) == VERSION
+        # second call: versions now match -> nothing to do
+        assert Storage(session).install_libraries(dag.id) == []
+
+    def test_option_injection_rows_refused(self, session, wheelhouse,
+                                           tmp_path):
+        """dag_library is worker-writable — rows must never become pip
+        options (--index-url=... would fetch from an attacker index)."""
+        from mlcomp_tpu.db.models import DagLibrary
+        from mlcomp_tpu.worker.storage import Storage
+        dag, _ = _dag(session, tmp_path)
+        session.add(DagLibrary(dag=dag.id,
+                               library='--index-url=http://evil/simple',
+                               version='1'))
+        with pytest.raises(ValueError, match='suspicious'):
+            Storage(session).install_libraries(dag.id)
+
+    def test_distributed_task_skips_install(self, session, monkeypatch,
+                                            wheelhouse, tmp_path):
+        import mlcomp_tpu
+        from mlcomp_tpu.utils.io import yaml_dump
+        from mlcomp_tpu.db.providers import TaskProvider
+        from mlcomp_tpu.worker.tasks import ExecuteBuilder
+        monkeypatch.setattr(mlcomp_tpu, 'INSTALL_LIBRARIES', True)
+        dag, tasks = _dag(session, tmp_path)
+        _record_library(session, dag.id)
+        tp = TaskProvider(session)
+        task = tp.by_id(tasks['needs'][0])
+        task.additional_info = yaml_dump(
+            {'distr_info': {'process_index': 0, 'process_count': 2}})
+        tp.update(task, ['additional_info'])
+        builder = ExecuteBuilder(task.id, session=session)
+        builder.create_base()
+        assert builder.install_libraries() is None
+        from importlib import metadata
+        with pytest.raises(metadata.PackageNotFoundError):
+            metadata.version(LIB)       # nothing was installed
+
+    def test_pip_failure_raises_with_output(self, session, wheelhouse,
+                                            tmp_path):
+        from mlcomp_tpu.worker.storage import Storage
+        dag, _ = _dag(session, tmp_path)
+        _record_library(session, dag.id, version='9.9.9')  # no such wheel
+        with pytest.raises(RuntimeError, match='pip install'):
+            Storage(session).install_libraries(dag.id)
+
+    def test_requeue_once_through_the_daemon(self, session, monkeypatch,
+                                             wheelhouse, tmp_path):
+        """First consume installs + requeues; second consume imports the
+        freshly installed library and succeeds. Flag off by default."""
+        import mlcomp_tpu
+        import mlcomp_tpu.worker.__main__ as wmain
+        from mlcomp_tpu.db.enums import TaskStatus
+        from mlcomp_tpu.db.providers import QueueProvider, TaskProvider
+        from mlcomp_tpu.server.supervisor import SupervisorBuilder
+        from mlcomp_tpu.utils.io import yaml_load
+        from mlcomp_tpu.utils.logging import create_logger
+        from tests.test_supervisor import add_computer
+
+        monkeypatch.setattr(mlcomp_tpu, 'INSTALL_LIBRARIES', True)
+        monkeypatch.setattr(wmain, 'HOSTNAME', 'host1')
+        # personal_queue() resolves the hostname at call time
+        monkeypatch.setenv('MLCOMP_HOSTNAME', 'host1')
+        dag, tasks = _dag(session, tmp_path)
+        _record_library(session, dag.id)
+        add_computer(session, name='host1')
+        SupervisorBuilder(session=session).build()
+        tid = tasks['needs'][0]
+        tp = TaskProvider(session)
+        qp = QueueProvider(session)
+        logger = create_logger(session)
+
+        assert wmain._consume_one(session, qp, logger, 0,
+                                  in_process=True)
+        mid = tp.by_id(tid)
+        assert mid.status == int(TaskStatus.Queued)      # requeued
+        info = yaml_load(mid.additional_info)
+        assert info['libraries_installed'] is True
+
+        assert wmain._consume_one(session, qp, logger, 0,
+                                  in_process=True)
+        final = tp.by_id(tid)
+        assert final.status == int(TaskStatus.Success), final.result
+        assert f'"lib_version": "{VERSION}"' in final.result
+
+    def test_flag_off_means_no_install(self, session, monkeypatch,
+                                       wheelhouse, tmp_path):
+        import mlcomp_tpu
+        from mlcomp_tpu.db.enums import TaskStatus
+        from mlcomp_tpu.db.providers import TaskProvider
+        from mlcomp_tpu.worker.tasks import execute_by_id
+        from importlib import metadata
+
+        assert mlcomp_tpu.INSTALL_LIBRARIES is False     # shipped default
+        dag, tasks = _dag(session, tmp_path)
+        _record_library(session, dag.id)
+        with pytest.raises(ModuleNotFoundError):
+            execute_by_id(tasks['needs'][0], exit=False, session=session)
+        assert TaskProvider(session).by_id(
+            tasks['needs'][0]).status == int(TaskStatus.Failed)
+        with pytest.raises(metadata.PackageNotFoundError):
+            metadata.version(LIB)
