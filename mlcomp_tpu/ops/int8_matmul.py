@@ -1,0 +1,147 @@
+"""Weight-only int8 matmul for the serving path.
+
+Small-batch inference is weight-bandwidth-bound: at M tokens per step
+the [K, N] weight read from HBM dwarfs the activations, so halving the
+weight bytes (int8 in HBM, per-output-channel f32 scales, transposed
+[N, K] storage) buys a proportional speedup AND halves the weight
+memory:
+
+    y[M, N] = (x[M, K] @ dequant(w_qt[N, K]).T) * scale[N]
+
+**Measured honestly on the v5e chip** (8-layer K=N=8192 serving stack,
+best-of-5 30-step runs; bench.py ``serving_int8`` records the
+driver-visible numbers every round):
+
+- the XLA lowering of ``dot_general(x, w_qt.astype(bf16) * scale)``
+  **fuses the dequantization into the dot's operand read** — it streams
+  the int8 bytes, never materializing bf16 weights — and beats the
+  bf16-weight matmul 1.1-1.2x across serving batch sizes (M=32..128).
+- this module's Pallas kernel ties that fused XLA path at M=32 and
+  loses above (XLA pipelines the revisited x block better); like
+  ops/fused_ce.py, it stays a verified-exact opt-in reference, and
+  ``impl='auto'`` resolves to the DENSE formulation — the fastest
+  measured path. The "don't hand-schedule what the compiler already
+  does" lesson, recorded with numbers a second time.
+
+So the serving win is real (int8 weights: ~1.15x step time, 2x less
+weight HBM) and the deliverable is the *formulation + integration*:
+``make_predictor(..., quantize='int8')`` (train/export.py) reroutes a
+model export's Dense projections through ``int8_matmul``. Quantization
+is symmetric per-output-channel (absmax / 127); classifier-head
+prediction drift is below 1e-2 on the digits example (tests assert it).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+def quantize_int8(w):
+    """Symmetric per-output-channel quantization of a [K, N] weight.
+    Returns (w_qt int8 [N, K] — TRANSPOSED, see module docstring —
+    and scale f32 [N]) with ``dequant = (w_qt * scale[:, None]).T``."""
+    w = jnp.asarray(w).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w), axis=0)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    w_q = jnp.clip(jnp.round(w / scale), -127, 127)
+    return jnp.asarray(w_q.T, jnp.int8), scale.astype(jnp.float32)
+
+
+def reference_int8_matmul(x, w_qt, scale, compute_dtype=jnp.bfloat16):
+    """The XLA formulation (dequantize then dot) — oracle and fallback."""
+    w = w_qt.astype(compute_dtype) * scale.astype(compute_dtype)[:, None]
+    return jax.lax.dot_general(
+        x.astype(compute_dtype), w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _fit(n: int, want: int, unit: int):
+    start = (min(want, n) // unit) * unit
+    for cand in range(start, unit - 1, -unit):
+        if n % cand == 0:
+            return cand
+    return None
+
+
+def _kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, n_k):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # dequantize the int8 tile in VMEM (VPU) straight into the MXU dot;
+    # per-channel scales apply once at the end so the accumulation stays
+    # a plain f32 GEMM. w tile is [bn, bk]: contract both on dim-1.
+    acc_ref[...] += jax.lax.dot_general(
+        x_ref[...], w_ref[...].astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(k == n_k - 1)
+    def _finalise():
+        o_ref[...] = acc_ref[...] * s_ref[...]
+
+
+def _pallas_int8_matmul(x, w_qt, scale, block_n, block_k,
+                        interpret=False):
+    m, k = x.shape
+    n, _ = w_qt.shape
+    n_k = k // block_k
+    kernel = functools.partial(_kernel, n_k=n_k)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        grid=(n // block_n, n_k),
+        in_specs=[
+            pl.BlockSpec((m, block_k), lambda j, kk: (0, kk)),
+            pl.BlockSpec((block_n, block_k), lambda j, kk: (j, kk)),
+            pl.BlockSpec((1, block_n), lambda j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((m, block_n), lambda j, kk: (0, j)),
+        scratch_shapes=[pltpu.VMEM((m, block_n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'arbitrary')),
+        interpret=interpret,
+    )(x.astype(jnp.bfloat16), w_qt, scale.reshape(1, n))
+
+
+def int8_matmul(x, w_qt, scale, impl: str = 'auto',
+                block_n: int = 512, block_k: int = 4096,
+                interpret: bool = False):
+    """``x [M, K] @ dequant(w_qt [N, K]).T -> f32 [M, N]``.
+
+    ``impl``: 'auto' (the dense formulation — XLA fuses the dequant
+    into the dot and it is the measured-fastest path, see module
+    docstring), 'pallas' (the opt-in kernel), 'dense'.
+    """
+    m, k = x.shape
+    n, k2 = w_qt.shape
+    if k != k2 or scale.shape != (n,):
+        raise ValueError(
+            f'shape mismatch: x {x.shape}, w_qt {w_qt.shape} '
+            f'(transposed [N, K] from quantize_int8), '
+            f'scale {scale.shape}')
+    bn = _fit(n, block_n, 128)
+    bk = _fit(k, block_k, 128)
+    tiles = bn is not None and bk is not None and m % 8 == 0
+    if impl == 'auto':
+        use_pallas = False   # dense measured faster (docstring)
+    elif impl == 'pallas':
+        if not tiles:
+            raise ValueError(
+                f'({m}, {k}) @ ({n}, {k2})^T does not tile '
+                f'(need M%8==0, K%128==0, N%128==0)')
+        use_pallas = True
+    elif impl == 'dense':
+        use_pallas = False
+    else:
+        raise ValueError(f'unknown impl {impl!r}')
+    if not use_pallas:
+        return reference_int8_matmul(x, w_qt, scale)
+    return _pallas_int8_matmul(x, w_qt, scale, bn, bk,
+                               interpret=interpret)
+
+
+__all__ = ['quantize_int8', 'int8_matmul', 'reference_int8_matmul']

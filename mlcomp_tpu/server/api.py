@@ -553,12 +553,15 @@ def api_db(data, s):
             if 'not authorized' in msg or 'prohibited' in msg:
                 raise ApiError(f'denied by authorizer: {e}', status=403)
             # heal the CONFINED session, not the shared one — but only
-            # for connection-level failures (locked/closed/corrupt).
-            # IntegrityError/ProgrammingError are per-statement faults
-            # any worker could trigger at will; closing the shared
-            # confined connection for those would flap it under
-            # concurrent worker requests
-            if isinstance(e, sqlite3.OperationalError):
+            # for connection-level failures: OperationalError
+            # (locked/io), a closed connection (ProgrammingError whose
+            # message says so), or corruption. Plain Integrity/
+            # ProgrammingErrors are per-statement faults any worker
+            # could trigger at will; closing the shared confined
+            # connection for those would flap it under concurrent
+            # worker requests
+            if isinstance(e, sqlite3.OperationalError) \
+                    or 'closed' in msg or 'malformed' in msg:
                 from mlcomp_tpu.db.core import Session
                 Session.cleanup('api_db_worker')
             raise ApiError(f'worker db error: {e}', status=500)

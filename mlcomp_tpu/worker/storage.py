@@ -227,7 +227,11 @@ class Storage:
                 needed.append(f'{library}=={version}')
         if not needed:
             return []
-        cmd = [sys.executable, '-m', 'pip', 'install', *needed]
+        # --no-deps: control_reqs recorded the full import closure, and
+        # letting the resolver pull transitive deps could silently
+        # up/downgrade the worker's own pins (e.g. numpy under jax)
+        cmd = [sys.executable, '-m', 'pip', 'install', '--no-deps',
+               *needed]
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=600)
         if proc.returncode != 0:

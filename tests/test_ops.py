@@ -263,3 +263,69 @@ class TestFusedCE:
         np.testing.assert_allclose(np.asarray(pallas),
                                    np.asarray(dense),
                                    atol=1e-5, rtol=1e-5)
+
+
+class TestInt8Matmul:
+    """Weight-only int8 serving matmul (ops/int8_matmul.py) — kernel in
+    interpret mode vs the dequantize-then-dot oracle."""
+
+    def _case(self, m=32, k=256, n=384):
+        import jax.numpy as jnp
+        import numpy as np
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(m, k), jnp.float32)
+        w = jnp.asarray(rng.randn(k, n) * 0.05, jnp.float32)
+        return x, w
+
+    def test_quantization_roundtrip_error_bounded(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from mlcomp_tpu.ops.int8_matmul import quantize_int8
+        _, w = self._case()
+        w_qt, scale = quantize_int8(w)
+        assert w_qt.dtype == jnp.int8 and scale.shape == (384,)
+        assert w_qt.shape == (384, 256)          # transposed layout
+        deq = (np.asarray(w_qt, np.float32)
+               * np.asarray(scale)[:, None]).T
+        err = np.abs(deq - np.asarray(w))
+        # symmetric absmax/127: error bounded by scale/2 per channel
+        assert (err <= np.asarray(scale)[None, :] / 2 + 1e-7).all()
+
+    def test_kernel_matches_dequant_reference(self):
+        import numpy as np
+        from mlcomp_tpu.ops.int8_matmul import (
+            int8_matmul, quantize_int8, reference_int8_matmul,
+        )
+        x, w = self._case()
+        w_qt, scale = quantize_int8(w)
+        got = int8_matmul(x, w_qt, scale, impl='pallas',
+                          block_n=128, block_k=128, interpret=True)
+        want = reference_int8_matmul(x, w_qt, scale)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_matmul_close_to_exact(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from mlcomp_tpu.ops.int8_matmul import (
+            int8_matmul, quantize_int8,
+        )
+        x, w = self._case()
+        w_q, scale = quantize_int8(w)
+        got = int8_matmul(x, w_q, scale, impl='dense')
+        exact = np.asarray(jnp.dot(x, w))
+        rel = np.abs(np.asarray(got) - exact).max() / np.abs(exact).max()
+        assert rel < 0.02, rel
+
+    def test_auto_dispatch_and_untileable(self):
+        import pytest as _pytest
+        from mlcomp_tpu.ops.int8_matmul import (
+            int8_matmul, quantize_int8,
+        )
+        x, w = self._case(m=10, k=100, n=99)    # tiles nothing
+        w_q, scale = quantize_int8(w)
+        int8_matmul(x, w_q, scale)    # auto -> dense (measured faster)
+        with _pytest.raises(ValueError, match='tile'):
+            int8_matmul(x, w_q, scale, impl='pallas')
+        with _pytest.raises(ValueError, match='shape mismatch'):
+            int8_matmul(x, w_q, scale[:-1])
