@@ -122,20 +122,23 @@ class Equation(Executor):
             f'no predictions for {base!r} under {PRED_FOLDER}')
 
     def infer(self, file: str = None, batch_size: int = 512,
-              activation: str = 'softmax', tta=()) -> np.ndarray:
+              activation: str = 'softmax', tta=(),
+              quantize: str = None) -> np.ndarray:
         """Run a model export over this part's input batch on the TPU.
         The input comes from ``self.x`` (set by the concrete executor's
         ``create_base``), sliced to the current part. The loaded export
         + jitted apply are cached on the instance, so chunked parts and
-        TTA views reuse one XLA compilation."""
+        TTA views reuse one XLA compilation. ``quantize='int8'`` serves
+        through the weight-only int8 path (train/export.py)."""
         from mlcomp_tpu.train.export import make_predictor
         name = file or self._resolve_model_name() or self.name
         path = os.path.join('models', str(name))
-        key = (path, batch_size, activation)
+        key = (path, batch_size, activation, quantize)
         predict = self._predictors.get(key)
         if predict is None:
             predict = make_predictor(file=path, batch_size=batch_size,
-                                     activation=activation)
+                                     activation=activation,
+                                     quantize=quantize)
             self._predictors[key] = predict
         x = self._part_input()
         if tta:
