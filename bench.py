@@ -51,6 +51,18 @@ def _step_flops(train_step, state, x, y):
         return None
 
 
+#: wall-clock budget for the whole bench: optional legs are skipped
+#: once exceeded so ONE JSON line always lands even when the tunneled
+#: chip's remote-compile service is having a slow day (observed 2-3x
+#: compile-time swings). The primary CIFAR metric always runs.
+BENCH_BUDGET_S = float(os.environ.get('BENCH_BUDGET_S', '540'))
+_T0 = time.monotonic()
+
+
+def over_budget() -> bool:
+    return time.monotonic() - _T0 > BENCH_BUDGET_S
+
+
 def bench_lm(peak_tflops: float) -> dict:
     """Flagship transformer_lm: long-context training step with the
     Pallas flash-attention kernel (fwd+bwd, ops/flash_attention.py) vs
@@ -83,16 +95,19 @@ def bench_lm(peak_tflops: float) -> dict:
     mesh = mesh_from_spec({'dp': -1})
     n_devices = len(mesh.devices.flat)
     batch = n_devices
-    tokens = np.random.RandomState(0).randint(
-        0, vocab, (batch, seq_len)).astype(np.int32)
     optimizer, _ = make_optimizer({'name': 'adamw', 'lr': 3e-4}, 1000)
     loss_fn = loss_for_task('lm_ce')
 
-    def measure(attn_impl, remat=False):
+    def measure(attn_impl, remat=False, t=seq_len, d=d_model,
+                layers=n_layers, v=vocab, n_steps=steps):
+        """One timed config in its own scope: device buffers die with
+        the frame whether it returns or raises."""
+        tokens = np.random.RandomState(0).randint(
+            0, v, (batch, t)).astype(np.int32)
         model = create_model(
-            'transformer_lm', mesh=mesh, vocab_size=vocab,
-            d_model=d_model, n_layers=n_layers, n_heads=d_model // 64,
-            d_ff=4 * d_model, max_seq_len=seq_len, dtype='bfloat16',
+            'transformer_lm', mesh=mesh, vocab_size=v,
+            d_model=d, n_layers=layers, n_heads=d // 64,
+            d_ff=4 * d, max_seq_len=t, dtype='bfloat16',
             attn_impl=attn_impl, remat=remat)
         state = create_train_state(
             model, optimizer, tokens, jax.random.PRNGKey(0), mesh=mesh)
@@ -104,12 +119,12 @@ def bench_lm(peak_tflops: float) -> dict:
             state, metrics = step(state, x, None)
         float(metrics['loss'])        # value fetch = real barrier
         t0 = time.perf_counter()
-        for _ in range(steps):
+        for _ in range(n_steps):
             state, metrics = step(state, x, None)
         float(metrics['loss'])
         dt = time.perf_counter() - t0
-        tok_s = batch * seq_len * steps / dt
-        flops_per_token = 6 * n_params + 6 * n_layers * seq_len * d_model
+        tok_s = batch * t * n_steps / dt
+        flops_per_token = 6 * n_params + 6 * layers * t * d
         mfu = (tok_s * flops_per_token /
                (peak_tflops * 1e12 * n_devices))
         return tok_s, mfu, n_params
@@ -126,6 +141,23 @@ def bench_lm(peak_tflops: float) -> dict:
                      f'bf16, flash attention fwd+bwd',
     }
 
+    # long-context leg: a full training step at 4x the flagship context
+    # (where the dense formulation is far beyond HBM) — the first-class
+    # long-context claim, driver-captured instead of docstring-only
+    long_t = int(os.environ.get('BENCH_LM_LONG_SEQ', '32768'))
+    if long_t > seq_len and not over_budget():
+        try:
+            tok_s, _, _ = measure(flash_impl, t=long_t, d=512,
+                                  layers=4, v=8192, n_steps=5)
+            result['lm_long_context_tokens_per_sec'] = round(tok_s, 1)
+            result['lm_long_context'] = (
+                f'T={long_t} full train step, 4 layers d=512, flash '
+                f'attention (dense attn alone would need '
+                f'{8 * long_t * long_t * 2 / 1e9:.0f} GB/layer)')
+        except Exception as e:
+            result['lm_long_context_error'] = \
+                f'{type(e).__name__}: {e}'[:200]
+
     # dense baseline. Plain dense materializes [B,H,T,T] attention —
     # at the flagship config that alone is ~2 GB bf16 fwd + several
     # f32 copies in bwd and the whole graph needs ~33 GB on a 16 GB
@@ -140,6 +172,9 @@ def bench_lm(peak_tflops: float) -> dict:
     # per-DEVICE bytes: the batch is dp-sharded across n_devices
     attn_bytes = (batch // n_devices) * (d_model // 64) \
         * seq_len * seq_len * 2
+    if over_budget():
+        result['lm_dense_mode'] = 'skipped (budget)'
+        return result
     dense_mode = 'plain'
     try:
         if 8 * attn_bytes > hbm:     # fwd+bwd copies, f32 upcasts
@@ -162,57 +197,76 @@ def bench_lm(peak_tflops: float) -> dict:
 
 
 def bench_serving_int8() -> dict:
-    """Weight-only int8 serving matmul (ops/int8_matmul.py): an 8-layer
-    K=N=8192 stack at M=64 tokens — bf16 weights vs int8+fused-dequant
-    (the auto path). The win is HBM bytes: int8 weights stream at half
-    the bf16 bytes and halve the weight memory."""
+    """Weight-only int8 serving matmul: an 8-layer K=N=8192 stack at
+    M=64 tokens, bf16 weights vs int8+dequant (the formulation
+    ops/int8_matmul.py's auto path uses).
+
+    Measured honestly: INTERLEAVED runs of two single-dispatch programs
+    (160 unrolled matmuls each — per-call dispatch latency on a
+    tunneled chip varies more than the effect, and naive per-call loops
+    produced ratios anywhere from 0.67x to 1.5x for identical code).
+    The steady-state answer on this chip is speed PARITY (~1.0x); the
+    int8 win is MEMORY — weights at rest in HBM halve — which is what
+    the serving_int8_weight_memory_ratio records."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from mlcomp_tpu.ops.int8_matmul import int8_matmul, quantize_int8
+    from mlcomp_tpu.ops.int8_matmul import (
+        int8_matmul, quantize_int8,
+    )
 
-    m, kn, layers, steps = 64, 8192, 8, 30
+    m, kn, layers, reps = 64, 8192, 8, 20
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.randn(m, kn), jnp.bfloat16)
-    ws = [jnp.asarray(rng.randn(kn, kn) * 0.02, jnp.float32)
+    x0 = jnp.asarray(rng.randn(m, kn), jnp.bfloat16)
+    ws = [jnp.asarray(rng.randn(kn, kn) * 0.02, jnp.bfloat16)
           for _ in range(layers)]
-    packs = [quantize_int8(w) for w in ws]
-    w_bf16 = [w.astype(jnp.bfloat16) for w in ws]
-    flat = [a for p in packs for a in p]
-    del ws, packs      # drop the ~2 GB f32 originals before timing
+    # the REAL serving path: quantize_int8's transposed [N, K] layout
+    # consumed by int8_matmul's auto formulation
+    packs = []
+    for w in ws:
+        w_qt, scale = quantize_int8(w)
+        packs += [w_qt, scale]
+
+    def feed(y):
+        # keep activations bounded through 160 matmuls; identical cost
+        # on both paths
+        return (y / (jnp.max(jnp.abs(y)) + 1e-6)).astype(jnp.bfloat16)
 
     @jax.jit
     def run_bf16(x, *ws):
-        return sum(jnp.sum(jnp.dot(x, w,
-                                   preferred_element_type=jnp.float32))
-                   for w in ws)
+        for _ in range(reps):
+            for w in ws:
+                x = feed(jnp.dot(x, w,
+                                 preferred_element_type=jnp.float32))
+        return jnp.sum(x.astype(jnp.float32))
 
     @jax.jit
     def run_int8(x, *flat):
-        return sum(jnp.sum(int8_matmul(x, flat[i], flat[i + 1]))
-                   for i in range(0, len(flat), 2))
-
-    def measure(fn, args, reps=5):
-        out = fn(*args)
-        float(out)                  # value fetch = real barrier
-        best = float('inf')
         for _ in range(reps):
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                out = fn(*args)
-            float(out)
-            best = min(best, (time.perf_counter() - t0) / steps)
-        return best * 1e3
+            for i in range(0, len(flat), 2):
+                x = feed(int8_matmul(x, flat[i], flat[i + 1]))
+        return jnp.sum(x.astype(jnp.float32))
 
-    t_bf16 = measure(run_bf16, [x, *w_bf16])
-    t_int8 = measure(run_int8, [x, *flat])
+    float(run_bf16(x0, *ws))        # value fetch = real barrier
+    float(run_int8(x0, *packs))
+    t_bf16, t_int8 = [], []
+    for _ in range(4):              # interleaved: shared conditions
+        t0 = time.perf_counter()
+        float(run_bf16(x0, *ws))
+        t_bf16.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        float(run_int8(x0, *packs))
+        t_int8.append(time.perf_counter() - t0)
+    ms_bf16 = min(t_bf16) / reps * 1e3
+    ms_int8 = min(t_int8) / reps * 1e3
     return {
-        'serving_int8_speedup': round(t_bf16 / t_int8, 3),
-        'serving_int8_ms': round(t_int8, 3),
-        'serving_bf16_ms': round(t_bf16, 3),
+        'serving_int8_speedup': round(ms_bf16 / ms_int8, 3),
+        'serving_int8_ms': round(ms_int8, 3),
+        'serving_bf16_ms': round(ms_bf16, 3),
+        'serving_int8_weight_memory_ratio': 2.0,
         'serving_config': f'{layers}x {kn}x{kn} @ M={m}, weight-only '
-                          f'int8, fused-dequant auto path',
+                          f'int8, interleaved single-dispatch x{reps}',
     }
 
 
@@ -370,14 +424,21 @@ def main():
         # donated-step aliases) so the LM model compiles/runs against a
         # clean HBM
         del state, x_all, y_all, x, y, run_epoch
-        try:
-            result.update(bench_lm(peak_tflops))
-        except Exception as e:     # never lose the primary metric
-            result['lm_error'] = f'{type(e).__name__}: {e}'[:300]
-        try:
-            result.update(bench_serving_int8())
-        except Exception as e:
-            result['serving_int8_error'] = f'{type(e).__name__}: {e}'[:200]
+        if over_budget():
+            result['lm_note'] = 'skipped (budget)'
+        else:
+            try:
+                result.update(bench_lm(peak_tflops))
+            except Exception as e:   # never lose the primary metric
+                result['lm_error'] = f'{type(e).__name__}: {e}'[:300]
+        if over_budget():
+            result.setdefault('serving_int8_note', 'skipped (budget)')
+        else:
+            try:
+                result.update(bench_serving_int8())
+            except Exception as e:
+                result['serving_int8_error'] = \
+                    f'{type(e).__name__}: {e}'[:200]
 
     print(json.dumps(result))
 

@@ -137,7 +137,7 @@ def _fit_block(t: int, want: int) -> int:
 
 def flash_attention_forward(q, k, v, causal: bool = True,
                             scale: Optional[float] = None,
-                            block_q: int = 512, block_k: int = 512,
+                            block_q: int = 512, block_k: int = 1024,
                             interpret: bool = False,
                             with_lse: bool = False):
     """Pallas forward over [B, T, H, D]. T must divide by both block

@@ -8,23 +8,29 @@ memory:
 
     y[M, N] = (x[M, K] @ dequant(w_qt[N, K]).T) * scale[N]
 
-**Measured honestly on the v5e chip** (8-layer K=N=8192 serving stack,
-best-of-5 30-step runs; bench.py ``serving_int8`` records the
-driver-visible numbers every round):
+**Measured honestly on the v5e chip** (8-layer K=N=8192 serving stack;
+bench.py ``serving_int8`` records the driver-visible numbers every
+round). Naive per-call timing loops through the tunneled chip produced
+ratios anywhere from 0.67x to 1.5x for identical code — dispatch
+latency variance swamps the effect. The defensible measurement
+(interleaved single-dispatch programs of 160 unrolled matmuls each)
+says:
 
-- the XLA lowering of ``dot_general(x, w_qt.astype(bf16) * scale)``
-  **fuses the dequantization into the dot's operand read** — it streams
-  the int8 bytes, never materializing bf16 weights — and beats the
-  bf16-weight matmul 1.1-1.2x across serving batch sizes (M=32..128).
-- this module's Pallas kernel ties that fused XLA path at M=32 and
-  loses above (XLA pipelines the revisited x block better); like
-  ops/fused_ce.py, it stays a verified-exact opt-in reference, and
-  ``impl='auto'`` resolves to the DENSE formulation — the fastest
-  measured path. The "don't hand-schedule what the compiler already
-  does" lesson, recorded with numbers a second time.
+- this module's auto path (transposed [N, K] int8 + dot_general) runs
+  between parity and ~1.35x vs the plain bf16 ``x @ w`` a Dense layer
+  would otherwise execute, varying with chip conditions — the
+  dependable part of the speedup is the transposed streaming layout +
+  halved weight bytes, the variance is the tunnel;
+- this module's Pallas kernel ties the XLA lowering at M=32 and loses
+  above; like ops/fused_ce.py it stays a verified-exact opt-in
+  reference, and ``impl='auto'`` resolves to the DENSE formulation.
+  "Don't hand-schedule what the compiler already does", recorded with
+  numbers a second time.
 
-So the serving win is real (int8 weights: ~1.15x step time, 2x less
-weight HBM) and the deliverable is the *formulation + integration*:
+So the dependable serving win is **memory**: weights at rest in HBM
+halve (2x more/larger models per chip), with speed at parity or
+better. The
+deliverable is the formulation + integration:
 ``make_predictor(..., quantize='int8')`` (train/export.py) reroutes a
 model export's Dense projections through ``int8_matmul``. Quantization
 is symmetric per-output-channel (absmax / 127); classifier-head

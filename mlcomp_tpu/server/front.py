@@ -528,7 +528,41 @@ async function viewSupervisor(el) {
   el.appendChild(h(`<div class="pager"><button class="btn"
     onclick="if(confirm('stop worker daemons on this host?'))
       api('stop').then(render)">stop workers</button></div>`));
-  el.appendChild(h('<pre>'+esc(JSON.stringify(res,null,2))+'</pre>'));
+  // structured decision trace (reference auxiliary/supervisor page)
+  const sup = (res && res.supervisor) || res || {};
+  el.appendChild(h(`<div class="cards">
+    <div class="card"><h3>tick</h3>
+      <div class="dim">${esc(sup.time||'no tick yet')}</div>
+      <div>${sup.duration!=null ? (sup.duration*1000).toFixed(1)+' ms'
+            : ''}</div></div>
+    <div class="card"><h3>live queues</h3>
+      <div>${(sup.queues||[]).map(esc).join('<br>')
+             || '<span class=dim>none</span>'}</div></div>
+    <div class="card"><h3>runnable tasks</h3>
+      <div>${(sup.tasks_to_process||[]).map(esc).join(', ')
+             || '<span class=dim>none</span>'}</div></div>
+  </div>`));
+  if ((sup.computers||[]).length)
+    el.appendChild(h('<h3>computer slots</h3><table>'
+      + '<tr><th>name</th><th>cores (x=busy)</th><th>cpu</th>'
+      + '<th>memory</th><th>ports in use</th></tr>'
+      + sup.computers.map(c => `<tr><td>${esc(c.name)}</td>
+        <td style="font-family:monospace">${esc(c.cores)}</td>
+        <td>${esc(c.cpu)}</td><td>${esc(c.memory)}</td>
+        <td>${esc((c.ports||[]).join(', '))}</td></tr>`).join('')
+      + '</table>'));
+  if ((sup.dispatched||[]).length)
+    el.appendChild(h('<h3>dispatched this tick</h3><pre>'
+      + esc(JSON.stringify(sup.dispatched, null, 1)) + '</pre>'));
+  const np = sup.not_placed || {};
+  if (Object.keys(np).length)
+    el.appendChild(h('<h3>not placed (reasons)</h3><table>'
+      + '<tr><th>task</th><th>reasons</th></tr>'
+      + Object.entries(np).map(([tid, r]) => `<tr><td>${esc(tid)}</td>
+        <td><pre style="margin:0">${esc(JSON.stringify(r))}</pre></td>
+        </tr>`).join('') + '</table>'));
+  el.appendChild(h('<details><summary class="dim">raw trace</summary>'
+    + '<pre>'+esc(JSON.stringify(res,null,2))+'</pre></details>'));
   el.appendChild(h('<h3>db audit (proxied writes, newest first)</h3>'
     + '<table><tr><th>time</th><th>role</th><th>computer</th>'
     + '<th>op</th><th>sql</th></tr>'
