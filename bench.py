@@ -205,9 +205,9 @@ def bench_serving_int8() -> dict:
     (160 unrolled matmuls each — per-call dispatch latency on a
     tunneled chip varies more than the effect, and naive per-call loops
     produced ratios anywhere from 0.67x to 1.5x for identical code).
-    The steady-state answer on this chip is speed PARITY (~1.0x); the
-    int8 win is MEMORY — weights at rest in HBM halve — which is what
-    the serving_int8_weight_memory_ratio records."""
+    The steady-state answer on this chip ranges parity..~1.35x run to
+    run; the dependable int8 win is MEMORY — weights at rest in HBM
+    halve — which serving_int8_weight_memory_ratio records."""
     import jax
     import jax.numpy as jnp
     import numpy as np
