@@ -41,10 +41,10 @@ def test_hierarchical_logging_executes(session):
     config = yaml_load(file=os.path.join(folder, 'config.yml'))
     dag, tasks = dag_standard(session, config, upload_folder=folder)
     tp = TaskProvider(session)
-    for name in config['executors']:
-        for tid in tasks[name]:
-            execute_by_id(tid, exit=False, session=session)
-            assert tp.by_id(tid).status == int(TaskStatus.Success)
+    # creation (id) order is the builder's dependency-validated order
+    for tid in sorted(t for ids in tasks.values() for t in ids):
+        execute_by_id(tid, exit=False, session=session)
+        assert tp.by_id(tid).status == int(TaskStatus.Success)
     any_task = next(iter(tasks.values()))[0]
     steps = StepProvider(session).by_task(any_task)
     assert len(steps) >= 2          # nested steps recorded

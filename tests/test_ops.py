@@ -329,3 +329,41 @@ class TestInt8Matmul:
             int8_matmul(x, w_q, scale, impl='pallas')
         with _pytest.raises(ValueError, match='shape mismatch'):
             int8_matmul(x, w_q, scale[:-1])
+
+
+class TestBf16KernelPath:
+    """The MXU dots take bf16 operands when inputs are bf16 (for f32
+    inputs every cast in the kernel is a no-op, so the f32 suites above
+    cannot catch bf16-path regressions like dropping the f32
+    accumulation)."""
+
+    def test_bf16_forward_close_to_f32_reference(self):
+        import jax.numpy as jnp
+        q, k, v = _qkv(t=256, dtype='bfloat16')
+        out = fused_attention(q, k, v, causal=True, impl='interpret')
+        assert out.dtype == jnp.bfloat16
+        ref = reference_attention(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), causal=True)
+        # bf16 rounding of p + output cast: ~8-bit mantissa tolerance
+        err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+        assert err < 2e-2, err
+
+    def test_bf16_gradients_close_to_f32_reference(self):
+        import jax
+        import jax.numpy as jnp
+        q, k, v = _qkv(t=256, dtype='bfloat16')
+        g16 = jax.grad(
+            lambda q, k, v: (fused_attention(
+                q, k, v, impl='interpret').astype(jnp.float32) ** 2)
+            .sum(), argnums=(0, 1, 2))(q, k, v)
+        g32 = jax.grad(
+            lambda q, k, v: (reference_attention(q, k, v) ** 2).sum(),
+            argnums=(0, 1, 2))(
+                q.astype(jnp.float32), k.astype(jnp.float32),
+                v.astype(jnp.float32))
+        for a, b in zip(g16, g32):
+            assert a.dtype == jnp.bfloat16
+            rel = float(jnp.max(jnp.abs(a.astype(jnp.float32) - b))
+                        / (jnp.max(jnp.abs(b)) + 1e-9))
+            assert rel < 5e-2, rel
