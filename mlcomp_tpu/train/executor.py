@@ -68,6 +68,10 @@ class JaxTrain(Executor):
                  checkpoint_every=1, infer_valid=None, profile=None,
                  async_checkpoint=True, **kwargs):
         self.model_spec = dict(model or {'name': 'mlp'})
+        # pretrained init (reference contrib/model/pretrained.py:6-59
+        # head-swap): popped so create_model and the export .json see
+        # architecture args only
+        self.params_file = self.model_spec.pop('params_file', None)
         self.dataset_spec = dict(dataset or {})
         self.loss_name = loss
         self.batch_size = int(batch_size)
@@ -342,6 +346,34 @@ class JaxTrain(Executor):
                         model, optimizer, sample,
                         jax.random.PRNGKey(self.seed), mesh=mesh,
                         with_dropout_rng=True)
+        if self.params_file and jax.process_count() > 1:
+            # the restore-vs-pretrained branch must be UNANIMOUS across
+            # ranks (same hazard _infer_valid votes on): a rank that
+            # restores while another applies pretrained weights trains
+            # collectives on divergent params with no error
+            from jax.experimental import multihost_utils
+            votes = multihost_utils.process_allgather(np.array(
+                [restored is not None,
+                 os.path.exists(self.params_file) or os.path.exists(
+                     self.params_file + '.msgpack')]))
+            restored_flags, file_flags = votes[:, 0], votes[:, 1]
+            if restored_flags.any() != restored_flags.all():
+                raise RuntimeError(
+                    'checkpoint restore succeeded on some hosts only — '
+                    'sync the checkpoint folder before resuming a '
+                    'params_file run')
+            if restored is None and not file_flags.all():
+                raise FileNotFoundError(
+                    f'params_file {self.params_file!r} must be readable '
+                    f'on EVERY host ({int(file_flags.sum())}/'
+                    f'{len(file_flags)} have it)')
+        if restored is None and self.params_file:
+            # pretrained weights seed a FRESH run only; a checkpoint
+            # restore (resume) wins over them, like the reference where
+            # resume checkpoints override pretrained encoder weights
+            from mlcomp_tpu.train.pretrained import apply_pretrained
+            state, summary = apply_pretrained(state, self.params_file)
+            self.info(f'pretrained {self.params_file}: {summary}')
         best = None
         if restored is not None:
             from mlcomp_tpu.train.loop import place_state

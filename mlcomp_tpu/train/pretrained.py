@@ -1,0 +1,154 @@
+"""Pretrained-weight loading with head-swap semantics.
+
+Parity: the reference's practical training story initializes encoders
+from pretrained weights — every vendored encoder family carries
+``pretrained_settings`` with weight URLs (reference
+contrib/segmentation/encoders/resnet.py) and the ``Pretrained``
+classifier head-swaps over pretrainedmodels (reference
+contrib/model/pretrained.py:6-59; segmentation_model_pytorch.py:6-36
+passes ``encoder_weights``). Downloads are impossible in this
+environment, so the TPU-native contract is **local files**: a DAG config
+says ``model: {name: ..., params_file: path}`` and the file is one of
+
+- a framework export (``.msgpack`` written by ``train/export.py`` — the
+  ``.json`` spec next to it is ignored here, only weights are read), or
+- an ``.npz`` whose keys are ``/``-joined parameter paths
+  (``params/Dense_0/kernel``; a missing ``params/`` prefix means the
+  whole archive is the params tree) — the interchange format for
+  weights converted from any other framework.
+
+Merge rule (the head-swap): a leaf loads iff the same path exists in
+the fresh init with the same shape; mismatched shapes keep their fresh
+init (a classifier head whose ``num_classes`` differs re-initializes,
+exactly the reference's ``Pretrained.__init__`` last-layer swap), and
+paths absent from the file keep fresh init too. Loading nothing is an
+error — it means the file doesn't belong to this architecture.
+"""
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def load_pretrained_variables(path: str) -> Dict[str, Any]:
+    """Read ``{'params': ..., 'batch_stats': ...?}`` from a local
+    .msgpack export or .npz; ``path`` may omit the .msgpack suffix."""
+    if path.endswith('.npz'):
+        if not os.path.exists(path):
+            raise FileNotFoundError(f'params_file not found: {path}')
+        with np.load(path) as z:
+            flat = {k: z[k] for k in z.files}
+        tree: Dict[str, Any] = {}
+        for key, value in flat.items():
+            parts = [p for p in key.split('/') if p]
+            node = tree
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+                if not isinstance(node, dict):
+                    raise ValueError(
+                        f'npz key {key!r} nests under a non-dict leaf')
+            node[parts[-1]] = value
+        if 'params' not in tree:
+            tree = {'params': tree}
+        return tree
+    base = path[:-len('.msgpack')] if path.endswith('.msgpack') else path
+    if not os.path.exists(base + '.msgpack'):
+        raise FileNotFoundError(f'params_file not found: {base}.msgpack')
+    from mlcomp_tpu.train.export import load_export
+    variables, _ = load_export(base)
+    return variables
+
+
+class MergeSummary:
+    def __init__(self):
+        self.loaded = []      # paths copied from the file
+        self.reinit = []      # (path, file_shape, init_shape) mismatches
+        self.missing = []     # init paths absent from the file
+
+    def __str__(self):
+        s = (f'{len(self.loaded)} leaves loaded, '
+             f'{len(self.reinit)} shape-mismatched (fresh init), '
+             f'{len(self.missing)} absent from file (fresh init)')
+        if self.reinit:
+            heads = ', '.join(
+                '/'.join(p) + f' {fs}->{ins}'
+                for p, fs, ins in self.reinit[:4])
+            s += f'; reinitialized: {heads}'
+        return s
+
+
+def _merge_tree(init_tree, loaded_tree, path, summary: MergeSummary):
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(init_tree, dict):
+        loaded = loaded_tree if isinstance(loaded_tree, dict) else {}
+        return {k: _merge_tree(v, loaded.get(k), path + (k,), summary)
+                for k, v in init_tree.items()}
+    raw = nn.meta.unbox(init_tree)
+    if loaded_tree is None or isinstance(loaded_tree, dict):
+        summary.missing.append(path)
+        return init_tree
+    arr = np.asarray(loaded_tree)
+    if tuple(arr.shape) != tuple(raw.shape):
+        summary.reinit.append((path, tuple(arr.shape),
+                               tuple(raw.shape)))
+        return init_tree
+    # cast on HOST, then device_put with the init leaf's sharding: only
+    # each device's shard transfers — materializing the full leaf on
+    # device 0 first would OOM exactly the models big enough to need
+    # the mesh
+    host = arr.astype(raw.dtype) if arr.dtype != raw.dtype else arr
+    if isinstance(raw, jax.Array) and hasattr(raw, 'sharding'):
+        placed = jax.device_put(host, raw.sharding)
+    else:
+        placed = jnp.asarray(host)
+    summary.loaded.append(path)
+    return nn.meta.replace_boxed(init_tree, placed)
+
+
+def merge_pretrained(init_variables: Dict[str, Any],
+                     loaded_variables: Dict[str, Any],
+                     ) -> Tuple[Dict[str, Any], MergeSummary]:
+    """Return ``init_variables`` with every shape-matching leaf replaced
+    by the loaded value (placed with the init leaf's sharding, cast to
+    its dtype). Collections beyond params/batch_stats pass through."""
+    summary = MergeSummary()
+    out = {}
+    for col, init_tree in init_variables.items():
+        if col in ('params', 'batch_stats'):
+            out[col] = _merge_tree(init_tree,
+                                   loaded_variables.get(col), (col,),
+                                   summary)
+        else:
+            out[col] = init_tree
+    if not summary.loaded:
+        raise ValueError(
+            'params_file matched ZERO parameters of the freshly '
+            'initialized model — the file does not belong to this '
+            f'architecture ({len(summary.missing)} paths missing, '
+            f'{len(summary.reinit)} shape mismatches)')
+    return out, summary
+
+
+def apply_pretrained(state, params_file: str):
+    """Merge a local weight file into a fresh TrainState (params +
+    batch_stats). Returns ``(state, summary)``. The optimizer state is
+    left at init — fine-tuning starts with fresh moments, matching the
+    reference where the torch optimizer is always constructed after
+    weight loading."""
+    loaded = load_pretrained_variables(params_file)
+    init_vars = {'params': state.params}
+    if state.batch_stats is not None:
+        init_vars['batch_stats'] = state.batch_stats
+    merged, summary = merge_pretrained(init_vars, loaded)
+    state = state.replace(
+        params=merged['params'],
+        batch_stats=merged.get('batch_stats', state.batch_stats))
+    return state, summary
+
+
+__all__ = ['load_pretrained_variables', 'merge_pretrained',
+           'apply_pretrained', 'MergeSummary']
