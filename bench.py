@@ -54,13 +54,202 @@ def _step_flops(train_step, state, x, y):
 #: wall-clock budget for the whole bench: optional legs are skipped
 #: once exceeded so ONE JSON line always lands even when the tunneled
 #: chip's remote-compile service is having a slow day (observed 2-3x
-#: compile-time swings). The primary CIFAR metric always runs.
-BENCH_BUDGET_S = float(os.environ.get('BENCH_BUDGET_S', '540'))
+#: compile-time swings). The primary CIFAR metric always runs; the
+#: grid-DAG leg (the other primary) has its own hard timeout.
+#: 720 covers both primaries + LM + serving at normal tunnel speed.
+BENCH_BUDGET_S = float(os.environ.get('BENCH_BUDGET_S', '720'))
 _T0 = time.monotonic()
 
 
 def over_budget() -> bool:
     return time.monotonic() - _T0 > BENCH_BUDGET_S
+
+
+GRID_CONFIG = """\
+info:
+  name: grid_bench
+  project: grid_bench
+
+executors:
+  train:
+    type: jax_train
+    cores: 1
+    grid:
+      - lr: [0.05, 0.1]
+      - seed: [0, 1, 2]
+    model: {name: resnet18, num_classes: 10, dtype: bfloat16}
+    dataset: {name: cifar10, n_train: %(n_train)d, n_valid: 512}
+    batch_size: 256
+    main_metric: accuracy
+    epochs: %(epochs)d
+    optimizer: {name: sgd, lr: 0.1, momentum: 0.9}
+"""
+# ^ optimizer lives at the TOP level (not inside stages:) so the bare
+#   `lr` grid axis suffix-matches optimizer/lr — `stages` is a list,
+#   opaque to dict_flatten, and a cell key that matches nothing would
+#   silently no-op the grid (tests/test_examples.py pins this config's
+#   cells to distinct lrs)
+
+
+def bench_grid_dag() -> dict:
+    """Grid-search DAG wall-clock through the REAL stack (the second
+    half of BASELINE.json's "metric": never measured before round 4).
+
+    A 6-cell CIFAR grid (2 lr x 3 seeds) is submitted through the CLI
+    to a live server process group (API + 1 Hz supervisor +
+    worker-supervisor + 1 worker). The supervisor places cells onto
+    the worker's TPU slot; the worker runs them with ``--in-process``
+    (one persistent TPU client across cells — measured 75 s/cell with
+    fresh per-task processes, dominated by client init + checkpoint
+    gather through the tunnel, vs ~35 s in-process). Wall-clock and
+    per-task spans come from the DB afterwards (one clock: the
+    framework's own timestamps).
+
+    Accounting: scheduling overhead is the fraction of DAG wall-clock
+    during which NO worker was handling a task — wallclock minus the
+    sum of claim->finished spans. Everything the worker does after the
+    claim (executor build, compile-cache reads, training, checkpoint)
+    counts as task handling, not scheduler idle; the
+    started->finished execution sum is also reported so the split is
+    visible. Cells share the persistent XLA compilation cache (cells
+    differing only in seed reuse lr-mates' executables).
+
+    MUST run before this process initializes jax: a second live client
+    on the tunneled chip — even idle — starves the other's compiles
+    ~30x (measured 26 s -> 125 s).
+    """
+    import signal
+    import socket
+    import sqlite3
+    import subprocess
+    import tempfile
+    from datetime import datetime
+
+    timeout_s = float(os.environ.get('BENCH_GRID_TIMEOUT', '480'))
+    root = tempfile.mkdtemp(prefix='bench_grid_')
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+    env = dict(
+        os.environ,
+        MLCOMP_TPU_ROOT=os.path.join(root, 'root'),
+        WEB_HOST='127.0.0.1', WEB_PORT=str(port),
+        MLCOMP_TPU_CORES='1',
+        QUEUE_POLL_INTERVAL='0.1',
+        JAX_COMPILATION_CACHE_DIR=os.path.join(root, 'jaxcache'),
+    )
+    cfg = os.path.join(root, 'config.yml')
+    with open(cfg, 'w') as fh:
+        fh.write(GRID_CONFIG % {
+            'n_train': int(os.environ.get('BENCH_GRID_SAMPLES', '8192')),
+            'epochs': int(os.environ.get('BENCH_GRID_EPOCHS', '1'))})
+
+    def ts(s):
+        return datetime.fromisoformat(s).timestamp()
+
+    db_path = os.path.join(root, 'root', 'db', 'sqlite.db')
+    repo = os.path.dirname(os.path.abspath(__file__))
+    # --in-process: the worker keeps ONE persistent TPU client across
+    # cells (the TPU-native answer to the reference's per-task
+    # os._exit, SURVEY §7 hard-part (d)) — measured 75 s/cell with
+    # fresh per-task processes (client init + compile-cache reads +
+    # checkpoint gather through the tunnel dominate) vs the training
+    # itself at seconds
+    group = subprocess.Popen(
+        [sys.executable, '-m', 'mlcomp_tpu.server', 'start', '1',
+         '--in-process'],
+        env=env, cwd=repo, start_new_session=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    result = {}
+    try:
+        deadline = time.time() + 90
+        while time.time() < deadline:        # API (hence DB) up?
+            if os.path.exists(db_path):
+                break
+            time.sleep(0.5)
+        sub = subprocess.run(
+            [sys.executable, '-m', 'mlcomp_tpu', 'dag', cfg],
+            env=env, cwd=repo, capture_output=True, text=True,
+            timeout=120)
+        if sub.returncode != 0:
+            raise RuntimeError(f'dag submit failed: {sub.stderr[-500:]}')
+
+        deadline = time.time() + timeout_s
+        n_cells = 0
+        while time.time() < deadline:
+            con = sqlite3.connect(db_path, timeout=10)
+            try:
+                rows = con.execute(
+                    'SELECT status FROM task').fetchall()
+            finally:
+                con.close()
+            n_cells = len(rows)
+            # terminal statuses: Failed=3..Success=6 (db/enums.py)
+            if n_cells and all(r[0] >= 3 for r in rows):
+                break
+            time.sleep(1)
+        con = sqlite3.connect(db_path, timeout=10)
+        try:
+            tasks = con.execute(
+                'SELECT id, status, started, finished, score '
+                'FROM task').fetchall()
+            msgs = con.execute(
+                "SELECT payload, created, claimed_at FROM queue_message "
+                "WHERE payload LIKE '%execute%'").fetchall()
+            dag_created = con.execute(
+                'SELECT created FROM dag').fetchone()[0]
+        finally:
+            con.close()
+        if not tasks or not all(r[1] == 6 for r in tasks):
+            raise RuntimeError(
+                f'grid DAG did not succeed: statuses='
+                f'{[r[1] for r in tasks]}')
+        import json as _json
+        claim_by_task = {}
+        for payload, created, claimed in msgs:
+            tid = _json.loads(payload).get('task_id')
+            if claimed is not None:
+                claim_by_task[tid] = (ts(created), ts(claimed))
+        finishes = [ts(r[3]) for r in tasks]
+        wallclock = max(finishes) - ts(dag_created)
+        exec_sum = sum(ts(r[3]) - ts(r[2]) for r in tasks)
+        busy_sum = sum(
+            ts(r[3]) - claim_by_task[r[0]][1] for r in tasks
+            if r[0] in claim_by_task)
+        overhead_pct = 100.0 * (wallclock - busy_sum) / wallclock
+        dispatch_lat = [c[1] - c[0] for c in claim_by_task.values()]
+        result = {
+            'dag_grid_wallclock_s': round(wallclock, 2),
+            'dag_grid_cells': len(tasks),
+            'dag_grid_worker_busy_s': round(busy_sum, 2),
+            'dag_grid_task_exec_s': round(exec_sum, 2),
+            'dag_grid_sched_overhead_pct': round(overhead_pct, 2),
+            'dag_grid_dispatch_latency_s': round(
+                sum(dispatch_lat) / max(len(dispatch_lat), 1), 3),
+            'dag_grid_best_score': max(
+                (r[4] for r in tasks if r[4] is not None),
+                default=None),
+            'dag_grid_config': '6-cell cifar10 resnet18 grid (2 lr x '
+                               '3 seeds; real npz when present, else '
+                               'synthetic same-shape), 1 worker slot, '
+                               'in-process worker (persistent TPU '
+                               'client), supervisor 1 Hz',
+        }
+    except Exception as e:
+        result = {'dag_grid_error': f'{type(e).__name__}: {e}'[:300]}
+    finally:
+        try:
+            os.killpg(os.getpgid(group.pid), signal.SIGTERM)
+            group.wait(timeout=20)
+        except Exception:
+            try:
+                os.killpg(os.getpgid(group.pid), signal.SIGKILL)
+            except Exception:
+                pass
+        # the chip must be FREE before the caller initializes jax —
+        # wait for any straggler task subprocess in the group
+        time.sleep(1.0)
+    return result
 
 
 def bench_lm(peak_tflops: float) -> dict:
@@ -196,6 +385,65 @@ def bench_lm(peak_tflops: float) -> dict:
     return result
 
 
+def bench_fused_ce() -> dict:
+    """Fused-CE kernel at LM loss shapes (N=8192, V=32768) with z-loss
+    + label smoothing, fwd+bwd: Pallas streaming kernel vs the XLA
+    composite. NOT part of the driver bench (the unrolled fwd+bwd
+    programs take minutes to compile through the tunnel): a manual
+    measurement tool. Round-4 verdict it documents: the kernel only
+    TIES XLA here (0.94-1.04 across block sizes) — auto stays dense,
+    see ops/fused_ce.py docstring for the full sweep."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlcomp_tpu.ops.fused_ce import softmax_ce_per_example
+
+    n, v = 8192, 32768
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(n, v), jnp.bfloat16)
+    labels = jnp.asarray(rng.randint(0, v, n), jnp.int32)
+    z, eps = 1e-4, 0.1
+    reps = 6
+
+    def make(impl):
+        @jax.jit
+        def run(lg, y):
+            total = 0.0
+            for _ in range(reps):
+                loss, grad = jax.value_and_grad(
+                    lambda l: softmax_ce_per_example(
+                        l, y, impl=impl, z_loss=z,
+                        label_smoothing=eps).mean())(lg)
+                total = total + loss
+                # grad feeds the next rep's input: serializes the
+                # unroll (2 live [N,V] buffers instead of 2*reps)
+                lg = lg + grad.astype(lg.dtype) * 1e-6
+            return total + jnp.sum(lg[:8, :128].astype(jnp.float32))
+        return run
+
+    run_pallas, run_dense = make('pallas'), make('dense')
+    float(run_pallas(logits, labels))
+    float(run_dense(logits, labels))
+    t_p, t_d = [], []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        float(run_dense(logits, labels))
+        t_d.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        float(run_pallas(logits, labels))
+        t_p.append(time.perf_counter() - t0)
+    ms_p = min(t_p) / reps * 1e3
+    ms_d = min(t_d) / reps * 1e3
+    return {
+        'ce_zloss_pallas_ms': round(ms_p, 3),
+        'ce_zloss_dense_ms': round(ms_d, 3),
+        'ce_zloss_kernel_speedup': round(ms_d / ms_p, 3),
+        'ce_zloss_config': f'N={n} V={v} bf16 fwd+bwd, z=1e-4 '
+                           f'smoothing=0.1, interleaved x{reps}',
+    }
+
+
 def bench_serving_int8() -> dict:
     """Weight-only int8 serving matmul: an 8-layer K=N=8192 stack at
     M=64 tokens, bf16 weights vs int8+dequant (the formulation
@@ -250,27 +498,46 @@ def bench_serving_int8() -> dict:
 
     float(run_bf16(x0, *ws))        # value fetch = real barrier
     float(run_int8(x0, *packs))
-    t_bf16, t_int8 = [], []
-    for _ in range(4):              # interleaved: shared conditions
+    # per-PAIR speedup ratios from adjacent interleaved trials: the
+    # tunnel's run-to-run swing (±7-40% observed) hits both programs of
+    # a pair roughly equally, so the paired ratio is the stable
+    # statistic. Median + range is what docs/README may claim.
+    ratios, t_bf16, t_int8 = [], [], []
+    trials = int(os.environ.get('BENCH_INT8_TRIALS', '7'))
+    for _ in range(trials):
         t0 = time.perf_counter()
         float(run_bf16(x0, *ws))
-        t_bf16.append(time.perf_counter() - t0)
+        b = time.perf_counter() - t0
         t0 = time.perf_counter()
         float(run_int8(x0, *packs))
-        t_int8.append(time.perf_counter() - t0)
-    ms_bf16 = min(t_bf16) / reps * 1e3
-    ms_int8 = min(t_int8) / reps * 1e3
+        q = time.perf_counter() - t0
+        t_bf16.append(b)
+        t_int8.append(q)
+        ratios.append(b / q)
+    ratios.sort()
+    med = ratios[len(ratios) // 2]
     return {
-        'serving_int8_speedup': round(ms_bf16 / ms_int8, 3),
-        'serving_int8_ms': round(ms_int8, 3),
-        'serving_bf16_ms': round(ms_bf16, 3),
+        'serving_int8_speedup': round(med, 3),
+        'serving_int8_speedup_range': [round(ratios[0], 3),
+                                       round(ratios[-1], 3)],
+        'serving_int8_ms': round(min(t_int8) / reps * 1e3, 3),
+        'serving_bf16_ms': round(min(t_bf16) / reps * 1e3, 3),
         'serving_int8_weight_memory_ratio': 2.0,
         'serving_config': f'{layers}x {kn}x{kn} @ M={m}, weight-only '
-                          f'int8, interleaved single-dispatch x{reps}',
+                          f'int8 (post-scale dense formulation), '
+                          f'median of {trials} interleaved paired '
+                          f'trials x{reps} matmul stacks',
     }
 
 
 def main():
+    # the grid-DAG leg runs FIRST, before this process initializes jax:
+    # its worker task subprocesses need the chip to themselves (a second
+    # live client starves their compiles ~30x through the tunnel)
+    grid_result = {}
+    if os.environ.get('BENCH_GRID', '1') == '1' and not over_budget():
+        grid_result = bench_grid_dag()
+
     import jax
     import numpy as np
 
@@ -412,6 +679,7 @@ def main():
         'mfu_peak_tflops_assumed': peak_tflops,
         'real_cifar10': data.get('source') != 'synthetic',
     }
+    result.update(grid_result)
 
     # second workload: the flagship long-context LM (skippable, and
     # skipped automatically on CPU where a T=8192 dense step is

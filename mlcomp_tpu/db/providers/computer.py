@@ -48,6 +48,22 @@ class ComputerProvider(BaseDataProvider):
             c.usage = json.dumps(usage)
             self.update(c, ['usage'])
 
+    def update_usage_fields(self, name: str, fields: dict):
+        """Merge keys into the live usage JSON without clobbering the
+        rest — lets the process that actually holds the TPU client
+        (an in-process worker) contribute the 'tpu' field while the
+        worker-supervisor owns cpu/memory/disk."""
+        c = self.by_name(name)
+        if c is None:
+            return
+        try:
+            usage = json.loads(c.usage) if c.usage else {}
+        except (ValueError, TypeError):
+            usage = {}
+        usage.update(fields)
+        c.usage = json.dumps(usage)
+        self.update(c, ['usage'])
+
     def add_usage_history(self, name: str, usage: dict, time=None):
         self.add(ComputerUsage(
             computer=name, usage=json.dumps(usage), time=time or now()))

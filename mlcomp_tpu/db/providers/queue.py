@@ -43,6 +43,21 @@ class QueueProvider(BaseDataProvider):
             return None
         return row['id'], json.loads(row['payload'])
 
+    def find_active(self, queue: str, payload: dict):
+        """id of a PENDING message with exactly this payload on this
+        queue, or None. Lets dispatch be idempotent: a supervisor that
+        died between queue-put and the task's status write must not
+        enqueue a SECOND execution on restart. Deliberately excludes
+        'claimed': a claimed message may belong to a dead worker (the
+        reaper fails its task; a restart must get a FRESH message —
+        claim() never re-delivers claimed ids) and the worker-side
+        status guard already refuses duplicate execution of live ones."""
+        row = self.session.query_one(
+            "SELECT id FROM queue_message WHERE queue=? AND payload=? "
+            "AND status='pending' ORDER BY id LIMIT 1",
+            (queue, json.dumps(payload)))
+        return row['id'] if row else None
+
     def complete(self, msg_id: int, result: str = None):
         self.session.execute(
             "UPDATE queue_message SET status='done', result=? WHERE id=?",

@@ -220,8 +220,14 @@ class SupervisorBuilder:
         task.cores_assigned = json.dumps(cores)
         docker = task.docker_assigned or 'default'
         queue = f'{comp["name"]}_{docker}'
-        msg_id = self.queue_provider.enqueue(
-            queue, {'action': 'execute', 'task_id': task.id})
+        # idempotent against a supervisor death between queue-put and
+        # the Queued status write: the task re-loads as NotRan on
+        # restart, but its execute message may already be out — reuse
+        # it instead of enqueueing a second execution
+        payload = {'action': 'execute', 'task_id': task.id}
+        msg_id = self.queue_provider.find_active(queue, payload)
+        if msg_id is None:
+            msg_id = self.queue_provider.enqueue(queue, payload)
         task.queue_id = msg_id
         self.provider.update(
             task, ['computer_assigned', 'cores_assigned', 'queue_id'])

@@ -55,10 +55,16 @@ def _tpu_core_count() -> int:
     env = os.environ.get('MLCOMP_TPU_CORES')
     if env is not None:
         return int(env)
+    # probe in a SUBPROCESS: initializing a jax client here would leave
+    # the daemon holding the chip for its whole lifetime, starving every
+    # task process's compiles ~30x (see _tpu_usage)
     try:
-        import jax
-        return len([d for d in jax.devices()
-                    if d.platform not in ('cpu',)])
+        out = subprocess.run(
+            [sys.executable, '-c',
+             'import jax; print(len([d for d in jax.devices() '
+             'if d.platform != "cpu"]))'],
+            capture_output=True, text=True, timeout=120)
+        return int(out.stdout.strip().splitlines()[-1])
     except Exception:
         return 0
 
@@ -122,6 +128,15 @@ def _consume_one(session, queue_provider, logger, index: int,
                 execute_by_id(task_id, exit=False, worker_index=index,
                               session=session)
                 ok = True
+                # this process holds the live TPU client — it is the
+                # only one that can report HBM telemetry (worker_usage
+                # preserves this field, see its docstring)
+                if 'jax' in sys.modules:
+                    try:
+                        ComputerProvider(session).update_usage_fields(
+                            HOSTNAME, {'tpu': _tpu_usage()})
+                    except Exception:
+                        pass
             else:
                 ok = _run_subprocess(task_id, index, logger, session)
             if ok:
@@ -216,14 +231,28 @@ def stop_processes_not_exist(session, logger):
 def worker_usage(session, logger):
     """Resource telemetry → computer row + usage history
     (reference worker/__main__.py:91-127; GPUtil/psutil there — here the
-    framework's own native /proc sampler, mlcomp_tpu/native)."""
+    framework's own native /proc sampler, mlcomp_tpu/native).
+
+    The 'tpu' field is NOT sampled here: this daemon must never hold a
+    TPU client (see _tpu_usage), so it preserves whatever the process
+    that does hold one — an in-process worker, via
+    update_usage_fields — last wrote."""
+    import json as _json
+
     from mlcomp_tpu import native
     provider = ComputerProvider(session)
+    row = provider.by_name(HOSTNAME)
+    prev_tpu = []
+    if row is not None and row.usage:
+        try:
+            prev_tpu = _json.loads(row.usage).get('tpu') or []
+        except (ValueError, TypeError):
+            pass
     usage = {
         'cpu': native.cpu_percent(),
         'memory': native.memory_percent(),
         'disk': native.disk_percent(ROOT_FOLDER),
-        'tpu': _tpu_usage(),
+        'tpu': prev_tpu or _tpu_usage(),
     }
     provider.current_usage(HOSTNAME, usage)
     provider.add_usage_history(HOSTNAME, usage)
@@ -232,7 +261,14 @@ def worker_usage(session, logger):
 def _tpu_usage():
     """Per-chip HBM occupancy when a jax client is alive in this process
     (TPU analogue of GPUtil load/memory, reference
-    worker/__main__.py:111-117)."""
+    worker/__main__.py:111-117).
+
+    Never INITIALIZES a client: on tunneled/real chips a second live
+    client — even an idle one — starves the compute client's compiles
+    ~30x (measured 26 s -> 125 s on v5e-via-axon). Telemetry reports
+    HBM only when this process already trains (in-process workers)."""
+    if 'jax' not in sys.modules:
+        return []
     try:
         import jax
         out = []

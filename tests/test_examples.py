@@ -48,3 +48,30 @@ def test_hierarchical_logging_executes(session):
     any_task = next(iter(tasks.values()))[0]
     steps = StepProvider(session).by_task(any_task)
     assert len(steps) >= 2          # nested steps recorded
+
+
+def test_bench_grid_config_cells_are_distinct(session):
+    """The bench's grid-DAG leg must actually sweep lr x seed: a cell
+    key that matches nothing in the executor spec silently no-ops the
+    whole grid (stages: lists are opaque to the suffix-path merge —
+    this pins the config to the working top-level-optimizer form)."""
+    import bench
+    from mlcomp_tpu.db.providers import TaskProvider
+    from mlcomp_tpu.utils.io import yaml_load
+    from mlcomp_tpu.worker.executors import Executor
+
+    config = yaml_load(
+        bench.GRID_CONFIG % {'n_train': 256, 'epochs': 1})
+    dag, tasks = dag_standard(session, config)
+    assert len(tasks['train']) == 6
+    tp = TaskProvider(session)
+    seen = set()
+    for tid in tasks['train']:
+        task = tp.by_id(tid)
+        info = yaml_load(task.additional_info or '{}')
+        ex = Executor.from_config('train', config,
+                                  additional_info=info,
+                                  session=session)
+        lr = ex.stages[0]['optimizer']['lr']
+        seen.add((lr, ex.seed))
+    assert seen == {(lr, s) for lr in (0.05, 0.1) for s in (0, 1, 2)}

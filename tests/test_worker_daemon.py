@@ -409,3 +409,49 @@ class TestChaos:
             assert row is not None and row.cores == 1
         finally:
             server2.shutdown()
+
+
+class TestTpuTelemetry:
+    def test_in_process_worker_reports_tpu_usage(self, session,
+                                                 monkeypatch, tmp_path):
+        """The in-process worker (the one process holding a TPU
+        client) writes the 'tpu' usage field after each task, and
+        worker_usage PRESERVES it instead of clobbering (the
+        worker-supervisor must never create its own client — a second
+        live client starves the compute client's compiles ~30x)."""
+        import json
+
+        import mlcomp_tpu.worker.__main__ as wmain
+        from mlcomp_tpu.db.providers import ComputerProvider
+
+        folder = tmp_path / 'exp'
+        folder.mkdir()
+        (folder / 'executors.py').write_text(
+            'from mlcomp_tpu.worker.executors import Executor\n'
+            '@Executor.register\n'
+            'class Noop2(Executor):\n'
+            '    def __init__(self, **kw):\n'
+            '        pass\n'
+            '    def work(self):\n'
+            '        return {}\n')
+        config = {
+            'info': {'name': 'tpu_usage_dag', 'project': 'p_usage'},
+            'executors': {'noop': {'type': 'noop2'}},
+        }
+        wmain.register_computer(session, cores=1)
+        fake = [{'id': 0, 'kind': 'fake-tpu', 'hbm_used': 123}]
+        monkeypatch.setattr(wmain, '_tpu_usage', lambda: fake)
+        dag, tasks = _dispatch(session, monkeypatch, config, str(folder))
+        logger = create_logger(session)
+        qp = QueueProvider(session)
+        assert wmain._consume_one(session, qp, logger, 0,
+                                  in_process=True)
+        provider = ComputerProvider(session)
+        row = provider.by_name(wmain.HOSTNAME)
+        assert json.loads(row.usage)['tpu'] == fake
+        # the supervisor's sampler keeps the worker-written field
+        monkeypatch.setattr(wmain, '_tpu_usage', lambda: [])
+        wmain.worker_usage(session, logger)
+        usage = json.loads(provider.by_name(wmain.HOSTNAME).usage)
+        assert usage['tpu'] == fake
+        assert 'cpu' in usage and 'memory' in usage
