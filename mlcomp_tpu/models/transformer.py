@@ -43,6 +43,12 @@ class TransformerConfig:
     # 'dense'/'pallas'/'interpret' force a path (no effect under sp —
     # ring attention owns the sharded case)
     attn_impl: str = 'auto'
+    # lm_head matmul dtype; None = follow ``dtype``. Earlier rounds ran
+    # the head in f32 unconditionally — at V=32k that is ~12% of model
+    # FLOPs running at the halved f32 MXU rate. bf16 operands with the
+    # loss's f32 upcast is the t5x/maxtext convention (z_loss guards
+    # logit drift)
+    head_dtype: Optional[str] = None
     # MoE (expert parallelism); 0 = dense MLP everywhere
     n_experts: int = 0
     moe_every: int = 2            # every k-th layer is MoE when n_experts>0
@@ -276,7 +282,8 @@ class TransformerLM(nn.Module):
             scale_init=nn.with_logical_partitioning(
                 nn.initializers.ones, ('norm',)))(x)
         # tied-untied head: separate projection, vocab sharded over tp
-        logits = _dense(cfg.vocab_size, ('embed', 'vocab'), jnp.float32,
+        head_dtype = jnp.dtype(cfg.head_dtype or cfg.dtype)
+        logits = _dense(cfg.vocab_size, ('embed', 'vocab'), head_dtype,
                         'lm_head')(x)
         return nn.with_logical_constraint(
             logits, ('batch', 'seq', 'vocab'))

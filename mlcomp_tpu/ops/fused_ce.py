@@ -8,15 +8,24 @@ repo's flash-attention conventions (ops/flash_attention.py):
 row-replicated [N, 128] tiles for per-row scalars, (8, 128)-aligned
 blocks, @pl.when init/accumulate/finalise over an 'arbitrary' grid axis.
 
-**Measured honestly on the v5e chip (N=16384, V=32768, bf16,
-amortized in-jit): the XLA lowering of optax's CE is FASTER — 13.6 ms
-vs 15.4 ms for this kernel's fwd+bwd.** XLA already fuses the f32
-cast + softmax + scatter-subtract into near-memory-bound passes on
-TPU, so ``impl='auto'`` resolves to the dense path; the kernel stays
-as a verified-exact Pallas reduction reference (and the path to custom
-CE variants — z-loss, label smoothing fused in, sampled vocab) rather
-than a default. This is the "don't hand-schedule what the compiler
-already does" lesson, recorded with numbers.
+**Measured honestly on the v5e chip — and the question is now CLOSED
+(round 4, the final stop decision).** Plain CE (N=16384, V=32768,
+bf16, amortized in-jit): the XLA lowering is FASTER — 13.6 ms vs
+15.4 ms fwd+bwd (round 2). Round 4 fused z-loss + label smoothing
+into the kernel's single stream — the composite its earlier docstring
+hypothesized XLA could not fuse — and XLA TIES that too:
+N=8192 V=32768 bf16 fwd+bwd with z=1e-4, smoothing=0.1, block sweep
+bn∈{128,256,512} x bv∈{1024,2048,4096}: kernel/XLA ratios 0.67–1.04,
+best 16.3 ms (dense) vs 15.6 ms (bn=512 bv=1024) — a ~4% edge inside
+the tunnel's run-to-run noise. XLA fuses the extra lse^2 / sum(x)
+terms into the same near-memory-bound passes. So ``impl='auto'``
+resolves to the dense formulation ALWAYS; the kernel stays the
+verified-exact reduction reference, and no further Pallas work on
+elementwise+reduction compositions is planned ("don't hand-schedule
+what the compiler already does", third and final measurement).
+The z_loss/label_smoothing API lands regardless — the dense path
+computes them at the same speed and `lm_ce_with` (train/loop.py)
+exposes them to DAG configs.
 
 ``softmax_ce_per_example`` is the entry point; CPU tests run the
 kernel in interpret mode.
@@ -33,13 +42,27 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def reference_ce(logits, labels):
-    """Exact per-example CE in f32 (the fallback and the test oracle)."""
+def reference_ce(logits, labels, z_loss: float = 0.0,
+                 label_smoothing: float = 0.0):
+    """Exact per-example CE in f32 (the fallback and the test oracle).
+
+    ``z_loss``: adds ``z * logsumexp^2`` (the PaLM/T5X logit-drift
+    regularizer). ``label_smoothing``: eps-smoothed targets —
+    ``lse - (1-eps)*picked - (eps/V)*sum(logits)``.
+    """
     logits = logits.astype(jnp.float32)
+    v = logits.shape[-1]
     lse = jax.nn.logsumexp(logits, axis=-1)
     picked = jnp.take_along_axis(
         logits, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
-    return lse - picked
+    loss = lse - picked
+    if label_smoothing:
+        eps = float(label_smoothing)
+        loss = (lse - (1.0 - eps) * picked
+                - (eps / v) * jnp.sum(logits, axis=-1))
+    if z_loss:
+        loss = loss + float(z_loss) * lse * lse
+    return loss
 
 
 def _fit(n: int, want: int, unit: int):
@@ -52,7 +75,7 @@ def _fit(n: int, want: int, unit: int):
 
 
 def _ce_fwd_kernel(x_ref, y_ref, loss_ref, lse_ref, m_scr, s_scr, p_scr,
-                   *, block_v, n_v):
+                   t_scr, *, block_v, n_v, z_loss, smoothing):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -60,6 +83,7 @@ def _ce_fwd_kernel(x_ref, y_ref, loss_ref, lse_ref, m_scr, s_scr, p_scr,
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         s_scr[:] = jnp.zeros_like(s_scr)
         p_scr[:] = jnp.zeros_like(p_scr)
+        t_scr[:] = jnp.zeros_like(t_scr)
 
     x = x_ref[...].astype(jnp.float32)               # [block_n, block_v]
     label = y_ref[:, :1]                             # [block_n, 1] int32
@@ -76,29 +100,49 @@ def _ce_fwd_kernel(x_ref, y_ref, loss_ref, lse_ref, m_scr, s_scr, p_scr,
     p_scr[:] = p_scr[:] + jnp.broadcast_to(
         jnp.sum(jnp.where(v_ids == label, x, 0.0), axis=-1,
                 keepdims=True), p_scr.shape)
+    if smoothing:                # running sum(x) for the smoothed term
+        t_scr[:] = t_scr[:] + jnp.broadcast_to(
+            jnp.sum(x, axis=-1, keepdims=True), t_scr.shape)
 
     @pl.when(j == n_v - 1)
     def _finalise():
         lse = m_scr[:, :1] + jnp.log(jnp.maximum(s_scr[:, :1], 1e-30))
         lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
-        loss_ref[...] = jnp.broadcast_to(lse - p_scr[:, :1],
-                                         loss_ref.shape)
+        if smoothing:
+            v_total = n_v * block_v
+            loss = (lse - (1.0 - smoothing) * p_scr[:, :1]
+                    - (smoothing / v_total) * t_scr[:, :1])
+        else:
+            loss = lse - p_scr[:, :1]
+        if z_loss:
+            loss = loss + z_loss * lse * lse
+        loss_ref[...] = jnp.broadcast_to(loss, loss_ref.shape)
 
 
-def _ce_bwd_kernel(x_ref, y_ref, lse_ref, g_ref, dx_ref, *, block_v):
+def _ce_bwd_kernel(x_ref, y_ref, lse_ref, g_ref, dx_ref, *, block_v,
+                   n_v, z_loss, smoothing):
     j = pl.program_id(1)
     x = x_ref[...].astype(jnp.float32)
-    p = jnp.exp(x - lse_ref[:, :1])
+    lse = lse_ref[:, :1]
+    p = jnp.exp(x - lse)
     v_ids = j * block_v + lax.broadcasted_iota(jnp.int32, x.shape, 1)
     onehot = (v_ids == y_ref[:, :1]).astype(jnp.float32)
-    dx_ref[...] = ((p - onehot) * g_ref[:, :1]).astype(dx_ref.dtype)
+    # d/dx [lse - (1-e)picked - (e/V)sum + z*lse^2]
+    #    = p*(1 + 2z*lse) - (1-e)*onehot - e/V
+    p_term = p * (1.0 + 2.0 * z_loss * lse) if z_loss else p
+    target = (1.0 - smoothing) * onehot + smoothing / (n_v * block_v) \
+        if smoothing else onehot
+    dx_ref[...] = ((p_term - target) * g_ref[:, :1]).astype(dx_ref.dtype)
 
 
-def _pallas_ce_fwd(logits, labels, block_n, block_v, interpret):
+def _pallas_ce_fwd(logits, labels, block_n, block_v, interpret,
+                   z_loss=0.0, smoothing=0.0):
     n, v = logits.shape
     n_v = v // block_v
     y_rep = jnp.broadcast_to(labels.astype(jnp.int32)[:, None], (n, 128))
-    kernel = functools.partial(_ce_fwd_kernel, block_v=block_v, n_v=n_v)
+    kernel = functools.partial(_ce_fwd_kernel, block_v=block_v, n_v=n_v,
+                               z_loss=float(z_loss),
+                               smoothing=float(smoothing))
     loss, lse = pl.pallas_call(
         kernel,
         out_shape=[jax.ShapeDtypeStruct((n, 128), jnp.float32),
@@ -116,6 +160,7 @@ def _pallas_ce_fwd(logits, labels, block_n, block_v, interpret):
             pltpu.VMEM((block_n, 128), jnp.float32),   # running max
             pltpu.VMEM((block_n, 128), jnp.float32),   # running sumexp
             pltpu.VMEM((block_n, 128), jnp.float32),   # picked logit
+            pltpu.VMEM((block_n, 128), jnp.float32),   # running sum(x)
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=('parallel', 'arbitrary')),
@@ -124,12 +169,15 @@ def _pallas_ce_fwd(logits, labels, block_n, block_v, interpret):
     return loss[:, 0], lse[:, 0]
 
 
-def _pallas_ce_bwd(logits, labels, lse, g, block_n, block_v, interpret):
+def _pallas_ce_bwd(logits, labels, lse, g, block_n, block_v, interpret,
+                   z_loss=0.0, smoothing=0.0):
     n, v = logits.shape
     y_rep = jnp.broadcast_to(labels.astype(jnp.int32)[:, None], (n, 128))
     lse_rep = jnp.broadcast_to(lse[:, None], (n, 128))
     g_rep = jnp.broadcast_to(g.astype(jnp.float32)[:, None], (n, 128))
-    kernel = functools.partial(_ce_bwd_kernel, block_v=block_v)
+    kernel = functools.partial(_ce_bwd_kernel, block_v=block_v,
+                               n_v=v // block_v, z_loss=float(z_loss),
+                               smoothing=float(smoothing))
     dx = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((n, v), logits.dtype),
@@ -148,22 +196,26 @@ def _pallas_ce_bwd(logits, labels, lse, g, block_n, block_v, interpret):
     return dx
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def _fused_ce(logits, labels, block_n, block_v, interpret):
-    loss, _ = _pallas_ce_fwd(logits, labels, block_n, block_v, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _fused_ce(logits, labels, block_n, block_v, interpret, z_loss,
+              smoothing):
+    loss, _ = _pallas_ce_fwd(logits, labels, block_n, block_v,
+                             interpret, z_loss, smoothing)
     return loss
 
 
-def _fused_ce_fwd(logits, labels, block_n, block_v, interpret):
+def _fused_ce_fwd(logits, labels, block_n, block_v, interpret, z_loss,
+                  smoothing):
     loss, lse = _pallas_ce_fwd(logits, labels, block_n, block_v,
-                               interpret)
+                               interpret, z_loss, smoothing)
     return loss, (logits, labels, lse)
 
 
-def _fused_ce_bwd(block_n, block_v, interpret, res, g):
+def _fused_ce_bwd(block_n, block_v, interpret, z_loss, smoothing, res,
+                  g):
     logits, labels, lse = res
     dx = _pallas_ce_bwd(logits, labels, lse, g, block_n, block_v,
-                        interpret)
+                        interpret, z_loss, smoothing)
     return dx, None
 
 
@@ -173,11 +225,19 @@ _fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
 def softmax_ce_per_example(logits, labels, block_n: int = 256,
                            block_v: int = 1024,
                            impl: str = 'auto',
-                           interpret: bool = False):
+                           interpret: bool = False,
+                           z_loss: float = 0.0,
+                           label_smoothing: float = 0.0):
     """Per-example softmax CE over [N, V] logits and [N] int labels,
-    f32 losses. ``impl``: 'auto' (dense — measured faster on TPU, see
-    module docstring), 'pallas' (the kernel; tests pass it with
+    f32 losses. ``impl``: 'auto' (the dense formulation ALWAYS — XLA
+    beats the kernel on plain CE and ties it with z-loss/smoothing
+    fused, module docstring), 'pallas' (the kernel; tests pass it with
     interpret=True), or 'dense'.
+
+    ``z_loss`` adds ``z * logsumexp^2`` per example (PaLM/T5X logit
+    drift control); ``label_smoothing`` is the usual eps-smoothed
+    target mix. Both fuse into the kernel's single streaming pass
+    (fwd: one extra running sum; bwd: two extra VPU multiplies).
 
     Labels outside [0, V) are clamped to the nearest valid index on
     both paths (unclamped they would diverge three ways: take_along_axis
@@ -189,7 +249,10 @@ def softmax_ce_per_example(logits, labels, block_n: int = 256,
     bv = _fit(v, block_v, 128)
     tiles = bn is not None and bv is not None
     if impl == 'auto':
-        use_pallas = False   # dense measured faster on TPU (docstring)
+        # dense always: XLA's lowering beats the kernel on plain CE and
+        # ties it on the z-loss/smoothing composite (module docstring,
+        # the round-4 final measurement)
+        use_pallas = False
     elif impl == 'pallas':
         if not tiles:
             raise ValueError(
@@ -206,8 +269,10 @@ def softmax_ce_per_example(logits, labels, block_n: int = 256,
     # the kernel's one-hot pick contributes 0 — three different answers
     labels = jnp.clip(labels.astype(jnp.int32), 0, v - 1)
     if not use_pallas:
-        return reference_ce(logits, labels)
-    return _fused_ce(logits, labels, bn, bv, interpret)
+        return reference_ce(logits, labels, z_loss=z_loss,
+                            label_smoothing=label_smoothing)
+    return _fused_ce(logits, labels, bn, bv, interpret,
+                     float(z_loss), float(label_smoothing))
 
 
 __all__ = ['softmax_ce_per_example', 'reference_ce']

@@ -73,7 +73,11 @@ class JaxTrain(Executor):
         # architecture args only
         self.params_file = self.model_spec.pop('params_file', None)
         self.dataset_spec = dict(dataset or {})
-        self.loss_name = loss
+        # loss may be a name or a dict spec ({name: lm_ce, z_loss: ..,
+        # label_smoothing: ..} routes through the fused CE kernel)
+        self.loss_spec = dict(loss) if isinstance(loss, dict) else loss
+        self.loss_name = loss.get('name') if isinstance(loss, dict) \
+            else loss
         self.batch_size = int(batch_size)
         self.eval_batch_size = int(eval_batch_size or batch_size)
         self.mesh_spec = mesh
@@ -111,6 +115,10 @@ class JaxTrain(Executor):
         # on Catalyst's host-side timers (SURVEY §5 tracing substitutes)
         # this records the real device timeline incl. fusion + HBM
         self.profile = dict(profile) if profile else None
+        # leftover config keys: NOT an error (forward-compat), but a
+        # silent swallow turns typos and non-matching grid-cell keys
+        # into no-op sweeps — _work logs them loudly
+        self._unknown_kwargs = sorted(kwargs)
 
     # ------------------------------------------------------------ plumbing
     def _init_distributed(self):
@@ -222,12 +230,18 @@ class JaxTrain(Executor):
 
     def _work(self):
         t_start = time.time()
+        if self._unknown_kwargs:
+            self.info(
+                f'WARNING: config keys {self._unknown_kwargs} match '
+                f'nothing in jax_train — a typo, or a grid-cell key '
+                f'whose suffix path does not reach the spec (lists '
+                f'like stages: are opaque to the merge)')
         self._is_main = self._init_distributed()
         if self._is_main and self.async_checkpoint:
             from mlcomp_tpu.train.checkpoint import AsyncCheckpointWriter
             self._ckpt_writer = AsyncCheckpointWriter()
         mesh = self._mesh()
-        loss_fn = loss_for_task(self.loss_name)
+        loss_fn = loss_for_task(self.loss_spec)
         self_supervised = self.loss_name == 'lm_ce'
 
         data = create_dataset(**self.dataset_spec) \

@@ -78,10 +78,55 @@ def seg_ce(logits, labels, weights=None):
     return loss, {'loss': loss, 'accuracy': acc}
 
 
+def lm_ce_with(z_loss: float = 0.0, label_smoothing: float = 0.0,
+               impl: str = 'auto') -> Callable:
+    """lm_ce with z-loss / label smoothing (ops/fused_ce.py). The
+    default impl='auto' is the dense formulation — measured to match
+    the Pallas kernel even with both terms fused (fused_ce.py
+    docstring); 'pallas' remains available for the kernel path."""
+
+    def loss_fn(logits, tokens, weights=None):
+        from mlcomp_tpu.ops.fused_ce import softmax_ce_per_example
+        lg = logits[:, :-1]
+        targets = tokens[:, 1:]
+        b, t, v = lg.shape
+        per_tok = softmax_ce_per_example(
+            lg.reshape(b * t, v), targets.reshape(-1), impl=impl,
+            z_loss=z_loss, label_smoothing=label_smoothing,
+        ).reshape(b, t)
+        per = per_tok.mean(-1)
+        correct = jnp.mean(
+            (jnp.argmax(lg.astype(jnp.float32), -1) == targets
+             ).astype(jnp.float32), -1)
+        loss, acc = _weighted(per, correct, weights)
+        return loss, {'loss': loss, 'accuracy': acc}
+
+    return loss_fn
+
+
 LOSSES = {'softmax_ce': softmax_ce, 'lm_ce': lm_ce, 'seg_ce': seg_ce}
 
 
-def loss_for_task(task: str) -> Callable:
+def loss_for_task(task) -> Callable:
+    """``task``: a registered loss name, or a dict spec — e.g.
+    ``{name: lm_ce, z_loss: 1e-4, label_smoothing: 0.1}`` builds the
+    fused-CE lm loss."""
+    if isinstance(task, dict):
+        spec = dict(task)
+        name = spec.pop('name', None)
+        if name == 'lm_ce' and spec:
+            allowed = {'z_loss', 'label_smoothing', 'impl'}
+            unknown = set(spec) - allowed
+            if unknown:
+                raise ValueError(
+                    f'unknown lm_ce options {sorted(unknown)}; '
+                    f'allowed: {sorted(allowed)}')
+            return lm_ce_with(**spec)
+        if spec:
+            raise ValueError(
+                f'loss options are supported for lm_ce only, '
+                f'got {task!r}')
+        task = name
     if task not in LOSSES:
         # contrib losses (dice/bce_dice/focal) register on import
         import mlcomp_tpu.contrib.criterion  # noqa: F401

@@ -38,6 +38,12 @@ def make_schedule(lr: float, spec: Optional[dict],
                                             alpha=final / lr if lr else 0)
     elif name in ('warmup_cosine', 'onecycle'):
         warmup = warmup or max(1, decay_steps // 25)
+        # a warmup longer than the whole run (short smoke runs of a
+        # production config) must degrade gracefully, not crash with
+        # non-positive cosine decay_steps (decay_steps=1 needs
+        # warmup=0: optax builds its cosine part over
+        # decay_steps - warmup)
+        warmup = min(warmup, max(decay_steps - 1, 0))
         sched = optax.warmup_cosine_decay_schedule(
             init_value=float(spec.get('init_lr', lr / 25)),
             peak_value=lr, warmup_steps=warmup,

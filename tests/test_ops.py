@@ -367,3 +367,69 @@ class TestBf16KernelPath:
             rel = float(jnp.max(jnp.abs(a.astype(jnp.float32) - b))
                         / (jnp.max(jnp.abs(b)) + 1e-9))
             assert rel < 5e-2, rel
+
+
+class TestFusedCeZLossSmoothing:
+    """z-loss + label smoothing fused into the CE kernel (round-3
+    VERDICT next #5): exact vs the XLA reference in interpret mode,
+    forward and gradients, separately and combined."""
+
+    def _case(self, n=32, v=256):
+        import jax.numpy as jnp
+        import numpy as np
+        rng = np.random.RandomState(3)
+        logits = jnp.asarray(rng.randn(n, v) * 3, jnp.float32)
+        labels = jnp.asarray(rng.randint(0, v, n), jnp.int32)
+        return logits, labels
+
+    @pytest.mark.parametrize('z,eps', [(1e-4, 0.0), (0.0, 0.1),
+                                       (1e-4, 0.1)])
+    def test_forward_and_grad_match_reference(self, z, eps):
+        import jax
+        import numpy as np
+        from mlcomp_tpu.ops.fused_ce import (
+            reference_ce, softmax_ce_per_example,
+        )
+        logits, labels = self._case()
+        got = softmax_ce_per_example(
+            logits, labels, block_n=8, block_v=128, impl='pallas',
+            interpret=True, z_loss=z, label_smoothing=eps)
+        want = reference_ce(logits, labels, z_loss=z,
+                            label_smoothing=eps)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
+        gw = jax.grad(lambda l: reference_ce(
+            l, labels, z_loss=z, label_smoothing=eps).mean())(logits)
+        gg = jax.grad(lambda l: softmax_ce_per_example(
+            l, labels, block_n=8, block_v=128, impl='pallas',
+            interpret=True, z_loss=z,
+            label_smoothing=eps).mean())(logits)
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(gw),
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_zero_coefs_reduce_to_plain_ce(self):
+        import numpy as np
+        from mlcomp_tpu.ops.fused_ce import (
+            reference_ce, softmax_ce_per_example,
+        )
+        logits, labels = self._case()
+        got = softmax_ce_per_example(
+            logits, labels, block_n=8, block_v=128, impl='pallas',
+            interpret=True, z_loss=0.0, label_smoothing=0.0)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(reference_ce(logits, labels)),
+            atol=1e-5, rtol=1e-5)
+
+    def test_auto_on_cpu_stays_dense_with_coefs(self):
+        """auto never routes to an uninterpreted pallas_call off-TPU."""
+        import numpy as np
+        from mlcomp_tpu.ops.fused_ce import (
+            reference_ce, softmax_ce_per_example,
+        )
+        logits, labels = self._case()
+        got = softmax_ce_per_example(logits, labels, z_loss=1e-4,
+                                     label_smoothing=0.1)
+        want = reference_ce(logits, labels, z_loss=1e-4,
+                            label_smoothing=0.1)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)

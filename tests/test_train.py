@@ -331,3 +331,36 @@ class TestQuantizedServing:
         vars_, _ = load_export(path)
         _, n_q = _quantized_interceptor(vars_['params'])
         assert n_q >= 1
+
+
+class TestLmCeOptions:
+    def test_loss_dict_spec_trains(self, tmp_path):
+        """loss: {name: lm_ce, z_loss, label_smoothing} routes through
+        the fused-CE path (dense formulation on CPU) and trains."""
+        result = run_executor({
+            'model': {'name': 'transformer_lm', 'vocab_size': 64,
+                      'd_model': 32, 'n_layers': 1, 'n_heads': 2,
+                      'd_ff': 64, 'max_seq_len': 32,
+                      'dtype': 'float32'},
+            'dataset': {'name': 'synthetic_lm', 'n_train': 128,
+                        'n_valid': 64, 'seq_len': 32, 'vocab_size': 64},
+            'loss': {'name': 'lm_ce', 'z_loss': 1e-4,
+                     'label_smoothing': 0.1},
+            'batch_size': 32,
+            'main_metric': 'loss',
+            'minimize': True,
+            'stages': [{'name': 's1', 'epochs': 2,
+                        'optimizer': {'name': 'adamw', 'lr': 3e-3}}],
+        }, str(tmp_path / 'ck'))
+        assert result['best_score'] < 5.0
+        import math
+        assert math.isfinite(result['best_score'])
+
+    def test_unknown_loss_option_fails_loud(self):
+        import pytest as _pytest
+
+        from mlcomp_tpu.train.loop import loss_for_task
+        with _pytest.raises(ValueError, match='unknown lm_ce options'):
+            loss_for_task({'name': 'lm_ce', 'zloss': 1e-4})
+        with _pytest.raises(ValueError, match='lm_ce only'):
+            loss_for_task({'name': 'softmax_ce', 'z_loss': 1e-4})
