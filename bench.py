@@ -38,6 +38,16 @@ import os
 import sys
 import time
 
+# persistent XLA compile cache for the IN-PROCESS legs (CIFAR/LM/
+# serving; set before any jax import): their compiles happen in
+# untimed warmup, so this only buys wall-clock against the bench
+# budget — ~26 s -> 2 s per program on repeat runs through the
+# tunnel's remote compiler. The grid-DAG leg deliberately overrides
+# this with a per-run throwaway dir: its metric IS wall-clock, and a
+# warm cache would make the number drift round-over-round
+os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
+                      '/tmp/mlcomp_bench_jaxcache')
+
 
 def _step_flops(train_step, state, x, y):
     """FLOPs of one compiled train step from XLA's cost analysis."""
@@ -692,6 +702,17 @@ def main():
         # donated-step aliases) so the LM model compiles/runs against a
         # clean HBM
         del state, x_all, y_all, x, y, run_epoch
+        # int8 first: it is the cheapest tracked metric (~40 s) and the
+        # round-over-round serving claim depends on it landing — the LM
+        # legs are the ones to shed on a slow-tunnel day
+        if over_budget():
+            result['serving_int8_note'] = 'skipped (budget)'
+        else:
+            try:
+                result.update(bench_serving_int8())
+            except Exception as e:
+                result['serving_int8_error'] = \
+                    f'{type(e).__name__}: {e}'[:200]
         if over_budget():
             result['lm_note'] = 'skipped (budget)'
         else:
@@ -699,14 +720,6 @@ def main():
                 result.update(bench_lm(peak_tflops))
             except Exception as e:   # never lose the primary metric
                 result['lm_error'] = f'{type(e).__name__}: {e}'[:300]
-        if over_budget():
-            result.setdefault('serving_int8_note', 'skipped (budget)')
-        else:
-            try:
-                result.update(bench_serving_int8())
-            except Exception as e:
-                result['serving_int8_error'] = \
-                    f'{type(e).__name__}: {e}'[:200]
 
     print(json.dumps(result))
 

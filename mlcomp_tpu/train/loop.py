@@ -454,4 +454,5 @@ __all__ = ['TrainState', 'make_train_step', 'make_device_train_step',
            'make_device_epoch_fn', 'make_eval_step',
            'make_device_eval_step', 'aggregate_metrics',
            'create_train_state', 'state_sharding', 'place_state',
-           'loss_for_task', 'LOSSES', 'softmax_ce', 'lm_ce', 'seg_ce']
+           'loss_for_task', 'LOSSES', 'softmax_ce', 'lm_ce', 'seg_ce',
+           'lm_ce_with']
