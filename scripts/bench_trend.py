@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Cross-round benchmark trend table from BENCH_r*.json.
+
+The driver records one bench JSON per round; this prints the tracked
+metrics side by side so regressions are visible at a glance::
+
+    python scripts/bench_trend.py            # repo root autodetected
+"""
+
+import glob
+import json
+import os
+import sys
+
+TRACKED = [
+    ('value', 'cifar img/s'),
+    ('mfu', 'cifar MFU'),
+    ('dag_grid_wallclock_s', 'grid wall s'),
+    ('dag_grid_sched_overhead_pct', 'grid sched %'),
+    ('lm_tokens_per_sec', 'lm tok/s'),
+    ('lm_mfu', 'lm MFU'),
+    ('lm_wide_mfu', 'lm-wide MFU'),
+    ('lm_flash_speedup', 'flash x'),
+    ('lm_long_context_tokens_per_sec', 'T=32k tok/s'),
+    ('serving_int8_speedup', 'int8 x'),
+]
+
+
+def load_rounds(root):
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(root, 'BENCH_r*.json'))):
+        name = os.path.basename(path)[len('BENCH_'):-len('.json')]
+        try:
+            with open(path) as fh:
+                blob = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f'{name}: unreadable ({e})', file=sys.stderr)
+            continue
+        # driver wrapping: the bench line may sit under 'parsed' —
+        # which is null for a round whose bench produced no JSON
+        data = blob.get('parsed', blob) if isinstance(blob, dict) \
+            else {}
+        if not isinstance(data, dict):
+            data = {}
+        rounds.append((name, data))
+    return rounds
+
+
+def main():
+    root = sys.argv[1] if len(sys.argv) > 1 else \
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rounds = load_rounds(root)
+    if not rounds:
+        print(f'no BENCH_r*.json under {root}')
+        return 1
+    width = max(len(label) for _, label in TRACKED) + 2
+    header = ' ' * width + ''.join(f'{name:>12}' for name, _ in rounds)
+    print(header)
+    for key, label in TRACKED:
+        cells = []
+        for _, data in rounds:
+            v = data.get(key)
+            if v is None:
+                cells.append(f'{"-":>12}')
+            elif isinstance(v, float) and v != int(v):
+                # keep fractional digits at any magnitude: overhead %
+                # and wall-clock drift live below the integer
+                cells.append(f'{v:>12.5g}')
+            elif isinstance(v, (int, float)):
+                cells.append(f'{v:>12,.0f}')
+            else:
+                cells.append(f'{str(v)[:11]:>12}')
+        print(f'{label:<{width}}' + ''.join(cells))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
