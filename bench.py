@@ -99,12 +99,15 @@ executors:
     main_metric: accuracy
     epochs: %(epochs)d
     optimizer: {name: sgd, lr: 0.1, momentum: 0.9}
+    checkpoint_every: 0
 """
 # ^ optimizer lives at the TOP level (not inside stages:) so the bare
 #   `lr` grid axis suffix-matches optimizer/lr — `stages` is a list,
 #   opaque to dict_flatten, and a cell key that matches nothing would
 #   silently no-op the grid (tests/test_examples.py pins this config's
-#   cells to distinct lrs)
+#   cells to distinct lrs). checkpoint_every: 0 = throwaway cells: the
+#   per-cell device->host state gather (~15 s through the tunnel) is
+#   search overhead a user sweeping hyperparameters would also skip
 
 
 def bench_grid_dag() -> dict:

@@ -100,6 +100,16 @@ class JaxTrain(Executor):
         # compile on XLA:CPU (scan-of-conv-graph), so opt-in
         self.epoch_scan = bool(epoch_scan)
         self.checkpoint_every = int(checkpoint_every)
+        if self.checkpoint_every == 0:
+            wants_best = bool(infer_valid) and \
+                bool(dict(infer_valid).get('best_only', True))
+            if stage_per_dispatch or model_name or wants_best:
+                raise ValueError(
+                    'checkpoint_every: 0 disables saving, but '
+                    'stage_per_dispatch requeue, model_name export, '
+                    'and infer_valid best_only (its default) all read '
+                    'checkpoint files — drop one, or set '
+                    'infer_valid: {best_only: false}')
         # {'out_prefix': str, 'best_only': bool} — dump validation
         # predictions as npy after training (the flax analogue of the
         # reference's InferBestCallback,
@@ -569,7 +579,14 @@ class JaxTrain(Executor):
                 # stage's final epoch (so resume/export always has a
                 # fresh `last`)
                 last_of_stage = epoch == int(stage.get('epochs', 1)) - 1
-                should_save = (
+                # checkpoint_every: 0 disables saving entirely — for
+                # grid-search cells whose artifacts are throwaway, the
+                # device->host state gather (~15 s for resnet18+sgd
+                # through a tunneled link) dominates short tasks. Such
+                # runs cannot resume or export — incompatible consumers
+                # (stage_per_dispatch, model_name, infer_valid
+                # best_only) are rejected in __init__
+                should_save = self.checkpoint_every != 0 and (
                     is_best or self.checkpoint_every <= 1
                     or (global_epoch + 1) % self.checkpoint_every == 0
                     or last_of_stage)

@@ -60,7 +60,8 @@ def main():
         cells = []
         for _, data in rounds:
             v = data.get(key)
-            if v is None:
+            if v is None or (isinstance(v, float)
+                             and (v != v or abs(v) == float('inf'))):
                 cells.append(f'{"-":>12}')
             elif isinstance(v, float) and v != int(v):
                 # keep fractional digits at any magnitude: overhead %

@@ -274,3 +274,25 @@ class TestDashboardRenders:
         html = browser.html('#main')
         assert '<pre>' not in html[:40]
         assert html.strip()
+
+
+class TestJsrtRegressions:
+    def test_return_multiline_template_no_asi(self):
+        """The bug class that silently broke every renderer: a template
+        literal opening on the return line but spanning lines must NOT
+        trigger automatic semicolon insertion (the token carries its
+        START line)."""
+        out = Interpreter().run(
+            'function f(x) {\n'
+            '  return `a\n'
+            '    ${x}\n'
+            '    b`;\n'
+            '}\n'
+            "f('mid')")
+        assert 'mid' in out and out.startswith('a')
+
+    def test_return_bare_newline_still_asi(self):
+        # the flip side: return followed by a newline IS return;
+        out = Interpreter().run(
+            'function f() {\n  return\n  5;\n}\nString(f())')
+        assert out == 'undefined'

@@ -364,3 +364,36 @@ class TestLmCeOptions:
             loss_for_task({'name': 'lm_ce', 'zloss': 1e-4})
         with _pytest.raises(ValueError, match='lm_ce only'):
             loss_for_task({'name': 'softmax_ce', 'z_loss': 1e-4})
+
+
+class TestCheckpointDisable:
+    def test_checkpoint_every_zero_saves_nothing(self, tmp_path):
+        """checkpoint_every: 0 — throwaway grid cells skip the
+        device->host gather entirely; no files appear."""
+        result = run_executor({
+            'model': {'name': 'mlp', 'num_classes': 4, 'hidden': [16],
+                      'dtype': 'float32'},
+            'dataset': {'name': 'synthetic_images', 'n_train': 128,
+                        'n_valid': 64, 'image_size': 8, 'channels': 1,
+                        'num_classes': 4},
+            'batch_size': 32,
+            'checkpoint_every': 0,
+            'stages': [{'name': 's1', 'epochs': 2}],
+        }, str(tmp_path / 'ck'))
+        assert result['best_score'] is not None
+        ck = tmp_path / 'ck'
+        assert not ck.exists() or not any(ck.iterdir())
+
+    def test_rejected_with_checkpoint_consumers(self):
+        with pytest.raises(ValueError, match='checkpoint_every: 0'):
+            JaxTrain(checkpoint_every=0, model_name='m')
+        with pytest.raises(ValueError, match='checkpoint_every: 0'):
+            JaxTrain(checkpoint_every=0, stage_per_dispatch=True)
+
+    def test_rejected_with_best_only_infer_valid(self):
+        with pytest.raises(ValueError, match='best_only'):
+            JaxTrain(checkpoint_every=0,
+                     infer_valid={'out_prefix': 'p'})
+        # explicit best_only: false is allowed (final-state preds)
+        JaxTrain(checkpoint_every=0,
+                 infer_valid={'out_prefix': 'p', 'best_only': False})
