@@ -1,5 +1,9 @@
-"""Driver benchmark: CIFAR-10 ResNet-18 **epoch** training throughput +
-MFU on the available accelerator (BASELINE.md primary metric).
+"""Driver benchmark — BOTH halves of BASELINE.md's primary metric:
+CIFAR-10 ResNet-18 **epoch** training throughput + MFU, and
+**grid-search DAG wall-clock** through the real supervisor + worker +
+queue stack (bench_grid_dag: 6 cells, scheduling overhead %, dispatch
+latency); plus the LM flagship (flash/long-context/dense/wide) and the
+int8 serving legs.
 
 Honest accounting (VERDICT round-1 weak #2): the timed region is a real
 training epoch through the framework's production input path — per-epoch
