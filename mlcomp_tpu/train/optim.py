@@ -13,10 +13,24 @@ Config shape::
       lr: 0.001
       weight_decay: 0.01
       grad_clip: 1.0
+      accum_steps: 4         # gradient accumulation (catalyst
+                             # OptimizerCallback accumulation_steps parity)
       schedule:
         name: warmup_cosine  # constant | cosine | warmup_cosine | onecycle
         warmup_steps: 100
         decay_steps: 10000
+
+With ``accum_steps: k`` each train step consumes one ``batch_size``
+microbatch; parameters move every k-th step on the mean of the k
+gradients (optax.MultiSteps), so the effective batch is
+``batch_size * k`` at the same per-step activation memory. Schedule
+step counts (``decay_steps``/``warmup_steps``/``boundaries``, and the
+derived stage length) stay written in microbatch steps — the unit the
+rest of the config uses — and are converted to optimizer updates
+internally, so the same schedule numbers mean the same data budget
+with or without accumulation. A trailing partial window (stage length
+not divisible by k) is dropped, standard MultiSteps semantics; a
+stage shorter than k raises at build time.
 """
 
 from typing import Optional
@@ -66,7 +80,34 @@ def make_optimizer(spec: Optional[dict],
     name = spec.get('name', 'adam').lower()
     lr = float(spec.get('lr', 1e-3))
     wd = float(spec.get('weight_decay', 0.0))
-    sched = make_schedule(lr, spec.get('schedule'), total_steps)
+    accum = int(spec.get('accum_steps', 1))
+    if accum < 1:
+        raise ValueError(f'accum_steps must be >= 1, got {accum}')
+    if accum > 1 and total_steps:
+        if total_steps < accum:
+            # MultiSteps would never reach its k-th microbatch: the
+            # whole stage would "train" with frozen params and save an
+            # untrained best.msgpack — a config error, not a run
+            raise ValueError(
+                f'accum_steps={accum} exceeds the stage\'s '
+                f'{total_steps} total steps — no optimizer update '
+                f'would ever fire; lower accum_steps or raise '
+                f'epochs/dataset size')
+        # the inner optimizer's count advances once per k microbatches
+        total_steps = max(1, total_steps // accum)
+    sched_spec = spec.get('schedule')
+    if accum > 1 and sched_spec:
+        # explicit schedule counts are written in microbatch steps like
+        # everything else in the config — convert to optimizer updates
+        # so enabling accumulation doesn't silently stretch the decay
+        sched_spec = dict(sched_spec)
+        for key in ('decay_steps', 'warmup_steps'):
+            if sched_spec.get(key):
+                sched_spec[key] = max(1, int(sched_spec[key]) // accum)
+        if sched_spec.get('boundaries'):
+            sched_spec['boundaries'] = [
+                max(1, int(b) // accum) for b in sched_spec['boundaries']]
+    sched = make_schedule(lr, sched_spec, total_steps)
 
     if name == 'sgd':
         opt = optax.sgd(sched, momentum=float(spec.get('momentum', 0.9)),
@@ -93,6 +134,8 @@ def make_optimizer(spec: Optional[dict],
     clip = float(spec.get('grad_clip', 0.0))
     if clip:
         opt = optax.chain(optax.clip_by_global_norm(clip), opt)
+    if accum > 1:
+        opt = optax.MultiSteps(opt, every_k_schedule=accum)
     return opt, sched
 
 

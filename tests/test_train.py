@@ -56,6 +56,61 @@ class TestOptim:
                                    'gammas': [0.1]})
         assert float(step(6)) == pytest.approx(0.1)
 
+    def test_accum_steps_semantics(self):
+        # k identical microbatch gradients == one plain-sgd step on
+        # their mean; params must not move before the k-th microbatch
+        import jax.numpy as jnp
+        import optax
+        opt, _ = make_optimizer({'name': 'sgd', 'lr': 0.1, 'momentum': 0,
+                                 'accum_steps': 2})
+        params = {'w': jnp.ones(3)}
+        st = opt.init(params)
+        g1 = {'w': jnp.full(3, 2.0)}
+        g2 = {'w': jnp.full(3, 4.0)}
+        up1, st = opt.update(g1, st, params)
+        mid = optax.apply_updates(params, up1)
+        assert np.allclose(mid['w'], 1.0)  # frozen until k-th
+        up2, st = opt.update(g2, st, params)
+        done = optax.apply_updates(params, up2)
+        assert np.allclose(done['w'], 1.0 - 0.1 * 3.0)  # mean(2,4)=3
+
+    def test_accum_steps_divides_schedule(self):
+        # decay must land at the END of the stage measured in optimizer
+        # updates: 100 microbatches / k=4 -> cosine hits floor at
+        # update 25, not update 100
+        opt, sched = make_optimizer(
+            {'name': 'sgd', 'lr': 1.0, 'momentum': 0,
+             'accum_steps': 4, 'schedule': {'name': 'cosine'}},
+            total_steps=100)
+        assert float(sched(25)) < 1e-6
+        assert float(sched(12)) > 0.4
+
+    def test_accum_steps_rescales_explicit_schedule_counts(self):
+        # explicit decay_steps/warmup_steps/boundaries are written in
+        # microbatch steps like the rest of the config — turning on
+        # accumulation must not stretch the decay past the stage end
+        _, sched = make_optimizer(
+            {'name': 'sgd', 'lr': 1.0, 'momentum': 0, 'accum_steps': 4,
+             'schedule': {'name': 'cosine', 'decay_steps': 100}},
+            total_steps=100)
+        assert float(sched(25)) < 1e-6  # 100 microbatches = 25 updates
+        _, step_sched = make_optimizer(
+            {'name': 'sgd', 'lr': 1.0, 'momentum': 0, 'accum_steps': 4,
+             'schedule': {'name': 'step', 'boundaries': [40],
+                          'gammas': [0.1]}},
+            total_steps=100)
+        assert float(step_sched(9)) == pytest.approx(1.0)
+        assert float(step_sched(11)) == pytest.approx(0.1)
+
+    def test_accum_steps_invalid(self):
+        with pytest.raises(ValueError):
+            make_optimizer({'name': 'sgd', 'accum_steps': 0})
+        # a stage too short to ever fire an update is a config error,
+        # not a silent frozen-params run
+        with pytest.raises(ValueError, match='no optimizer update'):
+            make_optimizer({'name': 'sgd', 'accum_steps': 4},
+                           total_steps=2)
+
     def test_unknown_raises(self):
         with pytest.raises(ValueError):
             make_optimizer({'name': 'nope'})
@@ -112,6 +167,22 @@ class TestJaxTrain:
         assert result['stages'] == ['s1']
         assert os.path.exists(tmp_path / 'ck' / 'last.msgpack')
         assert os.path.exists(tmp_path / 'ck' / 'best.msgpack')
+
+    def test_mlp_learns_with_accum(self, tmp_path):
+        # same recipe as test_mlp_learns at effective batch 64 = 32 x 2:
+        # accumulation must neither break the loop (scan path included)
+        # nor stop the model learning
+        result = run_executor({
+            'model': {'name': 'mlp', 'num_classes': 10, 'hidden': [64],
+                      'dtype': 'float32'},
+            'dataset': {'name': 'synthetic_images', 'n_train': 512,
+                        'n_valid': 128, 'image_size': 8, 'channels': 1},
+            'batch_size': 32,
+            'stages': [{'name': 's1', 'epochs': 3,
+                        'optimizer': {'name': 'adam', 'lr': 3e-3,
+                                      'accum_steps': 2}}],
+        }, str(tmp_path / 'ck'))
+        assert result['best_score'] > 0.8
 
     def test_infer_valid_saves_best_preds(self, tmp_path, monkeypatch):
         """infer_valid dumps best-checkpoint validation predictions
