@@ -16,15 +16,24 @@ EXAMPLES = sorted(
         os.path.join(os.path.dirname(__file__), '..', 'examples',
                      '*', 'config.yml')))
 
+# every yml in an example folder is a DAG config (variants like
+# grid.yml / distr.yml included), and all of them must build
+EXAMPLE_CONFIGS = sorted(
+    p for p in glob.glob(
+        os.path.join(os.path.dirname(__file__), '..', 'examples',
+                     '*', '*.yml')))
+
 
 @pytest.mark.parametrize(
-    'folder', EXAMPLES, ids=[os.path.basename(f) for f in EXAMPLES])
-def test_example_builds(session, folder):
-    config = yaml_load(file=os.path.join(folder, 'config.yml'))
+    'config_path', EXAMPLE_CONFIGS,
+    ids=['/'.join(p.split(os.sep)[-2:]) for p in EXAMPLE_CONFIGS])
+def test_example_builds(session, config_path):
+    folder = os.path.dirname(config_path)
+    config = yaml_load(file=config_path)
     has_code = os.path.exists(os.path.join(folder, 'executors.py'))
     dag, tasks = dag_standard(
         session, config, upload_folder=folder if has_code else None)
-    assert tasks, f'{folder} produced no tasks'
+    assert tasks, f'{config_path} produced no tasks'
     # every declared executor materialized at least one task
     declared = set(config['executors'])
     assert declared == set(tasks)
@@ -75,3 +84,55 @@ def test_bench_grid_config_cells_are_distinct(session):
         lr = ex.stages[0]['optimizer']['lr']
         seen.add((lr, ex.seed))
     assert seen == {(lr, s) for lr in (0.05, 0.1) for s in (0, 1, 2)}
+
+
+def test_digit_recognizer_grid_cells_are_distinct(session):
+    """The digit-recognizer grid variant must sweep lr x hidden on the
+    CUSTOM executor's own kwargs (reference grid.yml sweeps the
+    catalyst executor the same way)."""
+    import importlib.util
+    from mlcomp_tpu.db.providers import TaskProvider
+    from mlcomp_tpu.worker.executors import Executor
+
+    folder = [f for f in EXAMPLES if f.endswith('digit-recognizer')][0]
+    # register the example's custom executors (worker-side this happens
+    # via the code-in-DB AST import)
+    spec_mod = importlib.util.spec_from_file_location(
+        'digit_recognizer_executors',
+        os.path.join(folder, 'executors.py'))
+    mod = importlib.util.module_from_spec(spec_mod)
+    spec_mod.loader.exec_module(mod)
+    config = yaml_load(file=os.path.join(folder, 'grid.yml'))
+    dag, tasks = dag_standard(session, config, upload_folder=folder)
+    assert len(tasks['train']) == 4
+    tp = TaskProvider(session)
+    seen = set()
+    for tid in tasks['train']:
+        info = yaml_load(tp.by_id(tid).additional_info or '{}')
+        ex = Executor.from_config('train', config,
+                                  additional_info=info,
+                                  session=session)
+        seen.add((ex.lr, ex.hidden))
+    assert seen == {(lr, h) for lr in (0.001, 0.01)
+                    for h in (128, 256)}
+
+
+def test_digits_distr_variant_carries_scheduler_hints(session):
+    """The distributed staged variant must reach the task row with the
+    hints the supervisor's fan-out reads (distr/single_node/cores) and
+    the stage_per_dispatch flag the executor reads."""
+    from mlcomp_tpu.db.providers import TaskProvider
+
+    folder = [f for f in EXAMPLES if f.endswith('digits')][0]
+    config = yaml_load(file=os.path.join(folder, 'distr.yml'))
+    dag, tasks = dag_standard(session, config, upload_folder=folder)
+    tp = TaskProvider(session)
+    train = tp.by_id(tasks['train'][0])
+    assert (train.cores, train.cores_max) == (8, 8)
+    assert not train.single_node          # multi-host fan-out allowed
+    info = yaml_load(train.additional_info)
+    assert info['distr'] is True
+    from mlcomp_tpu.db.providers import DagProvider
+    dag_row = DagProvider(session).by_id(train.dag)
+    spec = yaml_load(dag_row.config)['executors']['train']
+    assert spec['stage_per_dispatch'] is True

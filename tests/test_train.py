@@ -318,6 +318,27 @@ class TestJaxTrain:
         }, str(tmp_path / 'ck'))
         assert result['best_score'] < 4.0  # well below ln(64)≈4.16
 
+    def test_sharded_training_with_accum(self, tmp_path):
+        """accum_steps on a dp×tp mesh: the MultiSteps opt state (incl.
+        the params-shaped acc_grads buffer) must shard-place cleanly and
+        the model must still learn."""
+        result = run_executor({
+            'model': {'name': 'transformer_lm', 'vocab_size': 64,
+                      'd_model': 32, 'n_layers': 2, 'n_heads': 2,
+                      'd_ff': 64, 'max_seq_len': 32, 'dtype': 'float32'},
+            'dataset': {'name': 'synthetic_lm', 'n_train': 256,
+                        'n_valid': 64, 'seq_len': 32, 'vocab_size': 64},
+            'loss': 'lm_ce',
+            'batch_size': 32,
+            'mesh': {'dp': 4, 'tp': 2},
+            'main_metric': 'loss',
+            'minimize': True,
+            'stages': [{'name': 's1', 'epochs': 2,
+                        'optimizer': {'name': 'adamw', 'lr': 3e-3,
+                                      'accum_steps': 2}}],
+        }, str(tmp_path / 'ck'))
+        assert result['best_score'] < 4.0
+
     def test_resnet_batchnorm_training(self, tmp_path):
         result = run_executor({
             'model': {'name': 'resnet18', 'num_classes': 4,
