@@ -37,11 +37,41 @@ from typing import Optional
 
 import optax
 
+# Unknown spec keys are config errors, not no-ops: a typo like
+# `acum_steps` or a key valid for a different optimizer must fail at
+# build time (same loud-failure contract jax_train applies to its
+# top-level keys), because a silently ignored hyperparameter trains a
+# different model than the config says.
+_COMMON_KEYS = {'name', 'lr', 'weight_decay', 'grad_clip',
+                'accum_steps', 'schedule'}
+_OPT_KEYS = {
+    'sgd': {'momentum', 'nesterov'},
+    'adam': {'b1', 'b2'},
+    'adamw': {'b1', 'b2'},
+    'lamb': set(),
+    'adafactor': set(),
+}
+_SCHED_KEYS = {
+    'constant': {'name'},
+    'cosine': {'name', 'decay_steps', 'final_lr'},
+    'warmup_cosine': {'name', 'decay_steps', 'warmup_steps',
+                      'final_lr', 'init_lr'},
+    'onecycle': {'name', 'decay_steps', 'warmup_steps',
+                 'final_lr', 'init_lr'},
+    'step': {'name', 'decay_steps', 'boundaries', 'gammas'},
+}
+
 
 def make_schedule(lr: float, spec: Optional[dict],
                   total_steps: Optional[int] = None):
     spec = dict(spec or {'name': 'constant'})
     name = spec.get('name', 'constant').lower()
+    if name in _SCHED_KEYS:
+        unknown = set(spec) - _SCHED_KEYS[name]
+        if unknown:
+            raise ValueError(
+                f'unknown schedule key(s) {sorted(unknown)} for '
+                f'{name!r}; valid: {sorted(_SCHED_KEYS[name])}')
     decay_steps = int(spec.get('decay_steps') or total_steps or 10000)
     warmup = int(spec.get('warmup_steps', 0))
     final = float(spec.get('final_lr', 0.0))
@@ -78,6 +108,13 @@ def make_optimizer(spec: Optional[dict],
     """Build an optax GradientTransformation from an optimizer spec."""
     spec = dict(spec or {})
     name = spec.get('name', 'adam').lower()
+    if name in _OPT_KEYS:
+        unknown = set(spec) - _COMMON_KEYS - _OPT_KEYS[name]
+        if unknown:
+            raise ValueError(
+                f'unknown optimizer key(s) {sorted(unknown)} for '
+                f'{name!r}; valid: '
+                f'{sorted(_COMMON_KEYS | _OPT_KEYS[name])}')
     lr = float(spec.get('lr', 1e-3))
     wd = float(spec.get('weight_decay', 0.0))
     accum = int(spec.get('accum_steps', 1))

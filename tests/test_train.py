@@ -102,6 +102,16 @@ class TestOptim:
         assert float(step_sched(9)) == pytest.approx(1.0)
         assert float(step_sched(11)) == pytest.approx(0.1)
 
+    def test_unknown_spec_keys_fail_loud(self):
+        # a typo'd hyperparameter must not silently train a different
+        # model than the config says
+        with pytest.raises(ValueError, match='acum_steps'):
+            make_optimizer({'name': 'sgd', 'lr': 0.1, 'acum_steps': 4})
+        with pytest.raises(ValueError, match='momentum'):
+            make_optimizer({'name': 'adam', 'momentum': 0.9})
+        with pytest.raises(ValueError, match='warmup_steps'):
+            make_schedule(1.0, {'name': 'cosine', 'warmup_steps': 5})
+
     def test_accum_steps_invalid(self):
         with pytest.raises(ValueError):
             make_optimizer({'name': 'sgd', 'accum_steps': 0})
