@@ -61,6 +61,38 @@ def start(n_workers, in_process):
                f'(http://{WEB_HOST}:{WEB_PORT})')
 
 
+@main.command()
+@click.argument('model')
+@click.option('--project', default=None,
+              help='project folder to resolve MODEL in')
+@click.option('--host', default='127.0.0.1')
+@click.option('--port', type=int, default=4202)
+@click.option('--batch-size', type=int, default=64)
+@click.option('--activation', default=None,
+              help='softmax | sigmoid | argmax')
+@click.option('--quantize', default=None,
+              help="'int8' = weight-only int8 serving (half the weight"
+                   " HBM)")
+def serve(model, project, host, port, batch_size, activation, quantize):
+    """Serve a model export over HTTP (GET /health, POST /predict).
+
+    MODEL is an export name from the registry (models/<project>/<name>)
+    or a path to a .msgpack export. Runs its own process — and its own
+    TPU client — so it never contends with a training worker's compiles.
+    """
+    from mlcomp_tpu.server.serve import ModelServer, resolve_model
+    path = resolve_model(model, project)
+    server = ModelServer(path, batch_size=batch_size,
+                         activation=activation, quantize=quantize,
+                         host=host, port=port)
+    warmed = server.warmup()
+    server.bind()
+    print(f'serving {server.name} on http://{host}:{server.port} '
+          f'(warmup={"done" if warmed else "first-request"}, '
+          f'quantize={quantize or "none"})')
+    server.serve_forever()
+
+
 @main.command(name='issue-token')
 @click.argument('computer')
 @click.option('--revoke', is_flag=True,

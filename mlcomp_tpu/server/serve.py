@@ -1,0 +1,188 @@
+"""Standalone model-serving process — the deploy end of the export story.
+
+The reference's registry stops at model rows + start-training dialogs
+(mlcomp/server/back/app.py:264-297 `model/start_begin|start_end`); it
+has no serving path at all. Here an export becomes an endpoint:
+
+    python -m mlcomp_tpu.server serve my_model --project p [--quantize int8]
+
+loads the self-describing msgpack export ONCE, builds the jitted
+predictor at a static batch shape (exactly one XLA compile — warmed at
+startup when the export's meta carries ``input_shape``), and serves:
+
+- ``GET  /health``   (no auth) — model name, platform, request count
+- ``POST /predict``  ``{"x": [[...]]}`` → ``{"y": [...], "ms": ...}``
+  (token auth, same header contract as the JSON API)
+
+A separate process by design, not a route on the API server: a second
+live TPU client in the same process tree starves a training worker's
+compiles ~30x (measured — see bench.py's grid-leg ordering note), so
+serving owns its chip placement explicitly and the operator decides
+where it runs. Requests serialize through one lock: one chip, one
+compiled program — concurrency belongs in the batch dimension
+(``--batch-size``), which is where the MXU wants it anyway.
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from mlcomp_tpu import MODEL_FOLDER, TOKEN
+
+
+def resolve_model(name_or_path: str, project: str = None) -> str:
+    """An explicit path wins; otherwise look under
+    MODEL_FOLDER/<project>/<name>.msgpack, searching all projects when
+    none is given (unique match required)."""
+    from mlcomp_tpu.train.export import export_base
+    base = export_base(name_or_path)
+    if os.path.exists(base + '.msgpack'):
+        return base
+    if project:
+        cand = os.path.join(MODEL_FOLDER, project, base)
+        if os.path.exists(cand + '.msgpack'):
+            return cand
+        raise FileNotFoundError(
+            f'no export {base!r} in project {project!r} '
+            f'({cand}.msgpack missing)')
+    hits = glob.glob(os.path.join(MODEL_FOLDER, '*', base + '.msgpack'))
+    if len(hits) == 1:
+        return hits[0][:-len('.msgpack')]
+    if not hits:
+        raise FileNotFoundError(
+            f'no export {base!r} under {MODEL_FOLDER}/*/')
+    raise ValueError(
+        f'{base!r} exists in multiple projects '
+        f'({sorted(os.path.basename(os.path.dirname(h)) for h in hits)})'
+        f' — pass --project')
+
+
+class ModelServer:
+    """One export, one compiled predictor, one HTTP endpoint."""
+
+    def __init__(self, file: str, batch_size: int = 64,
+                 activation: str = None, quantize: str = None,
+                 host: str = '127.0.0.1', port: int = 4202,
+                 token: str = None):
+        from mlcomp_tpu.train.export import (
+            export_base, load_export_meta, make_predictor,
+        )
+        self.file = file
+        self.name = os.path.basename(export_base(file))
+        self.batch_size = batch_size
+        self.predict = make_predictor(
+            file=file, batch_size=batch_size, activation=activation,
+            quantize=quantize)
+        self.host, self.port = host, port
+        self.token = TOKEN if token is None else token
+        self.requests = 0
+        self.lock = threading.Lock()
+        self.meta = load_export_meta(file)
+        self.httpd = None
+
+    def warmup(self):
+        """Pay the XLA compile before the first request when the export
+        records its per-example input shape — at the FULL static batch
+        shape, the only shape requests are ever applied at (see
+        _handle_predict's padding)."""
+        shape = self.meta.get('input_shape')
+        if shape:
+            self.predict(np.zeros([self.batch_size] + list(shape),
+                                  np.float32))
+            return True
+        return False
+
+    def _handle_predict(self, body: dict):
+        x = body.get('x')
+        if x is None:
+            raise ValueError("body must carry 'x': [[...], ...]")
+        x = np.asarray(x, np.float32)
+        # a single example (shape == the export's per-example
+        # input_shape, or a flat vector) gets the batch dim added
+        shape = self.meta.get('input_shape')
+        if (shape and list(x.shape) == list(shape)) or x.ndim == 1:
+            x = x[None]
+        n = len(x)
+        # pad up to the static batch so EVERY request hits the one
+        # compiled program (the predictor's chunking handles n larger
+        # than batch_size at that same shape; without this, each
+        # distinct n < batch_size would compile its own program while
+        # holding the lock)
+        if 0 < n < self.batch_size:
+            x = np.concatenate(
+                [x, np.zeros((self.batch_size - n,) + x.shape[1:],
+                             np.float32)])
+        t0 = time.monotonic()
+        with self.lock:
+            y = self.predict(x)
+            self.requests += 1
+        return {'y': np.asarray(y)[:n].tolist(),
+                'ms': round((time.monotonic() - t0) * 1e3, 3)}
+
+    def _handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, payload):
+                blob = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def do_GET(self):
+                if self.path != '/health':
+                    return self._send(404, {'error': 'not found'})
+                import jax
+                self._send(200, {
+                    'status': 'ok', 'model': server.name,
+                    'platform': jax.default_backend(),
+                    'score': server.meta.get('score'),
+                    'input_shape': server.meta.get('input_shape'),
+                    'requests': server.requests})
+
+            def do_POST(self):
+                if self.path != '/predict':
+                    return self._send(404, {'error': 'not found'})
+                supplied = self.headers.get('Authorization', '').strip()
+                if supplied != server.token:
+                    return self._send(401, {'error': 'unauthorized'})
+                try:
+                    n = int(self.headers.get('Content-Length', 0))
+                    body = json.loads(self.rfile.read(n) or '{}')
+                    self._send(200, server._handle_predict(body))
+                except (ValueError, TypeError) as e:
+                    self._send(400, {'error': str(e)})
+                except Exception as e:  # noqa — keep the server up
+                    self._send(500, {'error': str(e)})
+
+        return Handler
+
+    def bind(self):
+        """Bind the listening socket (resolves ``port 0`` to the real
+        ephemeral port) without blocking; ``serve_forever`` reuses it."""
+        if self.httpd is None:
+            self.httpd = ThreadingHTTPServer(
+                (self.host, self.port), self._handler())
+            self.port = self.httpd.server_address[1]
+        return self.port
+
+    def serve_forever(self):
+        self.bind()
+        self.httpd.serve_forever()
+
+    def shutdown(self):
+        if self.httpd is not None:
+            self.httpd.shutdown()
+
+
+__all__ = ['ModelServer', 'resolve_model']

@@ -627,7 +627,9 @@ class JaxTrain(Executor):
         # everything below reads checkpoint files — drain pending writes
         self._drain_ckpt_writer()
         if self._is_main and self.model_name:
-            self._export_model(ck_dir, best)
+            self._export_model(ck_dir, best,
+                               input_shape=[int(d) for d in
+                                            x_train.shape[1:]])
         # the post-train passes run collective programs (valid forward,
         # checkpoint gather) — EVERY rank must execute the same sequence;
         # only rank 0 touches DB/filesystem inside each helper
@@ -805,10 +807,13 @@ class JaxTrain(Executor):
             n = builder.build(x_valid, y_valid, probs, epoch=epoch)
         self.info(f'report imgs: {n} {kind} rows for epoch {epoch}')
 
-    def _export_model(self, ck_dir, best_score):
+    def _export_model(self, ck_dir, best_score, input_shape=None):
         """Write the deployable export for the model registry — the
         TPU-native analogue of the reference's post-train torch.jit trace
-        (catalyst.py:372-374). Best checkpoint wins; falls back to last."""
+        (catalyst.py:372-374). Best checkpoint wins; falls back to last.
+        ``input_shape`` (per-example, no batch dim) makes the export
+        self-describing enough for the serving process to warm up its
+        XLA compile before the first request."""
         from mlcomp_tpu.train.export import export_from_checkpoint
         src = os.path.join(ck_dir, 'best.msgpack')
         if not os.path.exists(src):
@@ -816,8 +821,10 @@ class JaxTrain(Executor):
         if not os.path.exists(src):
             return
         out = os.path.join(self._model_folder(), self.model_name)
-        export_from_checkpoint(src, self.model_spec, out,
-                               meta={'score': best_score})
+        meta = {'score': best_score}
+        if input_shape:
+            meta['input_shape'] = list(input_shape)
+        export_from_checkpoint(src, self.model_spec, out, meta=meta)
         self.info(f'exported model {self.model_name!r} -> {out}.msgpack')
 
     def _model_folder(self):

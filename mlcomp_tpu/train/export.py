@@ -42,8 +42,7 @@ def export_model(out_path: str, params, model_spec: dict,
     if batch_stats is not None:
         variables['batch_stats'] = batch_stats
     variables = nn.meta.unbox(jax.device_get(variables))
-    base = out_path[:-len('.msgpack')] if out_path.endswith('.msgpack') \
-        else out_path
+    base = export_base(out_path)
     os.makedirs(os.path.dirname(base) or '.', exist_ok=True)
     blob_path = base + '.msgpack'
     tmp = blob_path + '.tmp'
@@ -70,17 +69,29 @@ def export_from_checkpoint(ck_path: str, model_spec: dict,
                         batch_stats=stats, meta=meta)
 
 
+def export_base(path: str) -> str:
+    """Strip an optional .msgpack suffix — the canonical export stem."""
+    return path[:-len('.msgpack')] if path.endswith('.msgpack') else path
+
+
+def load_export_meta(path: str) -> dict:
+    """The export's full .json sidecar ({'model': spec, ...meta}), or
+    {} when absent."""
+    base = export_base(path)
+    if os.path.exists(base + '.json'):
+        with open(base + '.json') as fh:
+            return json.load(fh)
+    return {}
+
+
 def load_export(path: str) -> Tuple[dict, dict]:
     """Returns (variables, model_spec) from an export written by
     export_model. ``path`` may omit the .msgpack suffix."""
     from flax import serialization
-    base = path[:-len('.msgpack')] if path.endswith('.msgpack') else path
+    base = export_base(path)
     with open(base + '.msgpack', 'rb') as fh:
         variables = serialization.msgpack_restore(fh.read())
-    spec = {}
-    if os.path.exists(base + '.json'):
-        with open(base + '.json') as fh:
-            spec = json.load(fh).get('model', {})
+    spec = load_export_meta(base).get('model', {})
     return _unwrap_value_nodes(variables), spec
 
 
@@ -228,5 +239,6 @@ def jax_infer(x: np.ndarray, file: str = None, model_spec: dict = None,
         quantize=quantize)(x)
 
 
-__all__ = ['export_model', 'export_from_checkpoint', 'load_export',
-           'make_predictor', 'jax_infer']
+__all__ = ['export_model', 'export_from_checkpoint', 'export_base',
+           'load_export', 'load_export_meta', 'make_predictor',
+           'jax_infer']

@@ -1,0 +1,153 @@
+"""Model-serving process: resolve -> load -> warm -> HTTP predict.
+
+The reference has no serving path (its registry ends at start-training
+dialogs, mlcomp/server/back/app.py:264-297); this is the deploy end of
+the TPU export story, so it gets the same treatment the API server
+does: real HTTP requests against a live server thread."""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from mlcomp_tpu import MODEL_FOLDER, TOKEN
+from mlcomp_tpu.models import create_model
+from mlcomp_tpu.server.serve import ModelServer, resolve_model
+from mlcomp_tpu.train.export import export_model, make_predictor
+
+
+@pytest.fixture(scope='module')
+def export(tmp_path_factory):
+    folder = tmp_path_factory.mktemp('serve')
+    spec = {'name': 'mlp', 'num_classes': 3, 'hidden': [8],
+            'dtype': 'float32'}
+    model = create_model(**spec)
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 4, 4, 1), np.float32),
+                           train=False)
+    path = export_model(
+        str(folder / 'm'), variables['params'], spec,
+        meta={'score': 0.9, 'input_shape': [4, 4, 1]})
+    return path
+
+
+@pytest.fixture()
+def server(export):
+    srv = ModelServer(export, batch_size=8, activation='softmax',
+                      port=0)
+    assert srv.warmup() is True      # input_shape in meta -> compiles
+    srv.bind()
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+
+
+def _post(srv, body, token=TOKEN):
+    req = urllib.request.Request(
+        f'http://127.0.0.1:{srv.port}/predict',
+        data=json.dumps(body).encode(),
+        headers={'Authorization': token})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+class TestServe:
+    def test_health_no_auth(self, server):
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{server.port}/health',
+                timeout=30) as resp:
+            body = json.loads(resp.read())
+        assert body['status'] == 'ok'
+        assert body['model'] == 'm'
+        assert body['input_shape'] == [4, 4, 1]
+
+    def test_predict_matches_direct_predictor(self, server, export):
+        x = np.random.RandomState(0).rand(5, 4, 4, 1).astype(np.float32)
+        out = _post(server, {'x': x.tolist()})
+        direct = make_predictor(file=export, batch_size=8,
+                                activation='softmax')(x)
+        np.testing.assert_allclose(np.asarray(out['y']), direct,
+                                   rtol=1e-5, atol=1e-6)
+        assert out['ms'] > 0
+        # softmax rows sum to 1
+        np.testing.assert_allclose(np.sum(out['y'], axis=1), 1.0,
+                                   rtol=1e-4)
+
+    def test_single_example_gets_batch_dim(self, server):
+        out = _post(server, {'x': np.zeros((4, 4, 1)).tolist()})
+        assert np.asarray(out['y']).shape == (1, 3)
+
+    def test_auth_and_errors(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server, {'x': [[0.0]]}, token='wrong')
+        assert e.value.code == 401
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server, {})              # no x
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server, {'x': 'not-numbers'})
+        assert e.value.code == 400
+        # server survives all of the above
+        out = _post(server, {'x': np.zeros((2, 4, 4, 1)).tolist()})
+        assert np.asarray(out['y']).shape == (2, 3)
+
+    def test_every_request_size_hits_one_compiled_shape(self, server,
+                                                        export):
+        """Requests are padded to the static batch, so n=5, n=8 and a
+        chunked n=11 all apply at shape (8, ...) — and the padding rows
+        never leak into results."""
+        rng = np.random.RandomState(1)
+        direct = make_predictor(file=export, batch_size=8,
+                                activation='softmax')
+        for n in (5, 8, 11):
+            x = rng.rand(n, 4, 4, 1).astype(np.float32)
+            out = np.asarray(_post(server, {'x': x.tolist()})['y'])
+            assert out.shape == (n, 3)
+            np.testing.assert_allclose(out, direct(x),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_request_count_in_health(self, server):
+        _post(server, {'x': np.zeros((1, 4, 4, 1)).tolist()})
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{server.port}/health',
+                timeout=30) as resp:
+            assert json.loads(resp.read())['requests'] >= 1
+
+
+class TestResolve:
+    def test_explicit_path(self, export):
+        assert resolve_model(export).endswith('m')
+        assert resolve_model(export[:-len('.msgpack')]).endswith('m')
+
+    def test_registry_lookup(self, export, tmp_path, monkeypatch):
+        import mlcomp_tpu.server.serve as serve_mod
+        monkeypatch.setattr(serve_mod, 'MODEL_FOLDER', str(tmp_path))
+        proj = os.path.join(str(tmp_path), 'serve_proj')
+        os.makedirs(proj, exist_ok=True)
+        base = export[:-len('.msgpack')]
+        for ext in ('.msgpack', '.json'):
+            with open(base + ext, 'rb') as src, \
+                    open(os.path.join(proj, 'reg_model' + ext),
+                         'wb') as dst:
+                dst.write(src.read())
+        assert resolve_model('reg_model', 'serve_proj')
+        assert resolve_model('reg_model')       # unique across projects
+        with pytest.raises(FileNotFoundError):
+            resolve_model('no_such_model', 'serve_proj')
+        with pytest.raises(FileNotFoundError):
+            resolve_model('no_such_model')
+        # ambiguity across projects is an error, not a guess
+        proj2 = os.path.join(str(tmp_path), 'other_proj')
+        os.makedirs(proj2, exist_ok=True)
+        with open(base + '.msgpack', 'rb') as src, \
+                open(os.path.join(proj2, 'reg_model.msgpack'),
+                     'wb') as dst:
+            dst.write(src.read())
+        with pytest.raises(ValueError, match='multiple projects'):
+            resolve_model('reg_model')
