@@ -73,7 +73,11 @@ def start(n_workers, in_process):
 @click.option('--quantize', default=None,
               help="'int8' = weight-only int8 serving (half the weight"
                    " HBM)")
-def serve(model, project, host, port, batch_size, activation, quantize):
+@click.option('--coalesce-ms', type=float, default=0,
+              help='batch concurrent requests landing within this many'
+                   ' ms into one device dispatch (0 = off)')
+def serve(model, project, host, port, batch_size, activation, quantize,
+          coalesce_ms):
     """Serve a model export over HTTP (GET /health, POST /predict).
 
     MODEL is an export name from the registry (models/<project>/<name>)
@@ -84,7 +88,7 @@ def serve(model, project, host, port, batch_size, activation, quantize):
     path = resolve_model(model, project)
     server = ModelServer(path, batch_size=batch_size,
                          activation=activation, quantize=quantize,
-                         host=host, port=port)
+                         host=host, port=port, coalesce_ms=coalesce_ms)
     warmed = server.warmup()
     server.bind()
     print(f'serving {server.name} on http://{host}:{server.port} '

@@ -21,6 +21,13 @@ serving owns its chip placement explicitly and the operator decides
 where it runs. Requests serialize through one lock: one chip, one
 compiled program — concurrency belongs in the batch dimension
 (``--batch-size``), which is where the MXU wants it anyway.
+
+``--coalesce-ms W`` makes that literal: concurrent requests landing
+within a W-ms window are concatenated into ONE device dispatch (up to
+``batch_size`` rows) and their results split back per request — N
+simultaneous 1-row clients cost one padded-batch apply instead of N.
+Off by default; single-client latency is better served by the plain
+lock path.
 """
 
 import glob
@@ -62,13 +69,117 @@ def resolve_model(name_or_path: str, project: str = None) -> str:
         f' — pass --project')
 
 
+class _Coalescer:
+    """Concatenate concurrent requests into one device dispatch.
+
+    One worker thread owns the predictor. A request enqueues its rows
+    and blocks; the worker takes the oldest request, keeps collecting
+    same-example-shape requests until the batch is full or the window
+    expires, runs ONE predict over the concatenation, and hands each
+    requester its slice. Mismatched example shapes simply wait for
+    their own batch — they never poison a neighbour's.
+    """
+
+    def __init__(self, predict_padded, batch_size: int,
+                 window_s: float):
+        self.predict_padded = predict_padded
+        self.batch_size = batch_size
+        self.window_s = window_s
+        self.cv = threading.Condition()
+        self.queue = []
+        self.closed = False
+        self.dispatches = 0
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def submit(self, x: np.ndarray) -> np.ndarray:
+        item = {'x': x, 'event': threading.Event(),
+                'y': None, 'err': None}
+        with self.cv:
+            if self.closed:
+                raise RuntimeError('server shutting down')
+            self.queue.append(item)
+            self.cv.notify_all()
+        item['event'].wait()
+        if item['err'] is not None:
+            raise item['err']
+        return item['y']
+
+    def _take_matching(self, shape, capacity):
+        """Dequeue same-shape requests that FIT the remaining batch
+        capacity, in arrival order; stop at the first one that doesn't
+        (FIFO fairness — it starts the next batch instead of being
+        jumped by smaller latecomers)."""
+        take = []
+        for i in list(self.queue):
+            if i['x'].shape[1:] != shape:
+                continue
+            if len(i['x']) > capacity:
+                break
+            take.append(i)
+            capacity -= len(i['x'])
+            self.queue.remove(i)
+        return take
+
+    def _run(self):
+        while True:
+            with self.cv:
+                while not self.queue and not self.closed:
+                    self.cv.wait()
+                if self.closed and not self.queue:
+                    return
+                first = self.queue.pop(0)
+            batch = [first]
+            rows = len(first['x'])
+            deadline = time.monotonic() + self.window_s
+            while rows < self.batch_size:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                with self.cv:
+                    more = self._take_matching(
+                        first['x'].shape[1:], self.batch_size - rows)
+                    if not more:
+                        # nothing usable queued (empty, other shapes,
+                        # or nothing fits) — sleep until notified, then
+                        # try once more; never spin the window away
+                        self.cv.wait(timeout=remaining)
+                        more = self._take_matching(
+                            first['x'].shape[1:],
+                            self.batch_size - rows)
+                if not more and self.closed:
+                    break
+                batch.extend(more)
+                rows += sum(len(i['x']) for i in more)
+            try:
+                y = self.predict_padded(
+                    np.concatenate([i['x'] for i in batch]))
+                offset = 0
+                for i in batch:
+                    n = len(i['x'])
+                    i['y'] = y[offset:offset + n]
+                    offset += n
+            except Exception as e:  # propagate to every caller
+                for i in batch:
+                    i['err'] = e
+            self.dispatches += 1
+            for i in batch:
+                i['event'].set()
+
+    def shutdown(self):
+        with self.cv:
+            self.closed = True
+            self.cv.notify_all()
+        self.thread.join(timeout=5)
+
+
 class ModelServer:
     """One export, one compiled predictor, one HTTP endpoint."""
 
     def __init__(self, file: str, batch_size: int = 64,
                  activation: str = None, quantize: str = None,
                  host: str = '127.0.0.1', port: int = 4202,
-                 token: str = None):
+                 token: str = None, coalesce_ms: float = 0):
         from mlcomp_tpu.train.export import (
             export_base, load_export_meta, make_predictor,
         )
@@ -84,6 +195,9 @@ class ModelServer:
         self.lock = threading.Lock()
         self.meta = load_export_meta(file)
         self.httpd = None
+        self.coalescer = _Coalescer(
+            self._predict_padded, batch_size, coalesce_ms / 1e3) \
+            if coalesce_ms > 0 else None
 
     def warmup(self):
         """Pay the XLA compile before the first request when the export
@@ -108,21 +222,29 @@ class ModelServer:
         if (shape and list(x.shape) == list(shape)) or x.ndim == 1:
             x = x[None]
         n = len(x)
-        # pad up to the static batch so EVERY request hits the one
-        # compiled program (the predictor's chunking handles n larger
-        # than batch_size at that same shape; without this, each
-        # distinct n < batch_size would compile its own program while
-        # holding the lock)
+        t0 = time.monotonic()
+        if self.coalescer is not None and n:
+            y = self.coalescer.submit(x)
+            with self.lock:
+                self.requests += 1
+        else:
+            with self.lock:
+                y = self._predict_padded(x)
+                self.requests += 1
+        return {'y': np.asarray(y).tolist(),
+                'ms': round((time.monotonic() - t0) * 1e3, 3)}
+
+    def _predict_padded(self, x: np.ndarray) -> np.ndarray:
+        """Apply at the ONE compiled shape: pad up to the static batch
+        (the predictor's chunking handles larger n at that same shape;
+        without this, each distinct n < batch_size would compile its
+        own program) and slice the padding back off."""
+        n = len(x)
         if 0 < n < self.batch_size:
             x = np.concatenate(
                 [x, np.zeros((self.batch_size - n,) + x.shape[1:],
                              np.float32)])
-        t0 = time.monotonic()
-        with self.lock:
-            y = self.predict(x)
-            self.requests += 1
-        return {'y': np.asarray(y)[:n].tolist(),
-                'ms': round((time.monotonic() - t0) * 1e3, 3)}
+        return np.asarray(self.predict(x))[:n]
 
     def _handler(self):
         server = self
@@ -181,6 +303,8 @@ class ModelServer:
         self.httpd.serve_forever()
 
     def shutdown(self):
+        if self.coalescer is not None:
+            self.coalescer.shutdown()
         if self.httpd is not None:
             self.httpd.shutdown()
 

@@ -120,6 +120,110 @@ class TestServe:
             assert json.loads(resp.read())['requests'] >= 1
 
 
+class TestCoalesce:
+    def test_concurrent_requests_share_dispatches(self, export):
+        """8 simultaneous 1-row clients must cost far fewer device
+        dispatches than 8 — and every client still gets ITS rows."""
+        srv = ModelServer(export, batch_size=8, activation='softmax',
+                          port=0, coalesce_ms=120)
+        srv.warmup()
+        srv.bind()
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        direct = make_predictor(file=export, batch_size=8,
+                                activation='softmax')
+        rng = np.random.RandomState(2)
+        xs = [rng.rand(1, 4, 4, 1).astype(np.float32)
+              for _ in range(8)]
+        results = [None] * 8
+        before = srv.coalescer.dispatches
+
+        def client(i):
+            results[i] = np.asarray(
+                _post(srv, {'x': xs[i].tolist()})['y'])
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        try:
+            for i in range(8):
+                np.testing.assert_allclose(results[i], direct(xs[i]),
+                                           rtol=1e-5, atol=1e-6)
+            used = srv.coalescer.dispatches - before
+            assert used < 8, f'{used} dispatches for 8 requests'
+        finally:
+            srv.shutdown()
+
+    def test_batch_capacity_respected(self, export):
+        """A same-window request that doesn't FIT the remaining batch
+        capacity starts the next dispatch — one dispatch never exceeds
+        batch_size rows (docs contract), so a small client's latency
+        can't balloon behind a huge neighbour."""
+        import time as _time
+        srv = ModelServer(export, batch_size=8, activation='softmax',
+                          port=0, coalesce_ms=250)
+        srv.bind()
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        seen_rows = []
+        inner = srv.coalescer.predict_padded
+        srv.coalescer.predict_padded = \
+            lambda x: (seen_rows.append(len(x)), inner(x))[1]
+        results = {}
+
+        def client(key, n, delay):
+            _time.sleep(delay)
+            results[key] = np.asarray(_post(
+                srv, {'x': np.zeros((n, 4, 4, 1)).tolist()})['y']).shape
+
+        threads = [
+            threading.Thread(target=client, args=('small', 2, 0)),
+            threading.Thread(target=client, args=('big', 12, 0.05)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        try:
+            assert results['small'] == (2, 3)
+            assert results['big'] == (12, 3)
+            assert max(seen_rows) <= 12      # big alone, never 14
+            assert 2 in seen_rows            # small dispatched alone
+        finally:
+            srv.shutdown()
+
+    def test_coalescer_keeps_shapes_apart(self, export):
+        """A request with a different example shape must error alone,
+        never poisoning a same-window neighbour's batch."""
+        srv = ModelServer(export, batch_size=8, activation='softmax',
+                          port=0, coalesce_ms=60)
+        srv.bind()
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        outcomes = {}
+
+        def client(key, arr):
+            try:
+                outcomes[key] = np.asarray(
+                    _post(srv, {'x': arr.tolist()})['y']).shape
+            except urllib.error.HTTPError as e:
+                outcomes[key] = e.code
+
+        good = np.zeros((2, 4, 4, 1), np.float32)
+        bad = np.zeros((2, 5, 5, 2), np.float32)   # wrong input shape
+        threads = [threading.Thread(target=client, args=('good', good)),
+                   threading.Thread(target=client, args=('bad', bad))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        try:
+            assert outcomes['good'] == (2, 3)
+            assert outcomes['bad'] == 500
+        finally:
+            srv.shutdown()
+
+
 class TestResolve:
     def test_explicit_path(self, export):
         assert resolve_model(export).endswith('m')
