@@ -18,11 +18,12 @@ from mlcomp_tpu.models.transformer import (
     TransformerConfig, TransformerLM,
 )
 from mlcomp_tpu.models.unet import UNet
+from mlcomp_tpu.models.vit import ViT
 
 __all__ = [
     'create_model', 'model_names', 'param_count', 'register_model',
     'MLP', 'ResNet', 'BasicBlock', 'Bottleneck',
-    'TransformerConfig', 'TransformerLM', 'UNet',
+    'TransformerConfig', 'TransformerLM', 'UNet', 'ViT',
     'ResNetEncoder', 'FPN', 'LinkNet', 'PSPNet', 'DeepLabV3',
     'PipelinedTransformerLM',
     'VGGEncoder', 'DenseNetEncoder', 'EfficientNetEncoder',

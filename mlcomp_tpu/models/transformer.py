@@ -49,6 +49,9 @@ class TransformerConfig:
     # loss's f32 upcast is the t5x/maxtext convention (z_loss guards
     # logit drift)
     head_dtype: Optional[str] = None
+    # False = bidirectional attention (encoder use: ViT); the LM always
+    # runs causal
+    causal: bool = True
     # MoE (expert parallelism); 0 = dense MLP everywhere
     n_experts: int = 0
     moe_every: int = 2            # every k-th layer is MoE when n_experts>0
@@ -89,12 +92,12 @@ class Attention(nn.Module):
         v = nn.with_logical_constraint(v, ('batch', 'seq', 'heads', 'kv'))
 
         if self.mesh is not None:
-            attend = make_ring_attention(self.mesh, causal=True,
+            attend = make_ring_attention(self.mesh, causal=cfg.causal,
                                          attn_impl=cfg.attn_impl)
             out = attend(q, k, v)
         else:
             from mlcomp_tpu.ops.flash_attention import fused_attention
-            out = fused_attention(q, k, v, causal=True,
+            out = fused_attention(q, k, v, causal=cfg.causal,
                                   impl=cfg.attn_impl)
         out = nn.with_logical_constraint(
             out, ('batch', 'seq', 'heads', 'kv'))

@@ -1,0 +1,111 @@
+"""Vision Transformer classifier over the LM's encoder blocks.
+
+Green-field relative to the reference (its zoo is 2019-era CNNs —
+SURVEY.md §2.3), added because ViT is the canonical TPU vision model:
+the whole forward is a chain of big dense matmuls that tile straight
+onto the MXU, with none of the small-channel conv padding waste the
+CIFAR CNNs fight (docs/performance.md).
+
+Reuses the transformer's `DecoderLayer` with ``causal=False`` — same
+logical axis names, so tensor/sequence sharding rules apply to the
+patch sequence unchanged:
+
+- patchify as ONE reshape + DenseGeneral over (p*p*C) — a matmul, not a
+  conv: no im2col, no channel padding; XLA lowers it as the same
+  [n_patches, p²C] x [p²C, d] GEMM a conv with kernel=stride=p becomes
+  on its best day;
+- learned positional embedding, pre-LN encoder stack, final RMSNorm;
+- mean-pool over patches instead of a class token: one reduce instead
+  of a gather, and every patch position stays an identical program
+  (no token-0 special case to unroll).
+"""
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from mlcomp_tpu.models.base import register_model
+from mlcomp_tpu.models.transformer import (
+    DecoderLayer, TransformerConfig, _dense,
+)
+
+
+class ViT(nn.Module):
+    cfg: TransformerConfig
+    num_classes: int
+    patch_size: int = 4
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        p = self.patch_size
+        b, h, w, c = images.shape
+        if h % p or w % p:
+            raise ValueError(
+                f'image {h}x{w} not divisible by patch_size={p}')
+        x = jnp.asarray(images, dtype)
+        # [B,H,W,C] -> [B, n_patches, p*p*C]: pure data movement XLA
+        # folds into the patch projection's GEMM
+        x = x.reshape(b, h // p, p, w // p, p, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+            b, (h // p) * (w // p), p * p * c)
+        x = _dense(cfg.d_model, ('conv_in', 'embed'), dtype,
+                   'patch_embed')(x)
+        n = x.shape[1]
+        # the declared resolution is authoritative: pos_embed is sized
+        # from it, so a train/eval resolution mismatch fails loud here
+        # instead of silently re-initializing a different-shaped table
+        if n != cfg.max_seq_len:
+            raise ValueError(
+                f'{h}x{w}/p{p} gives {n} patches but the model was '
+                f'declared for {cfg.max_seq_len} '
+                f'(image_size/patch_size mismatch)')
+        pos = self.param(
+            'pos_embed',
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ('seq', 'embed')),
+            (n, cfg.d_model))
+        x = x + pos[None].astype(dtype)
+        x = nn.with_logical_constraint(x, ('batch', 'seq', 'embed'))
+
+        layer_cls = DecoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(DecoderLayer, static_argnums=(2,))
+        for i in range(cfg.n_layers):
+            layer = layer_cls(cfg, mesh=self.mesh, name=f'layer_{i}')
+            x = layer(x, train) if cfg.remat else layer(x, train=train)
+
+        x = nn.RMSNorm(
+            dtype=dtype, name='norm_final',
+            scale_init=nn.with_logical_partitioning(
+                nn.initializers.ones, ('norm',)))(x)
+        x = x.mean(axis=1)                      # mean-pool the patches
+        logits = _dense(self.num_classes, ('embed', 'vocab'),
+                        jnp.float32, 'head')(x)
+        return logits
+
+
+@register_model('vit')
+def _vit(num_classes: int, image_size: int = 32, patch_size: int = 4,
+         d_model: int = 192, n_layers: int = 6, n_heads: int = 3,
+         d_ff: int = 768, dropout: float = 0.0, dtype: str = 'bfloat16',
+         remat: bool = False, attn_impl: str = 'auto', mesh=None,
+         **kwargs):
+    """``model: {name: vit, num_classes: 10, patch_size: 4}`` — defaults
+    are a ViT-Ti-ish encoder sized for 32x32 inputs; pass
+    d_model/n_layers/n_heads/d_ff for larger variants."""
+    cfg = TransformerConfig(
+        vocab_size=1,   # unused — no token table in the encoder
+        d_model=d_model, n_layers=n_layers, n_heads=n_heads, d_ff=d_ff,
+        max_seq_len=(image_size // patch_size) ** 2, dropout=dropout,
+        dtype=dtype, remat=remat, attn_impl=attn_impl, causal=False)
+    return ViT(cfg, num_classes=num_classes, patch_size=patch_size,
+               mesh=mesh)
+
+
+__all__ = ['ViT']

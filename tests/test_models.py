@@ -135,3 +135,83 @@ def test_transformer_sharded_tp_sp():
         got = jax.jit(sharded.apply)(placed, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_vit_forward_and_noncausal():
+    """ViT forward shape, and the causal=False flag doing its job at
+    the feature level: patch 0's pre-pool representation must depend
+    on the LAST patch under bidirectional attention, and must NOT
+    under a causal stack (same weights, flag flipped)."""
+    from mlcomp_tpu.models import TransformerConfig, ViT
+
+    model = create_model('vit', num_classes=10, image_size=32,
+                         patch_size=4, d_model=64, n_layers=2,
+                         n_heads=4, d_ff=128, dtype='float32')
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 32, 32, 3),
+                    jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(variables, x)
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.float32
+
+    x2 = x.at[:, 28:, 28:, :].set(0.0)   # ONLY the last patch changes
+
+    def final_layer_features(causal, inputs):
+        cfg = TransformerConfig(
+            vocab_size=1, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+            max_seq_len=64, dtype='float32', causal=causal)
+        m = ViT(cfg, num_classes=10, patch_size=4)
+        _, state = m.apply(
+            variables, inputs,
+            capture_intermediates=lambda mdl, name: name == '__call__')
+        feats = state['intermediates']['layer_1']['__call__'][0]
+        assert feats.shape == (2, 64, 64)
+        return np.asarray(feats)
+
+    bi = final_layer_features(False, x) - final_layer_features(False, x2)
+    ca = final_layer_features(True, x) - final_layer_features(True, x2)
+    assert np.abs(bi[:, 0]).max() > 1e-6    # bidirectional: it flows back
+    np.testing.assert_allclose(ca[:, 0], 0, atol=1e-6)  # causal: it can't
+
+
+def test_vit_rejects_bad_patch_size():
+    model = create_model('vit', num_classes=4, image_size=32,
+                         patch_size=5, d_model=32, n_layers=1,
+                         n_heads=2, d_ff=64, dtype='float32')
+    with pytest.raises(ValueError, match='not divisible'):
+        model.init(jax.random.PRNGKey(0),
+                   jnp.zeros((1, 32, 32, 3), jnp.float32))
+
+
+def test_vit_rejects_resolution_mismatch():
+    """The declared image_size is authoritative — feeding a different
+    resolution fails loud instead of silently building a
+    different-shaped pos_embed."""
+    model = create_model('vit', num_classes=4, image_size=32,
+                         patch_size=4, d_model=32, n_layers=1,
+                         n_heads=2, d_ff=64, dtype='float32')
+    with pytest.raises(ValueError, match='mismatch'):
+        model.init(jax.random.PRNGKey(0),
+                   jnp.zeros((1, 16, 16, 3), jnp.float32))
+
+
+def test_vit_sharded_matches_dense():
+    """tp+dp sharded ViT on the 8-device mesh matches the unsharded
+    logits — the patch sequence rides the same logical axes as the LM."""
+    mesh = mesh_from_spec({'dp': 4, 'tp': 2})
+    kwargs = dict(num_classes=10, image_size=16, patch_size=4,
+                  d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                  dtype='float32')
+    dense = create_model('vit', **kwargs)
+    sharded = create_model('vit', mesh=mesh, **kwargs)
+    x = jnp.asarray(np.random.RandomState(1).rand(4, 16, 16, 3),
+                    jnp.float32)
+    variables = dense.init(jax.random.PRNGKey(0), x)
+    want = dense.apply(variables, x)
+    shardings = logical_to_sharding(
+        jax.eval_shape(lambda: variables), mesh)
+    placed = jax.device_put(variables, shardings)
+    with mesh:
+        got = jax.jit(sharded.apply)(placed, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-4, rtol=2e-4)

@@ -349,6 +349,24 @@ class TestJaxTrain:
         }, str(tmp_path / 'ck'))
         assert result['best_score'] < 4.0
 
+    def test_vit_training(self, tmp_path):
+        """ViT learns through the full jax_train path."""
+        result = run_executor({
+            'model': {'name': 'vit', 'num_classes': 10,
+                      'image_size': 8, 'patch_size': 2, 'd_model': 48,
+                      'n_layers': 2, 'n_heads': 4, 'd_ff': 96,
+                      'dropout': 0.0, 'dtype': 'float32'},
+            'dataset': {'name': 'synthetic_images', 'n_train': 512,
+                        'n_valid': 128, 'image_size': 8, 'channels': 1},
+            'batch_size': 64,
+            'stages': [{'name': 's1', 'epochs': 20,
+                        'optimizer': {'name': 'adamw', 'lr': 3e-3,
+                                      'schedule':
+                                          {'name': 'warmup_cosine',
+                                           'warmup_steps': 16}}}],
+        }, str(tmp_path / 'ck'))
+        assert result['best_score'] > 0.8
+
     def test_resnet_batchnorm_training(self, tmp_path):
         result = run_executor({
             'model': {'name': 'resnet18', 'num_classes': 4,
