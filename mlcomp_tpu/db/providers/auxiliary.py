@@ -21,6 +21,10 @@ class AuxiliaryProvider(BaseDataProvider):
             self.session.execute(
                 'UPDATE auxiliary SET data=? WHERE name=?', (payload, name))
 
+    def remove_by_name(self, name: str):
+        self.session.execute(
+            'DELETE FROM auxiliary WHERE name=?', (name,))
+
     def get(self):
         rows = self.session.query('SELECT * FROM auxiliary')
         out = {}

@@ -76,8 +76,11 @@ def start(n_workers, in_process):
 @click.option('--coalesce-ms', type=float, default=0,
               help='batch concurrent requests landing within this many'
                    ' ms into one device dispatch (0 = off)')
+@click.option('--register', is_flag=True,
+              help='heartbeat this endpoint into the auxiliary table '
+                   'so the dashboard supervisor tab lists it')
 def serve(model, project, host, port, batch_size, activation, quantize,
-          coalesce_ms):
+          coalesce_ms, register):
     """Serve a model export over HTTP (GET /health, POST /predict).
 
     MODEL is an export name from the registry (models/<project>/<name>)
@@ -91,9 +94,25 @@ def serve(model, project, host, port, batch_size, activation, quantize,
                          host=host, port=port, coalesce_ms=coalesce_ms)
     warmed = server.warmup()
     server.bind()
+    if register:
+        session = Session.create_session(key='serve')
+        server.start_heartbeat(session)
     print(f'serving {server.name} on http://{host}:{server.port} '
           f'(warmup={"done" if warmed else "first-request"}, '
-          f'quantize={quantize or "none"})')
+          f'quantize={quantize or "none"}'
+          f'{", registered" if register else ""})')
+
+    # polite termination deregisters the endpoint; shutdown() must run
+    # on ANOTHER thread (stdlib shutdown blocks until the serve loop —
+    # this very thread — acknowledges)
+    import signal
+    import threading
+
+    def _stop(signum, frame):
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
     server.serve_forever()
 
 

@@ -436,7 +436,16 @@ def api_task_toggle_report(data, s):
 
 
 def api_auxiliary(data, s):
-    return AuxiliaryProvider(s).get()
+    out = AuxiliaryProvider(s).get()
+    # annotate serving heartbeats with their age by the SERVER clock so
+    # the dashboard can apply a liveness window without trusting the
+    # client's clock (same pattern as DockerProvider.alive)
+    import time as _time
+    for name, entry in out.items():
+        if name.startswith('serving:') and isinstance(entry, dict) \
+                and entry.get('ts'):
+            entry['age_s'] = round(_time.time() - float(entry['ts']), 1)
+    return out
 
 
 def api_logs(data, s):

@@ -554,6 +554,26 @@ async function viewSupervisor(el) {
   if ((sup.dispatched||[]).length)
     el.appendChild(h('<h3>dispatched this tick</h3><pre>'
       + esc(JSON.stringify(sup.dispatched, null, 1)) + '</pre>'));
+  // model-serving endpoints (server serve --register heartbeats);
+  // age_s is stamped by the API from the SERVER clock — rows past the
+  // 30s liveness window render grayed as stale (crashed server), clean
+  // shutdowns deregister their row entirely
+  const serving = Object.entries(res||{})
+    .filter(([k, v]) => k.startsWith('serving:'));
+  if (serving.length)
+    el.appendChild(h('<h3>serving endpoints</h3><table>'
+      + '<tr><th>model</th><th>endpoint</th><th>requests</th>'
+      + '<th>score</th><th>last heartbeat</th></tr>'
+      + serving.map(([k, s]) => {
+          const stale = s.age_s != null && s.age_s > 30;
+          return `<tr${stale?' class="dim"':''}><td>${esc(s.model||k)}</td>
+        <td style="font-family:monospace">${esc((s.host||'')+':'+(s.port||''))}</td>
+        <td>${esc(s.requests)}</td>
+        <td>${s.score==null?'':esc(s.score)}</td>
+        <td class="dim">${esc(s.updated||'')}${stale
+          ? ' (STALE '+esc(s.age_s)+'s)' : ''}</td></tr>`;
+        }).join('')
+      + '</table>'));
   const np = sup.not_placed || {};
   if (Object.keys(np).length)
     el.appendChild(h('<h3>not placed (reasons)</h3><table>'

@@ -195,6 +195,9 @@ class ModelServer:
         self.lock = threading.Lock()
         self.meta = load_export_meta(file)
         self.httpd = None
+        self._lifecycle = threading.Lock()
+        self._serving = False
+        self._closed = False
         self.coalescer = _Coalescer(
             self._predict_padded, batch_size, coalesce_ms / 1e3) \
             if coalesce_ms > 0 else None
@@ -300,13 +303,84 @@ class ModelServer:
 
     def serve_forever(self):
         self.bind()
-        self.httpd.serve_forever()
+        with self._lifecycle:
+            if self._closed:
+                return
+            self._serving = True
+        try:
+            self.httpd.serve_forever()
+        finally:
+            self._serving = False
+
+    def start_heartbeat(self, session, interval_s: float = 10.0) -> str:
+        """Register this endpoint in the auxiliary table (the same
+        no-auth introspection surface the supervisor trace uses) so the
+        dashboard's supervisor tab lists live serving endpoints.
+        Returns the auxiliary key. Works against a local DB or a
+        DB_TYPE=SERVER proxied session alike."""
+        import sys
+        from mlcomp_tpu.db.providers import AuxiliaryProvider
+        from mlcomp_tpu.utils.misc import now
+        provider = AuxiliaryProvider(session)
+        key = f'serving:{self.name}:{self.port}'
+        self._hb_stop = threading.Event()
+        self._hb_session = session
+        self._hb_key = key
+        last_err = [None]
+
+        def beat():
+            while True:
+                try:
+                    provider.create_or_update(key, {
+                        'model': self.name, 'host': self.host,
+                        'port': int(self.port),
+                        'requests': int(self.requests),
+                        'score': self.meta.get('score'),
+                        'input_shape': self.meta.get('input_shape'),
+                        'ts': time.time(),
+                        'updated': str(now())})
+                    last_err[0] = None
+                except Exception as e:
+                    # a DB hiccup must not kill serving, but a BROKEN
+                    # registration must not be silent either — say it
+                    # once per distinct error
+                    if str(e) != last_err[0]:
+                        last_err[0] = str(e)
+                        print(f'serving heartbeat failed: {e}',
+                              file=sys.stderr)
+                if self._hb_stop.wait(interval_s):
+                    return
+
+        beat_thread = threading.Thread(target=beat, daemon=True)
+        beat_thread.start()
+        self._hb_thread = beat_thread
+        return key
 
     def shutdown(self):
+        if getattr(self, '_hb_stop', None) is not None:
+            self._hb_stop.set()
+            # clean exits deregister; a crash leaves the row for the
+            # dashboard's liveness window (age_s) to gray out instead
+            try:
+                from mlcomp_tpu.db.providers import AuxiliaryProvider
+                AuxiliaryProvider(self._hb_session).remove_by_name(
+                    self._hb_key)
+            except Exception:
+                pass
         if self.coalescer is not None:
             self.coalescer.shutdown()
         if self.httpd is not None:
-            self.httpd.shutdown()
+            # stdlib shutdown() BLOCKS until the serve_forever loop
+            # acknowledges — calling it when the loop never started
+            # would hang forever (bind()-only servers, tests); the
+            # lifecycle lock closes the start/stop race (a loop that
+            # lost the race exits before touching the closed socket)
+            with self._lifecycle:
+                self._closed = True
+                serving = self._serving
+            if serving:
+                self.httpd.shutdown()
+            self.httpd.server_close()
 
 
 __all__ = ['ModelServer', 'resolve_model']

@@ -275,6 +275,34 @@ class TestDashboardRenders:
         assert '<pre>' not in html[:40]
         assert html.strip()
 
+    def test_supervisor_tab_lists_serving_endpoints(self, browser,
+                                                    session):
+        """A `server serve --register` heartbeat row renders as the
+        serving-endpoints table (real aux row -> real API -> real JS)."""
+        import time as _time
+        from mlcomp_tpu.db.providers import AuxiliaryProvider
+        AuxiliaryProvider(session).create_or_update(
+            'serving:digits_mlp:4202',
+            {'model': 'digits_mlp', 'host': '10.0.0.7', 'port': 4202,
+             'requests': 17, 'score': 0.97, 'ts': _time.time(),
+             'updated': '2026-07-31 12:00:00'})
+        AuxiliaryProvider(session).create_or_update(
+            'serving:dead_model:4203',
+            {'model': 'dead_model', 'host': '10.0.0.8', 'port': 4203,
+             'requests': 3, 'ts': _time.time() - 300,
+             'updated': '2026-07-31 11:00:00'})
+        browser.call('go', 'supervisor')
+        html = browser.html('#main')
+        assert 'serving endpoints' in html
+        assert 'digits_mlp' in html
+        assert '10.0.0.7:4202' in html
+        assert '17' in html
+        # the live row is not stale; the crashed one is grayed + marked
+        assert 'dead_model' in html
+        assert 'STALE' in html
+        live_row = html.split('digits_mlp')[1].split('dead_model')[0]
+        assert 'STALE' not in live_row
+
 
 class TestJsrtRegressions:
     def test_return_multiline_template_no_asi(self):

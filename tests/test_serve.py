@@ -224,6 +224,47 @@ class TestCoalesce:
             srv.shutdown()
 
 
+class TestHeartbeat:
+    def test_registers_in_auxiliary(self, export, session):
+        """--register's heartbeat lands in the auxiliary table (the
+        dashboard's supervisor tab lists serving endpoints from it)."""
+        from mlcomp_tpu.db.providers import AuxiliaryProvider
+        srv = ModelServer(export, batch_size=8, port=0)
+        srv.bind()
+        key = srv.start_heartbeat(session, interval_s=0.05)
+        try:
+            import time as _time
+            deadline = _time.monotonic() + 5
+            data = {}
+            while _time.monotonic() < deadline:
+                data = AuxiliaryProvider(session).get()
+                if key in data:
+                    break
+                _time.sleep(0.02)
+            assert key in data
+            entry = data[key]
+            assert entry['model'] == 'm'
+            assert entry['port'] == srv.port
+            assert entry['requests'] == 0
+            assert entry['input_shape'] == [4, 4, 1]
+            assert entry['ts'] > 0
+        finally:
+            srv.shutdown()
+        # clean shutdown deregisters — no dead endpoint left behind
+        assert key not in AuxiliaryProvider(session).get()
+
+    def test_shutdown_before_serve_forever_is_safe(self, export):
+        """shutdown() racing (or fully preceding) serve_forever must
+        neither hang nor let the loop touch a closed socket."""
+        srv = ModelServer(export, batch_size=8, port=0)
+        srv.bind()
+        srv.shutdown()                      # loop never started
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        t.join(timeout=5)
+        assert not t.is_alive()             # exited without serving
+
+
 class TestResolve:
     def test_explicit_path(self, export):
         assert resolve_model(export).endswith('m')
