@@ -194,6 +194,10 @@ class ModelServer:
         self.requests = 0
         self.lock = threading.Lock()
         self.meta = load_export_meta(file)
+        # integer-input exports (LM tokens) must be fed as integers —
+        # jnp.take raises on float indices
+        self.in_dtype = np.dtype(self.meta.get('input_dtype',
+                                               'float32'))
         self.httpd = None
         self._lifecycle = threading.Lock()
         self._serving = False
@@ -210,7 +214,7 @@ class ModelServer:
         shape = self.meta.get('input_shape')
         if shape:
             self.predict(np.zeros([self.batch_size] + list(shape),
-                                  np.float32))
+                                  self.in_dtype))
             return True
         return False
 
@@ -218,7 +222,7 @@ class ModelServer:
         x = body.get('x')
         if x is None:
             raise ValueError("body must carry 'x': [[...], ...]")
-        x = np.asarray(x, np.float32)
+        x = np.asarray(x, self.in_dtype)
         # a single example (shape == the export's per-example
         # input_shape, or a flat vector) gets the batch dim added
         shape = self.meta.get('input_shape')
@@ -246,7 +250,7 @@ class ModelServer:
         if 0 < n < self.batch_size:
             x = np.concatenate(
                 [x, np.zeros((self.batch_size - n,) + x.shape[1:],
-                             np.float32)])
+                             x.dtype)])
         return np.asarray(self.predict(x))[:n]
 
     def _handler(self):

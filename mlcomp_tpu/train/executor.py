@@ -629,7 +629,8 @@ class JaxTrain(Executor):
         if self._is_main and self.model_name:
             self._export_model(ck_dir, best,
                                input_shape=[int(d) for d in
-                                            x_train.shape[1:]])
+                                            x_train.shape[1:]],
+                               input_dtype=str(x_train.dtype))
         # the post-train passes run collective programs (valid forward,
         # checkpoint gather) — EVERY rank must execute the same sequence;
         # only rank 0 touches DB/filesystem inside each helper
@@ -807,13 +808,15 @@ class JaxTrain(Executor):
             n = builder.build(x_valid, y_valid, probs, epoch=epoch)
         self.info(f'report imgs: {n} {kind} rows for epoch {epoch}')
 
-    def _export_model(self, ck_dir, best_score, input_shape=None):
+    def _export_model(self, ck_dir, best_score, input_shape=None,
+                      input_dtype=None):
         """Write the deployable export for the model registry — the
         TPU-native analogue of the reference's post-train torch.jit trace
         (catalyst.py:372-374). Best checkpoint wins; falls back to last.
-        ``input_shape`` (per-example, no batch dim) makes the export
-        self-describing enough for the serving process to warm up its
-        XLA compile before the first request."""
+        ``input_shape`` (per-example, no batch dim) + ``input_dtype``
+        make the export self-describing enough for the serving process
+        to warm up its XLA compile before the first request — and to
+        feed INTEGER inputs (LM tokens) as integers."""
         from mlcomp_tpu.train.export import export_from_checkpoint
         src = os.path.join(ck_dir, 'best.msgpack')
         if not os.path.exists(src):
@@ -824,6 +827,8 @@ class JaxTrain(Executor):
         meta = {'score': best_score}
         if input_shape:
             meta['input_shape'] = list(input_shape)
+        if input_dtype:
+            meta['input_dtype'] = str(input_dtype)
         export_from_checkpoint(src, self.model_spec, out, meta=meta)
         self.info(f'exported model {self.model_name!r} -> {out}.msgpack')
 

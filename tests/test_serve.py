@@ -224,6 +224,31 @@ class TestCoalesce:
             srv.shutdown()
 
 
+class TestIntegerInputs:
+    def test_lm_export_serves_tokens(self, tmp_path):
+        """An integer-input export (LM tokens) must warm up and predict
+        — inputs follow the export's recorded input_dtype instead of
+        being force-cast to float (jnp.take raises on float indices)."""
+        spec = {'name': 'transformer_lm', 'vocab_size': 32,
+                'd_model': 16, 'n_layers': 1, 'n_heads': 2, 'd_ff': 32,
+                'max_seq_len': 8, 'dtype': 'float32'}
+        model = create_model(**spec)
+        tokens = np.zeros((1, 8), np.int32)
+        variables = model.init(jax.random.PRNGKey(0), tokens)
+        path = export_model(
+            str(tmp_path / 'lm'), variables['params'], spec,
+            meta={'input_shape': [8], 'input_dtype': 'int32'})
+        srv = ModelServer(path, batch_size=4, port=0)
+        assert srv.warmup() is True          # int zeros, not float
+        srv.bind()
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            out = _post(srv, {'x': [[1, 2, 3, 4, 5, 6, 7, 8]]})
+            assert np.asarray(out['y']).shape == (1, 8, 32)
+        finally:
+            srv.shutdown()
+
+
 class TestHeartbeat:
     def test_registers_in_auxiliary(self, export, session):
         """--register's heartbeat lands in the auxiliary table (the

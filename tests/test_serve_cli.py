@@ -1,0 +1,114 @@
+"""The `server serve` CLI as a real OS process: registry resolution,
+warmup, HTTP predict, --register heartbeat, SIGTERM deregistration.
+The in-process ModelServer tests (test_serve.py) cover the mechanics;
+this covers the click wiring and the signal handler, which only exist
+on the CLI path."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from mlcomp_tpu import MODEL_FOLDER
+from mlcomp_tpu.models import create_model
+from mlcomp_tpu.train.export import export_model
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture()
+def registry_export():
+    proj = os.path.join(MODEL_FOLDER, 'serve_cli_proj')
+    os.makedirs(proj, exist_ok=True)
+    spec = {'name': 'mlp', 'num_classes': 3, 'hidden': [8],
+            'dtype': 'float32'}
+    model = create_model(**spec)
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 4, 4, 1), np.float32),
+                           train=False)
+    export_model(os.path.join(proj, 'cli_model'), variables['params'],
+                 spec, meta={'score': 0.5, 'input_shape': [4, 4, 1]})
+    yield 'cli_model'
+    import shutil
+    shutil.rmtree(proj, ignore_errors=True)
+
+
+def test_serve_cli_end_to_end(registry_export, session):
+    from mlcomp_tpu.db.providers import AuxiliaryProvider
+
+    import mlcomp_tpu
+    port = _free_port()
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    # pin the subprocess to THIS test sandbox root whatever the xdist
+    # worker layout is
+    env['MLCOMP_TPU_ROOT'] = mlcomp_tpu.ROOT_FOLDER
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'mlcomp_tpu.server', 'serve',
+         registry_export, '--project', 'serve_cli_proj',
+         '--port', str(port), '--activation', 'softmax',
+         '--coalesce-ms', '2', '--register'],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.monotonic() + 90
+        up = False
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                out = proc.stdout.read().decode()
+                pytest.fail(f'serve exited rc={proc.returncode}: {out}')
+            try:
+                with urllib.request.urlopen(
+                        f'http://127.0.0.1:{port}/health',
+                        timeout=5) as resp:
+                    health = json.loads(resp.read())
+                up = True
+                break
+            except OSError:
+                time.sleep(0.3)
+        assert up, 'serve CLI never came up'
+        assert health['model'] == 'cli_model'
+        assert health['input_shape'] == [4, 4, 1]
+
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{port}/predict',
+            data=json.dumps(
+                {'x': np.zeros((2, 4, 4, 1)).tolist()}).encode(),
+            headers={'Authorization': 'token'})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            out = json.loads(resp.read())
+        y = np.asarray(out['y'])
+        assert y.shape == (2, 3)
+        np.testing.assert_allclose(y.sum(axis=1), 1.0, rtol=1e-4)
+
+        key = f'serving:cli_model:{port}'
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if key in AuxiliaryProvider(session).get():
+                break
+            time.sleep(0.2)
+        assert key in AuxiliaryProvider(session).get()
+
+        # polite SIGTERM: process exits and the row is deregistered
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=30)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if key not in AuxiliaryProvider(session).get():
+                break
+            time.sleep(0.2)
+        assert key not in AuxiliaryProvider(session).get()
+    finally:
+        if proc.poll() is None:
+            proc.kill()

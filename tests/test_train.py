@@ -194,6 +194,26 @@ class TestJaxTrain:
         }, str(tmp_path / 'ck'))
         assert result['best_score'] > 0.8
 
+    def test_export_meta_records_input_shape_and_dtype(self, tmp_path,
+                                                       monkeypatch):
+        """Registry exports are self-describing: serving warms up from
+        input_shape and feeds integer inputs per input_dtype."""
+        monkeypatch.chdir(tmp_path)
+        run_executor({
+            'model': {'name': 'mlp', 'num_classes': 4, 'hidden': [8],
+                      'dtype': 'float32'},
+            'dataset': {'name': 'synthetic_images', 'n_train': 64,
+                        'n_valid': 32, 'image_size': 8, 'channels': 1,
+                        'num_classes': 4},
+            'batch_size': 32,
+            'model_name': 'meta_m',
+            'stages': [{'name': 's1', 'epochs': 1}],
+        }, str(tmp_path / 'ck'))
+        from mlcomp_tpu.train.export import load_export_meta
+        meta = load_export_meta(str(tmp_path / 'models' / 'meta_m'))
+        assert meta['input_shape'] == [8, 8, 1]
+        assert np.dtype(meta['input_dtype']) == np.float32
+
     def test_infer_valid_saves_best_preds(self, tmp_path, monkeypatch):
         """infer_valid dumps best-checkpoint validation predictions
         (reference InferBestCallback semantics: the best epoch's
