@@ -363,6 +363,10 @@ class ModelServer:
     def shutdown(self):
         if getattr(self, '_hb_stop', None) is not None:
             self._hb_stop.set()
+            # join BEFORE deregistering: an in-flight beat (two HTTP
+            # round trips over a RemoteSession) finishing after the
+            # DELETE would re-register the dead endpoint
+            self._hb_thread.join(timeout=10)
             # clean exits deregister; a crash leaves the row for the
             # dashboard's liveness window (age_s) to gray out instead
             try:

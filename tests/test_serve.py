@@ -290,6 +290,46 @@ class TestHeartbeat:
         assert not t.is_alive()             # exited without serving
 
 
+class TestHeartbeatRemote:
+    def test_register_through_worker_token_proxy(self, export, session):
+        """The deployment story's remote case: a serving machine with
+        only a DML-confined WORKER_TOKEN heartbeats through /api/db —
+        registered, audited as worker-role, deregistered on shutdown."""
+        from mlcomp_tpu.db.providers import (
+            AuxiliaryProvider, WorkerTokenProvider,
+        )
+        from mlcomp_tpu.db.remote import RemoteSession
+        from mlcomp_tpu.server.api import ApiServer
+
+        api = ApiServer(host='127.0.0.1', port=0).start_background()
+        try:
+            wt = WorkerTokenProvider(session).issue('servebox')
+            remote = RemoteSession(f'http://127.0.0.1:{api.port}',
+                                   key='serve_remote', token=wt)
+            srv = ModelServer(export, batch_size=8, port=0)
+            srv.bind()
+            key = srv.start_heartbeat(remote, interval_s=0.05)
+            try:
+                import time as _time
+                deadline = _time.monotonic() + 10
+                while _time.monotonic() < deadline:
+                    if key in AuxiliaryProvider(session).get():
+                        break
+                    _time.sleep(0.05)
+                assert key in AuxiliaryProvider(session).get()
+                # the proxied write is audited as worker-role
+                rows = session.query(
+                    "SELECT role, computer FROM db_audit "
+                    "WHERE sql LIKE '%auxiliary%'")
+                assert rows and rows[0]['role'] == 'worker'
+                assert rows[0]['computer'] == 'servebox'
+            finally:
+                srv.shutdown()
+            assert key not in AuxiliaryProvider(session).get()
+        finally:
+            api.shutdown()
+
+
 class TestResolve:
     def test_explicit_path(self, export):
         assert resolve_model(export).endswith('m')
