@@ -239,7 +239,7 @@ class TestQuantizedServing:
                             spec, meta={'input_shape': [8, 8, 1]})
         srv = ModelServer(path, batch_size=8, activation='softmax',
                           port=0, quantize='int8')
-        srv.warmup()
+        assert srv.warmup() is True
         srv.bind()
         threading.Thread(target=srv.serve_forever, daemon=True).start()
         try:

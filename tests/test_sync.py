@@ -1,0 +1,116 @@
+"""FileSync orchestration: the TaskSynced-ledger walk that releases the
+executors' wait_data_sync barrier (parity: reference worker/sync.py:74-143).
+The copy engine itself is covered in test_native.py; this covers the
+decisions around it — what to pull, when to mark synced, and when NOT to."""
+
+import pytest
+
+from mlcomp_tpu.db.enums import TaskStatus
+from mlcomp_tpu.db.models import Computer
+from mlcomp_tpu.db.providers import (
+    ComputerProvider, TaskProvider, TaskSyncedProvider,
+)
+from mlcomp_tpu.utils.misc import hostname, now
+from mlcomp_tpu.worker.sync import FileSync
+
+
+@pytest.fixture()
+def project_dag(session):
+    from mlcomp_tpu.server.create_dags.standard import dag_standard
+    config = {
+        'info': {'name': 'sync_dag', 'project': 'p_sync'},
+        'executors': {'train': {'type': 'noop'}},
+    }
+    dag, tasks = dag_standard(session, config)
+    return dag, tasks['train'][0]
+
+
+def _succeed_on(session, task_id, computer):
+    tp = TaskProvider(session)
+    task = tp.by_id(task_id)
+    task.status = int(TaskStatus.Success)
+    task.computer_assigned = computer
+    task.last_activity = now()
+    tp.update(task, ['status', 'computer_assigned', 'last_activity'])
+    return task
+
+
+def _register(session, name):
+    ComputerProvider(session).create_or_update(
+        Computer(name=name, cores=8, cpu=8, memory=16,
+                 ip='127.0.0.1'), 'name')
+
+
+class TestFileSync:
+    def test_pull_marks_ledger_and_releases(self, session, project_dag,
+                                            monkeypatch):
+        """A successful task from another computer is pulled once (the
+        shared-storage fast path), marked in the ledger, and never
+        re-pulled; last_synced lands on our Computer row."""
+        import mlcomp_tpu.worker.sync as sync_mod
+        monkeypatch.setattr(sync_mod, '_rsync_available', lambda: False)
+        _register(session, hostname())
+        _register(session, 'otherhost')
+        dag, task_id = project_dag
+        _succeed_on(session, task_id, 'otherhost')
+
+        tsp = TaskSyncedProvider(session)
+        assert tsp.for_computer(hostname())   # pending work visible
+        assert FileSync(session=session).sync() == 1
+        assert tsp.for_computer(hostname()) == []
+        assert FileSync(session=session).sync() == 0   # ledger holds
+        me = ComputerProvider(session).by_name(hostname())
+        assert me.last_synced is not None
+
+    def test_failed_transfer_does_not_release_barrier(
+            self, session, project_dag, monkeypatch):
+        """A failed transfer must NOT mark the task synced — the
+        executor-side wait_data_sync barrier stays closed."""
+        import mlcomp_tpu.worker.sync as sync_mod
+        monkeypatch.setattr(sync_mod, 'sync_directed',
+                            lambda *a, **k: False)
+        _register(session, hostname())
+        _register(session, 'otherhost')
+        dag, task_id = project_dag
+        _succeed_on(session, task_id, 'otherhost')
+        assert FileSync(session=session).sync() == 0
+        assert TaskSyncedProvider(session).for_computer(hostname())
+
+    def test_own_tasks_not_pulled(self, session, project_dag,
+                                  monkeypatch):
+        """Tasks that succeeded HERE need no pull."""
+        import mlcomp_tpu.worker.sync as sync_mod
+        monkeypatch.setattr(sync_mod, '_rsync_available', lambda: False)
+        _register(session, hostname())
+        dag, task_id = project_dag
+        _succeed_on(session, task_id, hostname())
+        assert TaskSyncedProvider(session).for_computer(hostname()) == []
+        assert FileSync(session=session).sync() == 0
+
+    def test_only_computer_filter(self, session, project_dag,
+                                  monkeypatch):
+        """sync_manual(computer) pulls from that source only."""
+        import mlcomp_tpu.worker.sync as sync_mod
+        monkeypatch.setattr(sync_mod, '_rsync_available', lambda: False)
+        _register(session, hostname())
+        _register(session, 'otherhost')
+        dag, task_id = project_dag
+        _succeed_on(session, task_id, 'otherhost')
+        assert FileSync(session=session).sync_manual('thirdhost') == 0
+        assert FileSync(session=session).sync_manual('otherhost') == 1
+
+    def test_opt_out_respected(self, session, project_dag, monkeypatch):
+        """sync_with_this_computer=False on OUR row disables the loop
+        (reference worker/sync.py:84-86)."""
+        import mlcomp_tpu.worker.sync as sync_mod
+        monkeypatch.setattr(sync_mod, '_rsync_available', lambda: False)
+        cp = ComputerProvider(session)
+        cp.create_or_update(
+            Computer(name=hostname(), cores=8, cpu=8, memory=16,
+                     ip='127.0.0.1', sync_with_this_computer=False),
+            'name')
+        _register(session, 'otherhost')
+        dag, task_id = project_dag
+        _succeed_on(session, task_id, 'otherhost')
+        assert FileSync(session=session).sync() == 0
+        assert TaskSyncedProvider(session).for_computer(hostname())
