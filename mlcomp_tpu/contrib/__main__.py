@@ -7,6 +7,8 @@ fold-file generators for the standard dataset layouts.
 - ``split-segment IMG_PATH MASK_PATH N`` — image+mask folders →
   ``fold.csv`` (image, mask, fold)
 - ``split-frame CSV LABEL N`` — any csv with a label column
+- ``split-test-img IMG_PATH`` — test folder → single-fold
+  ``fold_test.csv``
 """
 
 import os
@@ -79,6 +81,24 @@ def split_segment(img_path, mask_path, n_splits, out):
     df['fold'] = rng.permutation(len(df)) % n_splits
     df.to_csv(out, index=False)
     click.echo(f'wrote {out}: {len(df)} rows, {n_splits} folds')
+
+
+@main.command(name='split-test-img')
+@click.argument('img_path')
+@click.option('--out', default='fold_test.csv')
+def split_test_img(img_path, out):
+    """Test-set folder → single-fold csv (parity: reference
+    contrib/__main__.py:75-82 split_test_img — inference-time datasets
+    use the same fold-csv reader as training ones)."""
+    import pandas as pd
+    images = sorted(
+        f for f in os.listdir(img_path)
+        if os.path.isfile(os.path.join(img_path, f)))
+    if not images:
+        raise click.ClickException(f'no files in {img_path}')
+    df = pd.DataFrame({'image': images, 'fold': 0})
+    df.to_csv(out, index=False)
+    click.echo(f'wrote {out}: {len(df)} rows')
 
 
 @main.command(name='split-frame')

@@ -248,6 +248,23 @@ def test_contrib_cli_split_segment(tmp_path):
     assert len(df) == 8 and set(df['fold']) == {0, 1, 2, 3}
 
 
+def test_contrib_cli_split_test_img(tmp_path):
+    import pandas as pd
+    from click.testing import CliRunner
+    from mlcomp_tpu.contrib.__main__ import main as contrib_main
+    (tmp_path / 'test').mkdir()
+    for i in range(5):
+        (tmp_path / 'test' / f't{i}.png').write_bytes(b'x')
+    (tmp_path / 'test' / 'subdir').mkdir()     # dirs are not images
+    out = tmp_path / 'fold_test.csv'
+    result = CliRunner().invoke(contrib_main, [
+        'split-test-img', str(tmp_path / 'test'), '--out', str(out)])
+    assert result.exit_code == 0, result.output
+    df = pd.read_csv(out)
+    assert len(df) == 5 and set(df['fold']) == {0}
+    assert list(df['image']) == sorted(df['image'])
+
+
 # --------------------------------------------------------- kaggle (gated)
 def test_kaggle_executors_registered_and_gated(tmp_path, monkeypatch):
     from mlcomp_tpu.worker.executors import Executor
