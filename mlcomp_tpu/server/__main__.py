@@ -62,9 +62,9 @@ def start(n_workers, in_process):
 
 
 @main.command()
-@click.argument('model')
+@click.argument('model', nargs=-1, required=True)
 @click.option('--project', default=None,
-              help='project folder to resolve MODEL in')
+              help='project folder to resolve MODEL(s) in')
 @click.option('--host', default='127.0.0.1')
 @click.option('--port', type=int, default=4202)
 @click.option('--batch-size', type=int, default=64)
@@ -81,15 +81,17 @@ def start(n_workers, in_process):
                    'so the dashboard supervisor tab lists it')
 def serve(model, project, host, port, batch_size, activation, quantize,
           coalesce_ms, register):
-    """Serve a model export over HTTP (GET /health, POST /predict).
+    """Serve model exports over HTTP (GET /health, POST /predict;
+    with several MODELs, POST /predict/<name>).
 
-    MODEL is an export name from the registry (models/<project>/<name>)
-    or a path to a .msgpack export. Runs its own process — and its own
-    TPU client — so it never contends with a training worker's compiles.
+    Each MODEL is an export name from the registry
+    (models/<project>/<name>) or a path to a .msgpack export. Runs its
+    own process — and its own TPU client — so it never contends with a
+    training worker's compiles.
     """
     from mlcomp_tpu.server.serve import ModelServer, resolve_model
-    path = resolve_model(model, project)
-    server = ModelServer(path, batch_size=batch_size,
+    paths = [resolve_model(m, project) for m in model]
+    server = ModelServer(paths, batch_size=batch_size,
                          activation=activation, quantize=quantize,
                          host=host, port=port, coalesce_ms=coalesce_ms)
     warmed = server.warmup()
@@ -97,7 +99,8 @@ def serve(model, project, host, port, batch_size, activation, quantize,
     if register:
         session = Session.create_session(key='serve')
         server.start_heartbeat(session)
-    print(f'serving {server.name} on http://{host}:{server.port} '
+    print(f'serving {", ".join(server.models)} on '
+          f'http://{host}:{server.port} '
           f'(warmup={"done" if warmed else "first-request"}, '
           f'quantize={quantize or "none"}'
           f'{", registered" if register else ""})')

@@ -10,24 +10,29 @@ loads the self-describing msgpack export ONCE, builds the jitted
 predictor at a static batch shape (exactly one XLA compile — warmed at
 startup when the export's meta carries ``input_shape``), and serves:
 
-- ``GET  /health``   (no auth) — model name, platform, request count
+- ``GET  /health``   (no auth) — model names, platform, request counts
 - ``POST /predict``  ``{"x": [[...]]}`` → ``{"y": [...], "ms": ...}``
   (token auth, same header contract as the JSON API)
+
+Several exports can share one process and one chip (the ensemble case:
+``serve model_a model_b``) — each gets its own compiled predictor and
+``POST /predict/<name>`` route; ``/predict`` without a name keeps
+working when exactly one model is loaded.
 
 A separate process by design, not a route on the API server: a second
 live TPU client in the same process tree starves a training worker's
 compiles ~30x (measured — see bench.py's grid-leg ordering note), so
 serving owns its chip placement explicitly and the operator decides
-where it runs. Requests serialize through one lock: one chip, one
-compiled program — concurrency belongs in the batch dimension
+where it runs. Requests serialize through one lock per model: one
+compiled program each — concurrency belongs in the batch dimension
 (``--batch-size``), which is where the MXU wants it anyway.
 
-``--coalesce-ms W`` makes that literal: concurrent requests landing
-within a W-ms window are concatenated into ONE device dispatch (up to
-``batch_size`` rows) and their results split back per request — N
-simultaneous 1-row clients cost one padded-batch apply instead of N.
-Off by default; single-client latency is better served by the plain
-lock path.
+``--coalesce-ms W`` makes that literal: concurrent requests to the
+same model landing within a W-ms window are concatenated into ONE
+device dispatch (up to ``batch_size`` rows) and their results split
+back per request — N simultaneous 1-row clients cost one padded-batch
+apply instead of N. Off by default; single-client latency is better
+served by the plain lock path.
 """
 
 import glob
@@ -173,13 +178,11 @@ class _Coalescer:
         self.thread.join(timeout=5)
 
 
-class ModelServer:
-    """One export, one compiled predictor, one HTTP endpoint."""
+class _ServedModel:
+    """One export: compiled predictor + request path state."""
 
-    def __init__(self, file: str, batch_size: int = 64,
-                 activation: str = None, quantize: str = None,
-                 host: str = '127.0.0.1', port: int = 4202,
-                 token: str = None, coalesce_ms: float = 0):
+    def __init__(self, file: str, batch_size: int, activation, quantize,
+                 coalesce_ms: float):
         from mlcomp_tpu.train.export import (
             export_base, load_export_meta, make_predictor,
         )
@@ -189,28 +192,22 @@ class ModelServer:
         self.predict = make_predictor(
             file=file, batch_size=batch_size, activation=activation,
             quantize=quantize)
-        self.host, self.port = host, port
-        self.token = TOKEN if token is None else token
-        self.requests = 0
-        self.lock = threading.Lock()
         self.meta = load_export_meta(file)
         # integer-input exports (LM tokens) must be fed as integers —
         # jnp.take raises on float indices
         self.in_dtype = np.dtype(self.meta.get('input_dtype',
                                                'float32'))
-        self.httpd = None
-        self._lifecycle = threading.Lock()
-        self._serving = False
-        self._closed = False
+        self.requests = 0
+        self.lock = threading.Lock()
         self.coalescer = _Coalescer(
             self._predict_padded, batch_size, coalesce_ms / 1e3) \
             if coalesce_ms > 0 else None
 
-    def warmup(self):
+    def warmup(self) -> bool:
         """Pay the XLA compile before the first request when the export
         records its per-example input shape — at the FULL static batch
         shape, the only shape requests are ever applied at (see
-        _handle_predict's padding)."""
+        handle_predict's padding)."""
         shape = self.meta.get('input_shape')
         if shape:
             self.predict(np.zeros([self.batch_size] + list(shape),
@@ -218,7 +215,7 @@ class ModelServer:
             return True
         return False
 
-    def _handle_predict(self, body: dict):
+    def handle_predict(self, body: dict):
         x = body.get('x')
         if x is None:
             raise ValueError("body must carry 'x': [[...], ...]")
@@ -253,6 +250,110 @@ class ModelServer:
                              x.dtype)])
         return np.asarray(self.predict(x))[:n]
 
+    def health(self) -> dict:
+        return {'score': self.meta.get('score'),
+                'input_shape': self.meta.get('input_shape'),
+                'requests': self.requests}
+
+
+class ModelServer:
+    """One process, one chip, one HTTP endpoint — one or more compiled
+    predictors behind it."""
+
+    def __init__(self, file, batch_size: int = 64,
+                 activation: str = None, quantize: str = None,
+                 host: str = '127.0.0.1', port: int = 4202,
+                 token: str = None, coalesce_ms: float = 0):
+        from mlcomp_tpu.train.export import export_base
+        files = [os.fspath(file)] \
+            if isinstance(file, (str, os.PathLike)) \
+            else [os.fspath(f) for f in file]
+        if not files:
+            raise ValueError('need at least one export to serve')
+        # route names up front: same export name from two projects
+        # (ensemble members are conventionally named alike) qualifies
+        # EVERY clashing one with its parent folder; a true duplicate
+        # (same stem AND parent) is an error
+        stems = [os.path.basename(export_base(f)) for f in files]
+        names = []
+        for f, stem in zip(files, stems):
+            name = stem
+            if stems.count(stem) > 1:
+                parent = os.path.basename(
+                    os.path.dirname(os.path.abspath(f))) or 'root'
+                name = f'{parent}/{stem}'
+            if name in names:
+                raise ValueError(
+                    f'duplicate model {name!r} — the same export was '
+                    f'passed twice')
+            names.append(name)
+        self.models = {}
+        try:
+            for f, name in zip(files, names):
+                m = _ServedModel(f, batch_size, activation, quantize,
+                                 coalesce_ms)
+                m.name = name
+                self.models[name] = m
+        except Exception:
+            # partial construction must not leak coalescer threads
+            for m in self.models.values():
+                if m.coalescer is not None:
+                    m.coalescer.shutdown()
+            raise
+        self.primary = next(iter(self.models.values()))
+        self.host, self.port = host, port
+        self.token = TOKEN if token is None else token
+        self.httpd = None
+        self._lifecycle = threading.Lock()
+        self._serving = False
+        self._closed = False
+
+    # ------------------------------------------------- single-model API
+    # (the common case and the back-compat surface: name/meta/coalescer/
+    # requests refer to the primary model when exactly one is served)
+    @property
+    def name(self):
+        return self.primary.name
+
+    @property
+    def meta(self):
+        return self.primary.meta
+
+    @property
+    def batch_size(self):
+        return self.primary.batch_size
+
+    @property
+    def coalescer(self):
+        return self.primary.coalescer
+
+    @property
+    def requests(self):
+        return sum(m.requests for m in self.models.values())
+
+    def warmup(self) -> bool:
+        """True iff EVERY served export carried an input_shape to warm
+        its compile with."""
+        return all([m.warmup() for m in self.models.values()])
+
+    def _route(self, path: str):
+        """/predict → the only model; /predict/<name> → that model.
+        Returns (model, error-payload)."""
+        if path == '/predict':
+            if len(self.models) == 1:
+                return self.primary, None
+            return None, (400, {
+                'error': 'multiple models served — POST /predict/<name>',
+                'models': sorted(self.models)})
+        if path.startswith('/predict/'):
+            name = path[len('/predict/'):]
+            model = self.models.get(name)
+            if model is None:
+                return None, (404, {'error': f'no model {name!r}',
+                                    'models': sorted(self.models)})
+            return model, None
+        return None, (404, {'error': 'not found'})
+
     def _handler(self):
         server = self
 
@@ -272,23 +373,28 @@ class ModelServer:
                 if self.path != '/health':
                     return self._send(404, {'error': 'not found'})
                 import jax
-                self._send(200, {
-                    'status': 'ok', 'model': server.name,
+                payload = {
+                    'status': 'ok', 'model': server.primary.name,
                     'platform': jax.default_backend(),
-                    'score': server.meta.get('score'),
-                    'input_shape': server.meta.get('input_shape'),
-                    'requests': server.requests})
+                    'score': server.primary.meta.get('score'),
+                    'input_shape':
+                        server.primary.meta.get('input_shape'),
+                    'requests': server.requests,
+                    'models': {name: m.health()
+                               for name, m in server.models.items()}}
+                self._send(200, payload)
 
             def do_POST(self):
-                if self.path != '/predict':
-                    return self._send(404, {'error': 'not found'})
+                model, err = server._route(self.path)
+                if err is not None:
+                    return self._send(*err)
                 supplied = self.headers.get('Authorization', '').strip()
                 if supplied != server.token:
                     return self._send(401, {'error': 'unauthorized'})
                 try:
                     n = int(self.headers.get('Content-Length', 0))
                     body = json.loads(self.rfile.read(n) or '{}')
-                    self._send(200, server._handle_predict(body))
+                    self._send(200, model.handle_predict(body))
                 except (ValueError, TypeError) as e:
                     self._send(400, {'error': str(e)})
                 except Exception as e:  # noqa — keep the server up
@@ -317,32 +423,34 @@ class ModelServer:
             self._serving = False
 
     def start_heartbeat(self, session, interval_s: float = 10.0) -> str:
-        """Register this endpoint in the auxiliary table (the same
+        """Register every served model in the auxiliary table (the same
         no-auth introspection surface the supervisor trace uses) so the
         dashboard's supervisor tab lists live serving endpoints.
-        Returns the auxiliary key. Works against a local DB or a
-        DB_TYPE=SERVER proxied session alike."""
+        Returns the primary model's auxiliary key. Works against a
+        local DB or a DB_TYPE=SERVER proxied session alike."""
         import sys
         from mlcomp_tpu.db.providers import AuxiliaryProvider
         from mlcomp_tpu.utils.misc import now
         provider = AuxiliaryProvider(session)
-        key = f'serving:{self.name}:{self.port}'
+        self._hb_keys = [f'serving:{m.name}:{self.port}'
+                         for m in self.models.values()]
         self._hb_stop = threading.Event()
         self._hb_session = session
-        self._hb_key = key
         last_err = [None]
 
         def beat():
             while True:
                 try:
-                    provider.create_or_update(key, {
-                        'model': self.name, 'host': self.host,
-                        'port': int(self.port),
-                        'requests': int(self.requests),
-                        'score': self.meta.get('score'),
-                        'input_shape': self.meta.get('input_shape'),
-                        'ts': time.time(),
-                        'updated': str(now())})
+                    for key, m in zip(self._hb_keys,
+                                      self.models.values()):
+                        provider.create_or_update(key, {
+                            'model': m.name, 'host': self.host,
+                            'port': int(self.port),
+                            'requests': int(m.requests),
+                            'score': m.meta.get('score'),
+                            'input_shape': m.meta.get('input_shape'),
+                            'ts': time.time(),
+                            'updated': str(now())})
                     last_err[0] = None
                 except Exception as e:
                     # a DB hiccup must not kill serving, but a BROKEN
@@ -358,7 +466,7 @@ class ModelServer:
         beat_thread = threading.Thread(target=beat, daemon=True)
         beat_thread.start()
         self._hb_thread = beat_thread
-        return key
+        return self._hb_keys[0]
 
     def shutdown(self):
         if getattr(self, '_hb_stop', None) is not None:
@@ -367,16 +475,18 @@ class ModelServer:
             # round trips over a RemoteSession) finishing after the
             # DELETE would re-register the dead endpoint
             self._hb_thread.join(timeout=10)
-            # clean exits deregister; a crash leaves the row for the
+            # clean exits deregister; a crash leaves the rows for the
             # dashboard's liveness window (age_s) to gray out instead
             try:
                 from mlcomp_tpu.db.providers import AuxiliaryProvider
-                AuxiliaryProvider(self._hb_session).remove_by_name(
-                    self._hb_key)
+                provider = AuxiliaryProvider(self._hb_session)
+                for key in self._hb_keys:
+                    provider.remove_by_name(key)
             except Exception:
                 pass
-        if self.coalescer is not None:
-            self.coalescer.shutdown()
+        for m in self.models.values():
+            if m.coalescer is not None:
+                m.coalescer.shutdown()
         if self.httpd is not None:
             # stdlib shutdown() BLOCKS until the serve_forever loop
             # acknowledges — calling it when the loop never started

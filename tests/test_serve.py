@@ -224,6 +224,145 @@ class TestCoalesce:
             srv.shutdown()
 
 
+class TestMultiModel:
+    def test_two_models_one_process(self, export, tmp_path):
+        """Two exports share the process and chip: named routes answer
+        independently, bare /predict refuses with the name list, health
+        carries per-model state."""
+        spec = {'name': 'mlp', 'num_classes': 5, 'hidden': [16],
+                'dtype': 'float32'}
+        model = create_model(**spec)
+        v = model.init(jax.random.PRNGKey(1),
+                       np.zeros((1, 4, 4, 1), np.float32), train=False)
+        second = export_model(str(tmp_path / 'second'), v['params'],
+                              spec, meta={'input_shape': [4, 4, 1]})
+        srv = ModelServer([export, second], batch_size=8,
+                          activation='softmax', port=0)
+        assert srv.warmup() is True          # both compiles paid
+        srv.bind()
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            x = np.random.RandomState(5).rand(3, 4, 4, 1) \
+                .astype(np.float32)
+
+            def post_to(path):
+                req = urllib.request.Request(
+                    f'http://127.0.0.1:{srv.port}{path}',
+                    data=json.dumps({'x': x.tolist()}).encode(),
+                    headers={'Authorization': TOKEN})
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return json.loads(resp.read())
+
+            assert np.asarray(post_to('/predict/m')['y']).shape \
+                == (3, 3)
+            assert np.asarray(post_to('/predict/second')['y']).shape \
+                == (3, 5)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post_to('/predict')          # ambiguous without a name
+            assert e.value.code == 400
+            assert sorted(json.loads(e.value.read())['models']) \
+                == ['m', 'second']
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post_to('/predict/nope')
+            assert e.value.code == 404
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{srv.port}/health',
+                    timeout=30) as resp:
+                health = json.loads(resp.read())
+            assert set(health['models']) == {'m', 'second'}
+            assert health['models']['m']['requests'] == 1
+            assert health['models']['second']['requests'] == 1
+        finally:
+            srv.shutdown()
+
+    def test_duplicate_names_rejected(self, export):
+        with pytest.raises(ValueError, match='duplicate'):
+            ModelServer([export, export], batch_size=8, port=0)
+
+    def test_same_name_across_projects_qualifies_routes(self, export,
+                                                        tmp_path):
+        """Ensemble members conventionally share a name across project
+        folders — both serve, each under parent-qualified routes."""
+        import shutil
+        base = export[:-len('.msgpack')] \
+            if export.endswith('.msgpack') else export
+        for proj in ('proj_a', 'proj_b'):
+            d = tmp_path / proj
+            d.mkdir()
+            for ext in ('.msgpack', '.json'):
+                shutil.copy(base + ext, str(d / ('m' + ext)))
+        srv = ModelServer([str(tmp_path / 'proj_a' / 'm'),
+                           str(tmp_path / 'proj_b' / 'm')],
+                          batch_size=8, port=0)
+        try:
+            assert set(srv.models) == {'proj_a/m', 'proj_b/m'}
+            srv.bind()
+            threading.Thread(target=srv.serve_forever,
+                             daemon=True).start()
+            req = urllib.request.Request(
+                f'http://127.0.0.1:{srv.port}/predict/proj_a/m',
+                data=json.dumps(
+                    {'x': np.zeros((2, 4, 4, 1)).tolist()}).encode(),
+                headers={'Authorization': TOKEN})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                y = np.asarray(json.loads(resp.read())['y'])
+            assert y.shape == (2, 3)
+        finally:
+            srv.shutdown()
+
+    def test_pathlike_accepted(self, export):
+        import pathlib
+        srv = ModelServer(pathlib.Path(export), batch_size=8, port=0)
+        try:
+            assert srv.name == 'm'
+        finally:
+            srv.shutdown()
+
+    def test_failed_init_does_not_leak_coalescer_threads(self, export):
+        import time as _time
+        before = {t.ident for t in threading.enumerate()}
+        with pytest.raises(FileNotFoundError):
+            ModelServer([export, '/nonexistent/model'], batch_size=8,
+                        port=0, coalesce_ms=50)
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline:
+            leaked = [t for t in threading.enumerate()
+                      if t.ident not in before and t.is_alive()]
+            if not leaked:
+                break
+            _time.sleep(0.05)
+        assert not leaked
+
+    def test_multi_model_heartbeat_one_row_each(self, export, tmp_path,
+                                                session):
+        from mlcomp_tpu.db.providers import AuxiliaryProvider
+        spec = {'name': 'mlp', 'num_classes': 5, 'hidden': [16],
+                'dtype': 'float32'}
+        model = create_model(**spec)
+        v = model.init(jax.random.PRNGKey(1),
+                       np.zeros((1, 4, 4, 1), np.float32), train=False)
+        second = export_model(str(tmp_path / 'second'), v['params'],
+                              spec, meta={'input_shape': [4, 4, 1]})
+        srv = ModelServer([export, second], batch_size=8, port=0)
+        srv.bind()
+        srv.start_heartbeat(session, interval_s=0.05)
+        try:
+            import time as _time
+            deadline = _time.monotonic() + 5
+            while _time.monotonic() < deadline:
+                data = AuxiliaryProvider(session).get()
+                keys = [k for k in data if k.startswith('serving:')]
+                if len(keys) == 2:
+                    break
+                _time.sleep(0.02)
+            assert {data[k]['model'] for k in keys} == {'m', 'second'}
+        finally:
+            srv.shutdown()
+        left = [k for k in AuxiliaryProvider(session).get()
+                if k.startswith('serving:')]
+        assert left == []                   # both rows deregistered
+
+
 class TestQuantizedServing:
     def test_int8_endpoint_close_to_f32(self, tmp_path):
         """quantize='int8' through the serving path: the hidden kernel
