@@ -10,6 +10,14 @@ Here checkpoints are flax msgpack blobs + a JSON meta sidecar; the same
 
 Layout: ``<dir>/last.msgpack``, ``<dir>/best.msgpack``, each with
 ``.meta.json`` carrying {step, stage, stage_epoch, epoch, score, time}.
+
+Two wire formats share the ``last``/``best`` naming and this module's
+``load_meta``/``restore_checkpoint``/``checkpoint_exists`` dispatch:
+
+- ``<kind>.msgpack`` — single-host flat blob (this module);
+- ``<kind>/`` directory — per-host shard files + index, written when
+  the state is mesh-sharded or the run is multi-process, so no host
+  ever materializes the full parameter bytes (train/ckpt_shard.py).
 """
 
 import json
@@ -46,10 +54,22 @@ def save_checkpoint(directory: str, state: Any, meta: dict,
     with open(meta_tmp, 'w') as fh:
         json.dump(meta, fh)
     os.replace(meta_tmp, _meta_path(last))
+    # mirror of ckpt_shard's cleanup: a format switch back to msgpack
+    # must not leave a stale sharded dir shadowing this save. Only the
+    # kinds being WRITTEN are stale — an old-format best may remain the
+    # genuinely best-scoring checkpoint across a resume — and each
+    # stale dir goes only AFTER its replacement is fully on disk
+    def _drop_stale_dir(kind: str):
+        if os.path.exists(os.path.join(directory, kind, 'index.json')):
+            shutil.rmtree(os.path.join(directory, kind),
+                          ignore_errors=True)
+
+    _drop_stale_dir('last')
     if best:
         best_path = os.path.join(directory, 'best.msgpack')
         shutil.copyfile(last, best_path)
         shutil.copyfile(_meta_path(last), _meta_path(best_path))
+        _drop_stale_dir('best')
     return last
 
 
@@ -80,8 +100,9 @@ class AsyncCheckpointWriter:
             if item is None:
                 self._q.task_done()
                 return
+            fn, args, kwargs = item
             try:
-                save_checkpoint(*item)
+                fn(*args, **kwargs)
             except Exception as e:  # surfaced on wait()/next submit()
                 self._err = e
             finally:
@@ -95,7 +116,18 @@ class AsyncCheckpointWriter:
     def submit(self, directory: str, state, meta: dict,
                best: bool = False):
         self._raise_pending()
-        self._q.put((directory, state, meta, best))
+        self._q.put((save_checkpoint, (directory, state, meta),
+                     {'best': best}))
+
+    def submit_job(self, fn, *args, **kwargs):
+        """Queue an arbitrary write job (the sharded-format path submits
+        ``write_shard_plan`` with a host-side shard plan). Jobs must not
+        run collectives: ``write_shard_plan``'s cross-process barriers
+        sync global devices, so multi-process runs call it synchronously
+        on the main thread instead (the executor gates on
+        process_count) — their payoff is shard-sized I/O, not overlap."""
+        self._raise_pending()
+        self._q.put((fn, args, kwargs))
 
     def wait(self):
         self._q.join()
@@ -109,34 +141,82 @@ class AsyncCheckpointWriter:
             self._thread.join(timeout=60)
 
 
-def load_meta(directory: str, kind: str = 'last') -> Optional[dict]:
-    """Read just the meta sidecar — lets resume logic decide the restore
-    target's structure (e.g. which stage's optimizer) BEFORE
-    deserialising the blob."""
-    path = _meta_path(os.path.join(directory, f'{kind}.msgpack'))
+def _pick_format(directory: str, kind: str) -> Optional[str]:
+    """'msgpack' | 'sharded' | None. When BOTH formats exist (a crash
+    between committing one format and removing the stale other), prefer
+    the one whose meta is NEWER — the stale blob must not silently
+    shadow a more recent sharded save, or vice versa."""
+    blob = os.path.join(directory, f'{kind}.msgpack')
+    have_blob = os.path.exists(blob)
+    from mlcomp_tpu.train.ckpt_shard import checkpoint_meta_sharded
+    sharded_meta = checkpoint_meta_sharded(directory, kind)
+    if have_blob and sharded_meta is None:
+        return 'msgpack'
+    if sharded_meta is not None and not have_blob:
+        return 'sharded'
+    if not have_blob:
+        return None
+    blob_meta = _load_json(_meta_path(blob)) or {}
+    blob_t = float(blob_meta.get('time', 0) or 0)
+    shard_t = float(sharded_meta.get('time', 0) or 0)
+    return 'msgpack' if blob_t >= shard_t else 'sharded'
+
+
+def _load_json(path: str) -> Optional[dict]:
     if not os.path.exists(path):
         return None
     try:
         with open(path) as fh:
             return json.load(fh)
     except (json.JSONDecodeError, OSError):
-        # truncated/corrupt sidecar (crash mid-save) — treat as absent so
-        # the caller starts fresh instead of wedging the task forever
         return None
+
+
+def checkpoint_exists(directory: str,
+                      kind: str = 'last') -> Optional[str]:
+    """Path of the ``kind`` checkpoint in whichever format exists —
+    the ``.msgpack`` blob or the sharded directory — else None."""
+    fmt = _pick_format(directory, kind)
+    if fmt == 'msgpack':
+        return os.path.join(directory, f'{kind}.msgpack')
+    if fmt == 'sharded':
+        return os.path.join(directory, kind)
+    return None
+
+
+def load_meta(directory: str, kind: str = 'last') -> Optional[dict]:
+    """Read just the meta sidecar — lets resume logic decide the restore
+    target's structure (e.g. which stage's optimizer) BEFORE
+    deserialising the blob. Serves both wire formats."""
+    if _pick_format(directory, kind) == 'sharded':
+        from mlcomp_tpu.train.ckpt_shard import checkpoint_meta_sharded
+        return checkpoint_meta_sharded(directory, kind)
+    # _load_json: a truncated/corrupt sidecar (crash mid-save) reads as
+    # absent so the caller starts fresh instead of wedging the task
+    return _load_json(
+        _meta_path(os.path.join(directory, f'{kind}.msgpack')))
 
 
 def restore_checkpoint(directory: str, target: Any,
                        kind: str = 'last'
                        ) -> Tuple[Optional[Any], Optional[dict]]:
-    """Restore ``<kind>.msgpack`` into the structure of ``target``.
+    """Restore the ``kind`` checkpoint into the structure of ``target``.
+    Dispatches on wire format: msgpack blob (host arrays returned —
+    caller places them) or sharded directory (arrays land already
+    placed on ``target``'s shardings, resharding as needed).
     Returns (state, meta) or (None, None) when absent."""
     path = os.path.join(directory, f'{kind}.msgpack')
-    if not os.path.exists(path):
-        return None, None
+    if _pick_format(directory, kind) != 'msgpack':
+        from mlcomp_tpu.train.ckpt_shard import (
+            restore_checkpoint_sharded,
+        )
+        return restore_checkpoint_sharded(directory, target, kind)
     with open(path, 'rb') as fh:
         blob = fh.read()
     state = serialization.from_bytes(target, blob)
-    meta = load_meta(directory, kind) or {}
+    # read the blob's own sidecar directly — load_meta would re-run the
+    # format pick (and re-parse the sharded index) a second time
+    meta = _load_json(_meta_path(path)) or {}
     return state, meta
 
 
@@ -163,5 +243,6 @@ def resume_plan(stages: list, meta: Optional[dict]) -> Tuple[list, int]:
     return list(stages[idx:]), ck_epoch + 1
 
 
-__all__ = ['save_checkpoint', 'restore_checkpoint', 'resume_plan',
+__all__ = ['checkpoint_exists',
+           'save_checkpoint', 'restore_checkpoint', 'resume_plan',
            'load_meta', 'AsyncCheckpointWriter']

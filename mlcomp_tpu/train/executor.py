@@ -335,6 +335,23 @@ class JaxTrain(Executor):
         # structure must match the optimizer of the stage that SAVED the
         # checkpoint, not stages[0] (they can be different optim types).
         meta = load_meta(ck_dir)
+        if jax.process_count() > 1:
+            # EVERY rank must see the same meta or ranks build different
+            # optimizer structures and trim different stages — with the
+            # sharded per-host checkpoint format, a rank whose folder
+            # missed the index.json sync is the designed-for hazard, so
+            # vote BEFORE anything downstream depends on meta
+            from jax.experimental import multihost_utils
+            stage_idx = stage_names.index(meta['stage']) \
+                if meta and meta.get('stage') in stage_names else -1
+            votes = multihost_utils.process_allgather(np.array(
+                [int(meta is not None), stage_idx,
+                 int(meta.get('epoch', -1)) if meta else -1]))
+            if not (votes == votes[0]).all():
+                raise RuntimeError(
+                    f'checkpoint meta differs across hosts '
+                    f'({votes.tolist()}) — sync the checkpoint folder '
+                    f'(index.json + fragments) before resuming')
         target_stage = self.stages[0]
         if meta and meta.get('stage') in stage_names:
             target_stage = self.stages[stage_names.index(meta['stage'])]
@@ -370,23 +387,24 @@ class JaxTrain(Executor):
                         model, optimizer, sample,
                         jax.random.PRNGKey(self.seed), mesh=mesh,
                         with_dropout_rng=True)
-        if self.params_file and jax.process_count() > 1:
-            # the restore-vs-pretrained branch must be UNANIMOUS across
-            # ranks (same hazard _infer_valid votes on): a rank that
-            # restores while another applies pretrained weights trains
-            # collectives on divergent params with no error
+        if jax.process_count() > 1:
+            # restore SUCCESS must also be unanimous (same hazard
+            # _infer_valid votes on): a rank that restored while another
+            # starts from scratch trains collectives on divergent
+            # params with no error raised
             from jax.experimental import multihost_utils
+            have_file = bool(self.params_file) and (
+                os.path.exists(self.params_file) or os.path.exists(
+                    self.params_file + '.msgpack'))
             votes = multihost_utils.process_allgather(np.array(
-                [restored is not None,
-                 os.path.exists(self.params_file) or os.path.exists(
-                     self.params_file + '.msgpack')]))
+                [restored is not None, have_file]))
             restored_flags, file_flags = votes[:, 0], votes[:, 1]
             if restored_flags.any() != restored_flags.all():
                 raise RuntimeError(
                     'checkpoint restore succeeded on some hosts only — '
-                    'sync the checkpoint folder before resuming a '
-                    'params_file run')
-            if restored is None and not file_flags.all():
+                    'sync the checkpoint folder before resuming')
+            if self.params_file and restored is None \
+                    and not file_flags.all():
                 raise FileNotFoundError(
                     f'params_file {self.params_file!r} must be readable '
                     f'on EVERY host ({int(file_flags.sum())}/'
@@ -591,18 +609,38 @@ class JaxTrain(Executor):
                     or (global_epoch + 1) % self.checkpoint_every == 0
                     or last_of_stage)
                 if should_save:
-                    # the host gather is a collective every rank joins;
-                    # only rank 0 touches the filesystem
-                    # (reference rank>0 suppression, catalyst.py:298-311)
-                    from mlcomp_tpu.parallel.distributed import (
-                        host_replicated_copy,
+                    meta_d = {'stage': stage_name,
+                              'stage_epoch': epoch,
+                              'epoch': global_epoch, 'score': score,
+                              'step': int(state.step)}
+                    from mlcomp_tpu.train.ckpt_shard import (
+                        build_shard_plan, state_needs_sharded_ckpt,
+                        write_shard_plan,
                     )
-                    host_state = host_replicated_copy(state, mesh)
-                    if self._is_main:
-                        meta_d = {'stage': stage_name,
-                                  'stage_epoch': epoch,
-                                  'epoch': global_epoch, 'score': score,
-                                  'step': int(state.step)}
+                    if state_needs_sharded_ckpt(state):
+                        # sharded format: each process pulls only ITS
+                        # addressable replica-0 shards (no collective,
+                        # no full-state buffer on any host) and writes
+                        # its own fragment files; rank 0 adds the index
+                        plan = build_shard_plan(state)
+                        if self._ckpt_writer is not None \
+                                and jax.process_count() == 1:
+                            # off-thread only single-process: the
+                            # multi-process write barriers are
+                            # collectives and must stay on the main
+                            # thread, ordered with the train step's
+                            self._ckpt_writer.submit_job(
+                                write_shard_plan, ck_dir, plan,
+                                meta_d, best=is_best)
+                        else:
+                            write_shard_plan(ck_dir, plan, meta_d,
+                                             best=is_best)
+                    else:
+                        # single-process by construction (multi-process
+                        # always takes the sharded branch above): flat
+                        # msgpack blob (reference rank-0 write,
+                        # catalyst.py:298-311)
+                        host_state = jax.device_get(state)
                         if self._ckpt_writer is not None:
                             # serialise+write off-thread: the next
                             # epoch's compute overlaps the disk IO
@@ -730,21 +768,21 @@ class JaxTrain(Executor):
         if do_best and jax.process_count() > 1:
             # every process must make the SAME reload decision or their
             # params diverge mid-collective; a rank without a local
-            # best.msgpack (non-shared fs) forces the final state
+            # best checkpoint (non-shared fs) forces the final state
             from jax.experimental import multihost_utils
-            have = os.path.exists(os.path.join(ck_dir, 'best.msgpack'))
+            from mlcomp_tpu.train.checkpoint import checkpoint_exists
+            have = checkpoint_exists(ck_dir, 'best') is not None
             do_best = bool(multihost_utils.process_allgather(
                 np.array(have)).all())
         if do_best:
-            from mlcomp_tpu.parallel.distributed import (
-                host_replicated_copy,
-            )
             from mlcomp_tpu.train.loop import place_state
-            # the gather is a collective — every rank joins it
-            host_state = host_replicated_copy(state, mesh)
+            # no gather: the msgpack path only reads target STRUCTURE
+            # (host values land below via place_state), and the sharded
+            # path restores straight onto the live state's shardings —
+            # each host reads only its own devices' slices
             try:
                 best_state, _ = restore_checkpoint(
-                    ck_dir, host_state, kind='best')
+                    ck_dir, state, kind='best')
             except Exception as e:  # stage drift: best saved under a
                 best_state = None   # different optimizer structure
                 if self._is_main:
@@ -817,11 +855,11 @@ class JaxTrain(Executor):
         make the export self-describing enough for the serving process
         to warm up its XLA compile before the first request — and to
         feed INTEGER inputs (LM tokens) as integers."""
+        from mlcomp_tpu.train.checkpoint import checkpoint_exists
         from mlcomp_tpu.train.export import export_from_checkpoint
-        src = os.path.join(ck_dir, 'best.msgpack')
-        if not os.path.exists(src):
-            src = os.path.join(ck_dir, 'last.msgpack')
-        if not os.path.exists(src):
+        src = checkpoint_exists(ck_dir, 'best') \
+            or checkpoint_exists(ck_dir, 'last')
+        if not src:
             return
         out = os.path.join(self._model_folder(), self.model_name)
         meta = {'score': best_score}
@@ -829,7 +867,15 @@ class JaxTrain(Executor):
             meta['input_shape'] = list(input_shape)
         if input_dtype:
             meta['input_dtype'] = str(input_dtype)
-        export_from_checkpoint(src, self.model_spec, out, meta=meta)
+        try:
+            export_from_checkpoint(src, self.model_spec, out, meta=meta)
+        except FileNotFoundError as e:
+            # sharded checkpoint on a non-shared fs: rank 0 holds only
+            # its own fragment files until FileSync ships the rest —
+            # the TRAINING succeeded, so defer the export (a ModelAdd
+            # task after sync produces it) instead of failing the task
+            self.info(f'WARNING: export deferred — {e}')
+            return
         self.info(f'exported model {self.model_name!r} -> {out}.msgpack')
 
     def _model_folder(self):

@@ -56,12 +56,18 @@ def export_model(out_path: str, params, model_spec: dict,
 
 def export_from_checkpoint(ck_path: str, model_spec: dict,
                            out_path: str, meta: dict = None) -> str:
-    """Export from a raw TrainState checkpoint blob (last/best.msgpack)
-    WITHOUT knowing the optimizer structure that saved it — restore the
-    untyped msgpack tree and lift params/batch_stats out."""
+    """Export from a raw TrainState checkpoint (a last/best.msgpack
+    blob OR a sharded checkpoint directory) WITHOUT knowing the
+    optimizer structure that saved it — restore the untyped tree and
+    lift params/batch_stats out. The sharded read assembles one full
+    leaf at a time; an export must fit one chip to serve anyway."""
     from flax import serialization
-    with open(ck_path, 'rb') as fh:
-        raw = serialization.msgpack_restore(fh.read())
+    if os.path.isdir(ck_path):
+        from mlcomp_tpu.train.ckpt_shard import read_checkpoint_tree
+        raw = read_checkpoint_tree(ck_path)
+    else:
+        with open(ck_path, 'rb') as fh:
+            raw = serialization.msgpack_restore(fh.read())
     params = _unwrap_value_nodes(raw['params'])
     stats = _unwrap_value_nodes(raw.get('batch_stats')) \
         if raw.get('batch_stats') is not None else None

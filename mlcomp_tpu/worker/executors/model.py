@@ -68,12 +68,13 @@ class ModelAdd(Executor):
             # _checkpoint_folder), so resolve through task.parent
             ck_task = task.parent or task.id
             ck_dir = os.path.join(TASK_FOLDER, str(ck_task), 'checkpoints')
+            from mlcomp_tpu.train.checkpoint import checkpoint_exists
             src = self.file and os.path.join(ck_dir, self.file)
             if not src or not os.path.exists(src):
-                src = os.path.join(ck_dir, 'best.msgpack')
-            if not os.path.exists(src):
-                src = os.path.join(ck_dir, 'last.msgpack')
-            if not os.path.exists(src):
+                # either wire format: flat msgpack blob or sharded dir
+                src = checkpoint_exists(ck_dir, 'best') \
+                    or checkpoint_exists(ck_dir, 'last')
+            if not src:
                 raise FileNotFoundError(
                     f'no checkpoint under {ck_dir!r} to register')
 

@@ -1,0 +1,128 @@
+"""Probe int8 serving matmul variants on the real chip.
+
+Variants at the serving shape (8-layer stack, K=N=8192, M=64):
+  bf16     : plain x @ w (baseline)
+  dense    : current auto path (int8 -> bf16 convert inside dot_general)
+  int8dot  : x quantized per-row to int8, int8 x int8 dot -> int32
+  pallas   : dequant-in-VMEM kernel, block sweep
+
+All weights are created ON DEVICE (the tunnel makes host transfers the
+bottleneck otherwise). Timing: one jitted program per variant unrolling
+REPS matmul stacks; interleaved paired trials vs bf16.
+"""
+import os
+
+os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
+                      '/tmp/mlcomp_bench_jaxcache')
+import time  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from mlcomp_tpu.ops.int8_matmul import (  # noqa: E402
+    _pallas_int8_matmul, quantize_int8, reference_int8_matmul,
+)
+
+KN = 8192
+LAYERS = 8
+REPS = 20
+TRIALS = 5
+
+
+def feed(y):
+    return (y / (jnp.max(jnp.abs(y)) + 1e-6)).astype(jnp.bfloat16)
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def make(k):
+        w = jax.random.normal(k, (KN, KN), jnp.float32) * 0.02
+        wq, sc = quantize_int8(w)
+        return w.astype(jnp.bfloat16), wq, sc
+
+    w_bf, packs = [], []
+    for i in range(LAYERS):
+        w, wq, sc = make(jax.random.fold_in(key, i))
+        w_bf.append(w)
+        packs.append((wq, sc))
+    jax.block_until_ready((w_bf, packs))
+    print('weights ready', flush=True)
+
+    m = 64
+    x0 = jax.random.normal(jax.random.fold_in(key, 99), (m, KN),
+                           jnp.bfloat16)
+
+    def stack(body):
+        def run(x):
+            for _ in range(REPS):
+                for i in range(LAYERS):
+                    x = feed(body(x, i))
+            return jnp.sum(x.astype(jnp.float32))
+        return jax.jit(run)
+
+    def int8dot(x, i):
+        wq, sc = packs[i]
+        xf = x.astype(jnp.float32)
+        am = jnp.max(jnp.abs(xf), axis=1, keepdims=True)
+        xs = jnp.where(am > 0, am / 127.0, 1.0)
+        xq = jnp.clip(jnp.round(xf / xs), -127, 127).astype(jnp.int8)
+        y = jax.lax.dot_general(
+            xq, wq, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        return y.astype(jnp.float32) * xs * sc[None, :]
+
+    variants = {
+        'bf16': stack(lambda x, i: jnp.dot(
+            x, w_bf[i], preferred_element_type=jnp.float32)),
+        'dense': stack(
+            lambda x, i: reference_int8_matmul(x, *packs[i])),
+        'int8dot': stack(int8dot),
+    }
+    for bn, bk in ((512, 4096), (1024, 4096), (2048, 2048),
+                   (8192, 1024)):
+        variants[f'pallas_{bn}x{bk}'] = stack(
+            lambda x, i, bn=bn, bk=bk: _pallas_int8_matmul(
+                x, packs[i][0], packs[i][1], bn, bk))
+
+    # compile all first (warmup), reporting compile times
+    good = {}
+    for name, fn in variants.items():
+        t0 = time.perf_counter()
+        try:
+            float(fn(x0))
+            good[name] = fn
+            print(f'  [{name} compiled+warm '
+                  f'{time.perf_counter()-t0:.1f}s]', flush=True)
+        except Exception as e:
+            print(f'  [{name} ERR {str(e)[:100]}]', flush=True)
+
+    if 'bf16' not in good:
+        raise SystemExit('bf16 baseline failed to compile — no '
+                         'reference to compare against')
+    base = good.pop('bf16')
+    results = {name: [] for name in good}
+    base_ts = []
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        float(base(x0))
+        b = time.perf_counter() - t0
+        base_ts.append(b)
+        for name, fn in good.items():
+            t0 = time.perf_counter()
+            float(fn(x0))
+            results[name].append((time.perf_counter() - t0, b))
+    bmin = min(base_ts)
+    print(f'bf16: min {bmin/REPS*1e3:.3f} ms/stack')
+    for name, rows in results.items():
+        ts = [r[0] for r in rows]
+        ratios = sorted(r[1] / r[0] for r in rows)
+        print(f'{name:18s} min={min(ts)/REPS*1e3:7.3f} ms/stk '
+              f'min-ratio x{bmin/min(ts):5.3f} '
+              f'paired med x{ratios[len(ratios)//2]:5.3f} '
+              f'range [{ratios[0]:.3f}, {ratios[-1]:.3f}]')
+
+
+if __name__ == '__main__':
+    main()
