@@ -28,7 +28,10 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_ORDER = ('dp', 'fsdp', 'ep', 'pp', 'sp', 'tp')
+from mlcomp_tpu.parallel.meshspec import AXIS_ORDER, ICI_AXES  # noqa: F401
+# AXIS_ORDER/ICI_AXES live in meshspec (jax-free) so the supervisor and
+# DAG builder validate specs without importing jax; re-exported here
+# for the device-side modules that already depend on this one.
 
 # axes whose gradient contributions must be summed across (batch-like axes)
 DATA_AXES = ('dp', 'fsdp')

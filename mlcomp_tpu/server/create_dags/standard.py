@@ -177,7 +177,14 @@ class DagStandardBuilder:
         # (reference supervisor.py:228-313 reads `distr`/`single_node`)
         if 'distr' in spec:
             additional_info['distr'] = bool(spec['distr'])
-        if isinstance(spec.get('mesh'), dict):
+        if spec.get('mesh') is not None:
+            # fail a bad mesh/cores combination at SUBMISSION, not hours
+            # later at executor mesh build (axis names, -1 rules,
+            # product-vs-cores, tp/sp/ep multi-host pinning)
+            from mlcomp_tpu.parallel.meshspec import validate_mesh_request
+            validate_mesh_request(          # non-dict rejected inside
+                spec['mesh'], cores, cores_max,
+                single_node=bool(spec.get('single_node', True)))
             additional_info['mesh'] = spec['mesh']
 
         task = Task(

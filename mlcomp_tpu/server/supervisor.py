@@ -276,6 +276,27 @@ class SupervisorBuilder:
             if task.additional_info else {}
         distr = bool((info or {}).get('distr', task.cores_max > 1))
         single_node = bool(task.single_node)
+        mesh_spec = (info or {}).get('mesh') \
+            if isinstance((info or {}).get('mesh'), dict) else None
+        from mlcomp_tpu.parallel.meshspec import (
+            check_mesh_spec, host_grant_granularity,
+        )
+        # tp/sp/ep collectives must stay on intra-host ICI: every
+        # host's grant is a multiple of their product, so the mesh's
+        # inner axes never straddle the DCN boundary (the TPU
+        # re-basing of reference supervisor.py:228-317's slot logic)
+        grain = host_grant_granularity(mesh_spec)
+        mesh_exact = None
+        mesh_fixed = 1   # fixed-axes product a wildcard grant must
+        if mesh_spec:    # divide (normalize_mesh_spec rejects others)
+            try:
+                fixed, wild = check_mesh_spec(mesh_spec)
+                mesh_exact = fixed if wild is None else None
+                mesh_fixed = max(fixed, 1)
+            except ValueError as e:   # legacy task rows predate build-
+                self.aux.setdefault('mesh_rejected', {})[task.id] = \
+                    str(e)            # time validation: surface, skip
+                return
 
         # multi-host fan-out only for tasks that asked for distributed
         # execution (distr, default True when cores_max>1) AND are not
@@ -283,12 +304,20 @@ class SupervisorBuilder:
         if task.cores_max <= 1 or single_node or not distr:
             comp = fits[0]
             free = self._free_cores(comp)
-            want = task.cores_max or task.cores or 0
+            want = mesh_exact or task.cores_max or task.cores or 0
             cores = free[:want] if want else []
-            if (task.cores or 0) > len(cores):
+            # a fixed-product mesh needs exactly that many; a remainder
+            # mesh needs a whole multiple of the fixed axes (grain
+            # divides mesh_fixed, so one trim covers both)
+            if mesh_spec:
+                cores = cores[:len(cores) // mesh_fixed * mesh_fixed]
+            need = mesh_exact or task.cores or 0
+            if need > len(cores):
                 self.aux.setdefault('not_placed', {})[task.id] = {
-                    comp['name']: f'need {task.cores} cores, '
-                                  f'free {len(free)}'}
+                    comp['name']: f'need {need} cores'
+                                  + (f' (mesh {mesh_spec})'
+                                     if mesh_spec else '')
+                                  + f', free {len(free)}'}
                 return
             queue = self.dispatch(task, comp, cores)
             self.aux.setdefault('dispatched', []).append(
@@ -296,22 +325,49 @@ class SupervisorBuilder:
             return
 
         # multi-host distributed: service task per computer
-        # (coordinator = first host; jax distributed runtime over DCN)
+        # (coordinator = first host; jax distributed runtime over DCN).
+        # Per-host takes honour the ICI granularity; the axis→link
+        # assignment then follows from mesh_from_spec's canonical
+        # outer→inner order (dp/fsdp/pp outermost, spanning hosts).
+        want_total = mesh_exact or task.cores_max
         total_cores = 0
         placements = []
         for comp in fits:
             free = self._free_cores(comp)
-            if not free:
+            take = free[:max(grain, want_total - total_cores)]
+            take = take[:len(take) // grain * grain]
+            if not take:
                 continue
-            take = free[:max(1, task.cores_max - total_cores)]
             placements.append((comp, take))
             total_cores += len(take)
-            if total_cores >= task.cores_max:
+            if total_cores >= want_total:
                 break
-        if total_cores < (task.cores or 1):
+        if mesh_spec and mesh_exact is None and placements:
+            # remainder-axis mesh: the granted TOTAL must divide by the
+            # fixed axes product or normalize_mesh_spec rejects it at
+            # executor build. Shed the excess from the tail hosts in
+            # grain-sized chunks (both totals are grain multiples).
+            rem = total_cores % mesh_fixed
+            while rem and placements:
+                comp, take = placements[-1]
+                drop = min(rem, len(take))
+                take = take[:len(take) - drop]
+                total_cores -= drop
+                rem -= drop
+                if take:
+                    placements[-1] = (comp, take)
+                else:
+                    placements.pop()
+        need = mesh_exact or task.cores or 1
+        satisfied = total_cores == mesh_exact if mesh_exact \
+            else total_cores >= need
+        if not satisfied:
             self.aux.setdefault('not_placed', {})[task.id] = {
-                'distributed': f'need {task.cores} cores, '
-                               f'found {total_cores}'}
+                'distributed': f'need {need} cores'
+                               + (f' in multiples of {grain} per host '
+                                  f'(mesh {mesh_spec})'
+                                  if mesh_spec and grain > 1 else '')
+                               + f', found {total_cores}'}
             return
         master_comp = placements[0][0]
         port = self.find_port(master_comp)
