@@ -264,8 +264,15 @@ def write_shard_plan(directory: str, plan: dict, meta: dict,
     _write_fragment(folder, gen, rank, plan)
     _barrier('ckpt-shards-written')
     if rank == 0:
+        # the per-leaf table goes in its own generation-tagged file,
+        # BEFORE the index flips: index.json stays small (the format
+        # pick and load_meta parse it on every dispatch) and a torn
+        # save still leaves the previous generation's pair intact
+        tmp = os.path.join(folder, f'leaves-g{gen}.json.tmp')
+        with open(tmp, 'w') as fh:
+            json.dump({'leaves': plan['leaves']}, fh)
+        os.replace(tmp, os.path.join(folder, f'leaves-g{gen}.json'))
         index = {'generation': gen, 'nprocs': nprocs,
-                 'leaves': plan['leaves'],
                  'meta': dict(meta, time=_time.time())}
         tmp = os.path.join(folder, 'index.json.tmp')
         with open(tmp, 'w') as fh:
@@ -274,6 +281,17 @@ def write_shard_plan(directory: str, plan: dict, meta: dict,
     _barrier('ckpt-index-written')
     _cleanup_stale(folder, gen, rank, nprocs)
     if rank == 0:
+        for path in glob.glob(os.path.join(folder, 'leaves-g*.json')):
+            name = os.path.basename(path)
+            try:
+                g = int(name.split('-g')[1].split('.')[0])
+            except (IndexError, ValueError):
+                continue
+            if g != gen:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
         # a resumed run that switched wire formats must not leave a
         # stale flat blob shadowing this save (checkpoint_exists
         # prefers the msgpack file). Only 'last' here: the stale best
@@ -287,11 +305,14 @@ def write_shard_plan(directory: str, plan: dict, meta: dict,
     if best:
         bfolder = os.path.join(directory, 'best')
         os.makedirs(bfolder, exist_ok=True)
-        stem = f'shards-g{gen}-p{rank:05d}'
-        for suffix in ('.npz', '.json'):
-            tmp = os.path.join(bfolder, stem + suffix + '.tmp')
-            shutil.copyfile(os.path.join(folder, stem + suffix), tmp)
-            os.replace(tmp, os.path.join(bfolder, stem + suffix))
+        names = [f'shards-g{gen}-p{rank:05d}.npz',
+                 f'shards-g{gen}-p{rank:05d}.json']
+        if rank == 0:
+            names.append(f'leaves-g{gen}.json')
+        for name in names:
+            tmp = os.path.join(bfolder, name + '.tmp')
+            shutil.copyfile(os.path.join(folder, name), tmp)
+            os.replace(tmp, os.path.join(bfolder, name))
         _barrier('ckpt-best-shards')
         if rank == 0:
             tmp = os.path.join(bfolder, 'index.json.tmp')
@@ -325,6 +346,17 @@ class _ShardReader:
             raise FileNotFoundError(f'no index.json under {folder!r}')
         self.index = index
         gen = int(index['generation'])
+        if 'leaves' in index:          # early format: table inline
+            self.leaves = index['leaves']
+        else:
+            leaves_path = os.path.join(folder, f'leaves-g{gen}.json')
+            try:
+                with open(leaves_path) as fh:
+                    self.leaves = json.load(fh)['leaves']
+            except (OSError, json.JSONDecodeError, KeyError):
+                raise FileNotFoundError(
+                    f'{folder!r}: leaves table for generation {gen} '
+                    f'missing or unreadable ({leaves_path})')
         nprocs = int(index['nprocs'])
         frags = sorted(
             f for f in glob.glob(
@@ -427,7 +459,7 @@ def restore_checkpoint_sharded(directory: str, target: Any,
     try:
         index = reader.index
         target_leaves = _flatten(_state_dict(target))
-        saved_paths = [tuple(d['path']) for d in index['leaves']]
+        saved_paths = [tuple(d['path']) for d in reader.leaves]
         got_paths = [p for p, _ in target_leaves]
         if saved_paths != got_paths:
             missing = set(saved_paths) ^ set(got_paths)
@@ -437,7 +469,7 @@ def restore_checkpoint_sharded(directory: str, target: Any,
                 f'leaves; differing: {sorted(missing)[:4]}…)')
         restored = {}
         for li, ((path, leaf), desc) in enumerate(
-                zip(target_leaves, index['leaves'])):
+                zip(target_leaves, reader.leaves)):
             if desc.get('none'):
                 if leaf is not None:
                     raise ValueError(
@@ -493,7 +525,7 @@ def read_checkpoint_tree(folder: str) -> dict:
     reader = _ShardReader(folder)
     try:
         out = {}
-        for li, desc in enumerate(reader.index['leaves']):
+        for li, desc in enumerate(reader.leaves):
             if desc.get('none'):
                 _set_path(out, tuple(desc['path']), None)
                 continue

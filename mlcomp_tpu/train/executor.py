@@ -426,6 +426,24 @@ class JaxTrain(Executor):
             best_meta = load_meta(ck_dir, 'best')
             if best_meta and best_meta.get('score') is not None:
                 best = float(best_meta['score'])
+            if jax.process_count() > 1:
+                # the seed must be UNANIMOUS: is_best gates collective
+                # barriers inside the sharded best-save, so ranks
+                # disagreeing on `best` (a host whose best/ folder
+                # missed the sync) would split at the barrier and hang
+                from jax.experimental import multihost_utils
+                seeds = multihost_utils.process_allgather(np.array(
+                    [best is not None,
+                     float('nan') if best is None else float(best)]))
+                flags, scores = seeds[:, 0], seeds[:, 1]
+                same = flags.all() and (
+                    np.nanmax(scores) - np.nanmin(scores) < 1e-12) \
+                    or not flags.any()
+                if not same:
+                    raise RuntimeError(
+                        f'best-checkpoint meta differs across hosts '
+                        f'({seeds.tolist()}) — sync the checkpoint '
+                        f'folder before resuming')
             self.info(
                 f'resumed from checkpoint: stage={meta.get("stage")} '
                 f'epoch={meta.get("epoch")} best={best}')
