@@ -136,6 +136,39 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal,
                 m_scr[:, :1] + jnp.log(norm[:, :1]), lse_ref.shape[1:])
 
 
+def _causal_kv_ix(block_q: int, block_k: int, causal: bool):
+    """Index map for operands streamed over k-blocks (grid order
+    (bh, iq, ik)). ``pl.when`` skips a masked block's COMPUTE but
+    Pallas still copies the tiles the index map names — half the K/V
+    HBM traffic for nothing in causal attention. Clamping to the last
+    live k-block makes every dead step re-name the tile already
+    resident in VMEM, and Pallas elides copies whose block index is
+    unchanged. Kernels read the TRUE ik from program_id, so masking
+    and skip logic are unaffected. Must mirror the kernels' live
+    predicate ``i_k * block_k <= (i_q + 1) * block_q - 1``."""
+    if not causal:
+        return lambda bh, iq, ik: (bh, ik, 0)
+
+    def ix(bh, iq, ik):
+        live_max = ((iq + 1) * block_q - 1) // block_k
+        return (bh, jnp.minimum(ik, live_max), 0)
+    return ix
+
+
+def _causal_q_ix(block_q: int, block_k: int, causal: bool):
+    """Dual of ``_causal_kv_ix`` for operands streamed over q-blocks
+    (grid order (bh, ik, iq)): the dead steps sit BELOW the diagonal
+    start, so clamp iq from below to this k-block's first live
+    q-block."""
+    if not causal:
+        return lambda bh, ik, iq: (bh, iq, 0)
+
+    def ix(bh, ik, iq):
+        first_live = (ik * block_k) // block_q
+        return (bh, jnp.maximum(iq, first_live), 0)
+    return ix
+
+
 def _fit_block(t: int, want: int) -> int:
     """Largest multiple of 128 ≤ want that divides t (any t % 128 == 0
     admits at least 128 itself, so tileability == t % 128 == 0)."""
@@ -171,6 +204,10 @@ def flash_attention_forward(q, k, v, causal: bool = True,
         _fa_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, n_k=n_k, emit_lse=with_lse)
 
+    # causal dead-tile DMA elision for the streamed k/v operands (see
+    # _causal_kv_ix)
+    kv_ix = _causal_kv_ix(block_q, block_k, causal)
+
     out_shape = [jax.ShapeDtypeStruct((b * h, t, d), q.dtype)]
     out_specs = [pl.BlockSpec((1, block_q, d),
                               lambda bh, iq, ik: (bh, iq, 0))]
@@ -188,8 +225,8 @@ def flash_attention_forward(q, k, v, causal: bool = True,
         grid=(b * h, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), kv_ix),
+            pl.BlockSpec((1, block_k, d), kv_ix),
         ],
         out_specs=out_specs,
         scratch_shapes=[
@@ -332,6 +369,9 @@ def flash_attention_backward(q, k, v, out, lse, do,
     row_spec = pl.BlockSpec((1, block_q, 128),
                             lambda bh, iq, ik: (bh, iq, 0))
 
+    # dead-tile DMA elision, same as the forward: dq streams k/v
+    kv_ix = _causal_kv_ix(block_q, block_k, causal)
+
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, n_k=n_k),
@@ -339,8 +379,8 @@ def flash_attention_backward(q, k, v, out, lse, do,
         grid=(b * h, n_q, n_k),
         in_specs=[
             q_spec,
-            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), kv_ix),
+            pl.BlockSpec((1, block_k, d), kv_ix),
             q_spec, row_spec, row_spec,
         ],
         out_specs=q_spec,
@@ -349,6 +389,9 @@ def flash_attention_backward(q, k, v, out, lse, do,
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
     )(qf, kf, vf, dof, lsef, delta)
+
+    # dk/dv streams q/do/lse/delta with iq innermost (see _causal_q_ix)
+    q_ix = _causal_q_ix(block_q, block_k, causal)
 
     k_spec = pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0))
     dk, dv = pl.pallas_call(
@@ -360,13 +403,11 @@ def flash_attention_backward(q, k, v, out, lse, do,
         ],
         grid=(b * h, n_k, n_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, ik, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, d), q_ix),
             k_spec, k_spec,
-            pl.BlockSpec((1, block_q, d), lambda bh, ik, iq: (bh, iq, 0)),
-            pl.BlockSpec((1, block_q, 128),
-                         lambda bh, ik, iq: (bh, iq, 0)),
-            pl.BlockSpec((1, block_q, 128),
-                         lambda bh, ik, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, d), q_ix),
+            pl.BlockSpec((1, block_q, 128), q_ix),
+            pl.BlockSpec((1, block_q, 128), q_ix),
         ],
         out_specs=[k_spec, k_spec],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),

@@ -11,9 +11,15 @@ bottleneck otherwise). Timing: one jitted program per variant unrolling
 REPS matmul stacks; interleaved paired trials vs bf16.
 """
 import os
+import sys
 
 os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
                       '/tmp/mlcomp_bench_jaxcache')
+# resolve the repo root by file location: sys.path (NOT PYTHONPATH,
+# which breaks the axon PJRT plugin registration) so the probe runs
+# from any cwd
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 import time  # noqa: E402
 
 import jax  # noqa: E402
@@ -80,8 +86,7 @@ def main():
             lambda x, i: reference_int8_matmul(x, *packs[i])),
         'int8dot': stack(int8dot),
     }
-    for bn, bk in ((512, 4096), (1024, 4096), (2048, 2048),
-                   (8192, 1024)):
+    for bn, bk in ((512, 4096), (2048, 2048)):
         variants[f'pallas_{bn}x{bk}'] = stack(
             lambda x, i, bn=bn, bk=bk: _pallas_int8_matmul(
                 x, packs[i][0], packs[i][1], bn, bk))
