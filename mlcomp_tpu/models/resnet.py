@@ -158,9 +158,12 @@ _VARIANTS = {
 
 for _name, (_sizes, _block) in _VARIANTS.items():
     def _factory(num_classes=10, cifar_stem=True, dtype='bfloat16',
-                 _sizes=_sizes, _block=_block, **_):
+                 num_filters=64, _sizes=_sizes, _block=_block, **_):
+        # num_filters: base width (torchvision uses 64; smaller widths
+        # serve toy configs and the converter golden tests)
         return ResNet(stage_sizes=_sizes, block=_block,
                       num_classes=num_classes, cifar_stem=cifar_stem,
+                      num_filters=int(num_filters),
                       dtype=jnp.dtype(dtype))
     register_model(_name)(_factory)
 

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Generate the committed golden torch checkpoints for the converter
+tests (tests/golden/). Synthetic VALUES (seeded, fixed at generation
+time — the .pth files are the source of truth, not this script), REAL
+torchvision NAMING and layout so ``scripts/torch_to_npz.py`` exercises
+the exact key grammar a downloaded checkpoint has, at toy widths that
+keep the committed files small.
+
+- resnet18_synth.pth: resnet18-shaped ([2,2,2,2] BasicBlocks, 7x7
+  stem, downsamples at stage transitions, fc) at width 8, 7 classes.
+- vgg16_synth.pth: vgg16_bn-shaped features (13 conv+BN pairs in
+  stages 2,2,3,3,3) at widths (8,16,32,32,32).
+"""
+
+import os
+
+import torch
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), 'tests', 'golden')
+
+
+def resnet18_synth(width=8, num_classes=7):
+    g = torch.Generator().manual_seed(0)
+
+    def t(*shape):
+        return torch.randn(*shape, generator=g) * 0.1
+
+    sd = {}
+
+    def bn(prefix, ch):
+        sd[f'{prefix}.weight'] = t(ch).abs() + 0.5
+        sd[f'{prefix}.bias'] = t(ch)
+        sd[f'{prefix}.running_mean'] = t(ch)
+        sd[f'{prefix}.running_var'] = t(ch).abs() + 0.5
+        sd[f'{prefix}.num_batches_tracked'] = torch.tensor(100)
+
+    sd['conv1.weight'] = t(width, 3, 7, 7)
+    bn('bn1', width)
+    in_ch = width
+    for stage, n_blocks in enumerate([2, 2, 2, 2], start=1):
+        ch = width * 2 ** (stage - 1)
+        for b in range(n_blocks):
+            p = f'layer{stage}.{b}'
+            sd[f'{p}.conv1.weight'] = t(ch, in_ch, 3, 3)
+            bn(f'{p}.bn1', ch)
+            sd[f'{p}.conv2.weight'] = t(ch, ch, 3, 3)
+            bn(f'{p}.bn2', ch)
+            if in_ch != ch:
+                sd[f'{p}.downsample.0.weight'] = t(ch, in_ch, 1, 1)
+                bn(f'{p}.downsample.1', ch)
+            in_ch = ch
+    sd['fc.weight'] = t(num_classes, in_ch)
+    sd['fc.bias'] = t(num_classes)
+    return sd
+
+
+def vgg16_synth(widths=(8, 16, 32, 32, 32)):
+    g = torch.Generator().manual_seed(1)
+
+    def t(*shape):
+        return torch.randn(*shape, generator=g) * 0.1
+
+    sd = {}
+    stages = (2, 2, 3, 3, 3)
+    idx, in_ch = 0, 3
+    for si, n in enumerate(stages):
+        for _ in range(n):
+            ch = widths[si]
+            sd[f'features.{idx}.weight'] = t(ch, in_ch, 3, 3)
+            sd[f'features.{idx}.bias'] = torch.zeros(ch)
+            sd[f'features.{idx + 1}.weight'] = t(ch).abs() + 0.5
+            sd[f'features.{idx + 1}.bias'] = t(ch)
+            sd[f'features.{idx + 1}.running_mean'] = t(ch)
+            sd[f'features.{idx + 1}.running_var'] = t(ch).abs() + 0.5
+            sd[f'features.{idx + 1}.num_batches_tracked'] = \
+                torch.tensor(100)
+            idx += 3          # conv, bn, relu
+            in_ch = ch
+        idx += 1              # maxpool
+    return sd
+
+
+if __name__ == '__main__':
+    os.makedirs(OUT, exist_ok=True)
+    torch.save(resnet18_synth(),
+               os.path.join(OUT, 'resnet18_synth.pth'))
+    torch.save(vgg16_synth(), os.path.join(OUT, 'vgg16_synth.pth'))
+    for name in ('resnet18_synth.pth', 'vgg16_synth.pth'):
+        path = os.path.join(OUT, name)
+        print(f'{name}: {os.path.getsize(path) / 1024:.0f} KB')

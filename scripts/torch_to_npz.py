@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Convert a torch state_dict checkpoint into the npz weight
+interchange that ``model: {params_file: ...}`` consumes.
+
+The reference's pretrained story downloads torchvision/pretrainedmodels
+checkpoints by URL (reference contrib/segmentation/encoders/resnet.py
+``pretrained_settings``; contrib/model/pretrained.py:6-59 head-swaps
+them). This environment has zero egress, so the contract is a LOCAL
+torch file: run this script on any machine that has the .pth, ship the
+npz, and ``train/pretrained.py`` head-swaps it into the flax model
+(shape-mismatched heads re-initialize — the reference's last-layer
+swap).
+
+No network, no torchvision import — only ``torch.load`` on a local
+file. Supported source layouts:
+
+- ``resnet`` (torchvision ResNet naming: conv1/bn1/layer{L}.{B}/fc):
+  any depth — stage sizes and block type are inferred from the keys.
+  Targets the ``resnet{18,34,50,...}`` flax models (models/resnet.py).
+- ``vgg`` (torchvision vgg*_bn naming: features.{i}, conv+BN pairs):
+  targets the ``vgg13/vgg16`` EncoderClassifier trunks
+  (models/encoders.py). The torchvision 3-layer MLP classifier has no
+  GAP-head analogue and is skipped (the head re-initializes).
+
+Layout conversions: conv OIHW -> HWIO, linear [out, in] -> [in, out],
+BatchNorm weight/bias/running_mean/running_var ->
+scale/bias/mean/var (params vs batch_stats collections).
+
+Usage::
+
+    python scripts/torch_to_npz.py resnet18.pth resnet18.npz
+    python scripts/torch_to_npz.py vgg16_bn.pth vgg16.npz --arch vgg
+"""
+
+import argparse
+import re
+import sys
+from collections import OrderedDict
+
+import numpy as np
+
+
+def _np(t):
+    return np.asarray(t.detach().cpu().numpy()) \
+        if hasattr(t, 'detach') else np.asarray(t)
+
+
+def _conv(t):
+    """OIHW -> HWIO."""
+    return _np(t).transpose(2, 3, 1, 0)
+
+
+def _linear(t):
+    """[out, in] -> [in, out]."""
+    return _np(t).T
+
+
+def _bn(flat, src_prefix, dst):
+    """BatchNorm params+stats under torch ``src_prefix`` into flax
+    naming at ``dst`` (path WITHOUT collection prefix)."""
+    out = {}
+    out[f'params/{dst}/scale'] = _np(flat[f'{src_prefix}.weight'])
+    out[f'params/{dst}/bias'] = _np(flat[f'{src_prefix}.bias'])
+    out[f'batch_stats/{dst}/mean'] = _np(
+        flat[f'{src_prefix}.running_mean'])
+    out[f'batch_stats/{dst}/var'] = _np(
+        flat[f'{src_prefix}.running_var'])
+    return out
+
+
+def detect_arch(sd) -> str:
+    keys = set(sd)
+    if 'conv1.weight' in keys and any(k.startswith('layer1.')
+                                      for k in keys):
+        return 'resnet'
+    if 'features.0.weight' in keys:
+        return 'vgg'
+    raise ValueError(
+        'cannot detect source layout: expected torchvision resnet '
+        '(conv1/layer1...) or vgg (features.N...) naming; pass --arch')
+
+
+def convert_resnet(sd) -> OrderedDict:
+    """torchvision ResNet state_dict -> flax ResNet npz keys
+    (models/resnet.py naming: conv_stem/norm_stem, {Basic,Bottle}neck_i
+    with Conv_j/BatchNorm_j/conv_proj/norm_proj, head)."""
+    out = OrderedDict()
+    out['params/conv_stem/kernel'] = _conv(sd['conv1.weight'])
+    out.update(_bn(sd, 'bn1', 'norm_stem'))
+
+    # infer stage sizes + block type from the key space
+    layers = {}
+    for key in sd:
+        m = re.match(r'layer(\d+)\.(\d+)\.', key)
+        if m:
+            layers.setdefault(int(m.group(1)), set()).add(
+                int(m.group(2)))
+    stage_sizes = [len(layers[i]) for i in sorted(layers)]
+    bottleneck = any(k.startswith('layer1.0.conv3') for k in sd)
+    block_cls = 'Bottleneck' if bottleneck else 'BasicBlock'
+    n_convs = 3 if bottleneck else 2
+
+    block_idx = 0
+    for stage in sorted(layers):
+        for b in sorted(layers[stage]):
+            src = f'layer{stage}.{b}'
+            dst = f'{block_cls}_{block_idx}'
+            for c in range(n_convs):
+                out[f'params/{dst}/Conv_{c}/kernel'] = \
+                    _conv(sd[f'{src}.conv{c + 1}.weight'])
+                out.update(_bn(sd, f'{src}.bn{c + 1}',
+                               f'{dst}/BatchNorm_{c}'))
+            if f'{src}.downsample.0.weight' in sd:
+                out[f'params/{dst}/conv_proj/kernel'] = \
+                    _conv(sd[f'{src}.downsample.0.weight'])
+                out.update(_bn(sd, f'{src}.downsample.1',
+                               f'{dst}/norm_proj'))
+            block_idx += 1
+
+    if 'fc.weight' in sd:
+        out['params/head/kernel'] = _linear(sd['fc.weight'])
+        out['params/head/bias'] = _np(sd['fc.bias'])
+    assert stage_sizes, 'no layerN.M keys found'
+    return out
+
+
+#: conv-count -> per-stage conv layout for torchvision vgg*_bn
+_VGG_STAGES = {
+    8: (1, 1, 2, 2, 2),     # vgg11_bn
+    10: (2, 2, 2, 2, 2),    # vgg13_bn
+    13: (2, 2, 3, 3, 3),    # vgg16_bn
+    16: (2, 2, 4, 4, 4),    # vgg19_bn
+}
+
+
+def convert_vgg(sd, encoder_prefix: str = 'VGGEncoder_0'
+                ) -> OrderedDict:
+    """torchvision vgg*_bn features -> flax VGGEncoder npz keys
+    (s{stage}_conv{j} / s{stage}_norm{j} under the EncoderClassifier's
+    auto-named trunk). The MLP classifier is skipped (no GAP-head
+    analogue — the head re-initializes, by design)."""
+    conv_ids = sorted(
+        int(m.group(1)) for k in sd
+        if (m := re.match(r'features\.(\d+)\.weight$', k))
+        and _np(sd[k]).ndim == 4)
+    stages = _VGG_STAGES.get(len(conv_ids))
+    if stages is None:
+        raise ValueError(
+            f'unrecognized vgg layout: {len(conv_ids)} conv layers '
+            f'(known: {sorted(_VGG_STAGES)})')
+    if not any(f'features.{cid + 1}.running_mean' in sd
+               for cid in conv_ids):
+        raise ValueError(
+            'vgg checkpoint has no BatchNorm stats — this looks like '
+            'the plain (non-bn) torchvision vgg, whose conv-only '
+            'trunk has no flax analogue here; convert a vgg*_bn '
+            'checkpoint instead')
+    out = OrderedDict()
+    it = iter(conv_ids)
+    for si, n in enumerate(stages):
+        for j in range(n):
+            cid = next(it)
+            base = f'{encoder_prefix}/s{si}_conv{j}' if encoder_prefix \
+                else f's{si}_conv{j}'
+            nbase = f'{encoder_prefix}/s{si}_norm{j}' if encoder_prefix \
+                else f's{si}_norm{j}'
+            out[f'params/{base}/kernel'] = _conv(
+                sd[f'features.{cid}.weight'])
+            # vgg conv has a bias in torchvision, flax trunk does not
+            # (BN immediately follows — the bias is redundant); skip it
+            out.update(_bn(sd, f'features.{cid + 1}', nbase))
+    return out
+
+
+def convert(sd, arch: str = 'auto', **kwargs) -> OrderedDict:
+    sd = {k: v for k, v in sd.items()
+          if not k.endswith('num_batches_tracked')}
+    if all(k.startswith('module.') for k in sd) and sd:
+        # nn.DataParallel-saved checkpoints (common in Kaggle shares)
+        sd = {k[len('module.'):]: v for k, v in sd.items()}
+    if arch == 'auto':
+        arch = detect_arch(sd)
+    if arch == 'resnet':
+        return convert_resnet(sd)
+    if arch == 'vgg':
+        return convert_vgg(sd, **kwargs)
+    raise ValueError(f'unknown arch {arch!r} (resnet | vgg | auto)')
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+    ap.add_argument('src', help='torch checkpoint (.pth state_dict, '
+                                'or a dict with a state_dict entry)')
+    ap.add_argument('dst', help='output .npz')
+    ap.add_argument('--arch', default='auto',
+                    choices=('auto', 'resnet', 'vgg'))
+    args = ap.parse_args(argv)
+
+    import torch
+    sd = torch.load(args.src, map_location='cpu', weights_only=True)
+    for key in ('state_dict', 'model'):
+        if isinstance(sd, dict) and key in sd \
+                and isinstance(sd[key], dict):
+            sd = sd[key]
+    flat = convert(sd, arch=args.arch)
+    np.savez(args.dst, **flat)
+    print(f'{args.dst}: {len(flat)} arrays '
+          f'({sum(v.nbytes for v in flat.values()) / 1e6:.1f} MB)')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
