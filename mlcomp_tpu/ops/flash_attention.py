@@ -85,12 +85,9 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal: the whole k-block is masked when its first key sits
-    # beyond the last query of this q-block
-    if causal:
-        live = i_k * block_k <= (i_q + 1) * block_q - 1
-    else:
-        live = True
+    # causal: skip whole blocks above the diagonal (shared rule —
+    # the index-map clamps derive from the same helpers)
+    live = _block_live(i_q, i_k, block_q, block_k, causal)
 
     @pl.when(live)
     def _accumulate():
@@ -136,6 +133,27 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal,
                 m_scr[:, :1] + jnp.log(norm[:, :1]), lse_ref.shape[1:])
 
 
+def _last_live_k(i_q, block_q: int, block_k: int):
+    """Highest k-block index with any unmasked element for q-block
+    ``i_q`` — THE causal liveness rule. The kernels' skip predicates
+    and the index-map clamps below both derive from it, so they cannot
+    drift apart (a divergence would DMA the wrong tile for a live
+    step, a correctness bug, not just lost elision)."""
+    return ((i_q + 1) * block_q - 1) // block_k
+
+
+def _first_live_q(i_k, block_q: int, block_k: int):
+    """Dual: lowest live q-block index for k-block ``i_k``."""
+    return (i_k * block_k) // block_q
+
+
+def _block_live(i_q, i_k, block_q: int, block_k: int, causal: bool):
+    """The kernels' skip predicate: does tile (i_q, i_k) contain any
+    unmasked element?"""
+    return (i_k <= _last_live_k(i_q, block_q, block_k)) \
+        if causal else True
+
+
 def _causal_kv_ix(block_q: int, block_k: int, causal: bool):
     """Index map for operands streamed over k-blocks (grid order
     (bh, iq, ik)). ``pl.when`` skips a masked block's COMPUTE but
@@ -144,14 +162,13 @@ def _causal_kv_ix(block_q: int, block_k: int, causal: bool):
     live k-block makes every dead step re-name the tile already
     resident in VMEM, and Pallas elides copies whose block index is
     unchanged. Kernels read the TRUE ik from program_id, so masking
-    and skip logic are unaffected. Must mirror the kernels' live
-    predicate ``i_k * block_k <= (i_q + 1) * block_q - 1``."""
+    and skip logic are unaffected."""
     if not causal:
         return lambda bh, iq, ik: (bh, ik, 0)
 
     def ix(bh, iq, ik):
-        live_max = ((iq + 1) * block_q - 1) // block_k
-        return (bh, jnp.minimum(ik, live_max), 0)
+        return (bh, jnp.minimum(ik, _last_live_k(iq, block_q, block_k)),
+                0)
     return ix
 
 
@@ -164,8 +181,8 @@ def _causal_q_ix(block_q: int, block_k: int, causal: bool):
         return lambda bh, ik, iq: (bh, iq, 0)
 
     def ix(bh, ik, iq):
-        first_live = (ik * block_k) // block_q
-        return (bh, jnp.maximum(iq, first_live), 0)
+        return (bh, jnp.maximum(iq, _first_live_q(ik, block_q, block_k)),
+                0)
     return ix
 
 
@@ -288,7 +305,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    live = (i_k * block_k <= (i_q + 1) * block_q - 1) if causal else True
+    live = _block_live(i_q, i_k, block_q, block_k, causal)
 
     @pl.when(live)
     def _accumulate():
@@ -316,7 +333,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    live = (i_k * block_k <= (i_q + 1) * block_q - 1) if causal else True
+    live = _block_live(i_q, i_k, block_q, block_k, causal)
 
     @pl.when(live)
     def _accumulate():
