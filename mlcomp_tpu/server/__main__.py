@@ -79,8 +79,14 @@ def start(n_workers, in_process):
 @click.option('--register', is_flag=True,
               help='heartbeat this endpoint into the auxiliary table '
                    'so the dashboard supervisor tab lists it')
+@click.option('--max-pending', type=int, default=256,
+              help='per-model bound on in-flight requests; beyond it '
+                   'clients get 429 instead of queueing')
+@click.option('--drain-timeout', type=float, default=30.0,
+              help='seconds SIGTERM waits for in-flight requests '
+                   'before shutting down')
 def serve(model, project, host, port, batch_size, activation, quantize,
-          coalesce_ms, register):
+          coalesce_ms, register, max_pending, drain_timeout):
     """Serve model exports over HTTP (GET /health, POST /predict;
     with several MODELs, POST /predict/<name>).
 
@@ -93,7 +99,8 @@ def serve(model, project, host, port, batch_size, activation, quantize,
     paths = [resolve_model(m, project) for m in model]
     server = ModelServer(paths, batch_size=batch_size,
                          activation=activation, quantize=quantize,
-                         host=host, port=port, coalesce_ms=coalesce_ms)
+                         host=host, port=port, coalesce_ms=coalesce_ms,
+                         max_pending=max_pending)
     warmed = server.warmup()
     server.bind()
     if register:
@@ -105,14 +112,28 @@ def serve(model, project, host, port, batch_size, activation, quantize,
           f'quantize={quantize or "none"}'
           f'{", registered" if register else ""})')
 
-    # polite termination deregisters the endpoint; shutdown() must run
-    # on ANOTHER thread (stdlib shutdown blocks until the serve loop —
+    # polite termination: stop admitting (503), let in-flight requests
+    # finish (bounded by --drain-timeout), deregister, close. Runs on
+    # ANOTHER thread (stdlib shutdown blocks until the serve loop —
     # this very thread — acknowledges)
     import signal
     import threading
 
+    stops = {'n': 0}
+
     def _stop(signum, frame):
-        threading.Thread(target=server.shutdown, daemon=True).start()
+        stops['n'] += 1
+        if stops['n'] == 1:
+            threading.Thread(
+                target=server.graceful_shutdown,
+                kwargs={'drain_timeout_s': drain_timeout},
+                daemon=True).start()
+        else:
+            # second signal escalates: the operator wants OUT now —
+            # skip the drain and close immediately
+            print('second signal — forcing shutdown', flush=True)
+            threading.Thread(target=server.shutdown,
+                             daemon=True).start()
 
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)

@@ -40,11 +40,18 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from mlcomp_tpu import MODEL_FOLDER, TOKEN
+
+
+class Backpressure(RuntimeError):
+    """Raised when a model's pending-request bound is hit; the HTTP
+    layer maps it to 429 so load balancers and clients back off instead
+    of piling threads onto the device lock."""
 
 
 def resolve_model(name_or_path: str, project: str = None) -> str:
@@ -182,7 +189,7 @@ class _ServedModel:
     """One export: compiled predictor + request path state."""
 
     def __init__(self, file: str, batch_size: int, activation, quantize,
-                 coalesce_ms: float):
+                 coalesce_ms: float, max_pending: int = 256):
         from mlcomp_tpu.train.export import (
             export_base, load_export_meta, make_predictor,
         )
@@ -199,6 +206,18 @@ class _ServedModel:
                                                'float32'))
         self.requests = 0
         self.lock = threading.Lock()
+        # bounded admission: requests beyond max_pending get 429
+        # instead of queueing without limit (one compiled program —
+        # waiting can only serialize; a client retry later is cheaper
+        # than a thread pile-up now). Its counter has its OWN lock:
+        # self.lock is held across the whole device call, and a 429
+        # must not wait a full predict to be delivered
+        self.max_pending = max_pending
+        self.pending = 0
+        self.admit_lock = threading.Lock()
+        # last-K request latencies for /health percentiles — a ring, so
+        # the stats track CURRENT behavior, not the process lifetime
+        self.latencies_ms = deque(maxlen=1024)
         self.coalescer = _Coalescer(
             self._predict_padded, batch_size, coalesce_ms / 1e3) \
             if coalesce_ms > 0 else None
@@ -227,16 +246,27 @@ class _ServedModel:
             x = x[None]
         n = len(x)
         t0 = time.monotonic()
-        if self.coalescer is not None and n:
-            y = self.coalescer.submit(x)
-            with self.lock:
-                self.requests += 1
-        else:
-            with self.lock:
-                y = self._predict_padded(x)
-                self.requests += 1
-        return {'y': np.asarray(y).tolist(),
-                'ms': round((time.monotonic() - t0) * 1e3, 3)}
+        with self.admit_lock:
+            if self.pending >= self.max_pending:
+                raise Backpressure(
+                    f'{self.pending} requests pending (bound '
+                    f'{self.max_pending}) — retry later')
+            self.pending += 1
+        try:
+            if self.coalescer is not None and n:
+                y = self.coalescer.submit(x)
+                with self.lock:
+                    self.requests += 1
+            else:
+                with self.lock:
+                    y = self._predict_padded(x)
+                    self.requests += 1
+        finally:
+            with self.admit_lock:
+                self.pending -= 1
+        ms = round((time.monotonic() - t0) * 1e3, 3)
+        self.latencies_ms.append(ms)
+        return {'y': np.asarray(y).tolist(), 'ms': ms}
 
     def _predict_padded(self, x: np.ndarray) -> np.ndarray:
         """Apply at the ONE compiled shape: pad up to the static batch
@@ -251,9 +281,22 @@ class _ServedModel:
         return np.asarray(self.predict(x))[:n]
 
     def health(self) -> dict:
+        lat = list(self.latencies_ms)
+        stats = None
+        if lat:
+            stats = {'p50': round(float(np.percentile(lat, 50)), 3),
+                     'p99': round(float(np.percentile(lat, 99)), 3),
+                     'window': len(lat)}
+        depth = self.pending
+        if self.coalescer is not None:
+            with self.coalescer.cv:
+                depth = max(depth, len(self.coalescer.queue))
         return {'score': self.meta.get('score'),
                 'input_shape': self.meta.get('input_shape'),
-                'requests': self.requests}
+                'requests': self.requests,
+                'queue_depth': depth,
+                'max_pending': self.max_pending,
+                'latency_ms': stats}
 
 
 class ModelServer:
@@ -263,7 +306,8 @@ class ModelServer:
     def __init__(self, file, batch_size: int = 64,
                  activation: str = None, quantize: str = None,
                  host: str = '127.0.0.1', port: int = 4202,
-                 token: str = None, coalesce_ms: float = 0):
+                 token: str = None, coalesce_ms: float = 0,
+                 max_pending: int = 256):
         from mlcomp_tpu.train.export import export_base
         files = [os.fspath(file)] \
             if isinstance(file, (str, os.PathLike)) \
@@ -291,7 +335,7 @@ class ModelServer:
         try:
             for f, name in zip(files, names):
                 m = _ServedModel(f, batch_size, activation, quantize,
-                                 coalesce_ms)
+                                 coalesce_ms, max_pending=max_pending)
                 m.name = name
                 self.models[name] = m
         except Exception:
@@ -307,6 +351,12 @@ class ModelServer:
         self._lifecycle = threading.Lock()
         self._serving = False
         self._closed = False
+        self._draining = False
+        # HTTP-level in-flight count, incremented BEFORE the draining
+        # check — drain() waits on this, not the models' pending, so a
+        # request between accept and admission can't slip the drain
+        self._http_inflight = 0
+        self._inflight_lock = threading.Lock()
 
     # ------------------------------------------------- single-model API
     # (the common case and the back-compat surface: name/meta/coalescer/
@@ -385,16 +435,30 @@ class ModelServer:
                 self._send(200, payload)
 
             def do_POST(self):
+                with server._inflight_lock:
+                    server._http_inflight += 1
+                try:
+                    self._do_post()
+                finally:
+                    with server._inflight_lock:
+                        server._http_inflight -= 1
+
+            def _do_post(self):
                 model, err = server._route(self.path)
                 if err is not None:
                     return self._send(*err)
                 supplied = self.headers.get('Authorization', '').strip()
                 if supplied != server.token:
                     return self._send(401, {'error': 'unauthorized'})
+                if server._draining:
+                    return self._send(503, {
+                        'error': 'server draining — shutting down'})
                 try:
                     n = int(self.headers.get('Content-Length', 0))
                     body = json.loads(self.rfile.read(n) or '{}')
                     self._send(200, model.handle_predict(body))
+                except Backpressure as e:
+                    self._send(429, {'error': str(e)})
                 except (ValueError, TypeError) as e:
                     self._send(400, {'error': str(e)})
                 except Exception as e:  # noqa — keep the server up
@@ -468,6 +532,27 @@ class ModelServer:
         self._hb_thread = beat_thread
         return self._hb_keys[0]
 
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Stop admitting predicts (503) and wait for in-flight ones to
+        finish. Returns True when everything drained in time."""
+        self._draining = True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._inflight_lock:
+                busy = self._http_inflight
+            if not busy:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def graceful_shutdown(self, drain_timeout_s: float = 30.0) -> bool:
+        """SIGTERM path: finish what's in flight, then shut down —
+        a rolling restart must not fail the requests it interrupts.
+        Returns drain success (False = timed out, shut down anyway)."""
+        drained = self.drain(drain_timeout_s)
+        self.shutdown()
+        return drained
+
     def shutdown(self):
         if getattr(self, '_hb_stop', None) is not None:
             self._hb_stop.set()
@@ -501,4 +586,4 @@ class ModelServer:
             self.httpd.server_close()
 
 
-__all__ = ['ModelServer', 'resolve_model']
+__all__ = ['ModelServer', 'resolve_model', 'Backpressure']

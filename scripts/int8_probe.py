@@ -7,8 +7,9 @@ Variants at the serving shape (8-layer stack, K=N=8192, M=64):
   pallas   : dequant-in-VMEM kernel, block sweep
 
 All weights are created ON DEVICE (the tunnel makes host transfers the
-bottleneck otherwise). Timing: one jitted program per variant unrolling
-REPS matmul stacks; interleaved paired trials vs bf16.
+bottleneck otherwise). Timing: one jitted program per variant — a
+lax.scan of REPS stacks over the 8-layer body (small enough for the
+tunnel's remote compiler) — interleaved paired trials vs bf16.
 """
 import os
 import sys
@@ -61,10 +62,16 @@ def main():
                            jnp.bfloat16)
 
     def stack(body):
+        # lax.scan over REPS keeps the compiled program 8 matmuls big
+        # (the fully unrolled version has been observed to kill the
+        # tunnel's remote-compile service)
+        def step(x, _):
+            for i in range(LAYERS):
+                x = feed(body(x, i))
+            return x, None
+
         def run(x):
-            for _ in range(REPS):
-                for i in range(LAYERS):
-                    x = feed(body(x, i))
+            x, _ = jax.lax.scan(step, x, None, length=REPS)
             return jnp.sum(x.astype(jnp.float32))
         return jax.jit(run)
 

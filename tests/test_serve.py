@@ -8,6 +8,7 @@ does: real HTTP requests against a live server thread."""
 import json
 import os
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -533,3 +534,110 @@ class TestResolve:
             dst.write(src.read())
         with pytest.raises(ValueError, match='multiple projects'):
             resolve_model('reg_model')
+
+
+class TestRobustness:
+    """VERDICT r4 item 8: latency percentiles + queue depth on /health,
+    bounded admission with 429 backpressure, graceful drain."""
+
+    def _health(self, srv):
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{srv.port}/health',
+                timeout=30) as resp:
+            return json.loads(resp.read())
+
+    def test_health_latency_and_queue_depth(self, server):
+        for _ in range(5):
+            _post(server, {'x': np.zeros((2, 4, 4, 1)).tolist()})
+        body = self._health(server)['models']['m']
+        assert body['queue_depth'] == 0
+        assert body['max_pending'] == 256
+        lat = body['latency_ms']
+        assert lat['window'] >= 5
+        assert 0 <= lat['p50'] <= lat['p99']
+
+    def test_backpressure_429(self, export):
+        """With the bound at 1 and a slowed predictor, a concurrent
+        burst must see 429s — and every accepted request succeeds."""
+        srv = ModelServer(export, batch_size=8, activation='softmax',
+                          port=0, max_pending=1)
+        srv.warmup()
+        srv.bind()
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        model = srv.primary
+        inner = model.predict
+        model.predict = lambda x: (time.sleep(0.3), inner(x))[1]
+        codes = []
+        lock = threading.Lock()
+
+        def client():
+            try:
+                _post(srv, {'x': np.zeros((1, 4, 4, 1)).tolist()})
+                code = 200
+            except urllib.error.HTTPError as e:
+                code = e.code
+            with lock:
+                codes.append(code)
+
+        threads = [threading.Thread(target=client) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        try:
+            assert 200 in codes
+            assert 429 in codes, codes
+        finally:
+            srv.shutdown()
+
+    def test_graceful_drain_finishes_in_flight(self, export):
+        """SIGTERM semantics: the in-flight request completes 200, new
+        requests get 503, then the server closes."""
+        srv = ModelServer(export, batch_size=8, activation='softmax',
+                          port=0)
+        srv.warmup()
+        srv.bind()
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        model = srv.primary
+        inner = model.predict
+        model.predict = lambda x: (time.sleep(0.5), inner(x))[1]
+        result = {}
+
+        def slow_client():
+            result['y'] = _post(
+                srv, {'x': np.zeros((1, 4, 4, 1)).tolist()})
+
+        t = threading.Thread(target=slow_client)
+        t.start()
+        time.sleep(0.15)          # the request is now in flight
+        done = {}
+
+        def stopper():
+            done['drained'] = srv.graceful_shutdown(drain_timeout_s=10)
+
+        st = threading.Thread(target=stopper)
+        st.start()
+        time.sleep(0.1)           # draining flag is up
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(srv, {'x': np.zeros((1, 4, 4, 1)).tolist()})
+        assert exc.value.code == 503
+        t.join(timeout=30)
+        st.join(timeout=30)
+        assert done['drained'] is True
+        assert 'y' in result      # the in-flight request completed
+
+    def test_drain_timeout_reports_false(self, export):
+        srv = ModelServer(export, batch_size=8, activation='softmax',
+                          port=0)
+        srv.warmup()
+        srv.bind()
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        model = srv.primary
+        inner = model.predict
+        model.predict = lambda x: (time.sleep(2.0), inner(x))[1]
+        t = threading.Thread(target=lambda: _post(
+            srv, {'x': np.zeros((1, 4, 4, 1)).tolist()}))
+        t.start()
+        time.sleep(0.2)
+        assert srv.graceful_shutdown(drain_timeout_s=0.2) is False
+        t.join(timeout=30)
