@@ -1,0 +1,31 @@
+"""User code for the real-data digits DAG: one tiny executor that
+materializes the label frame the framework Split executor stratifies.
+
+Everything else in the DAG is framework machinery (split → jax_train →
+infer_classify → valid_classify); parity target is the reference's
+digit-recognizer example (reference examples/digit-recognizer/Readme.md)
+with sklearn's real handwritten-digit scans standing in for the Kaggle
+download in a zero-egress environment.
+"""
+
+import os
+
+from mlcomp_tpu.worker.executors import Executor
+
+
+@Executor.register
+class PrepareDigitsLabels(Executor):
+    """Write data/labels.csv (one row per load_digits sample, in order)
+    for the stratified Split executor."""
+
+    def work(self):
+        import pandas as pd
+        from sklearn.datasets import load_digits
+
+        os.makedirs('data', exist_ok=True)
+        y = load_digits().target
+        out = os.path.join('data', 'labels.csv')
+        pd.DataFrame({'sample': range(len(y)), 'label': y}).to_csv(
+            out, index=False)
+        self.info(f'wrote {len(y)} real digit labels -> {out}')
+        return {'count': int(len(y))}

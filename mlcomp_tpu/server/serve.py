@@ -424,7 +424,8 @@ class ModelServer:
                     return self._send(404, {'error': 'not found'})
                 import jax
                 payload = {
-                    'status': 'ok', 'model': server.primary.name,
+                    'status': 'draining' if server._draining else 'ok',
+                    'model': server.primary.name,
                     'platform': jax.default_backend(),
                     'score': server.primary.meta.get('score'),
                     'input_shape':
@@ -534,8 +535,11 @@ class ModelServer:
 
     def drain(self, timeout_s: float = 30.0) -> bool:
         """Stop admitting predicts (503) and wait for in-flight ones to
-        finish. Returns True when everything drained in time."""
+        finish. Returns True when everything drained in time. Traffic
+        steering learns FIRST: the registry heartbeat deregisters and
+        /health flips to 'draining' before any predict is rejected."""
         self._draining = True
+        self._stop_heartbeat()
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             with self._inflight_lock:
@@ -553,22 +557,27 @@ class ModelServer:
         self.shutdown()
         return drained
 
+    def _stop_heartbeat(self):
+        if getattr(self, '_hb_stop', None) is None:
+            return
+        self._hb_stop.set()
+        # join BEFORE deregistering: an in-flight beat (two HTTP
+        # round trips over a RemoteSession) finishing after the
+        # DELETE would re-register the dead endpoint
+        self._hb_thread.join(timeout=10)
+        # clean exits deregister; a crash leaves the rows for the
+        # dashboard's liveness window (age_s) to gray out instead
+        try:
+            from mlcomp_tpu.db.providers import AuxiliaryProvider
+            provider = AuxiliaryProvider(self._hb_session)
+            for key in self._hb_keys:
+                provider.remove_by_name(key)
+        except Exception:
+            pass
+        self._hb_stop = None
+
     def shutdown(self):
-        if getattr(self, '_hb_stop', None) is not None:
-            self._hb_stop.set()
-            # join BEFORE deregistering: an in-flight beat (two HTTP
-            # round trips over a RemoteSession) finishing after the
-            # DELETE would re-register the dead endpoint
-            self._hb_thread.join(timeout=10)
-            # clean exits deregister; a crash leaves the rows for the
-            # dashboard's liveness window (age_s) to gray out instead
-            try:
-                from mlcomp_tpu.db.providers import AuxiliaryProvider
-                provider = AuxiliaryProvider(self._hb_session)
-                for key in self._hb_keys:
-                    provider.remove_by_name(key)
-            except Exception:
-                pass
+        self._stop_heartbeat()
         for m in self.models.values():
             if m.coalescer is not None:
                 m.coalescer.shutdown()

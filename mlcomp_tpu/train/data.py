@@ -118,6 +118,53 @@ def _digits(fold_csv: Optional[str] = None, fold_number: int = 0,
             'source': 'sklearn.load_digits'}
 
 
+@register_dataset('digits_segmentation')
+def _digits_seg(fold_csv: Optional[str] = None, fold_number: int = 0,
+                valid_fraction: float = 0.2, seed: int = 0,
+                image_size: int = 32, threshold: float = 0.35, **_):
+    """REAL-image segmentation: sklearn's handwritten digit scans
+    upscaled to ``image_size``, with the MASK derived from the real
+    image by foreground thresholding (ink vs paper). The input is the
+    genuine scan — noise, stroke-width variation, anti-aliased edges —
+    so the model must learn a real image→mask mapping; only the LABEL
+    is programmatic. This is the zero-egress stand-in for the
+    reference's camvid/Severstal segmentation configs
+    (reference worker/reports/segmenation.py:16-173 consumes the same
+    task→mask gallery rows this feeds).
+
+    ``fold_csv``/``fold_number`` follow the digits dataset's contract
+    (rows aligned with load_digits order, fold==k is validation).
+    """
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    x8 = d.images.astype(np.float32) / 16.0          # [N, 8, 8]
+    rep = int(image_size) // 8
+    if rep < 1 or int(image_size) % 8:
+        raise ValueError(f'image_size {image_size} must be a '
+                         f'multiple of 8')
+    # nearest-neighbour upscale keeps the pixels REAL (no invented
+    # detail); a light blur would only soften the threshold edge
+    x = np.kron(x8, np.ones((rep, rep), np.float32))[..., None]
+    y = (x[..., 0] > float(threshold)).astype(np.int32)
+    if fold_csv:
+        import pandas as pd
+        folds = pd.read_csv(fold_csv)['fold'].to_numpy()
+        if len(folds) != len(y):
+            raise ValueError(
+                f'fold_csv {fold_csv!r} has {len(folds)} rows; '
+                f'expected {len(y)} (load_digits order)')
+        mask = folds == int(fold_number)
+    else:
+        rng = np.random.RandomState(seed)
+        mask = np.zeros(len(y), bool)
+        mask[rng.permutation(len(y))[:int(len(y) * valid_fraction)]] \
+            = True
+    return {'x_train': x[~mask], 'y_train': y[~mask],
+            'x_valid': x[mask], 'y_valid': y[mask],
+            'source': 'sklearn.load_digits (masks: foreground '
+                      'threshold)'}
+
+
 @register_dataset('synthetic_images')
 def _synth_images(n_train: int = 8192, n_valid: int = 1024,
                   image_size: int = 32, channels: int = 3,

@@ -152,7 +152,24 @@ class JaxTrain(Executor):
         # the supervisor may pin the mesh for the whole fanned-out job
         if distr.get('mesh'):
             spec = distr['mesh']
-        return mesh_from_spec(spec)
+        devices = None
+        if spec and not distr.get('mesh') \
+                and all(int(v) != -1 for v in spec.values()):
+            # a fully-pinned mesh smaller than the visible device set
+            # takes a prefix — the in-process `execute` debug path has
+            # no supervisor to restrict cores, but the config's intent
+            # (exactly product-many chips) is unambiguous. Only when
+            # the supervisor did NOT pin the mesh: for a fanned-out
+            # job a size mismatch is a placement bug that must stay a
+            # loud normalize_mesh_spec error, not a silent prefix
+            import math as _math
+
+            import jax as _jax
+            product = _math.prod(int(v) for v in spec.values())
+            visible = _jax.devices()
+            if 0 < product < len(visible):
+                devices = visible[:product]
+        return mesh_from_spec(spec, devices=devices)
 
     def _checkpoint_folder(self):
         if self.checkpoint_dir:

@@ -173,4 +173,85 @@ class ValidClassify(DatasetInputMixin, Valid):
                 size=len(img)))
 
 
-__all__ = ['Valid', 'ValidClassify']
+@Executor.register
+class ValidSegment(DatasetInputMixin, Valid):
+    """Foreground IoU / dice of mask predictions vs dataset masks —
+    the segmentation twin of ValidClassify, closing the reference's
+    config #5 loop (split → train → infer → ensemble → score; the
+    reference scores via its Catalyst valid pass and renders with
+    worker/reports/segmenation.py:16-173).
+
+    Config::
+
+        valid:
+          type: valid_segment
+          dataset: {name: digits_segmentation, fold_csv: fold.csv}
+          y: (load('unet_a') + load('unet_b')) / 2   # prob ensembles
+          metric: iou                                 # or dice
+    """
+
+    def __init__(self, y: str = None, metric: str = 'iou', **kwargs):
+        super().__init__(**kwargs)
+        self.y = y or "load()"
+        self.metric = metric
+        if metric not in ('iou', 'dice'):
+            raise ValueError(f"metric must be 'iou' or 'dice', "
+                             f'got {metric!r}')
+        self._inter = 0
+        self._union = 0
+        self._sum_true = 0
+        self._sum_pred = 0
+        self._plot_remaining = self.plot_count
+
+    def create_base(self):
+        self.x, self.y_true = self.load_dataset_arrays(part='valid')
+        if self.y_true is None:
+            raise ValueError('valid_segment needs a mask-labeled '
+                             'dataset')
+
+    def _labels(self, preds) -> np.ndarray:
+        preds = np.asarray(preds)
+        # [n, H, W, C] class probabilities -> argmax; [n, H, W] ids
+        return preds.argmax(-1) if preds.ndim == 4 else preds
+
+    def score(self, preds) -> float:
+        from mlcomp_tpu.contrib.metrics import dice_numpy, iou_numpy
+        labels = self._labels(preds)
+        lo, hi = self.part
+        truth = self.y_true[lo:hi if hi is not None
+                            else len(self.y_true)]
+        labels = labels[:len(truth)]
+        t = np.asarray(truth) > 0        # foreground vs background
+        p = np.asarray(labels) > 0
+        self._inter += int(np.logical_and(t, p).sum())
+        self._union += int(np.logical_or(t, p).sum())
+        self._sum_true += int(t.sum())
+        self._sum_pred += int(p.sum())
+        fn = iou_numpy if self.metric == 'iou' else dice_numpy
+        return fn(t, p)
+
+    def score_final(self) -> float:
+        if self.metric == 'dice':
+            denom = self._sum_true + self._sum_pred
+            return 1.0 if denom == 0 else 2.0 * self._inter / denom
+        return 1.0 if self._union == 0 else self._inter / self._union
+
+    def plot(self, preds, score):
+        """Worst-dice overlay gallery rows for the scored part."""
+        if self.session is None or self.task is None \
+                or self._plot_remaining <= 0:
+            return
+        from mlcomp_tpu.worker.reports import SegmentationReportBuilder
+        labels = self._labels(preds)
+        lo, hi = self.part
+        hi = hi if hi is not None else len(self.y_true)
+        n_part = min(hi - lo, len(labels))
+        n = min(n_part, self._plot_remaining)
+        builder = SegmentationReportBuilder(
+            self.session, self.task, part='valid', plot_count=n)
+        builder.build(self.x[lo:lo + n_part],
+                      self.y_true[lo:lo + n_part], labels[:n_part])
+        self._plot_remaining -= n
+
+
+__all__ = ['Valid', 'ValidClassify', 'ValidSegment']
