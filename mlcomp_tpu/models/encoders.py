@@ -1,11 +1,12 @@
 """Additional encoder families: VGG, DenseNet, SE-ResNet,
-EfficientNet-lite, Xception, DPN, Inception-ResNet-v2 — in flax, NHWC,
-bf16-ready.
+EfficientNet-lite, MobileNetV2, DRN, Xception, DPN,
+Inception-ResNet-v2 — in flax, NHWC, bf16-ready.
 
 Parity: the reference vendors 8 torch encoder families for its
 segmentation zoo (reference contrib/segmentation/encoders/: resnet,
 vgg, densenet, senet, efficientnet, dpn, inceptionresnetv2, plus the
-deeplab xception backbone) and a
+deeplab xception/drn/mobilenet backbones,
+contrib/segmentation/deeplabv3/backbone/) and a
 pretrainedmodels-backed classifier zoo (reference
 contrib/model/pretrained.py:6-59). Here each family is implemented
 natively with the framework's shared conventions: logical partitioning
@@ -160,6 +161,17 @@ class MBConv(nn.Module):
 _EFFNET_LITE0 = (
     (1, 16, 1, 1, 3), (6, 24, 2, 2, 3), (6, 40, 2, 2, 5),
     (6, 80, 3, 2, 3), (6, 112, 3, 1, 5), (6, 192, 4, 2, 5),
+    (6, 320, 1, 1, 3),
+)
+
+# MobileNetV2's stage table (Sandler et al., table 2) — the SAME
+# inverted-residual trunk as efficientnet (MBConv, relu6, no SE), so
+# the encoder is a stage-table instantiation, not a new class. Parity:
+# the reference's DeepLab mobilenet backbone
+# (reference contrib/segmentation/deeplabv3/backbone/mobilenet.py).
+_MOBILENET_V2 = (
+    (1, 16, 1, 1, 3), (6, 24, 2, 2, 3), (6, 32, 3, 2, 3),
+    (6, 64, 4, 2, 3), (6, 96, 3, 1, 3), (6, 160, 3, 2, 3),
     (6, 320, 1, 1, 3),
 )
 
@@ -463,6 +475,14 @@ def _se_encoder(sizes, block, dtype, cifar_stem):
                          cifar_stem=cifar_stem, dtype=dtype)
 
 
+def _drn_encoder(dtype, cifar_stem):
+    # reuse the ResNetEncoder trunk with dilated late stages
+    from mlcomp_tpu.models.segmentation import ResNetEncoder
+    return ResNetEncoder(stage_sizes=[2, 2, 2, 2], block=BasicBlock,
+                         stage_dilations=(1, 1, 2, 4),
+                         cifar_stem=cifar_stem, dtype=dtype)
+
+
 ENCODER_FACTORIES = {
     'vgg13': lambda dtype, cifar_stem: VGGEncoder(
         stage_sizes=(2, 2, 2, 2, 2), dtype=dtype, cifar_stem=cifar_stem),
@@ -480,6 +500,15 @@ ENCODER_FACTORIES = {
         [3, 4, 6, 3], SEBottleneck, dtype, cifar_stem),
     'efficientnet_lite0': lambda dtype, cifar_stem: EfficientNetEncoder(
         dtype=dtype, cifar_stem=cifar_stem),
+    'mobilenetv2': lambda dtype, cifar_stem: EfficientNetEncoder(
+        stages=_MOBILENET_V2, stem_features=32, dtype=dtype,
+        cifar_stem=cifar_stem),
+    # DRN-C-26-shaped dilated trunk: stages 3/4 trade stride for
+    # dilation (2, 4), so c4/c5 stay at c3's resolution — built for
+    # ASPP/DeepLabV3 (which reads only c5); decoders that rely on the
+    # strict halving pyramid (fpn/unet/linknet skip fusion) should
+    # pick a conventional family instead
+    'drn26': lambda dtype, cifar_stem: _drn_encoder(dtype, cifar_stem),
     'xception': lambda dtype, cifar_stem: XceptionEncoder(
         dtype=dtype, cifar_stem=cifar_stem),
     'dpn68': lambda dtype, cifar_stem: DPNEncoder(

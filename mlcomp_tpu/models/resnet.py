@@ -63,14 +63,17 @@ class BasicBlock(nn.Module):
     act: Callable
     strides: Tuple[int, int] = (1, 1)
     se: bool = False     # squeeze-excite before the residual add
+    dilation: int = 1    # atrous 3x3s (DRN trades stride for dilation)
 
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        d = (self.dilation, self.dilation)
+        y = self.conv(self.filters, (3, 3), self.strides,
+                      kernel_dilation=d)(x)
         y = self.norm()(y)
         y = self.act(y)
-        y = self.conv(self.filters, (3, 3))(y)
+        y = self.conv(self.filters, (3, 3), kernel_dilation=d)(y)
         y = self.norm(scale_init=nn.initializers.zeros)(y)
         if self.se:
             y = SqueezeExcite(dtype=y.dtype, name='se')(y)
@@ -88,6 +91,7 @@ class Bottleneck(nn.Module):
     act: Callable
     strides: Tuple[int, int] = (1, 1)
     se: bool = False
+    dilation: int = 1
 
     @nn.compact
     def __call__(self, x):
@@ -95,7 +99,8 @@ class Bottleneck(nn.Module):
         y = self.conv(self.filters, (1, 1))(x)
         y = self.norm()(y)
         y = self.act(y)
-        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.conv(self.filters, (3, 3), self.strides,
+                      kernel_dilation=(self.dilation, self.dilation))(y)
         y = self.norm()(y)
         y = self.act(y)
         y = self.conv(self.filters * 4, (1, 1))(y)

@@ -36,6 +36,11 @@ class ResNetEncoder(nn.Module):
     num_filters: int = 64
     cifar_stem: bool = False
     dtype: jnp.dtype = jnp.bfloat16
+    #: per-stage atrous rate; a stage with rate > 1 KEEPS its spatial
+    #: resolution (stride 1) and dilates its 3x3s instead — the DRN
+    #: recipe (reference contrib/segmentation/deeplabv3/backbone/drn.py)
+    #: that keeps c4/c5 dense for ASPP decoders
+    stage_dilations: Sequence[int] = (1, 1, 1, 1)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -54,10 +59,14 @@ class ResNetEncoder(nn.Module):
         if not self.cifar_stem:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
         for i, n_blocks in enumerate(self.stage_sizes):
+            dil = int(self.stage_dilations[i]) \
+                if i < len(self.stage_dilations) else 1
             for j in range(n_blocks):
-                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                strides = (2, 2) if i > 0 and j == 0 and dil == 1 \
+                    else (1, 1)
                 x = self.block(self.num_filters * 2 ** i, conv=conv,
-                               norm=norm, act=act, strides=strides)(x)
+                               norm=norm, act=act, strides=strides,
+                               dilation=dil)(x)
             features.append(x)                # c2..c5
         return features
 

@@ -53,7 +53,8 @@ def test_unet_forward():
 
 @pytest.mark.parametrize('name', ['vgg13', 'densenet121', 'seresnet18',
                                   'efficientnet_lite0', 'xception',
-                                  'dpn68', 'inceptionresnetv2'])
+                                  'dpn68', 'inceptionresnetv2',
+                                  'mobilenetv2', 'drn26'])
 def test_encoder_family_classifier(name):
     """New encoder families (reference contrib/segmentation/encoders/:
     vgg/densenet/senet/efficientnet) as GAP classifiers."""
@@ -72,7 +73,10 @@ def test_encoder_family_classifier(name):
                                   'deeplabv3_efficientnet_lite0',
                                   'unet_vgg13', 'unet_resnet34',
                                   'pspnet_xception', 'fpn_dpn68',
-                                  'linknet_inceptionresnetv2'])
+                                  'linknet_inceptionresnetv2',
+                                  'deeplabv3_mobilenetv2',
+                                  'fpn_mobilenetv2',
+                                  'deeplabv3_drn26'])
 def test_encoder_family_decoders(name):
     """Every decoder accepts every encoder family (shared pyramid
     contract)."""
@@ -215,3 +219,17 @@ def test_vit_sharded_matches_dense():
         got = jax.jit(sharded.apply)(placed, x)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_drn_keeps_late_stages_dense():
+    """The DRN recipe: c4/c5 trade stride for dilation, staying at
+    c3's resolution — what ASPP wants (reference deeplabv3 drn
+    backbone)."""
+    from mlcomp_tpu.models.encoders import make_family_encoder
+    enc = make_family_encoder('drn26', jnp.float32, cifar_stem=True)
+    x = jnp.zeros((1, 32, 32, 3))
+    variables = enc.init(jax.random.PRNGKey(0), x, train=False)
+    feats = enc.apply(variables, x, train=False)
+    hw = [f.shape[1:3] for f in feats]
+    assert hw[2] == hw[3] == hw[4], hw   # dilated stages keep c3's HW
+    assert hw[1][0] == 2 * hw[2][0]      # the one real stride remains
