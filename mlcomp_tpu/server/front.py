@@ -661,8 +661,8 @@ async function viewDagDetail(el, id) {
     <button class="btn" onclick="if(confirm('delete stored code files '+
       'of dag ${id}?')) api('remove_files',{dag:${id}}).then(render)"
       >remove files</button></p>`));
-  el.appendChild(h('<div class="card" style="overflow:auto">' +
-    layerGraph(g.nodes, g.edges) + '</div>'));
+  el.appendChild(h('<div class="card" style="overflow:auto" id="dagraph">'
+    + layerGraph(g.nodes, g.edges) + '</div>'));
   el.appendChild(h('<h3>config</h3><pre>'+esc(cfg.data)+'</pre>'));
   const tree = (items) => '<div class="tree">' + items.map(it =>
     it.children.length ? `<div>&#128193; ${esc(it.name)}${tree(it.children)}</div>`
@@ -697,24 +697,69 @@ async function viewTaskDetail(el, id) {
     + '</table>'));
 }
 
+// per-chart zoom windows survive re-renders (keyed by series name);
+// chartData is rebuilt every render and onclick/onmouseover handlers
+// reference charts by numeric index — no user string ever lands in
+// generated JS (the gallery-key convention)
+const chartState = {};      // key -> {lo, hi} epoch window
+let chartData = [];
+
+function chartHover(ci, si, j) {
+  const c = chartData[ci]; if (!c) return;
+  const p = (c.series[si]||[])[j]; if (!p) return;
+  const el = document.getElementById('chr'+ci);
+  if (el) el.textContent = c.names[si] + '  epoch ' + p.epoch +
+    ': ' + (+p.value).toPrecision(5);
+}
+
+function chartZoom(ci, dir) {
+  const c = chartData[ci]; if (!c) return;
+  const cur = chartState[c.key] || {lo: c.x0, hi: c.x1};
+  const span = Math.max(cur.hi-cur.lo, 1), mid = (cur.lo+cur.hi)/2;
+  if (dir === 0) delete chartState[c.key];
+  else if (dir > 0)
+    chartState[c.key] = {lo: mid-span/4, hi: mid+span/4};
+  else chartState[c.key] = {lo: mid-span, hi: mid+span};
+  render();
+}
+
 function lineChart(name, part, points) {
   const w=360, hgt=180, pad=34;
-  const xs = points.map(p=>p.epoch), ys = points.map(p=>p.value);
+  const key = name + '/' + part, zoom = chartState[key];
+  let pts = zoom ? points.filter(p =>
+    p.epoch >= zoom.lo && p.epoch <= zoom.hi) : points;
+  if (!pts.length) pts = points;   // over-zoomed: show everything
+  const xs = pts.map(p=>p.epoch), ys = pts.map(p=>p.value);
   const x0=Math.min(...xs), x1=Math.max(...xs,x0+1);
   const y0=Math.min(...ys), y1=Math.max(...ys,y0+1e-9);
   const X=e=>pad+(e-x0)/(x1-x0)*(w-pad-10);
   const Y=v=>hgt-pad+ (y0===y1?0:-(v-y0)/(y1-y0)*(hgt-pad-16));
   const byTask = {};
-  points.forEach(p => (byTask[p.task_name||p.task] ||= []).push(p));
+  pts.forEach(p => (byTask[p.task_name||p.task] ||= []).push(p));
+  const ci = chartData.length;
+  chartData.push({key, x0, x1, series: Object.values(byTask),
+                  names: Object.keys(byTask)});
   const colors=['#4da3ff','#41c07c','#d9a13c','#e2574c','#b07fe8','#5bc8c8'];
   let svg = `<svg width="${w}" height="${hgt}">
     <text x="8" y="14">${esc(name)} / ${esc(part)}</text>
+    <text id="chr${ci}" x="${pad+60}" y="${hgt-6}" fill="#9fb0bd"></text>
     <text x="8" y="${hgt-6}" fill="#7b8894">${y0.toPrecision(4)}..${y1.toPrecision(4)}</text>`;
-  Object.values(byTask).forEach((pts,i) => {
-    const d = pts.map((p,j)=>(j?'L':'M')+X(p.epoch)+','+Y(p.value)).join(' ');
+  chartData[ci].series.forEach((sp,i) => {
+    const d = sp.map((p,j)=>(j?'L':'M')+X(p.epoch)+','+Y(p.value)).join(' ');
     svg += `<path d="${d}" fill="none" stroke="${colors[i%6]}" stroke-width="1.6"/>`;
+    // invisible hover targets, one per sample: value readout without
+    // a mouse-position event object
+    sp.forEach((p,j) => { svg +=
+      `<circle cx="${X(p.epoch)}" cy="${Y(p.value)}" r="6"
+        fill="transparent" onmouseover="chartHover(${ci},${i},${j})"/>`;
+    });
   });
-  return '<div class="card">'+svg+'</svg></div>';
+  return '<div class="card">'+svg+'</svg>' +
+    `<div><button class="btn" onclick="chartZoom(${ci},1)">zoom+</button>
+     <button class="btn" onclick="chartZoom(${ci},-1)">zoom-</button>
+     <button class="btn" onclick="chartZoom(${ci},0)">reset</button>
+     ${zoom ? `<span class="dim">x: ${zoom.lo.toFixed(1)}..${zoom.hi.toFixed(1)}</span>` : ''}
+     </div></div>`;
 }
 
 // ------------------------------------------------- layout-driven report
@@ -854,6 +899,7 @@ const VIEWS = {projects:viewProjects, dags:viewDags, tasks:viewTasks,
 
 async function render() {
   nav();
+  chartData = [];          // rebuilt by every lineChart this pass
   const el = document.getElementById('main');
   el.innerHTML = '';
   if (!token) {
@@ -884,6 +930,17 @@ setInterval(() => { document.getElementById('clock').textContent =
   new Date().toLocaleTimeString(); }, 1000);
 setInterval(() => { if (token && !detail
   && !document.getElementById('dlg').open) render(); }, 5000);
+async function refreshDagGraph() {
+  // live task statuses on an OPEN dag detail without a full reload
+  // (the list-view interval above deliberately skips detail views —
+  // a reload would drop scroll position and the code-file selection)
+  if (!token || !detail || detail.kind !== 'dag') return;
+  const host = document.getElementById('dagraph');
+  if (!host) return;
+  const g = await api('graph', {id: detail.id});
+  host.innerHTML = layerGraph(g.nodes, g.edges);
+}
+setInterval(refreshDagGraph, 5000);
 render();
 </script></body></html>
 """
