@@ -10,7 +10,8 @@ from mlcomp_tpu.ops.flash_attention import (
     flash_attention_forward, fused_attention, reference_attention,
 )
 from mlcomp_tpu.ops.fused_ce import reference_ce, softmax_ce_per_example
+from mlcomp_tpu.ops.serving_stack import reference_stack, serving_stack
 
 __all__ = ['fused_attention', 'flash_attention_forward',
            'reference_attention', 'softmax_ce_per_example',
-           'reference_ce']
+           'reference_ce', 'serving_stack', 'reference_stack']

@@ -3,42 +3,40 @@
 Small-batch inference is weight-bandwidth-bound: at M tokens per step
 the [K, N] weight read from HBM dwarfs the activations. Storing weights
 as int8 (per-output-channel f32 scales, transposed [N, K] layout)
-halves the weight memory outright; the measured step-time effect
-ranges from parity to ~1.35x depending on chip conditions (details
-below):
+halves the weight memory outright:
 
     y[M, N] = (x[M, K] @ dequant(w_qt[N, K]).T) * scale[N]
 
-**Measured honestly on the v5e chip** (8-layer K=N=8192 serving stack;
-bench.py ``serving_int8`` records the driver-visible numbers every
-round). Naive per-call timing loops through the tunneled chip produced
-ratios anywhere from 0.67x to 1.5x for identical code — dispatch
-latency variance swamps the effect. The defensible measurement
-(interleaved single-dispatch programs of 160 unrolled matmuls each)
-says:
+**Measured honestly on the v5e chip** (8-layer K=N=8192 serving stack
+at M=64; bench.py ``serving_int8`` records the driver-visible numbers
+every round). Two measurement artifacts long buried the real effect —
+the tunnel's per-call round trip (tens of ms, varying run to run)
+must amortize over ~100 stacks per dispatch, and weights must pass as
+jit ARGUMENTS (closed-over arrays embed as ~1 GB of HLO literal
+constants that kill the remote compiler). With both fixed (round 5):
 
 - this module's auto path (transposed [N, K] int8 + dot_general with
   POST-scaling — the scale applies once to the f32 output, keeping the
-  weight-operand read a pure int8->bf16 convert) runs between 0.9x and
-  ~1.35x vs the plain bf16 ``x @ w`` a Dense layer would otherwise
-  execute, varying with chip conditions — the dependable part of the
-  speedup is the transposed streaming layout + halved weight bytes,
-  the variance is the tunnel (bench.py reports median + range of
-  interleaved paired trials);
-- this module's Pallas kernel ties the XLA lowering at M=32 and loses
-  above; like ops/fused_ce.py it stays a verified-exact opt-in
-  reference, and ``impl='auto'`` resolves to the DENSE formulation.
-  "Don't hand-schedule what the compiler already does", recorded with
-  numbers a second time.
+  weight-operand read a pure int8->bf16 convert; measured faster than
+  pre-scaling) runs ~1.4-1.5x vs the plain bf16 ``x @ w`` chain a
+  stack of Dense layers executes;
+- the FUSED whole-stack kernel (ops/serving_stack.py: all layers in
+  one Pallas program, activation resident in VMEM) edges it further,
+  1.52-1.55x with a paired-range floor >1.2 — the bench headline;
+- this module's per-op Pallas kernel ties the XLA lowering; like
+  ops/fused_ce.py it stays a verified-exact opt-in reference, and
+  ``impl='auto'`` resolves to the DENSE formulation. "Don't
+  hand-schedule what the compiler already does" — the win that DID
+  materialize (serving_stack) came from restructuring (one program,
+  resident activation), not re-scheduling one op.
 
-So the dependable serving win is **memory**: weights at rest in HBM
-halve (2x more/larger models per chip), with speed at parity or
-better. The
-deliverable is the formulation + integration:
-``make_predictor(..., quantize='int8')`` (train/export.py) reroutes a
-model export's Dense projections through ``int8_matmul``. Quantization
-is symmetric per-output-channel (absmax / 127); classifier-head
-prediction drift is below 1e-2 on the digits example (tests assert it).
+The dependable part is **memory**: weights at rest in HBM halve
+(2x more/larger models per chip). The deliverable is the formulation +
+integration: ``make_predictor(..., quantize='int8')`` (train/export.py)
+reroutes a model export's Dense projections through ``int8_matmul``.
+Quantization is symmetric per-output-channel (absmax / 127);
+classifier-head prediction drift is below 1e-2 on the digits example
+(tests assert it).
 """
 
 import functools

@@ -1,15 +1,15 @@
 """Probe int8 serving matmul variants on the real chip.
 
 Variants at the serving shape (8-layer stack, K=N=8192, M=64):
-  bf16     : plain x @ w (baseline)
-  dense    : current auto path (int8 -> bf16 convert inside dot_general)
-  int8dot  : x quantized per-row to int8, int8 x int8 dot -> int32
-  pallas   : dequant-in-VMEM kernel, block sweep
+  bf16       : plain x @ w chain (baseline)
+  dense      : auto path (int8 -> bf16 convert inside dot_general)
+  int8dot    : x quantized per-row to int8, int8 x int8 dot -> int32
+  pallas     : per-op dequant-in-VMEM kernel
+  stack_*    : fused whole-stack megakernel (ops/serving_stack.py)
 
-All weights are created ON DEVICE (the tunnel makes host transfers the
-bottleneck otherwise). Timing: one jitted program per variant — a
-lax.scan of REPS stacks over the 8-layer body (small enough for the
-tunnel's remote compiler) — interleaved paired trials vs bf16.
+Measurement rules live in ops/serving_stack.make_chain_runner (weights
+as jit arguments, scan over reps, reps high enough to amortize the
+tunnel round trip).
 """
 import os
 import sys
@@ -29,15 +29,14 @@ import jax.numpy as jnp  # noqa: E402
 from mlcomp_tpu.ops.int8_matmul import (  # noqa: E402
     _pallas_int8_matmul, quantize_int8, reference_int8_matmul,
 )
+from mlcomp_tpu.ops.serving_stack import (  # noqa: E402
+    make_chain_runner, serving_stack, stack_feed,
+)
 
 KN = 8192
 LAYERS = 8
-REPS = 20
+REPS = 100      # amortizes the tunnel's per-call round trip
 TRIALS = 5
-
-
-def feed(y):
-    return (y / (jnp.max(jnp.abs(y)) + 1e-6)).astype(jnp.bfloat16)
 
 
 def main():
@@ -61,22 +60,15 @@ def main():
     x0 = jax.random.normal(jax.random.fold_in(key, 99), (m, KN),
                            jnp.bfloat16)
 
-    def stack(body):
-        # lax.scan over REPS keeps the compiled program 8 matmuls big
-        # (the fully unrolled version has been observed to kill the
-        # tunnel's remote-compile service)
-        def step(x, _):
+    def per_layer(body, args):
+        def step(x, *a):
             for i in range(LAYERS):
-                x = feed(body(x, i))
-            return x, None
+                x = stack_feed(body(x, i, *a))
+            return x
+        return make_chain_runner(step, args, x0, REPS)
 
-        def run(x):
-            x, _ = jax.lax.scan(step, x, None, length=REPS)
-            return jnp.sum(x.astype(jnp.float32))
-        return jax.jit(run)
-
-    def int8dot(x, i):
-        wq, sc = packs[i]
+    def int8dot(x, i, *flat):
+        wq, sc = flat[2 * i], flat[2 * i + 1]
         xf = x.astype(jnp.float32)
         am = jnp.max(jnp.abs(xf), axis=1, keepdims=True)
         xs = jnp.where(am > 0, am / 127.0, 1.0)
@@ -86,24 +78,37 @@ def main():
             preferred_element_type=jnp.int32)
         return y.astype(jnp.float32) * xs * sc[None, :]
 
+    flat_packs = [t for pack in packs for t in pack]
     variants = {
-        'bf16': stack(lambda x, i: jnp.dot(
-            x, w_bf[i], preferred_element_type=jnp.float32)),
-        'dense': stack(
-            lambda x, i: reference_int8_matmul(x, *packs[i])),
-        'int8dot': stack(int8dot),
+        'bf16': per_layer(lambda x, i, *ws: jnp.dot(
+            x, ws[i], preferred_element_type=jnp.float32), w_bf),
+        'dense': per_layer(
+            lambda x, i, *flat: reference_int8_matmul(
+                x, flat[2 * i], flat[2 * i + 1]), flat_packs),
+        'int8dot': per_layer(int8dot, flat_packs),
     }
     for bn, bk in ((512, 4096), (2048, 2048)):
-        variants[f'pallas_{bn}x{bk}'] = stack(
-            lambda x, i, bn=bn, bk=bk: _pallas_int8_matmul(
-                x, packs[i][0], packs[i][1], bn, bk))
+        variants[f'pallas_{bn}x{bk}'] = per_layer(
+            lambda x, i, *flat, bn=bn, bk=bk: _pallas_int8_matmul(
+                x, flat[2 * i], flat[2 * i + 1], bn, bk), flat_packs)
 
-    # compile all first (warmup), reporting compile times
+    wq_stack = jnp.stack([p[0] for p in packs])
+    sc_stack = jnp.stack([p[1] for p in packs])
+    w_stack_bf = jnp.stack([jnp.transpose(w) for w in w_bf])
+    for bn, bk in ((1024, 2048), (1024, 4096), (512, 2048)):
+        variants[f'stack_bf16_{bn}x{bk}'] = make_chain_runner(
+            lambda x, w, bn=bn, bk=bk: stack_feed(serving_stack(
+                x, w, block_n=bn, block_k=bk)), [w_stack_bf], x0, REPS)
+        variants[f'stack_int8_{bn}x{bk}'] = make_chain_runner(
+            lambda x, w, s, bn=bn, bk=bk: stack_feed(serving_stack(
+                x, w, s, block_n=bn, block_k=bk)),
+            [wq_stack, sc_stack], x0, REPS)
+
     good = {}
     for name, fn in variants.items():
         t0 = time.perf_counter()
         try:
-            float(fn(x0))
+            fn()
             good[name] = fn
             print(f'  [{name} compiled+warm '
                   f'{time.perf_counter()-t0:.1f}s]', flush=True)
@@ -118,19 +123,19 @@ def main():
     base_ts = []
     for _ in range(TRIALS):
         t0 = time.perf_counter()
-        float(base(x0))
+        base()
         b = time.perf_counter() - t0
         base_ts.append(b)
         for name, fn in good.items():
             t0 = time.perf_counter()
-            float(fn(x0))
+            fn()
             results[name].append((time.perf_counter() - t0, b))
     bmin = min(base_ts)
     print(f'bf16: min {bmin/REPS*1e3:.3f} ms/stack')
     for name, rows in results.items():
         ts = [r[0] for r in rows]
         ratios = sorted(r[1] / r[0] for r in rows)
-        print(f'{name:18s} min={min(ts)/REPS*1e3:7.3f} ms/stk '
+        print(f'{name:22s} min={min(ts)/REPS*1e3:7.3f} ms/stk '
               f'min-ratio x{bmin/min(ts):5.3f} '
               f'paired med x{ratios[len(ratios)//2]:5.3f} '
               f'range [{ratios[0]:.3f}, {ratios[-1]:.3f}]')
