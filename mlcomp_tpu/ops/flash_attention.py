@@ -27,8 +27,12 @@ for f32 inputs). Measured on the chip (B=1, H=16, D=64 bf16): fwd+bwd
 16 ms at seq 8,192 — 3.9x the tokens/sec of dense+remat attention in
 the full-model BENCH — and runs at seq 32,768 where the dense backward
 cannot compile (its [T, T] probability tensor alone is 8.6 GB at 16k).
-Forward default block_k=1024 after an on-chip sweep; backward keeps
-512 (larger backward blocks measured 2-5x slower).
+Forward default block_k=1024 after an on-chip sweep. The backward
+defaults are 1024x1024 (round-5 re-sweep at B=1/H=16/T=8192/D=64:
+14.3 ms vs 15.8 at the old 512x512 — the earlier "larger backward
+blocks 2-5x slower" anomaly was the causally-DEAD tile DMA, which the
+clamped index maps now elide; with dead tiles no longer fetched,
+bigger tiles amortize better and the anomaly is gone).
 
 ``fused_attention`` is the entry point the transformer uses: it picks
 the kernel on TPU, the interpreter in tests, and the dense jnp path
@@ -357,7 +361,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def flash_attention_backward(q, k, v, out, lse, do,
                              causal: bool = True,
                              scale: Optional[float] = None,
-                             block_q: int = 512, block_k: int = 512,
+                             block_q: int = 1024, block_k: int = 1024,
                              interpret: bool = False):
     """Fused flash backward: O(T) residuals (just out + lse), the
     probability tiles reconstructed in VMEM from lse exactly as the

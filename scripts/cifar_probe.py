@@ -1,0 +1,137 @@
+"""Attribute the CIFAR ResNet-18 roofline residual on the real chip.
+
+docs/performance.md derives a 0.61 memory-bound MFU ceiling and the
+measured 0.51 sits at 84% of it; this probe bills the residual by
+ablation (the only attribution a tunneled chip allows — XLA's cost
+analysis is aggregate and xprof traces need a UI):
+
+  full       : the production train step (bs=512, bf16)
+  remat      : residual blocks under nn.remat — recompute activations
+               in the backward instead of writing+reading them (trades
+               FLOPs for HBM bytes; promising exactly because the
+               step is memory-bound)
+  no_bn      : BatchNorm replaced by identity — bills BN's statistics
+               + elementwise HBM traffic
+  fwd_only   : forward pass alone
+
+Each variant reports ms/step and XLA's cost-analysis bytes/FLOPs, so
+the bytes-vs-time correlation is explicit.
+"""
+import os
+import sys
+
+os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
+                      '/tmp/mlcomp_bench_jaxcache')
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import time  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+BATCH = 512
+STEPS = 30
+PEAK = 197e12
+
+
+def cost(fn, *args):
+    try:
+        c = fn.lower(*args).compile().cost_analysis()
+        if isinstance(c, (list, tuple)):
+            c = c[0]
+        return float(c.get('flops', 0)), float(
+            c.get('bytes accessed', 0))
+    except Exception:
+        return None, None
+
+
+def timed(fn, state, x, y, label, flops=None, bytes_=None):
+    state2 = state
+    for _ in range(5):
+        state2, m = fn(state2, x, y)
+    float(m['loss'])
+    best = float('inf')
+    for _ in range(3):
+        t0 = time.perf_counter()
+        s = state2
+        for _ in range(STEPS):
+            s, m = fn(s, x, y)
+        float(m['loss'])
+        best = min(best, time.perf_counter() - t0)
+    ms = best / STEPS * 1e3
+    extra = ''
+    if flops:
+        mfu = flops * (1 / (best / STEPS)) / PEAK
+        extra = (f'  {flops/1e12:.2f} TF  {bytes_/1e9:.2f} GB  '
+                 f'mfu={mfu:.3f}  hbm_floor={bytes_/820e9*1e3:.1f} ms')
+    print(f'{label:10s} {ms:7.2f} ms/step{extra}', flush=True)
+    return ms
+
+
+def main():
+    import flax.linen as nn
+
+    from mlcomp_tpu.models import create_model
+    from mlcomp_tpu.models.resnet import BasicBlock, ResNet
+    from mlcomp_tpu.parallel import mesh_from_spec
+    from mlcomp_tpu.train import (
+        create_train_state, loss_for_task, make_optimizer,
+        make_train_step,
+    )
+    from mlcomp_tpu.train.data import create_dataset, place_batch
+
+    mesh = mesh_from_spec({'dp': -1})
+    optimizer, _ = make_optimizer(
+        {'name': 'sgd', 'lr': 0.1, 'momentum': 0.9}, 1000)
+    loss_fn = loss_for_task('softmax_ce')
+    data = create_dataset('cifar10', n_train=BATCH * 2, n_valid=256)
+    x_np, y_np = data['x_train'][:BATCH], data['y_train'][:BATCH]
+
+    def build(model, label):
+        state = create_train_state(model, optimizer, x_np[:1],
+                                   jax.random.PRNGKey(0), mesh=mesh)
+        step = make_train_step(model, optimizer, loss_fn, mesh=mesh)
+        x, y = place_batch((x_np, y_np), mesh)
+        f, b = cost(step, state, x, y)
+        timed(step, state, x, y, label, f, b)
+
+    build(create_model('resnet18', num_classes=10, dtype='bfloat16'),
+          'full')
+    build(ResNet(stage_sizes=[2, 2, 2, 2], block=nn.remat(BasicBlock),
+                 num_classes=10, cifar_stem=True,
+                 dtype=jnp.bfloat16), 'remat')
+
+    import mlcomp_tpu.models.resnet as R
+
+    class _NoNorm(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return x
+
+    orig = R.norm_partial
+    R.norm_partial = lambda dtype, train: (lambda **kw: _NoNorm())
+    try:
+        build(create_model('resnet18', num_classes=10,
+                           dtype='bfloat16'), 'no_bn')
+    finally:
+        R.norm_partial = orig
+
+    # forward only
+    model = create_model('resnet18', num_classes=10, dtype='bfloat16')
+    state = create_train_state(model, optimizer, x_np[:1],
+                               jax.random.PRNGKey(0), mesh=mesh)
+    x, y = place_batch((x_np, y_np), mesh)
+
+    @jax.jit
+    def fwd(s, x, y):
+        logits = model.apply(
+            {'params': s.params, 'batch_stats': s.batch_stats}, x,
+            train=False)
+        return s, {'loss': jnp.mean(logits)}
+    f, b = cost(fwd, state, x, y)
+    timed(fwd, state, x, y, 'fwd_only', f, b)
+
+
+if __name__ == '__main__':
+    main()
