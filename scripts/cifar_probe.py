@@ -47,14 +47,16 @@ def cost(fn, *args):
 
 
 def timed(fn, state, x, y, label, flops=None, bytes_=None):
-    state2 = state
+    # state threads CONTINUOUSLY: the train step donates its input
+    # state, so restarting a trial from a donated buffer poisons the
+    # run (surfaces as an opaque backend error at the next fetch)
+    s = state
     for _ in range(5):
-        state2, m = fn(state2, x, y)
+        s, m = fn(s, x, y)
     float(m['loss'])
     best = float('inf')
     for _ in range(3):
         t0 = time.perf_counter()
-        s = state2
         for _ in range(STEPS):
             s, m = fn(s, x, y)
         float(m['loss'])
@@ -93,8 +95,13 @@ def main():
                                    jax.random.PRNGKey(0), mesh=mesh)
         step = make_train_step(model, optimizer, loss_fn, mesh=mesh)
         x, y = place_batch((x_np, y_np), mesh)
+        ms = timed(step, state, x, y, label)
         f, b = cost(step, state, x, y)
-        timed(step, state, x, y, label, f, b)
+        if f:
+            mfu = f / (ms / 1e3) / PEAK
+            print(f'           cost: {f/1e12:.2f} TF {b/1e9:.2f} GB '
+                  f'mfu={mfu:.3f} hbm_floor={b/820e9*1e3:.1f} ms',
+                  flush=True)
 
     build(create_model('resnet18', num_classes=10, dtype='bfloat16'),
           'full')
