@@ -27,12 +27,17 @@ for f32 inputs). Measured on the chip (B=1, H=16, D=64 bf16): fwd+bwd
 16 ms at seq 8,192 — 3.9x the tokens/sec of dense+remat attention in
 the full-model BENCH — and runs at seq 32,768 where the dense backward
 cannot compile (its [T, T] probability tensor alone is 8.6 GB at 16k).
-Forward default block_k=1024 after an on-chip sweep. The backward
-defaults are 1024x1024 (round-5 re-sweep at B=1/H=16/T=8192/D=64:
-14.3 ms vs 15.8 at the old 512x512 — the earlier "larger backward
-blocks 2-5x slower" anomaly was the causally-DEAD tile DMA, which the
-clamped index maps now elide; with dead tiles no longer fetched,
-bigger tiles amortize better and the anomaly is gone).
+Block defaults re-swept on-chip in round 5 AFTER the dead-tile DMA
+elision landed: forward 1024x1024 (12.9 vs 14.3 ms at the old
+512x1024, B=1/H=16/T=8192/D=64 with lse; 2048x1024 measured 10.0
+standalone but exceeds the 16 MB scoped-vmem limit inside the full
+model — 17.25 MB — so it is not the default), backward 1024x1024
+(14.3 vs 15.8 at the old 512x512; larger backward tiles also fail
+VMEM). The
+earlier "larger backward blocks 2-5x slower" anomaly was the
+causally-DEAD tile DMA — pl.when skips compute, not the BlockSpec
+copies — which the clamped index maps now elide; with dead tiles no
+longer fetched, bigger tiles amortize better and the anomaly is gone.
 
 ``fused_attention`` is the entry point the transformer uses: it picks
 the kernel on TPU, the interpreter in tests, and the dense jnp path
@@ -203,7 +208,7 @@ def _fit_block(t: int, want: int) -> int:
 
 def flash_attention_forward(q, k, v, causal: bool = True,
                             scale: Optional[float] = None,
-                            block_q: int = 512, block_k: int = 1024,
+                            block_q: int = 1024, block_k: int = 1024,
                             interpret: bool = False,
                             with_lse: bool = False):
     """Pallas forward over [B, T, H, D]. T must divide by both block
