@@ -725,10 +725,18 @@ function chartZoom(ci, dir) {
 
 function lineChart(name, part, points) {
   const w=360, hgt=180, pad=34;
-  const key = name + '/' + part, zoom = chartState[key];
+  // key includes the open view: the same series name in two reports
+  // must not share a zoom window
+  const view = detail ? detail.kind + detail.id : tab;
+  const key = view + '/' + name + '/' + part;
+  let zoom = chartState[key];
   let pts = zoom ? points.filter(p =>
     p.epoch >= zoom.lo && p.epoch <= zoom.hi) : points;
-  if (!pts.length) pts = points;   // over-zoomed: show everything
+  if (zoom && !pts.length) {
+    // over-zoomed past every sample: drop the stale window instead of
+    // silently showing ALL points under a narrow-window label
+    delete chartState[key]; zoom = null; pts = points;
+  }
   const xs = pts.map(p=>p.epoch), ys = pts.map(p=>p.value);
   const x0=Math.min(...xs), x1=Math.max(...xs,x0+1);
   const y0=Math.min(...ys), y1=Math.max(...ys,y0+1e-9);
