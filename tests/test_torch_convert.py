@@ -206,3 +206,65 @@ class TestCli:
         assert rc == 0
         with np.load(out) as z:
             assert 'params/conv_stem/kernel' in z.files
+
+
+class TestBottleneckLayout:
+    def test_resnet50_style_keys_convert_and_load(self, tmp_path):
+        """Bottleneck depths (conv1..3/bn1..3) map to Bottleneck_i/
+        Conv_0..2 — the path the golden resnet18 fixture never touches."""
+        g = torch.Generator().manual_seed(11)
+
+        def t(*shape):
+            return torch.randn(*shape, generator=g) * 0.1
+
+        sd = {}
+
+        def bn(prefix, ch):
+            sd[f'{prefix}.weight'] = t(ch).abs() + 0.5
+            sd[f'{prefix}.bias'] = t(ch)
+            sd[f'{prefix}.running_mean'] = t(ch)
+            sd[f'{prefix}.running_var'] = t(ch).abs() + 0.5
+
+        width = 4
+        sd['conv1.weight'] = t(width, 3, 7, 7)
+        bn('bn1', width)
+        in_ch = width
+        for stage, n_blocks in enumerate([1, 1], start=1):
+            ch = width * 2 ** (stage - 1)
+            for b in range(n_blocks):
+                p = f'layer{stage}.{b}'
+                sd[f'{p}.conv1.weight'] = t(ch, in_ch, 1, 1)
+                bn(f'{p}.bn1', ch)
+                sd[f'{p}.conv2.weight'] = t(ch, ch, 3, 3)
+                bn(f'{p}.bn2', ch)
+                sd[f'{p}.conv3.weight'] = t(ch * 4, ch, 1, 1)
+                bn(f'{p}.bn3', ch * 4)
+                if in_ch != ch * 4:
+                    sd[f'{p}.downsample.0.weight'] = t(ch * 4, in_ch,
+                                                       1, 1)
+                    bn(f'{p}.downsample.1', ch * 4)
+                in_ch = ch * 4
+        sd['fc.weight'] = t(5, in_ch)
+        sd['fc.bias'] = t(5)
+
+        flat = convert(sd)
+        assert 'params/Bottleneck_0/Conv_2/kernel' in flat
+        assert 'params/Bottleneck_1/conv_proj/kernel' in flat
+        npz = str(tmp_path / 'r50.npz')
+        np.savez(npz, **flat)
+
+        from mlcomp_tpu.models.resnet import Bottleneck, ResNet
+        from mlcomp_tpu.train.pretrained import (
+            load_pretrained_variables, merge_pretrained,
+        )
+        model = ResNet(stage_sizes=[1, 1], block=Bottleneck,
+                       num_filters=width, num_classes=5,
+                       cifar_stem=False, dtype=jnp.float32)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 32, 32, 3)), train=False)
+        _, summary = merge_pretrained(
+            {'params': variables['params'],
+             'batch_stats': variables['batch_stats']},
+            load_pretrained_variables(npz))
+        assert len(summary.loaded) == len(flat)
+        assert not summary.reinit and not summary.missing
