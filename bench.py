@@ -545,29 +545,40 @@ def bench_serving_int8() -> dict:
         FEED_EPS, make_chain_runner, stack_feed,
     )
 
-    def per_layer(body, args):
+    # per-variant latency histograms through the driver itself
+    # (telemetry/metrics.py): sessionless recorder — summaries land in
+    # this leg's JSON, and the same hook feeds the metric table when a
+    # session-bound recorder is passed instead
+    from mlcomp_tpu.telemetry import MetricRecorder
+    tel = MetricRecorder(component='serving', flush_every=10 ** 9)
+
+    def per_layer(body, args, name):
         def step(x, *a):
             for i in range(layers):
                 x = stack_feed(body(x, i, *a))
             return x
-        return make_chain_runner(step, args, x0, reps)
+        return make_chain_runner(step, args, x0, reps, recorder=tel,
+                                 metric=f'serving.{name}_ms')
 
     flat = [t for pack in packs for t in pack]
     variants = {
         'bf16': per_layer(lambda x, i, *ws: jnp.dot(
-            x, ws[i], preferred_element_type=jnp.float32), w_bf),
+            x, ws[i], preferred_element_type=jnp.float32), w_bf,
+            'bf16'),
         'int8_dense': per_layer(
             lambda x, i, *fl: reference_int8_matmul(
-                x, fl[2 * i], fl[2 * i + 1]), flat),
+                x, fl[2 * i], fl[2 * i + 1]), flat, 'int8_dense'),
         'int8_stack': make_chain_runner(
             lambda x, wq, sc: stack_feed(serving_stack(
                 x, wq, sc, block_n=1024, block_k=2048)),
             [jnp.stack([p[0] for p in packs]),
-             jnp.stack([p[1] for p in packs])], x0, reps),
+             jnp.stack([p[1] for p in packs])], x0, reps,
+            recorder=tel, metric='serving.int8_stack_ms'),
         'bf16_stack': make_chain_runner(
             lambda x, w: stack_feed(serving_stack(
                 x, w, block_n=1024, block_k=2048)),
-            [jnp.stack([jnp.transpose(w) for w in w_bf])], x0, reps),
+            [jnp.stack([jnp.transpose(w) for w in w_bf])], x0, reps,
+            recorder=tel, metric='serving.bf16_stack_ms'),
     }
     times = {}
     for name, fn in variants.items():
@@ -624,6 +635,11 @@ def bench_serving_int8() -> dict:
         out['serving_int8_dense_ms'] = ms('int8_dense')
     if ms('bf16_stack') is not None:
         out['serving_stack_bf16_ms'] = ms('bf16_stack')
+    # the driver-side latency histograms (telemetry): p50/p99 expose
+    # the tail the min-based headline hides
+    out['serving_latency_hist'] = {
+        name: {k: round(v, 3) for k, v in summary.items()}
+        for name, summary in tel.histogram_summaries().items()}
     return out
 
 
@@ -773,6 +789,52 @@ def main():
         steps_per_sec = n_steps / epoch_dt
         mfu = flops * steps_per_sec / (peak_tflops * 1e12 * n_devices)
 
+    # ---- telemetry hot-path overhead (budget: <1% of step time).
+    # The recorder cost is measured in isolation — an instrumented
+    # no-op step (the real wrapper: perf_counter + buffered appends,
+    # telemetry/metrics.py) timed over many iterations, divided by the
+    # measured compute step time. Differencing two device-bound loops
+    # cannot resolve a <1% budget through the tunnel's ±5-7% run-to-run
+    # noise; the isolated cost is deterministic and conservative (the
+    # production step records the same 3 samples per step).
+    #
+    # The recorder runs in the PRODUCTION config — a real migrated
+    # sqlite session, flush_every=100, async_flush — and records the
+    # warmup loop's live device loss, so the measured window amortizes
+    # what flushing actually costs the loop thread (lock handoff, GIL
+    # share of the batched device pull + executemany; the transfer
+    # itself overlaps, as in train). A sessionless never-flushing
+    # recorder here would certify only the cheap half of the budget.
+    import shutil
+    import tempfile
+
+    from mlcomp_tpu.db.core import Session
+    from mlcomp_tpu.db.migration import migrate
+    from mlcomp_tpu.telemetry import MetricRecorder
+    from mlcomp_tpu.train.loop import instrumented_step
+
+    tele_dir = tempfile.mkdtemp(prefix='bench-telemetry-')
+    tele_session = Session.create_session(
+        key='bench-telemetry',
+        connection_string=f'sqlite:///{tele_dir}/telemetry.db')
+    migrate(tele_session)
+    rec = MetricRecorder(session=tele_session, component='bench',
+                         flush_every=100, async_flush=True)
+    fake_metrics = {'loss': metrics['loss']}  # live device scalar
+    instr = instrumented_step(
+        lambda s, xb, yb: (s, fake_metrics), rec,
+        batch_size=batch_size)
+    n_rec = 20000
+    t0 = time.perf_counter()
+    for _ in range(n_rec):
+        instr(None, None, None)
+    per_step_cost = (time.perf_counter() - t0) / n_rec
+    rec.close()
+    Session.cleanup('bench-telemetry')
+    shutil.rmtree(tele_dir, ignore_errors=True)
+    telemetry_overhead_pct = \
+        100.0 * per_step_cost / (compute_dt / compute_steps)
+
     baseline = None
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -795,6 +857,12 @@ def main():
         'mfu': round(mfu, 4) if mfu is not None else None,
         'mfu_peak_tflops_assumed': peak_tflops,
         'real_cifar10': data.get('source') != 'synthetic',
+        'telemetry_overhead_pct': round(telemetry_overhead_pct, 4),
+        'telemetry_overhead_note':
+            f'instrumented no-op step cost ({per_step_cost * 1e6:.2f} '
+            f'us/step, 3 buffered samples/step incl amortized '
+            f'async flush to sqlite, {rec.flushed_count} rows) vs the '
+            f'measured compute step; budget <1%',
     }
     result.update(grid_result)
 

@@ -1,11 +1,14 @@
-"""User code for the real-data digits DAG: one tiny executor that
-materializes the label frame the framework Split executor stratifies.
+"""User code for the real-image segmentation-ensemble DAG: one tiny
+executor that materializes the label frame the framework Split executor
+stratifies.
 
-Everything else in the DAG is framework machinery (split → jax_train →
-infer_classify → valid_classify); parity target is the reference's
-digit-recognizer example (reference examples/digit-recognizer/Readme.md)
-with sklearn's real handwritten-digit scans standing in for the Kaggle
-download in a zero-egress environment.
+Everything else in the DAG is framework machinery (prepare → split →
+two unet ``jax_train`` tasks with ``infer_valid`` prediction dumps →
+``valid_segment`` on member A and on the ensemble average); parity
+target is the reference's Severstal segmentation ensemble (BASELINE
+config #5: split → train unets → infer → ensemble), with sklearn's real
+handwritten-digit scans — masks derived by foreground thresholding —
+standing in for the Kaggle download in a zero-egress environment.
 """
 
 import os

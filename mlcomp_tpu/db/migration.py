@@ -173,7 +173,15 @@ def _v3_auth(session: Session):
             session.execute(stmt)
 
 
-MIGRATIONS = [_v1_init, _v2_data, _v3_auth]
+def _v4_telemetry(session: Session):
+    """metric + telemetry_span tables (telemetry subsystem)."""
+    from mlcomp_tpu.db.models import Metric, TelemetrySpan
+    for model in (Metric, TelemetrySpan):
+        for stmt in model.create_table_ddl():   # IF NOT EXISTS — safe
+            session.execute(stmt)
+
+
+MIGRATIONS = [_v1_init, _v2_data, _v3_auth, _v4_telemetry]
 
 
 def migrate(session: Session = None):

@@ -1,4 +1,4 @@
-"""DB schema: 20 declarative models (parity: reference db/models/__init__.py:1-19)."""
+"""DB schema: declarative models (parity: reference db/models/__init__.py:1-19)."""
 
 from mlcomp_tpu.db.models.project import Project
 from mlcomp_tpu.db.models.dag import Dag
@@ -15,12 +15,13 @@ from mlcomp_tpu.db.models.model import Model
 from mlcomp_tpu.db.models.auxiliary import Auxiliary
 from mlcomp_tpu.db.models.queue import QueueMessage
 from mlcomp_tpu.db.models.auth import DbAudit, WorkerToken
+from mlcomp_tpu.db.models.telemetry import Metric, TelemetrySpan
 
 ALL_MODELS = [
     Project, Report, ReportLayout, Dag, Task, TaskDependence, TaskSynced,
     Computer, ComputerUsage, Docker, File, DagStorage, DagLibrary, Log, Step,
     ReportImg, ReportSeries, ReportTasks, Model, Auxiliary, QueueMessage,
-    WorkerToken, DbAudit,
+    WorkerToken, DbAudit, Metric, TelemetrySpan,
 ]
 
 __all__ = [m.__name__ for m in ALL_MODELS] + ['ALL_MODELS']

@@ -1,0 +1,47 @@
+"""Telemetry models — metric series + tracing spans.
+
+The reference persists per-epoch report series (db/models/report.py) and
+per-computer usage samples; this build's telemetry subsystem
+(mlcomp_tpu/telemetry/) additionally records PER-STEP metric series and
+tracing spans from inside the hot paths, buffered in memory and flushed
+in batches. Two tables:
+
+- ``metric``: one row per sample. ``task`` is nullable — supervisor
+  tick timings and serving latency summaries belong to no task.
+- ``telemetry_span``: one row per finished span. ``span_id``/
+  ``parent_id`` are client-generated (pid-scoped) so nesting survives
+  batch insertion without a DB round trip per span.
+"""
+
+from mlcomp_tpu.db.core import Column, DBModel
+
+
+class Metric(DBModel):
+    __tablename__ = 'metric'
+
+    id = Column('INTEGER', primary_key=True)
+    task = Column('INTEGER', index=True)    # nullable: system metrics
+    name = Column('TEXT', index=True, nullable=False)
+    kind = Column('TEXT', default='series')  # series|counter|gauge|histogram
+    step = Column('INTEGER')                # per-step series position
+    value = Column('REAL')
+    time = Column('TEXT', dtype='datetime')
+    component = Column('TEXT')              # train|worker|supervisor|serving
+    tags = Column('TEXT')                   # json dict or None
+
+
+class TelemetrySpan(DBModel):
+    __tablename__ = 'telemetry_span'
+
+    id = Column('INTEGER', primary_key=True)
+    span_id = Column('TEXT', index=True, nullable=False)
+    parent_id = Column('TEXT')
+    task = Column('INTEGER', index=True)    # nullable
+    name = Column('TEXT', nullable=False)
+    started = Column('REAL')                # epoch seconds (wall clock)
+    duration = Column('REAL')               # seconds (monotonic diff)
+    status = Column('TEXT', default='ok')   # ok|error
+    tags = Column('TEXT')                   # json dict or None
+
+
+__all__ = ['Metric', 'TelemetrySpan']

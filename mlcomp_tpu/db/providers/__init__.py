@@ -22,9 +22,13 @@ from mlcomp_tpu.db.providers.queue import QueueProvider
 from mlcomp_tpu.db.providers.auth import (
     DbAuditProvider, WorkerTokenProvider
 )
+from mlcomp_tpu.db.providers.telemetry import (
+    MetricProvider, TelemetrySpanProvider
+)
 
 __all__ = [
     'WorkerTokenProvider', 'DbAuditProvider',
+    'MetricProvider', 'TelemetrySpanProvider',
     'BaseDataProvider', 'ProjectProvider', 'DagProvider', 'TaskProvider',
     'ComputerProvider', 'DockerProvider', 'FileProvider',
     'DagStorageProvider', 'DagLibraryProvider', 'LogProvider',

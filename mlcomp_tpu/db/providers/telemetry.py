@@ -1,0 +1,107 @@
+"""Telemetry providers — batch ingest + query for metrics and spans.
+
+The write path is ``add_many`` (one executemany per flush) because the
+telemetry buffers hand over hundreds of rows at a time; per-row ``add``
+would pay a commit each.
+"""
+
+import json
+
+from mlcomp_tpu.db.models import Metric, TelemetrySpan
+from mlcomp_tpu.db.providers.base import BaseDataProvider
+
+
+class MetricProvider(BaseDataProvider):
+    model = Metric
+
+    _INSERT = ('INSERT INTO metric '
+               '(task, name, kind, step, value, time, component, tags) '
+               'VALUES (?, ?, ?, ?, ?, ?, ?, ?)')
+
+    def add_many(self, rows):
+        """``rows``: iterables matching _INSERT's column order."""
+        rows = list(rows)
+        if rows:
+            self.session.executemany(self._INSERT, rows)
+        return len(rows)
+
+    def series(self, task_id=None, name=None, component=None,
+               limit: int = 100000):
+        """Samples grouped by metric name, each ordered by (step, id):
+        ``{name: [{'step':, 'value':, 'time':, 'kind':}, ...]}``."""
+        where, params = [], []
+        if task_id is not None:
+            where.append('task=?')
+            params.append(int(task_id))
+        if name is not None:
+            where.append('name=?')
+            params.append(name)
+        if component is not None:
+            where.append('component=?')
+            params.append(component)
+        sql = 'SELECT * FROM metric'
+        if where:
+            sql += ' WHERE ' + ' AND '.join(where)
+        sql += ' ORDER BY name, COALESCE(step, id), id LIMIT ?'
+        params.append(int(limit))
+        out = {}
+        for r in self.session.query(sql, tuple(params)):
+            out.setdefault(r['name'], []).append({
+                'step': r['step'], 'value': r['value'],
+                'time': r['time'], 'kind': r['kind']})
+        return out
+
+    def names(self, task_id=None):
+        where = ' WHERE task=?' if task_id is not None else ''
+        params = (int(task_id),) if task_id is not None else ()
+        return [r['name'] for r in self.session.query(
+            f'SELECT DISTINCT name FROM metric{where} ORDER BY name',
+            params)]
+
+
+class TelemetrySpanProvider(BaseDataProvider):
+    model = TelemetrySpan
+
+    _INSERT = ('INSERT INTO telemetry_span '
+               '(span_id, parent_id, task, name, started, duration, '
+               'status, tags) VALUES (?, ?, ?, ?, ?, ?, ?, ?)')
+
+    def add_many(self, rows):
+        rows = list(rows)
+        if rows:
+            self.session.executemany(self._INSERT, rows)
+        return len(rows)
+
+    def by_task(self, task_id: int):
+        rows = self.session.query(
+            'SELECT * FROM telemetry_span WHERE task=? '
+            'ORDER BY started, id', (int(task_id),))
+        return [TelemetrySpan.from_row(r) for r in rows]
+
+    def tree(self, task_id: int):
+        """Spans of a task as a parent→children forest of dicts (tags
+        decoded), ordered by start time — the shape the dashboard and
+        ``GET /telemetry/spans`` serve."""
+        spans = []
+        by_id = {}
+        for s in self.by_task(task_id):
+            node = s.to_dict()
+            try:
+                node['tags'] = json.loads(node['tags']) \
+                    if node['tags'] else None
+            except ValueError:
+                pass
+            node['children'] = []
+            by_id[node['span_id']] = node
+            spans.append(node)
+        roots = []
+        for node in spans:
+            parent = by_id.get(node['parent_id'])
+            if parent is not None and parent is not node:
+                parent['children'].append(node)
+            else:
+                roots.append(node)
+        return roots
+
+
+__all__ = ['MetricProvider', 'TelemetrySpanProvider']

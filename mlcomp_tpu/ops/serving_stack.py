@@ -27,6 +27,7 @@ this kernel's effect every round.
 """
 
 import functools
+import time
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -169,7 +170,8 @@ def stack_feed(y):
     return (y / (jnp.max(jnp.abs(y)) + FEED_EPS)).astype(jnp.bfloat16)
 
 
-def make_chain_runner(step, args, x0, reps: int):
+def make_chain_runner(step, args, x0, reps: int, recorder=None,
+                      metric: str = 'serving.chain_ms'):
     """Timed-chain harness encoding the tunnel-compiler survival rules
     learned in round 5: operands pass as jit ARGUMENTS (closed-over
     arrays embed as HLO literal constants — ~1 GB here — and kill the
@@ -177,14 +179,32 @@ def make_chain_runner(step, args, x0, reps: int):
     program did the same), with enough reps per dispatch to amortize
     the tunnel's tens-of-ms per-call round trip. ``step(x, *args)``
     runs ONE stack; returns a no-arg callable whose float() forces
-    completion."""
+    completion.
+
+    ``recorder`` (a telemetry ``MetricRecorder``) turns the driver into
+    its own latency histogram: each call after the first observes the
+    per-stack wall-clock (ms) under ``metric`` — the first call is the
+    compile+warm pass every harness makes, and a one-off compile in a
+    steady-state latency histogram would poison mean/max — so a flush
+    emits ``<metric>.p50/.p99/…`` summary rows next to the ratios the
+    bench publishes (the in-DB counterpart of bench.py's JSON mins)."""
     def run(x, *a):
         def body(x, _):
             return step(x, *a), None
         x, _ = jax.lax.scan(body, x, None, length=reps)
         return jnp.sum(x.astype(jnp.float32))
     fn = jax.jit(run)
-    return lambda: float(fn(x0, *args))
+    warmed = [False]
+
+    def call():
+        t0 = time.perf_counter()
+        out = float(fn(x0, *args))
+        if recorder is not None and warmed[0]:
+            recorder.observe(
+                metric, (time.perf_counter() - t0) / reps * 1e3)
+        warmed[0] = True
+        return out
+    return call
 
 
 __all__ = ['serving_stack', 'reference_stack', 'quantize_stack',

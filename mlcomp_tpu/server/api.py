@@ -24,6 +24,9 @@ the behavior is subtle):
   ``resume{master_computer, master_task_id, load_last}`` attached,
   including distributed-master discovery (app.py:488-552)
 - ``/api/auxiliary`` supervisor introspection, no auth (app.py:555-558)
+- ``/api/telemetry/series|spans`` (also GET ``/telemetry/series`` +
+  ``/telemetry/spans``, no auth) and ``/api/telemetry/profile`` —
+  telemetry subsystem reads + on-demand profiler toggle (telemetry/)
 - ``/api/logs``, ``/api/reports``, ``/api/report``,
   ``/api/report/update_layout_start|update_layout_end``
 - ``/api/remove_imgs``, ``/api/remove_files`` (app.py:672-688)
@@ -448,6 +451,68 @@ def api_auxiliary(data, s):
     return out
 
 
+def _int_arg(data, key, required=False):
+    """Parse an integer request arg; bad input is the caller's fault
+    (400), not a server error — GET args arrive as strings."""
+    value = data.get(key)
+    if value is None:
+        if required:
+            raise ApiError(f'{key} required')
+        return None
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        raise ApiError(f'{key} must be an integer', status=400)
+
+
+def api_telemetry_series(data, s):
+    """Metric series recorded from inside the system (telemetry/):
+    per-step loss/throughput from the train loop, supervisor tick
+    gauges, serving latency summaries. Filter by task / name /
+    component; GET and POST serve the same payload."""
+    from mlcomp_tpu.db.providers import MetricProvider
+    task = _int_arg(data, 'task')
+    provider = MetricProvider(s)
+    return {
+        'task': task,
+        'series': provider.series(
+            task_id=task, name=data.get('name'),
+            component=data.get('component')),
+    }
+
+
+def api_telemetry_spans(data, s):
+    """Span forest of one task: the worker pipeline phases (download,
+    executor import, run) with durations — where the wall-clock went."""
+    from mlcomp_tpu.db.providers import TelemetrySpanProvider
+    task = _int_arg(data, 'task', required=True)
+    return {'task': task, 'spans': TelemetrySpanProvider(s).tree(task)}
+
+
+def api_telemetry_profile(data, s):
+    """Toggle an on-demand ``jax.profiler`` trace on a RUNNING task:
+    action start|stop|status (telemetry/profiler.py — the training
+    process polls at epoch boundaries)."""
+    from mlcomp_tpu.telemetry import (
+        request_stop, request_trace, trace_status,
+    )
+    task = _int_arg(data, 'task', required=True)
+    action = data.get('action', 'start')
+    if action == 'start':
+        max_epochs = _int_arg(data, 'max_epochs')
+        row = request_trace(s, task, out_dir=data.get('dir'),
+                            max_epochs=1 if max_epochs is None
+                            else max_epochs)
+    elif action == 'stop':
+        row = request_stop(s, task)
+    elif action == 'status':
+        row = trace_status(s, task)
+    else:
+        raise ApiError(f'unknown action {action!r} '
+                       f'(start|stop|status)')
+    return dict(row, task=task)
+
+
 def api_logs(data, s):
     return LogProvider(s).get(data, _paginator(data))
 
@@ -672,6 +737,12 @@ _ROUTES = {
     '/api/dag/toogle_report': (api_dag_toggle_report, True),
     '/api/task/toogle_report': (api_task_toggle_report, True),
     '/api/auxiliary': (api_auxiliary, False),
+    # telemetry reads are an introspection surface like /api/auxiliary
+    # (no secrets: metric names + floats); the profile toggle mutates
+    # state and needs the token
+    '/api/telemetry/series': (api_telemetry_series, False),
+    '/api/telemetry/spans': (api_telemetry_spans, False),
+    '/api/telemetry/profile': (api_telemetry_profile, True),
     '/api/logs': (api_logs, True),
     '/api/reports': (api_reports, True),
     '/api/report': (api_report, True),
@@ -695,6 +766,7 @@ _READ_ONLY_ROUTES = frozenset({
     '/api/dags', '/api/code', '/api/tasks', '/api/task/info',
     '/api/task/steps', '/api/auxiliary', '/api/logs', '/api/reports',
     '/api/report', '/api/report/update_layout_start',
+    '/api/telemetry/series', '/api/telemetry/spans',
 })
 
 
@@ -854,6 +926,30 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self._send_json(
                     {'success': False,
                      'reason': traceback.format_exc()}, 500)
+            return
+        if parsed.path in ('/telemetry/series', '/telemetry/spans'):
+            # GET mirrors of the POST routes (curl-friendly:
+            # /telemetry/series?task=7&name=loss); same no-auth
+            # introspection tier as /api/auxiliary
+            qs = parse_qs(parsed.query)
+            data = {k: v[0] for k, v in qs.items()}
+            handler = api_telemetry_series \
+                if parsed.path == '/telemetry/series' \
+                else api_telemetry_spans
+            try:
+                try:
+                    res = handler(data, _session())
+                except sqlite3.ProgrammingError:
+                    res = handler(data, _session())  # healed mid-read
+                self._send_json(res)
+            except ApiError as e:
+                self._send_json(
+                    {'success': False, 'reason': str(e)}, e.status)
+            except Exception as exc:
+                if isinstance(exc, sqlite3.Error):
+                    _heal_session()
+                self._send_json(
+                    {'success': False, 'reason': 'internal error'}, 500)
             return
         if parsed.path in ('/', '/ui') or parsed.path.startswith('/ui/'):
             from mlcomp_tpu.server.front import dashboard_html

@@ -14,7 +14,10 @@ inline SVG) covering the same surfaces:
 - projects CRUD (reference front/src/app/project/)
 - DAG detail: layered SVG graph with per-status colors, config viewer,
   code browser, code zip download
-- task detail: step tree + logs (front/src/app/task/)
+- task detail: step tree + logs (front/src/app/task/), plus the
+  telemetry surfaces this build records from inside the hot paths
+  (telemetry/): per-step metric series charts, gauge table, the span
+  forest with durations, and on-demand profiler start/stop buttons
 - report detail: LAYOUT-DRIVEN rendering (reference
   db/report_info/info.py:28-129 consumed by the SPA's report renderer):
   panels of metric series, img_classify gallery with confusion-matrix
@@ -676,14 +679,27 @@ function showCode(c) {
   document.getElementById('codeview').textContent = decodeURIComponent(c);
 }
 
+async function profileToggle(id, action) {
+  // on-demand jax.profiler trace on a RUNNING task; the training
+  // process polls the request at epoch boundaries
+  const res = await api('telemetry/profile', {task:id, action});
+  alert('profiler: ' + (res.status||'?') + (res.dir?' '+res.dir:''));
+}
+
 async function viewTaskDetail(el, id) {
-  const [info, steps, logs] = await Promise.all([
+  const [info, steps, logs, tel, spans] = await Promise.all([
     api('task/info',{id}), api('task/steps',{id}),
-    api('logs',{task:id, paginator:{page_number:0,page_size:50}})]);
+    api('logs',{task:id, paginator:{page_number:0,page_size:50}}),
+    api('telemetry/series',{task:id}),
+    api('telemetry/spans',{task:id})]);
   el.appendChild(h(`<p><a href="#" onclick="detail=null;render();return false">
     &larr; back</a> &nbsp; <b>task ${id}</b> &nbsp;
     <button class="btn" onclick="toggleReportDialog('task',${id})"
-      >toggle report</button></p>`));
+      >toggle report</button>
+    <button class="btn" onclick="profileToggle(${id},'start')"
+      >profile</button>
+    <button class="btn" onclick="profileToggle(${id},'stop')"
+      >stop profile</button></p>`));
   el.appendChild(h('<pre>'+esc(JSON.stringify(info,null,2))+'</pre>'));
   const tree = (nodes) => '<div class="tree">' + nodes.map(s =>
     `<div>&#9656; ${esc(s.name)} <span class="dim">${esc(s.started||'')}
@@ -691,6 +707,40 @@ async function viewTaskDetail(el, id) {
      ${s.log_statuses.filter(x=>x.count).map(x=>x.name+':'+x.count).join(' ')}
      ${tree(s.children)}</div>`).join('') + '</div>';
   el.appendChild(h('<h3>steps</h3>' + tree(steps.data)));
+  // per-step metric series recorded from inside the train loop
+  // (telemetry/): stepped series chart like report series, scalar
+  // gauges/counters as a compact latest-value table
+  const series = tel.series || {};
+  const stepped = [], scalars = [];
+  Object.keys(series).forEach(n => {
+    const pts = series[n].filter(p => p.step != null);
+    if (pts.length >= 2) stepped.push([n, pts]);
+    else scalars.push([n, series[n][series[n].length-1]]);
+  });
+  if (stepped.length)
+    el.appendChild(h('<h3>telemetry series</h3><div class="charts">'
+      + stepped.map(([n, pts]) => lineChart(n, 'step',
+          pts.map(p => ({epoch: p.step, value: p.value})))).join('')
+      + '</div>'));
+  if (scalars.length)
+    el.appendChild(h('<h3>telemetry gauges</h3><table>'
+      + '<tr><th>metric</th><th>last value</th><th>kind</th>'
+      + '<th>time</th></tr>'
+      + scalars.map(([n, p]) => `<tr><td>${esc(n)}</td>
+        <td>${p && p.value!=null ? (+p.value).toPrecision(6) : ''}</td>
+        <td class="dim">${esc(p ? p.kind : '')}</td>
+        <td class="dim">${esc(p ? p.time||'' : '')}</td></tr>`).join('')
+      + '</table>'));
+  // span forest: where the task's wall-clock went (worker pipeline
+  // phases + executor internals), durations in ms
+  const spanTree = nodes => '<div class="tree">' + nodes.map(s =>
+    `<div>&#9656; ${esc(s.name)}
+     <span class="dim">${(s.duration*1000).toFixed(1)} ms</span>
+     ${s.status==='error' ? '<span class="status s-Failed">error</span>' : ''}
+     ${s.tags ? '<span class="dim">'+esc(JSON.stringify(s.tags))+'</span>' : ''}
+     ${spanTree(s.children||[])}</div>`).join('') + '</div>';
+  if ((spans.spans||[]).length)
+    el.appendChild(h('<h3>telemetry spans</h3>' + spanTree(spans.spans)));
   el.appendChild(h('<h3>logs</h3><table>' + logs.data.map(l =>
     `<tr><td class="dim">${esc(l.time)}</td><td>${esc(l.level_name)}</td>
      <td><pre style="margin:0">${esc(l.message)}</pre></td></tr>`).join('')

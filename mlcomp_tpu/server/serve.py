@@ -218,6 +218,10 @@ class _ServedModel:
         # last-K request latencies for /health percentiles — a ring, so
         # the stats track CURRENT behavior, not the process lifetime
         self.latencies_ms = deque(maxlen=1024)
+        # telemetry histogram (assigned by ModelServer): per-request
+        # observe is an in-memory aggregate; summary rows
+        # (p50/p99/count/…) flush with the registry heartbeat
+        self.telemetry = None
         self.coalescer = _Coalescer(
             self._predict_padded, batch_size, coalesce_ms / 1e3) \
             if coalesce_ms > 0 else None
@@ -266,6 +270,9 @@ class _ServedModel:
                 self.pending -= 1
         ms = round((time.monotonic() - t0) * 1e3, 3)
         self.latencies_ms.append(ms)
+        if self.telemetry is not None:
+            self.telemetry.observe(f'serving.{self.name}.latency_ms',
+                                   ms)
         return {'y': np.asarray(y).tolist(), 'ms': ms}
 
     def _predict_padded(self, x: np.ndarray) -> np.ndarray:
@@ -345,6 +352,14 @@ class ModelServer:
                     m.coalescer.shutdown()
             raise
         self.primary = next(iter(self.models.values()))
+        # shared latency-histogram recorder; flushes ride the registry
+        # heartbeat (no heartbeat/session → pure in-memory, /health
+        # still serves its own deque-based stats)
+        from mlcomp_tpu.telemetry import MetricRecorder
+        self.telemetry = MetricRecorder(component='serving',
+                                        flush_every=10 ** 9)
+        for m in self.models.values():
+            m.telemetry = self.telemetry
         self.host, self.port = host, port
         self.token = TOKEN if token is None else token
         self.httpd = None
@@ -516,6 +531,7 @@ class ModelServer:
                             'input_shape': m.meta.get('input_shape'),
                             'ts': time.time(),
                             'updated': str(now())})
+                    self.telemetry.flush(session)
                     last_err[0] = None
                 except Exception as e:
                     # a DB hiccup must not kill serving, but a BROKEN

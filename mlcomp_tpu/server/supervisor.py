@@ -54,6 +54,14 @@ class SupervisorBuilder:
         self.dep_status = {}
         self.computers = []
         self.aux = {}
+        # tick/dispatch telemetry: gauges buffered in memory, one DB
+        # batch per flush_every samples (~1/min at the 1 Hz tick) so
+        # observability never competes with the scheduling hot path
+        from mlcomp_tpu.telemetry import MetricRecorder
+        self.telemetry = MetricRecorder(
+            session=self.session, component='supervisor',
+            flush_every=60)
+        self._last_claim_ts = now()
 
     # ----------------------------------------------------------- base state
     def create_base(self):
@@ -330,6 +338,23 @@ class SupervisorBuilder:
         # assignment then follows from mesh_from_spec's canonical
         # outer→inner order (dp/fsdp/pp outermost, spanning hosts).
         want_total = mesh_exact or task.cores_max
+        if mesh_spec and mesh_exact is None:
+            # remainder-axis mesh: clamp the target DOWN to a
+            # mesh_fixed multiple before placing. The per-host loop
+            # takes at least `grain` cores per host until want_total is
+            # met, so a legacy row whose cores_max is not a mesh_fixed
+            # multiple would overshoot it (e.g. cores_max=6, fixed
+            # axes product 4 → hosts grant 4+4=8 cores); the
+            # tail-shedding below only trims total % mesh_fixed,
+            # which is 0 exactly in that overshoot case.
+            want_total = want_total // mesh_fixed * mesh_fixed
+            if not want_total:
+                self.aux.setdefault('not_placed', {})[task.id] = {
+                    'distributed':
+                        f'cores_max {task.cores_max} below the mesh '
+                        f'fixed-axes product {mesh_fixed} '
+                        f'(mesh {mesh_spec})'}
+                return
         total_cores = 0
         placements = []
         for comp in fits:
@@ -418,6 +443,40 @@ class SupervisorBuilder:
         (reference supervisor.py:396-403)."""
         self.auxiliary_provider.create_or_update('supervisor', self.aux)
 
+    def record_tick_telemetry(self):
+        """Per-tick gauges + dispatch-latency samples. The latency is
+        enqueue→claim of queue messages claimed since the previous
+        tick — the worker-side pickup delay bench.py's grid leg
+        measures from the outside, recorded here from the inside."""
+        tel = self.telemetry
+        if self.aux.get('duration') is not None:
+            tel.gauge('supervisor.tick_ms', self.aux['duration'] * 1e3)
+        dispatched = self.aux.get('dispatched')
+        if dispatched:
+            tel.count('supervisor.dispatched', len(dispatched))
+        if self.aux.get('not_placed'):
+            tel.gauge('supervisor.not_placed',
+                      len(self.aux['not_placed']))
+        from mlcomp_tpu.db.core import parse_datetime
+        try:
+            rows = self.session.query(
+                'SELECT created, claimed_at FROM queue_message '
+                'WHERE claimed_at IS NOT NULL AND claimed_at > ?',
+                (self._last_claim_ts,))
+        except Exception:
+            rows = []
+        latest = None
+        for r in rows:
+            created = parse_datetime(r['created'])
+            claimed = parse_datetime(r['claimed_at'])
+            if created and claimed:
+                tel.observe('supervisor.dispatch_latency_s',
+                            (claimed - created).total_seconds())
+            if claimed and (latest is None or claimed > latest):
+                latest = claimed
+        if latest is not None:
+            self._last_claim_ts = latest
+
     # ---------------------------------------------------------------- main
     def build(self):
         start = now()
@@ -429,6 +488,7 @@ class SupervisorBuilder:
             self.process_tasks()
             self.aux['duration'] = (now() - start).total_seconds()
             self.write_auxiliary()
+            self.record_tick_telemetry()
         except Exception:
             # heal-by-recreating-session (reference supervisor.py:423-427)
             if self.logger:

@@ -1,0 +1,41 @@
+"""Telemetry subsystem: spans, metric series, device stats, profiler.
+
+The observability layer the reference keeps in the DB+UI (ReportSeries
+rows, per-computer usage) rebuilt as a first-class, low-overhead
+package wired through every layer of this framework:
+
+- ``spans``    — context-manager tracing spans (worker task pipeline,
+  executor phases), buffered in a thread-safe ring, batch-flushed.
+- ``metrics``  — per-step counters/gauges/histograms whose hot-path
+  cost is a host-side append; device values pull at flush time.
+- ``device``   — HBM occupancy + compiled-step FLOPs from inside the
+  training process (MFU computed in the loop, not in bench.py).
+- ``profiler`` — on-demand ``jax.profiler`` traces toggled per task
+  through ``POST /api/telemetry/profile``.
+
+Query side: ``GET /telemetry/series?task=<id>`` and
+``GET /telemetry/spans?task=<id>`` (server/api.py), backed by the
+``metric``/``telemetry_span`` tables (db/models/telemetry.py).
+The overhead budget is <1% of step time — bench.py measures and
+publishes ``telemetry_overhead_pct`` every round.
+"""
+
+from mlcomp_tpu.telemetry.device import (
+    compiled_cost, device_memory_stats, mfu, record_device_stats,
+)
+from mlcomp_tpu.telemetry.metrics import Histogram, MetricRecorder
+from mlcomp_tpu.telemetry.profiler import (
+    TaskProfiler, request_stop, request_trace, trace_status,
+)
+from mlcomp_tpu.telemetry.spans import (
+    DEFAULT_BUFFER, SpanBuffer, current_span_id, flush_spans, span,
+)
+
+__all__ = [
+    'span', 'flush_spans', 'SpanBuffer', 'DEFAULT_BUFFER',
+    'current_span_id',
+    'MetricRecorder', 'Histogram',
+    'device_memory_stats', 'compiled_cost', 'mfu',
+    'record_device_stats',
+    'TaskProfiler', 'request_trace', 'request_stop', 'trace_status',
+]

@@ -1,0 +1,84 @@
+"""Device stats: TPU/HBM occupancy and compiled-step cost, from inside
+the training process.
+
+bench.py computes MFU from the outside by re-lowering the step; this
+module makes the same numbers available to the loop that is actually
+training, so ``mfu`` and ``hbm_used`` land in the metric table next to
+the loss series they explain.
+
+Never initializes a jax client: on tunneled/real chips a second live
+client starves the compute client's compiles ~30x (see
+worker/__main__.py:_tpu_usage). Everything here is a no-op returning
+empty data unless jax is already imported and initialized by the
+caller's own training code.
+"""
+
+import sys
+
+
+def device_memory_stats() -> list:
+    """Per-local-device HBM stats via ``device.memory_stats()``:
+    ``[{'id', 'platform', 'kind', 'bytes_in_use', 'bytes_limit'}]``.
+    Empty on CPU (no memory_stats) or when jax is not live."""
+    if 'jax' not in sys.modules:
+        return []
+    try:
+        import jax
+        out = []
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                stats = {}
+            out.append({
+                'id': d.id,
+                'platform': d.platform,
+                'kind': getattr(d, 'device_kind', str(d)),
+                'bytes_in_use': int(stats.get('bytes_in_use', 0)),
+                'bytes_limit': int(stats.get('bytes_limit', 0)),
+            })
+        return out
+    except Exception:
+        return []
+
+
+def compiled_cost(jitted_fn, *args) -> dict:
+    """FLOPs + bytes accessed of one compiled call from XLA's own cost
+    analysis. With a persistent compilation cache this re-lowering is
+    cheap; without one it costs a compile — call once per stage, not
+    per step. ``{}`` when the analysis is unavailable (e.g. the cost
+    lives inside a Pallas custom call XLA can't see)."""
+    try:
+        cost = jitted_fn.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return {
+            'flops': float(cost.get('flops', 0.0)) or None,
+            'bytes_accessed': float(cost.get('bytes accessed', 0.0))
+            or None,
+        }
+    except Exception:
+        return {}
+
+
+def mfu(flops_per_step: float, steps_per_sec: float, n_devices: int,
+        peak_tflops: float) -> float:
+    """Model FLOPs utilization against the chip's peak."""
+    return (flops_per_step * steps_per_sec /
+            (peak_tflops * 1e12 * max(1, n_devices)))
+
+
+def record_device_stats(recorder, step: int = None):
+    """Gauge rows per local device: ``device<i>.hbm_used`` /
+    ``device<i>.hbm_limit`` (bytes). Cheap no-op off-TPU."""
+    for d in device_memory_stats():
+        if not d['bytes_limit']:
+            continue
+        recorder.gauge(f'device{d["id"]}.hbm_used',
+                       d['bytes_in_use'], step=step)
+        recorder.gauge(f'device{d["id"]}.hbm_limit',
+                       d['bytes_limit'], step=step)
+
+
+__all__ = ['device_memory_stats', 'compiled_cost', 'mfu',
+           'record_device_stats']

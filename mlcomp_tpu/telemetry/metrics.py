@@ -1,0 +1,226 @@
+"""Per-step metric series: counters, gauges, histograms whose hot-path
+cost is a host-side list append.
+
+The rule that makes this usable inside a training loop: **recording
+never syncs the device**. ``series('loss', metrics['loss'], step)``
+appends the jax array itself; the device→host pull happens at flush
+time, once per ``flush_every`` steps, where one batch of ``float()``
+conversions and one ``executemany`` amortize across the window. (The
+per-scalar pull costs ~63 ms each through a tunneled chip —
+train/loop.py's ``aggregate_metrics`` learned this the hard way.)
+
+Counters and histograms aggregate in memory and emit summary rows at
+flush (``name.count``/``name.p50``/``name.p99``/…), so a serving
+process observing every request writes a handful of rows per flush
+interval, not one per request.
+"""
+
+import itertools
+import json
+import sys
+import threading
+from collections import deque
+
+import numpy as np
+
+
+class Histogram:
+    """Streaming aggregate + bounded reservoir for percentiles."""
+
+    __slots__ = ('count', 'total', 'min', 'max', '_reservoir')
+
+    def __init__(self, reservoir: int = 1024):
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self._reservoir = deque(maxlen=reservoir)
+
+    def observe(self, value: float):
+        value = float(value)
+        self.count += 1
+        self.total += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+        self._reservoir.append(value)
+
+    def summary(self) -> dict:
+        if not self.count:
+            return {}
+        window = list(self._reservoir)
+        return {
+            'count': float(self.count),
+            'mean': self.total / self.count,
+            'min': self.min, 'max': self.max,
+            'p50': float(np.percentile(window, 50)),
+            'p99': float(np.percentile(window, 99)),
+        }
+
+
+class MetricRecorder:
+    """One recorder per (task, component). Bind a session to persist;
+    without one it is a pure in-memory buffer (tests, bench).
+
+    Thread safety: every mutation holds ``_mutate_lock`` (an
+    uncontended acquire is ~100 ns — noise against the budget), so a
+    concurrent flush (serving heartbeat, ``async_flush`` worker) can
+    swap the buffers without losing racing samples or crashing the
+    snapshot iteration. ``async_flush=True`` moves the auto-flush
+    triggered by a full window onto a background daemon thread — the
+    instrumented step never blocks on the device pull or the DB write
+    (the training hot path wants this; explicit ``flush()`` calls stay
+    synchronous)."""
+
+    def __init__(self, session=None, task: int = None,
+                 component: str = None, flush_every: int = 100,
+                 capacity: int = 65536, async_flush: bool = False):
+        self.session = session
+        self.task = task
+        self.component = component
+        self.flush_every = max(1, int(flush_every))
+        self.capacity = int(capacity)
+        self.async_flush = bool(async_flush)
+        self._pending = []        # (name, kind, step, value) — hot path
+        self._counters = {}
+        self._histograms = {}
+        self._mutate_lock = threading.Lock()
+        self._flush_thread = None
+        self._steps = itertools.count()
+        self.dropped_count = 0
+        self.flushed_count = 0
+
+    # ------------------------------------------------------------ hot path
+    def _maybe_flush(self):
+        if len(self._pending) < self.flush_every or self.session is None:
+            return
+        if not self.async_flush:
+            self.flush()
+            return
+        t = self._flush_thread
+        if t is not None and t.is_alive():
+            return              # one in-flight flush is enough
+        t = threading.Thread(target=self.flush, daemon=True,
+                             name='telemetry-flush')
+        self._flush_thread = t
+        t.start()
+
+    def series(self, name: str, value, step: int = None):
+        """Per-step sample. ``value`` may be a live device array — it is
+        NOT converted here (no device sync on the hot path)."""
+        with self._mutate_lock:
+            self._pending.append((name, 'series', step, value))
+        self._maybe_flush()
+
+    def gauge(self, name: str, value, step: int = None):
+        with self._mutate_lock:
+            self._pending.append((name, 'gauge', step, value))
+        self._maybe_flush()
+
+    def count(self, name: str, inc: float = 1):
+        with self._mutate_lock:
+            self._counters[name] = self._counters.get(name, 0.0) + inc
+
+    def observe(self, name: str, value: float):
+        with self._mutate_lock:
+            hist = self._histograms.get(name)
+            if hist is None:
+                hist = self._histograms[name] = Histogram()
+            hist.observe(value)
+
+    def next_step(self) -> int:
+        return next(self._steps)
+
+    def histogram_summaries(self) -> dict:
+        """Live snapshot ``{name: summary_dict}`` of the open
+        histograms — read without flushing (bench legs publish these
+        in their JSON; a later flush still emits the rows)."""
+        with self._mutate_lock:
+            return {name: h.summary()
+                    for name, h in self._histograms.items()}
+
+    def series_array(self, name: str, values, start_step: int = 0):
+        """Bulk append — e.g. the [steps] metric arrays a whole-epoch
+        ``lax.scan`` returns (one host pull for the whole epoch)."""
+        arr = np.asarray(values).reshape(-1)
+        with self._mutate_lock:
+            for i, v in enumerate(arr):
+                self._pending.append((name, 'series', start_step + i,
+                                      float(v)))
+
+    # ----------------------------------------------------------- flush path
+    def _materialize(self):
+        """Swap out pending samples + aggregate snapshots, converting
+        values to floats (device pulls happen HERE, off the hot path).
+
+        Buffered live device arrays come to host in ONE batched
+        ``jax.device_get`` — per-scalar ``float()`` pulls cost a full
+        round trip each (63 ms apiece through a tunneled chip; see
+        train/loop.py's aggregate_metrics, which learned it the hard
+        way), so a 100-sample window must be one transfer, not 100."""
+        with self._mutate_lock:
+            pending, self._pending = self._pending, []
+            counters, self._counters = self._counters, {}
+            hists, self._histograms = self._histograms, {}
+        if len(pending) > self.capacity:
+            self.dropped_count += len(pending) - self.capacity
+            pending = pending[-self.capacity:]
+        values = [v for (_, _, _, v) in pending]
+        if 'jax' in sys.modules and values:
+            try:
+                import jax
+                values = jax.device_get(values)
+            except Exception:
+                pass
+        # naive-UTC like every other DB timestamp (utils.misc.now) —
+        # local time here would skew metric.time against log/queue rows
+        from mlcomp_tpu.utils.misc import now
+        ts = now()
+        rows = []
+        for (name, kind, step, _), value in zip(pending, values):
+            try:
+                rows.append((self.task, name, kind, step,
+                             float(np.asarray(value)), ts,
+                             self.component, None))
+            except (TypeError, ValueError):
+                continue
+        for name, total in counters.items():
+            rows.append((self.task, name, 'counter', None, float(total),
+                         ts, self.component, None))
+        for name, hist in hists.items():
+            summary = hist.summary()
+            for stat, v in summary.items():
+                rows.append((self.task, f'{name}.{stat}', 'histogram',
+                             None, float(v), ts, self.component,
+                             json.dumps({'of': name})))
+        return rows
+
+    def flush(self, session=None) -> int:
+        """Convert + persist everything pending in one batch. Telemetry
+        failures never propagate into the instrumented code."""
+        session = session or self.session
+        rows = self._materialize()
+        if not rows:
+            return 0
+        if session is None:
+            self.dropped_count += len(rows)
+            return 0
+        from mlcomp_tpu.db.providers.telemetry import MetricProvider
+        try:
+            n = MetricProvider(session).add_many(rows)
+        except Exception:
+            self.dropped_count += len(rows)
+            return 0
+        self.flushed_count += n
+        return n
+
+    def close(self) -> int:
+        """Join any in-flight background flush, then flush the rest
+        synchronously — the task-teardown call that guarantees every
+        recorded sample is either in the DB or counted dropped."""
+        t = self._flush_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=30)
+        return self.flush()
+
+
+__all__ = ['MetricRecorder', 'Histogram']

@@ -1,0 +1,138 @@
+"""On-demand ``jax.profiler`` traces, toggled per task through the API.
+
+The static route already exists (JaxTrain's ``profile:`` config key
+captures fixed epochs), but "the run is slow NOW, trace it" needs a
+control plane: ``POST /api/telemetry/profile {task, action}`` writes a
+request row into the auxiliary table (the same no-auth-to-read
+introspection surface the supervisor trace uses), and the training
+process polls it at epoch boundaries via ``TaskProfiler`` — zero
+overhead between polls, no new transport.
+
+Row lifecycle under key ``telemetry:profile:<task>``:
+``requested`` → (worker starts trace) → ``tracing`` → on a ``stop``
+request or ``max_epochs`` elapsed → ``done`` (with the trace dir).
+"""
+
+import os
+import time
+
+AUX_PREFIX = 'telemetry:profile:'
+
+
+def _provider(session):
+    from mlcomp_tpu.db.providers import AuxiliaryProvider
+    return AuxiliaryProvider(session)
+
+
+def request_trace(session, task_id: int, out_dir: str = None,
+                  max_epochs: int = 1) -> dict:
+    """API side: ask the worker running ``task_id`` to start a trace."""
+    row = {'status': 'requested', 'dir': out_dir,
+           'max_epochs': int(max_epochs), 'ts': time.time()}
+    _provider(session).create_or_update(
+        f'{AUX_PREFIX}{task_id}', row)
+    return row
+
+
+def request_stop(session, task_id: int) -> dict:
+    prov = _provider(session)
+    key = f'{AUX_PREFIX}{task_id}'
+    row = dict(prov.get().get(key) or {})
+    row.update({'status': 'stop_requested', 'ts': time.time()})
+    prov.create_or_update(key, row)
+    return row
+
+
+def trace_status(session, task_id: int) -> dict:
+    return _provider(session).get().get(
+        f'{AUX_PREFIX}{task_id}') or {'status': 'none'}
+
+
+class TaskProfiler:
+    """Worker side: poll the request row and drive the jax profiler.
+
+    ``poll()`` is called at epoch boundaries (cheap: one SELECT). The
+    tracer callables are injectable for tests; the defaults are
+    ``jax.profiler.start_trace`` / ``stop_trace``.
+    """
+
+    def __init__(self, session, task_id: int, default_dir: str,
+                 tracer_start=None, tracer_stop=None):
+        self.session = session
+        self.task_id = task_id
+        self.default_dir = default_dir
+        self._start = tracer_start
+        self._stop = tracer_stop
+        self.tracing = False
+        self._epochs_traced = 0
+        self._max_epochs = 1
+        self._dir = None
+
+    def _key(self):
+        return f'{AUX_PREFIX}{self.task_id}'
+
+    def _write(self, row: dict):
+        try:
+            _provider(self.session).create_or_update(self._key(), row)
+        except Exception:
+            pass
+
+    def _read(self) -> dict:
+        try:
+            return _provider(self.session).get().get(self._key()) or {}
+        except Exception:
+            return {}
+
+    def poll(self) -> bool:
+        """Advance the state machine one step; returns whether a trace
+        is running AFTER the poll."""
+        if self.session is None:
+            return False
+        row = self._read()
+        status = row.get('status')
+        if not self.tracing and status == 'requested':
+            self._dir = row.get('dir') or os.path.join(
+                self.default_dir, 'profile_on_demand')
+            self._max_epochs = int(row.get('max_epochs') or 1)
+            try:
+                start = self._start
+                if start is None:
+                    import jax
+                    start = jax.profiler.start_trace
+                start(self._dir)
+            except Exception as e:
+                self._write(dict(row, status='failed', error=str(e)))
+                return False
+            self.tracing = True
+            self._epochs_traced = 0
+            self._write(dict(row, status='tracing', dir=self._dir))
+            return True
+        if self.tracing:
+            self._epochs_traced += 1
+            if status == 'stop_requested' \
+                    or self._epochs_traced >= self._max_epochs:
+                self._finish(row)
+        return self.tracing
+
+    def _finish(self, row: dict):
+        try:
+            stop = self._stop
+            if stop is None:
+                import jax
+                stop = jax.profiler.stop_trace
+            stop()
+        except Exception:
+            pass
+        self.tracing = False
+        self._write(dict(row, status='done', dir=self._dir,
+                         epochs=self._epochs_traced))
+
+    def close(self):
+        """Stop an open trace (exception paths) so a restarted executor
+        can start a fresh one."""
+        if self.tracing:
+            self._finish(self._read())
+
+
+__all__ = ['TaskProfiler', 'request_trace', 'request_stop',
+           'trace_status', 'AUX_PREFIX']

@@ -1,0 +1,141 @@
+"""Tracing spans: context managers buffered in a thread-safe ring,
+flushed to the DB in batches off the hot path.
+
+Answering "where did the wall-clock of DAG 7 go?" needs timestamps from
+INSIDE the system, on one clock, with parent/child structure — the task
+row's started/finished pair can't split executor-import from training
+from checkpointing. A span records (span_id, parent_id, task, name,
+wall start, monotonic duration, tags); nesting is tracked per-thread so
+``with span('a'): with span('b'): ...`` links b→a without the caller
+threading ids around.
+
+Hot-path cost: entering a span is two ``perf_counter`` calls and a list
+push; exiting appends one dict to a bounded deque. Nothing touches the
+DB until ``flush_spans(session)`` (typically once per task, or on a
+flush cadence) hands the drained batch to one ``executemany``. When the
+ring overflows, the OLDEST spans drop and ``dropped_count`` says so —
+telemetry must never grow without bound inside a worker.
+"""
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+_counter = itertools.count(1)
+_tls = threading.local()
+
+
+def _new_span_id() -> str:
+    # pid-scoped: batch inserts from concurrent workers can't collide
+    return f'{os.getpid():x}-{next(_counter):x}'
+
+
+def _stack():
+    stack = getattr(_tls, 'stack', None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+class SpanBuffer:
+    """Bounded thread-safe ring of finished spans."""
+
+    def __init__(self, capacity: int = 4096):
+        self._ring = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self.dropped_count = 0
+
+    def add(self, record: dict):
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped_count += 1
+            self._ring.append(record)
+
+    def drain(self):
+        with self._lock:
+            out = list(self._ring)
+            self._ring.clear()
+        return out
+
+    def __len__(self):
+        return len(self._ring)
+
+
+#: process-wide default buffer — the worker pipeline and the executors
+#: share it so one flush at task end captures everything
+DEFAULT_BUFFER = SpanBuffer()
+
+
+class _SpanHandle:
+    __slots__ = ('span_id', 'tags')
+
+    def __init__(self, span_id, tags):
+        self.span_id = span_id
+        self.tags = tags
+
+    def tag(self, key, value):
+        self.tags[key] = value
+
+
+@contextmanager
+def span(name: str, task: int = None, tags: dict = None,
+         buffer: SpanBuffer = None):
+    """Trace the enclosed block. Nested spans parent automatically
+    (per-thread); ``task`` defaults to the enclosing span's task so
+    only the root span of a task needs to carry it."""
+    buf = buffer if buffer is not None else DEFAULT_BUFFER
+    stack = _stack()
+    parent_id, parent_task = (stack[-1] if stack else (None, None))
+    handle = _SpanHandle(_new_span_id(), dict(tags or {}))
+    if task is None:
+        task = parent_task
+    stack.append((handle.span_id, task))
+    started = time.time()
+    t0 = time.perf_counter()
+    status = 'ok'
+    try:
+        yield handle
+    except BaseException:
+        status = 'error'
+        raise
+    finally:
+        duration = time.perf_counter() - t0
+        stack.pop()
+        buf.add({
+            'span_id': handle.span_id, 'parent_id': parent_id,
+            'task': task, 'name': name, 'started': started,
+            'duration': duration, 'status': status,
+            'tags': handle.tags or None,
+        })
+
+
+def current_span_id():
+    stack = _stack()
+    return stack[-1][0] if stack else None
+
+
+def flush_spans(session, buffer: SpanBuffer = None) -> int:
+    """Drain the buffer into one batched insert. Returns rows written.
+    Failures are swallowed after re-buffering nothing — telemetry loss
+    must never fail the task it observes."""
+    buf = buffer if buffer is not None else DEFAULT_BUFFER
+    records = buf.drain()
+    if not records or session is None:
+        return 0
+    from mlcomp_tpu.db.providers.telemetry import TelemetrySpanProvider
+    rows = [(r['span_id'], r['parent_id'], r['task'], r['name'],
+             r['started'], r['duration'], r['status'],
+             json.dumps(r['tags']) if r['tags'] else None)
+            for r in records]
+    try:
+        return TelemetrySpanProvider(session).add_many(rows)
+    except Exception:
+        return 0
+
+
+__all__ = ['span', 'flush_spans', 'SpanBuffer', 'DEFAULT_BUFFER',
+           'current_span_id']

@@ -4,8 +4,11 @@ The msgpack checkpoint (train/checkpoint.py) pulls the FULL state to
 host before rank 0 writes — which un-does ``fsdp`` sharding exactly when
 it matters (every host materializes every parameter byte). This module
 writes what each host already holds: for every leaf, the process dumps
-its addressable replica-0 shards (``jax.Array.addressable_shards``) to a
-local ``.npz``; no collective, no full-state buffer anywhere. Restore is
+one copy of each distinct addressable slice
+(``jax.Array.addressable_shards``, replicated slices included — so each
+host's own fragments cover its restore even without a shared
+filesystem) to a local ``.npz``; no collective, no full-state buffer
+anywhere. Restore is
 geometric: each restoring device reads only the saved shards overlapping
 its own slice, so a checkpoint saved on one mesh shape reshards onto
 another (fsdp=8 → dp2×fsdp2, different process count, …) without any
@@ -117,20 +120,31 @@ def _flatten(tree):
 
 
 def build_shard_plan(state) -> dict:
-    """Device→host pull of THIS process's replica-0 shards. No
-    collective — safe to call from the training loop; the returned plan
-    is plain numpy and may be written on a background thread."""
-    import jax
+    """Device→host pull of THIS process's addressable shards, one copy
+    per distinct slice. No collective — safe to call from the training
+    loop; the returned plan is plain numpy and may be written on a
+    background thread.
+
+    Replicated slices are written by EVERY process that holds them
+    (deduped within the process, replica-0 copy preferred), not only by
+    whichever process owns replica 0: on a non-shared filesystem each
+    host's own fragment files must cover each restoring device's slice,
+    and a host whose devices carry only replica>0 copies would
+    otherwise save nothing for those leaves and fail its local restore.
+    The duplicate bytes are bounded by the replicated (non-sharded)
+    fraction of the state — exactly the leaves fsdp keeps small."""
     leaves = _flatten(_state_dict(state))
     plan_leaves, shards, max_bytes = [], [], 0
     for li, (path, leaf) in enumerate(leaves):
         if _is_jax_array(leaf):
             desc = {'path': list(path), 'shape': list(leaf.shape),
                     'dtype': str(leaf.dtype)}
+            slices = {}  # (start, stop) -> shard, replica 0 preferred
             for sh in leaf.addressable_shards:
-                if sh.replica_id != 0:
-                    continue
-                start, stop = _normalize_index(sh.index, leaf.shape)
+                key = _normalize_index(sh.index, leaf.shape)
+                if key not in slices or sh.replica_id == 0:
+                    slices[key] = sh
+            for (start, stop), sh in slices.items():
                 data = np.asarray(sh.data)
                 max_bytes = max(max_bytes, data.nbytes)
                 shards.append((li, start, stop, data))
@@ -150,11 +164,14 @@ def build_shard_plan(state) -> dict:
             desc = {'path': list(path), 'shape': list(arr.shape),
                     'dtype': str(arr.dtype),
                     'py': type(leaf).__name__}
-            if jax.process_index() == 0:
-                start = tuple(0 for _ in arr.shape)
-                stop = tuple(arr.shape)
-                max_bytes = max(max_bytes, arr.nbytes)
-                shards.append((li, start, stop, arr))
+            # host-side leaves are identical across ranks (the resume
+            # unanimity votes guarantee it) — every process writes its
+            # copy so its local fragment set restores without a shared
+            # filesystem, same rationale as replicated jax slices
+            start = tuple(0 for _ in arr.shape)
+            stop = tuple(arr.shape)
+            max_bytes = max(max_bytes, arr.nbytes)
+            shards.append((li, start, stop, arr))
         plan_leaves.append(desc)
     LAST_STATS['save_max_shard_bytes'] = max_bytes
     return {'leaves': plan_leaves, 'shards': shards}
@@ -333,6 +350,37 @@ def save_checkpoint_sharded(directory: str, state, meta: dict,
     write_shard_plan(directory, build_shard_plan(state), meta, best=best)
 
 
+def _boxes_overlap(a, b) -> bool:
+    return all(max(al, bl) < min(ah, bh)
+               for (al, ah), (bl, bh) in zip(a, b))
+
+
+def _rect_mask(shape, rects) -> np.ndarray:
+    mask = np.zeros(shape, bool)
+    for r in rects:
+        mask[tuple(slice(lo, hi) for lo, hi in r)] = True
+    return mask
+
+
+def _rects_cover(shape, rects) -> bool:
+    """Does the union of ``rects`` (per-dim (lo, hi) boxes, clipped to
+    the slice) cover all of ``[0, shape)``? O(#boxes) bookkeeping —
+    exact duplicates (every process re-writing a replicated slice)
+    collapse, disjoint boxes compare summed volume, and only the rare
+    partially-overlapping resharding geometry pays for an element
+    mask."""
+    total = int(np.prod(shape, dtype=np.int64))
+    uniq = sorted(set(rects))
+    if not uniq:
+        return total == 0
+    if any(_boxes_overlap(uniq[i], uniq[j])
+           for i in range(len(uniq)) for j in range(i + 1, len(uniq))):
+        return bool(_rect_mask(shape, uniq).all())
+    vol = sum(int(np.prod([hi - lo for lo, hi in r], dtype=np.int64))
+              for r in uniq)
+    return vol == total
+
+
 class _ShardReader:
     """Lazy access to a sharded checkpoint folder: per-leaf shard
     tables, one open NpzFile per fragment (members load on demand)."""
@@ -396,7 +444,14 @@ class _ShardReader:
         start, stop = tuple(start), tuple(stop)
         shape = tuple(b - a for a, b in zip(start, stop))
         out = np.empty(shape, dtype=dtype)
-        filled = 0
+        # coverage bookkeeping is per covered RECTANGLE, not a bool
+        # mask the size of the slice (which doubles the host peak for
+        # int8/bf16 leaves): fragments legitimately duplicate
+        # replicated slices (every process writes its copy), and
+        # _rects_cover collapses exact duplicates before deciding —
+        # double-counted copies must not mask a missing region
+        rects = []
+        filled_scalar = False
         for s_start, s_stop, npz, key in self.by_leaf.get(leaf_idx, ()):
             o_start = tuple(max(a, sa)
                             for a, sa in zip(start, s_start))
@@ -410,17 +465,21 @@ class _ShardReader:
                         zip(o_start, o_stop, s_start))
             if shape == ():
                 out[()] = data[()]
-                filled = 1
+                filled_scalar = True
             else:
                 out[dst] = data[src].astype(dtype, copy=False)
-                filled += int(np.prod([b - a for a, b in
-                                       zip(o_start, o_stop)]))
-        expect = int(np.prod(shape)) if shape else 1
-        if filled < expect:
+                rects.append(tuple(
+                    (a - ta, b - ta) for a, b, ta in
+                    zip(o_start, o_stop, start)))
+        covered = filled_scalar if shape == () else \
+            _rects_cover(shape, rects)
+        if not covered:
+            missing = 1 if shape == () else \
+                int((~_rect_mask(shape, rects)).sum())
             raise ValueError(
-                f'leaf {leaf_idx}: saved shards cover {filled}/{expect} '
-                f'elements of slice {start}:{stop} — checkpoint saved '
-                f'with missing fragments?')
+                f'leaf {leaf_idx}: saved shards leave {missing} '
+                f'element(s) of slice {start}:{stop} uncovered — '
+                f'checkpoint saved with missing fragments?')
         LAST_STATS['restore_max_buffer_bytes'] = max(
             LAST_STATS['restore_max_buffer_bytes'], out.nbytes)
         return out

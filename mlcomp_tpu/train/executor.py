@@ -66,7 +66,7 @@ class JaxTrain(Executor):
                  report_imgs=None, augment=None, prefetch=2,
                  device_data='auto', epoch_scan=False,
                  checkpoint_every=1, infer_valid=None, profile=None,
-                 async_checkpoint=True, **kwargs):
+                 async_checkpoint=True, telemetry=True, **kwargs):
         self.model_spec = dict(model or {'name': 'mlp'})
         # pretrained init (reference contrib/model/pretrained.py:6-59
         # head-swap): popped so create_model and the export .json see
@@ -125,6 +125,15 @@ class JaxTrain(Executor):
         # on Catalyst's host-side timers (SURVEY §5 tracing substitutes)
         # this records the real device timeline incl. fusion + HBM
         self.profile = dict(profile) if profile else None
+        # telemetry: True (default) | False | {flush_every: N,
+        # cost_analysis: bool, peak_tflops: float}. Per-step loss/
+        # throughput series + per-epoch device stats land in the
+        # metric table (telemetry/); cost_analysis re-lowers the step
+        # for XLA's FLOPs count so MFU is recorded from inside the
+        # loop — it defaults on off-CPU only (the AOT lowering is an
+        # extra compile the CPU test harness shouldn't pay)
+        self.telemetry_spec = dict(telemetry) \
+            if isinstance(telemetry, dict) else ({} if telemetry else None)
         # leftover config keys: NOT an error (forward-compat), but a
         # silent swallow turns typos and non-matching grid-cell keys
         # into no-op sweeps — _work logs them loudly
@@ -226,12 +235,24 @@ class JaxTrain(Executor):
     def work(self):
         self._ckpt_writer = None
         self._profile_open = False
+        self._telemetry = None
+        self._profiler = None
         ok = False
         try:
             result = self._work()
             ok = True
             return result
         finally:
+            if self._profiler is not None:
+                try:
+                    self._profiler.close()
+                except Exception:
+                    pass
+            if self._telemetry is not None:
+                try:
+                    self._telemetry.close()
+                except Exception:
+                    pass
             if self._profile_open:
                 # an exception mid-epoch skipped _stop_profile; close the
                 # trace so a restarted executor can start a new one
@@ -334,6 +355,45 @@ class JaxTrain(Executor):
         info = dict(getattr(self, 'additional_info', None) or {})
         ck_dir = self._checkpoint_folder()
         steps_per_epoch = max(1, len(x_train) // self.batch_size)
+
+        # telemetry: per-step series recorder + on-demand profiler
+        # control (rank 0 only — one writer per task, like
+        # _report_series). The recorder's hot path is a list append;
+        # device values pull at flush (every flush_every steps and at
+        # each epoch boundary).
+        self._step_flops = None
+        if self.telemetry_spec is not None and self.session is not None \
+                and self.task is not None and self._is_main:
+            from mlcomp_tpu.telemetry import MetricRecorder, TaskProfiler
+            # async_flush: the window-full auto-flush (device pull +
+            # DB write) runs on a background thread, never inside the
+            # wrapped train step
+            self._telemetry = MetricRecorder(
+                session=self.session, task=self.task.id,
+                component='train', async_flush=True,
+                flush_every=int(
+                    self.telemetry_spec.get('flush_every', 100)))
+            self._profiler = TaskProfiler(self.session, self.task.id,
+                                          ck_dir)
+
+        def _telemetry_step_flops(step_fn, *abstract_args):
+            """XLA cost analysis of the compiled step, once per run —
+            the inside-the-loop half of bench.py's MFU accounting.
+            Off by default on CPU (the AOT lowering is an extra
+            compile the test harness shouldn't pay)."""
+            if self._telemetry is None or self._step_flops is not None:
+                return
+            want = self.telemetry_spec.get('cost_analysis')
+            if want is None:
+                want = jax.default_backend() != 'cpu'
+            if not want:
+                return
+            from mlcomp_tpu.telemetry import compiled_cost
+            cost = compiled_cost(step_fn, *abstract_args)
+            # 0 = probed-and-unavailable: the is-not-None guard above
+            # must stop later stages from paying the AOT lower+compile
+            # again when cost_analysis has nothing for this backend
+            self._step_flops = cost.get('flops') or 0
 
         def stage_opt_spec(stage):
             return stage.get('optimizer') or \
@@ -491,6 +551,28 @@ class JaxTrain(Executor):
                 train_step = make_train_step(
                     model, optimizer, loss_fn, mesh=mesh,
                     self_supervised=self_supervised)
+            if self._telemetry is not None \
+                    and not (use_device_data and self.epoch_scan):
+                import jax.numpy as jnp
+                if use_device_data:
+                    _telemetry_step_flops(
+                        train_step, state, x_all, y_all,
+                        jax.ShapeDtypeStruct((self.batch_size,),
+                                             jnp.int32))
+                else:
+                    _telemetry_step_flops(
+                        train_step, state,
+                        jax.ShapeDtypeStruct(
+                            (self.batch_size,) + x_train.shape[1:],
+                            x_train.dtype),
+                        None if y_train is None else
+                        jax.ShapeDtypeStruct(
+                            (self.batch_size,) + y_train.shape[1:],
+                            y_train.dtype))
+                from mlcomp_tpu.train.loop import instrumented_step
+                train_step = instrumented_step(
+                    train_step, self._telemetry,
+                    batch_size=self.batch_size)
             eval_step = make_eval_step(
                 model, loss_fn, mesh=mesh,
                 self_supervised=self_supervised)
@@ -613,6 +695,31 @@ class JaxTrain(Executor):
                                         stage_name)
                 self._report_series('images_per_sec', n_train / train_dt,
                                     global_epoch, 'train', stage_name)
+                if self._telemetry is not None:
+                    tel = self._telemetry
+                    if use_device_data and self.epoch_scan:
+                        # scan path has no per-step host loop — the
+                        # [steps] metric arrays land as series in one
+                        # host pull
+                        base = global_epoch * steps_per_epoch
+                        for k, v in metric_arrays.items():
+                            tel.series_array(k, np.asarray(v), base)
+                    tel.gauge('epoch_time_s', train_dt)
+                    tel.gauge('epoch_throughput', n_train / train_dt)
+                    if self._step_flops:
+                        from mlcomp_tpu.telemetry import mfu as _mfu
+                        peak = float(self.telemetry_spec.get(
+                            'peak_tflops',
+                            os.environ.get('MLCOMP_PEAK_TFLOPS', 197)))
+                        tel.gauge('mfu', _mfu(
+                            self._step_flops,
+                            steps_per_epoch / train_dt,
+                            len(mesh.devices.flat), peak))
+                    from mlcomp_tpu.telemetry import record_device_stats
+                    record_device_stats(tel)
+                    tel.flush()
+                if self._profiler is not None:
+                    self._profiler.poll()
                 self.info(
                     f'[{stage_name}] epoch {global_epoch}: '
                     f'train {train_agg} valid {valid_agg} '

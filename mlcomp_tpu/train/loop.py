@@ -375,6 +375,45 @@ def make_eval_step(model, loss_fn: Callable,
     return jax.jit(step_in_context)
 
 
+def instrumented_step(step_fn, recorder, batch_size: int = None,
+                      metric_keys=('loss',)):
+    """Wrap a jit'd train step with per-step telemetry recording
+    (telemetry/metrics.py). Hot-path cost per step: a perf_counter
+    read and 2-3 list appends — the device arrays in ``metrics`` are
+    buffered as-is, NOT converted (no device sync; the recorder pulls
+    them at flush time, every ``flush_every`` steps).
+
+    ``step_time_ms`` is the host-observed interval between successive
+    step dispatches: with async dispatch the per-call time measures
+    the python/dispatch cost only, but once the device pipeline fills,
+    back-pressure makes the inter-call interval track true device step
+    time. ``throughput`` (samples/sec) derives from the same interval.
+    The first call records no timing (no previous dispatch to diff
+    against).
+    """
+    import time as _time
+    last = [None]
+
+    def wrapped(state, *args):
+        out = step_fn(state, *args)
+        t = _time.perf_counter()
+        step = recorder.next_step()
+        metrics = out[1] if isinstance(out, tuple) else {}
+        for key in metric_keys:
+            if key in metrics:
+                recorder.series(key, metrics[key], step=step)
+        prev, last[0] = last[0], t
+        if prev is not None:
+            dt = t - prev
+            recorder.series('step_time_ms', dt * 1e3, step=step)
+            if batch_size and dt > 0:
+                recorder.series('throughput', batch_size / dt,
+                                step=step)
+        return out
+
+    return wrapped
+
+
 def aggregate_metrics(metrics_list, weights=None):
     """Mean (optionally weighted) of a list of per-step metric dicts,
     pulled from device in ONE transfer.
@@ -453,6 +492,7 @@ def place_state(state: TrainState, mesh: Mesh) -> TrainState:
 __all__ = ['TrainState', 'make_train_step', 'make_device_train_step',
            'make_device_epoch_fn', 'make_eval_step',
            'make_device_eval_step', 'aggregate_metrics',
+           'instrumented_step',
            'create_train_state', 'state_sharding', 'place_state',
            'loss_for_task', 'LOSSES', 'softmax_ce', 'lm_ce', 'seg_ce',
            'lm_ce_with']

@@ -239,18 +239,31 @@ class ExecuteBuilder:
 
     # ----------------------------------------------------------------- main
     def build(self):
+        # each pipeline phase gets a telemetry span so "where did this
+        # task's wall-clock go?" (code download vs executor import vs
+        # the run itself) is answerable from GET /telemetry/spans
+        from mlcomp_tpu.telemetry.spans import flush_spans, span
         try:
-            self.create_base()
-            self.check_status()
-            self.mark_in_progress()
-            folder = self.download()
-            requeued = self.install_libraries()
-            if requeued:
-                return requeued
-            self.pin_cores()
-            self.init_distributed()
-            self.create_executor(folder)
-            return self.execute(folder)
+            with span('task.pipeline', task=self.task_id):
+                with span('task.load'):
+                    self.create_base()
+                    self.check_status()
+                    self.mark_in_progress()
+                with span('task.download'):
+                    folder = self.download()
+                with span('task.install_libraries'):
+                    requeued = self.install_libraries()
+                if requeued:
+                    return requeued
+                self.pin_cores()
+                with span('task.init_distributed'):
+                    self.init_distributed()
+                with span('task.create_executor',
+                          tags={'executor': self.task.executor}):
+                    self.create_executor(folder)
+                with span('task.execute',
+                          tags={'executor': self.task.executor}):
+                    return self.execute(folder)
         except Exception as e:
             if self.task is not None:
                 self.logger.error(
@@ -263,6 +276,10 @@ class ExecuteBuilder:
                     self.provider.change_status(task, TaskStatus.Failed)
             raise
         finally:
+            try:
+                flush_spans(self.session)
+            except Exception:
+                pass
             if self.exit_on_finish:
                 os._exit(0)  # noqa — per-task process hygiene
 

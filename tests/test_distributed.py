@@ -166,7 +166,6 @@ def test_two_process_fanout_matches_single_process(session, tmp_path):
     assert losses[-1][1] < losses[0][1]
 
 
-@pytest.mark.slow
 def _run_ranks(argv_for_rank, nprocs=2, timeout=300):
     """Spawn one CPU-mesh subprocess per rank (4 local devices each),
     kill leftovers on failure/timeout, return their outputs."""
@@ -194,9 +193,15 @@ def _run_ranks(argv_for_rank, nprocs=2, timeout=300):
     return outs
 
 
+@pytest.mark.slow
 def test_dryrun_multiprocess_entry(tmp_path):
     """__graft_entry__.dryrun_multichip in 2-process mode: each rank runs
-    the full sharded train step over the global 8-device mesh."""
+    the full sharded train step over the global 8-device mesh.
+
+    slow: 2 subprocesses with a 300 s budget — a mark on the
+    ``_run_ranks`` helper is inert (pytest only honours marks on
+    collected tests), so it lives HERE to keep this out of the fast
+    suite."""
     outs = _run_ranks(lambda rank: [
         sys.executable, '/root/repo/__graft_entry__.py', 'dryrun-mp',
         '8', str(rank), '2', '127.0.0.1:29655'])

@@ -266,6 +266,41 @@ class TestUiPayloads:
         code = api('/api/code', {'id': seeded['dag']})
         assert code['items'] == []
 
+    def test_task_detail_telemetry_calls(self, api, seeded):
+        """viewTaskDetail's telemetry calls, replayed with the same
+        payload shape the JS sends: series + spans always fetched with
+        {task}, the profile buttons post {task, action}."""
+        from mlcomp_tpu.telemetry import (
+            MetricRecorder, SpanBuffer, flush_spans, span,
+        )
+        task = seeded['task']
+        rec = MetricRecorder(session=api.session, task=task,
+                             component='train', flush_every=10 ** 9)
+        for i in range(3):
+            rec.series('loss', 1.0 - 0.1 * i, step=i)
+        rec.gauge('epoch_time_s', 2.5)
+        rec.flush()
+        buf = SpanBuffer()
+        with span('task.pipeline', task=task, buffer=buf):
+            with span('task.execute', buffer=buf):
+                pass
+        flush_spans(api.session, buf)
+
+        tel = api('/api/telemetry/series', {'task': task})
+        assert [p['value'] for p in tel['series']['loss']] == \
+            pytest.approx([1.0, 0.9, 0.8])
+        assert tel['series']['epoch_time_s'][0]['step'] is None
+        spans = api('/api/telemetry/spans', {'task': task})
+        assert spans['spans'][0]['name'] == 'task.pipeline'
+        assert [c['name'] for c in spans['spans'][0]['children']] == \
+            ['task.execute']
+        out = api('/api/telemetry/profile',
+                  {'task': task, 'action': 'start'})
+        assert out['status'] == 'requested'
+        out = api('/api/telemetry/profile',
+                  {'task': task, 'action': 'stop'})
+        assert out['status'] == 'stop_requested'
+
     def test_dashboard_serves_all_tabs(self, api, seeded):
         html = api('/ui', method='GET', raw=True).decode()
         for tab_name in ('projects', 'dags', 'tasks', 'computers',

@@ -197,6 +197,44 @@ class TestDistributed:
         assert ranks == {0, 1}
         assert tp.by_id(task.id).status == int(TaskStatus.Queued)
 
+    def test_wildcard_mesh_grant_clamped_to_cores_max(self, session,
+                                                      dag_id):
+        """A legacy wildcard-mesh row whose cores_max is not a multiple
+        of the mesh's fixed-axes product must not dispatch MORE cores
+        than cores_max: want_total clamps DOWN to a mesh_fixed multiple
+        before the per-host placement loop (which takes at least one
+        grain per host and would otherwise overshoot, e.g. 4+4=8 cores
+        against cores_max=6)."""
+        from mlcomp_tpu.utils.io import yaml_dump
+        add_computer(session, name='host1', cores=4)
+        add_computer(session, name='host2', cores=4)
+        task = add_task(
+            session, dag_id, name='train', cores=4, cores_max=6,
+            single_node=False,
+            additional_info=yaml_dump(
+                {'distr': True, 'mesh': {'dp': -1, 'tp': 4}}))
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        children = TaskProvider(session).children(task.id)
+        total = sum(len(json.loads(c.cores_assigned))
+                    for c in children)
+        assert total == 4, [c.cores_assigned for c in children]
+
+    def test_wildcard_mesh_below_fixed_product_not_placed(
+            self, session, dag_id):
+        from mlcomp_tpu.utils.io import yaml_dump
+        add_computer(session, name='host1', cores=8)
+        task = add_task(
+            session, dag_id, name='train', cores=2, cores_max=3,
+            single_node=False,
+            additional_info=yaml_dump(
+                {'distr': True, 'mesh': {'dp': -1, 'tp': 4}}))
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        assert TaskProvider(session).by_id(task.id).status == \
+            int(TaskStatus.NotRan)
+        assert task.id in sup.aux.get('not_placed', {})
+
     def test_single_node_prefers_most_free_cores(self, session, dag_id):
         add_computer(session, name='small', cores=2)
         add_computer(session, name='big', cores=8)

@@ -1,0 +1,491 @@
+"""Telemetry subsystem tests: span nesting/flush, metric-series
+persistence round trips, API endpoints, profiler control plane, and the
+hot-path overhead guard."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mlcomp_tpu import TOKEN
+from mlcomp_tpu.db.models import Dag, Task
+from mlcomp_tpu.db.providers import (
+    DagProvider, MetricProvider, TaskProvider, TelemetrySpanProvider,
+)
+from mlcomp_tpu.telemetry import (
+    Histogram, MetricRecorder, SpanBuffer, TaskProfiler, flush_spans,
+    request_stop, request_trace, span, trace_status,
+)
+from mlcomp_tpu.utils.misc import now
+
+
+def make_task(session, name='t'):
+    from mlcomp_tpu.db.providers import ProjectProvider
+    provider = ProjectProvider(session)
+    project = provider.by_name('p_telemetry')
+    if project is None:
+        provider.add_project('p_telemetry')
+        project = provider.by_name('p_telemetry')
+    dag = Dag(name='d', project=project.id, config='', created=now(),
+              docker_img='default')
+    DagProvider(session).add(dag)
+    task = Task(name=name, executor='e', dag=dag.id, status=0)
+    TaskProvider(session).add(task)
+    return task
+
+
+class TestSpans:
+    def test_nesting_and_flush(self, session):
+        task = make_task(session)
+        buf = SpanBuffer()
+        with span('outer', task=task.id, buffer=buf) as outer:
+            outer.tag('k', 'v')
+            with span('inner', buffer=buf):
+                time.sleep(0.01)
+        assert flush_spans(session, buf) == 2
+        provider = TelemetrySpanProvider(session)
+        rows = provider.by_task(task.id)
+        by_name = {r.name: r for r in rows}
+        # inner inherits the task AND parents to outer automatically
+        assert by_name['inner'].parent_id == by_name['outer'].span_id
+        assert by_name['inner'].task == task.id
+        assert by_name['inner'].duration >= 0.01
+        assert by_name['outer'].duration >= by_name['inner'].duration
+        tree = provider.tree(task.id)
+        assert len(tree) == 1
+        assert tree[0]['tags'] == {'k': 'v'}
+        assert [c['name'] for c in tree[0]['children']] == ['inner']
+
+    def test_error_status_recorded(self, session):
+        task = make_task(session)
+        buf = SpanBuffer()
+        with pytest.raises(ValueError):
+            with span('boom', task=task.id, buffer=buf):
+                raise ValueError('x')
+        flush_spans(session, buf)
+        (row,) = TelemetrySpanProvider(session).by_task(task.id)
+        assert row.status == 'error'
+
+    def test_ring_bounds_and_drop_count(self):
+        buf = SpanBuffer(capacity=4)
+        for i in range(7):
+            with span(f's{i}', buffer=buf):
+                pass
+        assert len(buf) == 4
+        assert buf.dropped_count == 3
+        names = [r['name'] for r in buf.drain()]
+        assert names == ['s3', 's4', 's5', 's6']  # oldest dropped
+
+    def test_flush_empty_and_sessionless(self, session):
+        buf = SpanBuffer()
+        assert flush_spans(session, buf) == 0
+        with span('s', buffer=buf):
+            pass
+        assert flush_spans(None, buf) == 0
+
+
+class TestMetrics:
+    def test_series_round_trip_across_flush_boundary(self, session):
+        task = make_task(session)
+        rec = MetricRecorder(session=session, task=task.id,
+                             component='train', flush_every=5)
+        for i in range(12):     # crosses two auto-flush boundaries
+            rec.series('loss', np.float32(1.0 - 0.05 * i), step=i)
+        rec.flush()
+        series = MetricProvider(session).series(task_id=task.id)
+        points = series['loss']
+        assert [p['step'] for p in points] == list(range(12))
+        assert points[0]['value'] == pytest.approx(1.0)
+        assert points[-1]['value'] == pytest.approx(0.45)
+
+    def test_device_array_values_convert_at_flush(self, session):
+        import jax.numpy as jnp
+        task = make_task(session)
+        rec = MetricRecorder(session=session, task=task.id,
+                             flush_every=10 ** 9)
+        rec.series('loss', jnp.float32(0.25), step=0)
+        rec.flush()
+        series = MetricProvider(session).series(task_id=task.id)
+        assert series['loss'][0]['value'] == pytest.approx(0.25)
+
+    def test_counters_and_histograms_emit_summaries(self, session):
+        task = make_task(session)
+        rec = MetricRecorder(session=session, task=task.id,
+                             flush_every=10 ** 9)
+        rec.count('dispatched', 3)
+        rec.count('dispatched', 2)
+        for v in (1.0, 2.0, 3.0, 4.0):
+            rec.observe('lat_ms', v)
+        rec.flush()
+        series = MetricProvider(session).series(task_id=task.id)
+        assert series['dispatched'][0]['value'] == 5.0
+        assert series['dispatched'][0]['kind'] == 'counter'
+        assert series['lat_ms.count'][0]['value'] == 4.0
+        assert series['lat_ms.min'][0]['value'] == 1.0
+        assert series['lat_ms.max'][0]['value'] == 4.0
+        assert series['lat_ms.p50'][0]['value'] == pytest.approx(2.5)
+
+    def test_sessionless_recorder_drops_and_counts(self):
+        rec = MetricRecorder(flush_every=10 ** 9)
+        rec.series('x', 1.0, step=0)
+        assert rec.flush() == 0
+        assert rec.dropped_count == 1
+
+    def test_histogram_summary(self):
+        h = Histogram()
+        assert h.summary() == {}
+        for v in range(100):
+            h.observe(float(v))
+        s = h.summary()
+        assert s['count'] == 100
+        assert s['mean'] == pytest.approx(49.5)
+        assert s['p99'] >= 95
+
+    def test_series_array_bulk(self, session):
+        task = make_task(session)
+        rec = MetricRecorder(session=session, task=task.id,
+                             flush_every=10 ** 9)
+        rec.series_array('loss', np.linspace(1, 0, 5), start_step=10)
+        rec.flush()
+        points = MetricProvider(session).series(task_id=task.id)['loss']
+        assert [p['step'] for p in points] == [10, 11, 12, 13, 14]
+
+
+@pytest.fixture()
+def api(session):
+    from mlcomp_tpu.server.api import ApiServer
+    server = ApiServer(host='127.0.0.1', port=0).start_background()
+    base = f'http://127.0.0.1:{server.port}'
+
+    def call(path, data=None, token=TOKEN, method='POST'):
+        if method == 'GET':
+            req = urllib.request.Request(base + path)
+        else:
+            req = urllib.request.Request(
+                base + path, data=json.dumps(data or {}).encode(),
+                headers={'Content-Type': 'application/json'})
+        if token is not None:
+            req.add_header('Authorization', token)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    yield call
+    server.shutdown()
+
+
+class TestApi:
+    def _seed(self, session):
+        task = make_task(session)
+        rec = MetricRecorder(session=session, task=task.id,
+                             component='train', flush_every=10 ** 9)
+        for i in range(4):
+            rec.series('loss', 1.0 - 0.1 * i, step=i)
+            rec.series('throughput', 100.0 + i, step=i)
+        rec.flush()
+        buf = SpanBuffer()
+        with span('task.pipeline', task=task.id, buffer=buf):
+            with span('task.execute', buffer=buf):
+                pass
+        flush_spans(session, buf)
+        return task
+
+    def test_get_series(self, api, session):
+        task = self._seed(session)
+        out = api(f'/telemetry/series?task={task.id}', method='GET',
+                  token=None)  # no-auth introspection tier
+        assert out['task'] == task.id
+        assert [p['value'] for p in out['series']['loss']] == \
+            pytest.approx([1.0, 0.9, 0.8, 0.7])
+        assert len(out['series']['throughput']) == 4
+        named = api(f'/telemetry/series?task={task.id}&name=loss',
+                    method='GET', token=None)
+        assert list(named['series']) == ['loss']
+
+    def test_get_spans(self, api, session):
+        task = self._seed(session)
+        out = api(f'/telemetry/spans?task={task.id}', method='GET',
+                  token=None)
+        assert len(out['spans']) == 1
+        root = out['spans'][0]
+        assert root['name'] == 'task.pipeline'
+        assert [c['name'] for c in root['children']] == ['task.execute']
+
+    def test_post_routes(self, api, session):
+        task = self._seed(session)
+        out = api('/api/telemetry/series', {'task': task.id})
+        assert 'loss' in out['series']
+        out = api('/api/telemetry/spans', {'task': task.id})
+        assert out['spans'][0]['name'] == 'task.pipeline'
+
+    def test_spans_requires_task(self, api):
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/api/telemetry/spans', {})
+        assert e.value.code == 400
+
+    def test_non_integer_task_is_client_error(self, api):
+        # GET args arrive as strings; garbage is the caller's 400,
+        # not a 500 out of int()
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/telemetry/series?task=nope', method='GET', token=None)
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/api/telemetry/spans', {'task': 'nope'})
+        assert e.value.code == 400
+
+    def test_profile_toggle_requires_auth(self, api, session):
+        import urllib.error
+        task = self._seed(session)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/api/telemetry/profile',
+                {'task': task.id, 'action': 'start'}, token='wrong')
+        assert e.value.code == 401
+        out = api('/api/telemetry/profile',
+                  {'task': task.id, 'action': 'start'})
+        assert out['status'] == 'requested'
+        out = api('/api/telemetry/profile',
+                  {'task': task.id, 'action': 'status'})
+        assert out['status'] == 'requested'
+
+
+class TestProfilerControl:
+    def test_request_trace_drives_worker_state_machine(self, session,
+                                                       tmp_path):
+        task = make_task(session)
+        started, stopped = [], []
+        prof = TaskProfiler(session, task.id, str(tmp_path),
+                            tracer_start=started.append,
+                            tracer_stop=lambda: stopped.append(True))
+        assert prof.poll() is False            # nothing requested
+        request_trace(session, task.id, max_epochs=2)
+        assert prof.poll() is True             # starts the trace
+        assert len(started) == 1
+        assert trace_status(session, task.id)['status'] == 'tracing'
+        assert prof.poll() is True             # epoch 1 of 2
+        assert prof.poll() is False            # epoch 2 → auto stop
+        assert stopped == [True]
+        status = trace_status(session, task.id)
+        assert status['status'] == 'done'
+        assert status['epochs'] == 2
+
+    def test_stop_request_wins_over_max_epochs(self, session, tmp_path):
+        task = make_task(session)
+        prof = TaskProfiler(session, task.id, str(tmp_path),
+                            tracer_start=lambda d: None,
+                            tracer_stop=lambda: None)
+        request_trace(session, task.id, max_epochs=100)
+        assert prof.poll() is True
+        request_stop(session, task.id)
+        assert prof.poll() is False
+        assert trace_status(session, task.id)['status'] == 'done'
+
+    def test_close_stops_open_trace(self, session, tmp_path):
+        task = make_task(session)
+        stopped = []
+        prof = TaskProfiler(session, task.id, str(tmp_path),
+                            tracer_start=lambda d: None,
+                            tracer_stop=lambda: stopped.append(True))
+        request_trace(session, task.id, max_epochs=100)
+        prof.poll()
+        prof.close()
+        assert stopped == [True]
+        assert trace_status(session, task.id)['status'] == 'done'
+
+
+class TestTrainLoopWiring:
+    def test_jax_train_records_per_step_series(self, session, tmp_path):
+        """The acceptance-criterion path: a jax_train run records
+        per-step loss + throughput from INSIDE the loop, queryable via
+        the metric provider by task id."""
+        from mlcomp_tpu.train import JaxTrain
+
+        class DummyStep:
+            def start(self, *a, **k):
+                pass
+
+            def info(self, m):
+                pass
+
+            def debug(self, m):
+                pass
+
+            def error(self, m):
+                pass
+
+            def end_all(self):
+                pass
+
+        task = make_task(session)
+        ex = JaxTrain(
+            model={'name': 'mlp', 'hidden': [16], 'num_classes': 4},
+            dataset={'name': 'synthetic_images', 'n_train': 256,
+                     'n_valid': 64, 'image_size': 8, 'channels': 1,
+                     'num_classes': 4},
+            loss='softmax_ce', batch_size=32, epochs=2,
+            telemetry={'flush_every': 16},
+            checkpoint_dir=str(tmp_path / 'ck'))
+        ex.step = DummyStep()
+        ex.task = task
+        ex.dag = DagProvider(session).by_id(task.dag)
+        ex.session = session
+        ex.additional_info = {}
+        ex.work()
+
+        series = MetricProvider(session).series(task_id=task.id)
+        assert 'loss' in series and 'throughput' in series
+        # 2 epochs x 8 steps — every step's loss recorded in order
+        assert [p['step'] for p in series['loss']] == list(range(16))
+        assert 'epoch_time_s' in series
+        assert 'epoch_throughput' in series
+
+    def test_telemetry_false_disables_recording(self, session,
+                                                tmp_path):
+        from mlcomp_tpu.train import JaxTrain
+
+        class DummyStep:
+            def start(self, *a, **k):
+                pass
+
+            def info(self, m):
+                pass
+
+            def debug(self, m):
+                pass
+
+            def error(self, m):
+                pass
+
+            def end_all(self):
+                pass
+
+        task = make_task(session)
+        ex = JaxTrain(
+            model={'name': 'mlp', 'hidden': [8], 'num_classes': 4},
+            dataset={'name': 'synthetic_images', 'n_train': 64,
+                     'n_valid': 32, 'image_size': 8, 'channels': 1,
+                     'num_classes': 4},
+            loss='softmax_ce', batch_size=32, epochs=1,
+            telemetry=False, checkpoint_dir=str(tmp_path / 'ck'))
+        ex.step = DummyStep()
+        ex.task = task
+        ex.dag = DagProvider(session).by_id(task.dag)
+        ex.session = session
+        ex.additional_info = {}
+        ex.work()
+        assert MetricProvider(session).series(task_id=task.id) == {}
+
+
+class TestOverheadGuard:
+    def test_instrumented_step_within_5pct_of_bare(self):
+        """The telemetry hot path (perf_counter + 3 buffered appends)
+        must be noise against a real step: instrumented = bare +
+        wrapper cost, so the guard asserts the wrapper's isolated
+        per-step cost is under 5% of the measured bare step time.
+        (Differencing two timed loops cannot resolve a few-percent
+        budget through this harness's ±10% scheduler drift — the same
+        reason bench.py publishes ``telemetry_overhead_pct`` from the
+        isolated measurement.)"""
+        import jax
+        import jax.numpy as jnp
+
+        from mlcomp_tpu.train.loop import instrumented_step
+
+        @jax.jit
+        def step(state, x, y):
+            return state, {'loss': jnp.sum(jnp.dot(x, x))}
+
+        x = jnp.ones((512, 512), jnp.float32)
+        step(0.0, x, None)          # compile
+        bare = float('inf')
+        for _ in range(7):
+            t0 = time.perf_counter()
+            for _ in range(50):
+                state, metrics = step(0.0, x, None)
+            jax.block_until_ready(metrics['loss'])
+            bare = min(bare, (time.perf_counter() - t0) / 50)
+
+        # wrapper cost in isolation: the identical wrapper around a
+        # no-op step, so the loop measures ONLY the telemetry path
+        rec = MetricRecorder(flush_every=10 ** 9, capacity=10 ** 6)
+        fake_metrics = {'loss': np.float32(0.5)}
+        instr = instrumented_step(
+            lambda s, xb, yb: (s, fake_metrics), rec, batch_size=512)
+        n = 20000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            instr(0.0, None, None)
+        wrapper_cost = (time.perf_counter() - t0) / n
+
+        assert wrapper_cost <= bare * 0.05, (wrapper_cost, bare)
+
+
+class TestDeviceStats:
+    def test_record_device_stats_noop_on_cpu(self, session):
+        from mlcomp_tpu.telemetry import (
+            device_memory_stats, record_device_stats,
+        )
+        stats = device_memory_stats()
+        # jax IS imported in the test process: every local device is
+        # reported (CPU devices usually carry no bytes_limit)
+        assert isinstance(stats, list)
+        rec = MetricRecorder(session=session, task=None,
+                             flush_every=10 ** 9)
+        record_device_stats(rec)    # must not raise without HBM stats
+
+    def test_mfu_arithmetic(self):
+        from mlcomp_tpu.telemetry import mfu
+        # 1 TFLOP/step at 100 steps/s on 1 chip of 200 TFLOPs → 0.5
+        assert mfu(1e12, 100, 1, 200) == pytest.approx(0.5)
+
+    def test_compiled_cost_on_cpu_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        from mlcomp_tpu.telemetry import compiled_cost
+
+        @jax.jit
+        def f(x):
+            return jnp.dot(x, x)
+
+        cost = compiled_cost(f, jnp.ones((64, 64), jnp.float32))
+        # XLA:CPU reports flops for a matmul; {} acceptable only if the
+        # backend hides cost analysis — either way the call must not
+        # raise
+        if cost:
+            assert cost['flops'] is None or cost['flops'] > 0
+
+
+class TestServingDriverHistogram:
+    def test_chain_runner_observes_latency_after_warm(self):
+        """ops/serving_stack.make_chain_runner with a recorder: each
+        call after the compile+warm first one lands a per-stack latency
+        sample in the named histogram."""
+        import jax.numpy as jnp
+
+        from mlcomp_tpu.ops.serving_stack import make_chain_runner
+
+        rec = MetricRecorder(flush_every=10 ** 9)
+        run = make_chain_runner(
+            lambda x: x * 1.0, [], jnp.ones((4, 4), jnp.float32),
+            reps=3, recorder=rec, metric='serving.toy_ms')
+        run()                       # compile+warm: NOT recorded
+        assert rec.histogram_summaries() == {}
+        run()
+        run()
+        summary = rec.histogram_summaries()['serving.toy_ms']
+        assert summary['count'] == 2
+        assert summary['min'] >= 0
+
+
+class TestSupervisorTelemetry:
+    def test_tick_records_gauges(self, session):
+        from mlcomp_tpu.server.supervisor import SupervisorBuilder
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        sup.telemetry.flush()
+        series = MetricProvider(session).series(component='supervisor')
+        assert 'supervisor.tick_ms' in series
+        assert series['supervisor.tick_ms'][0]['value'] >= 0
