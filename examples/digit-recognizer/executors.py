@@ -6,6 +6,7 @@ Data is synthetic (class-conditional patterns) because the build
 environment has no network egress; the learning task is real.
 """
 
+import functools
 import os
 
 import numpy as np
@@ -98,7 +99,9 @@ class TrainDigits(Executor):
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits, yb).mean()
 
-        @jax.jit
+        # donate the carried params/opt_state so XLA reuses their
+        # buffers instead of holding two copies live per step
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def train_step(params, opt_state, xb, yb):
             loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
             updates, opt_state = tx.update(grads, opt_state)

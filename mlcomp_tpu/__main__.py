@@ -1,7 +1,12 @@
 """CLI (parity: reference mlcomp/__main__.py:32-175).
 
 - ``mlcomp_tpu dag CONFIG``     — submit a DAG (client → DB writes only;
-  the supervisor picks tasks up on its next tick)
+  the supervisor picks tasks up on its next tick). Every submission is
+  preflighted (analysis/): errors reject before any DB insert, warnings
+  are stored with the dag row
+- ``mlcomp_tpu check CONFIG``   — run the preflight alone: DAG static
+  analysis + JAX hot-path lint of the experiment folder, no DB writes.
+  Exits non-zero when any error-severity finding remains
 - ``mlcomp_tpu execute CONFIG`` — run a whole DAG in-process without the
   scheduler/queues (debug mode, reference __main__.py:90-123): tasks run
   sequentially in topological order with all local TPU cores assigned
@@ -27,10 +32,11 @@ def main():
     pass
 
 
-def _load_config(config_path: str, params):
-    if not os.path.exists(config_path):
-        raise click.ClickException(f'config not found: {config_path}')
-    config = yaml_load(file=config_path)
+def _load_config(config_path: str, params, config: dict = None):
+    if config is None:
+        if not os.path.exists(config_path):
+            raise click.ClickException(f'config not found: {config_path}')
+        config = yaml_load(file=config_path)
     if params:
         overrides = dict_from_list_str(params)
         config = merge_dicts_smart(config, overrides)
@@ -44,13 +50,39 @@ def _load_config(config_path: str, params):
     return config, text
 
 
+def _preflight(config_path: str, params=()):
+    """(findings, config, folder) — the gate shared by ``check`` and
+    ``dag``: DAG rules over the RAW config (``--params`` overrides are
+    dry-run, not pre-applied, so ambiguity is a rule-tagged finding)
+    plus the JAX lint over the experiment folder."""
+    from mlcomp_tpu.analysis import folder_sources, preflight_config
+    if not os.path.exists(config_path):
+        raise click.ClickException(f'config not found: {config_path}')
+    config = yaml_load(file=config_path)
+    folder = os.path.dirname(os.path.abspath(config_path)) or '.'
+    overrides = dict_from_list_str(params) if params else None
+    findings = preflight_config(
+        config, sources=folder_sources(folder), params=overrides)
+    return findings, config, folder
+
+
 def _dag(config_path: str, params=(), debug: bool = False):
+    from mlcomp_tpu.analysis import format_report, split_findings
     from mlcomp_tpu.server.create_dags import dag_pipe, dag_standard
+
+    # the submit gate, on the same _preflight pass ``check`` uses (RAW
+    # config, so --params overrides are dry-run findings instead of a
+    # merge crash below): errors reject BEFORE any DB write
+    findings, raw, folder = _preflight(config_path, params)
+    errors, warnings = split_findings(findings)
+    if errors:
+        raise click.ClickException(
+            'preflight rejected the DAG:\n' + format_report(errors))
+
     session = Session.create_session()
     migrate(session)
-    config, text = _load_config(config_path, params)
+    config, text = _load_config(config_path, params, config=raw)
     logger = create_logger(session)
-    folder = os.path.dirname(os.path.abspath(config_path)) or '.'
     if 'pipes' in config:
         # pipe registration (reference __main__.py:49-52): nothing runs
         dag = dag_pipe(session, config, config_text=text,
@@ -58,7 +90,10 @@ def _dag(config_path: str, params=(), debug: bool = False):
         return session, dag, {}, config
     dag, tasks = dag_standard(
         session, config, debug=debug, config_text=text,
-        upload_folder=folder, logger=logger)
+        upload_folder=folder, logger=logger,
+        preflight_warnings=warnings)
+    if warnings:
+        click.echo(format_report(warnings))
     return session, dag, tasks, config
 
 
@@ -71,6 +106,27 @@ def dag(config, params):
     _, dag_row, tasks, _ = _dag(config, params)
     total = sum(len(v) for v in tasks.values())
     click.echo(f'dag {dag_row.id} created with {total} tasks')
+
+
+@main.command()
+@click.argument('config')
+@click.option('--params', multiple=True,
+              help='overrides to dry-run, e.g. --params lr:0.01')
+@click.option('--no-why', is_flag=True,
+              help='omit the per-rule rationale lines')
+def check(config, params, no_why):
+    """Preflight a DAG config without submitting it.
+
+    Runs both static-analysis engines (DAG validation + JAX hot-path
+    lint over the experiment folder) and prints rule-tagged findings.
+    Exit status: 0 when no errors (warnings allowed), 1 otherwise.
+    """
+    from mlcomp_tpu.analysis import format_report, split_findings
+    findings, _, _ = _preflight(config, params)
+    click.echo(format_report(findings, with_why=not no_why))
+    errors, _ = split_findings(findings)
+    if errors:
+        raise SystemExit(1)
 
 
 @main.command()

@@ -1,0 +1,36 @@
+"""Preflight static analysis: fail bad DAGs at submit, not on a TPU slot.
+
+Two engines, no jax import, no user-code import:
+
+- ``dag_check``: config + code-snapshot validation (executor resolution
+  by AST against the registry semantics, dependency cycles/dangling
+  edges, mesh-vs-cores arithmetic, ambiguous grid/--params overrides)
+- ``jax_lint``: AST lint of jit'd hot paths (host syncs, missing
+  donation, recompile hazards, leftover debug prints) with inline
+  ``# preflight: disable=<rule>`` suppressions
+
+Wired through: ``mlcomp_tpu check <config>`` (CLI), the ``dag`` upload
+gate (errors reject before DB insert; warnings stored with the dag row),
+``POST /api/dag/preflight`` (server + dashboard), and the supervisor
+(refuses to dispatch tasks of a DAG that fails preflight).
+``python -m mlcomp_tpu.analysis --self-lint`` lints mlcomp_tpu itself.
+"""
+
+from mlcomp_tpu.analysis.findings import (
+    RULES, Finding, PreflightError, format_report, split_findings,
+)
+from mlcomp_tpu.analysis.dag_check import (
+    builtin_executor_names, folder_sources, gate_config,
+    preflight_config, resolvable_executor_names, snapshot_sources,
+)
+from mlcomp_tpu.analysis.jax_lint import (
+    lint_paths, lint_source, lint_sources, self_lint,
+)
+
+__all__ = [
+    'Finding', 'PreflightError', 'RULES', 'format_report',
+    'split_findings', 'preflight_config', 'gate_config',
+    'resolvable_executor_names', 'builtin_executor_names',
+    'folder_sources', 'snapshot_sources',
+    'lint_source', 'lint_sources', 'lint_paths', 'self_lint',
+]

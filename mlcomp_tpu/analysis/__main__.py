@@ -1,0 +1,63 @@
+"""CLI for the lint engine:
+
+    python -m mlcomp_tpu.analysis --self-lint     # lint mlcomp_tpu/
+    python -m mlcomp_tpu.analysis PATH [PATH...]  # lint files/folders
+
+Exits non-zero when any unsuppressed finding remains — the CI contract:
+every finding in the framework's own code is either fixed or carries an
+inline ``# preflight: disable=<rule>`` with a justification. For config
+preflight use ``mlcomp_tpu check <config>``.
+"""
+
+import argparse
+import os
+import sys
+
+from mlcomp_tpu.analysis.findings import format_report
+from mlcomp_tpu.analysis.jax_lint import (
+    lint_paths, package_py_files, self_lint,
+)
+
+
+def _expand(paths):
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if d != '__pycache__'
+                           and not d.startswith('.')]
+                out.extend(os.path.join(dirpath, f) for f in files
+                           if f.endswith('.py'))
+        else:
+            out.append(p)
+    return sorted(out)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog='python -m mlcomp_tpu.analysis',
+        description='JAX hot-path linter (preflight rules jax-*)')
+    parser.add_argument('paths', nargs='*',
+                        help='files or directories to lint')
+    parser.add_argument('--self-lint', action='store_true',
+                        help='lint the installed mlcomp_tpu package')
+    args = parser.parse_args(argv)
+
+    if args.self_lint:
+        findings = self_lint()
+        scope = f'{len(package_py_files())} package files'
+    elif args.paths:
+        files = _expand(args.paths)
+        findings = lint_paths(files)
+        scope = f'{len(files)} files'
+    else:
+        parser.error('give paths to lint or --self-lint')
+        return 2
+
+    print(format_report(findings))
+    print(f'linted {scope}')
+    return 1 if findings else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

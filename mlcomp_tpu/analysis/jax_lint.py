@@ -1,0 +1,399 @@
+"""AST linter for JAX hot paths: the throughput killers telemetry can
+observe but not prevent.
+
+What counts as a "jit region": a function decorated with ``jax.jit``
+(directly or via ``functools.partial(jax.jit, ...)``), a function passed
+by name to a ``jax.jit(...)`` call in the same module, or a lambda
+passed inline — plus every function nested inside one (nested defs are
+traced too). Helper functions merely *called* from a jit region are not
+followed (static, single-module analysis); the rules target the step
+functions where the patterns actually bite.
+
+Rules (ids in findings.RULES):
+
+- ``jax-host-item``      ``.item()`` inside a jit region
+- ``jax-host-cast``      ``float()/int()/bool()`` on a traced value
+- ``jax-host-numpy``     ``np.asarray``/``np.array`` inside a jit region
+- ``jax-debug-print``    leftover ``jax.debug.print``/``breakpoint``
+- ``jax-donate``         train-step jit without ``donate_argnums``
+- ``jax-scalar-closure`` loop variable captured by a jitted closure
+- ``jax-jit-in-loop``    ``jax.jit(...)`` called inside a loop body
+
+Suppression: put ``# preflight: disable=<rule>[,<rule>...]`` (or
+``disable=all``) on the flagged line or on a comment line directly
+above it. Suppressions are honored per line, so a justification comment
+naturally sits next to the code it excuses.
+"""
+
+import ast
+import io
+import os
+import tokenize
+
+from mlcomp_tpu.analysis.findings import Finding
+
+_JIT_NAMES = {'jax.jit', 'jit', 'jax.pjit', 'pjit'}
+_PARTIAL_NAMES = {'functools.partial', 'partial'}
+_DONATE_KWARGS = {'donate_argnums', 'donate_argnames'}
+_STATE_PARAMS = {'state', 'params', 'train_state', 'carry'}
+_NUMPY_SYNC_ATTRS = {'asarray', 'array', 'copy', 'frombuffer'}
+_DEBUG_CALLS = {'jax.debug.print', 'debug.print',
+                'jax.debug.breakpoint', 'debug.breakpoint'}
+
+
+def _dotted(node):
+    """'jax.jit' for Name/Attribute chains, None otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+def parse_suppressions(text: str) -> dict:
+    """{line: set(rule ids)} from ``# preflight: disable=...`` comments.
+    A comment standing alone on its line also covers the next line."""
+    out = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(text).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            comment = tok.string.lstrip('#').strip()
+            if not comment.startswith('preflight:'):
+                continue
+            directive = comment[len('preflight:'):].strip()
+            if not directive.startswith('disable='):
+                continue
+            rules = {r.strip() for r in
+                     directive[len('disable='):].split(',') if r.strip()}
+            line = tok.start[0]
+            out.setdefault(line, set()).update(rules)
+            # standalone comment: nothing but whitespace before it
+            if not tok.line[:tok.start[1]].strip():
+                out.setdefault(line + 1, set()).update(rules)
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+class _Module:
+    """One parsed module with parent links and import aliases."""
+
+    def __init__(self, text: str, path: str):
+        self.path = path
+        self.tree = ast.parse(text)
+        self.suppress = parse_suppressions(text)
+        self.parent = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+        self.numpy_aliases = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == 'numpy':
+                        self.numpy_aliases.add(
+                            alias.asname or alias.name)
+
+    def enclosing_functions(self, node):
+        """Function defs wrapping ``node``, innermost first."""
+        out = []
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(cur)
+            cur = self.parent.get(cur)
+        return out
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppress.get(line)
+        return bool(rules) and ('all' in rules or rule in rules)
+
+
+def _is_jit_ref(node) -> bool:
+    return _dotted(node) in _JIT_NAMES
+
+
+def _decorator_jit(dec):
+    """(is_jit, has_donate) for a decorator node."""
+    if _is_jit_ref(dec):
+        return True, False
+    if isinstance(dec, ast.Call):
+        if _is_jit_ref(dec.func):
+            return True, any(k.arg in _DONATE_KWARGS
+                             for k in dec.keywords)
+        if _dotted(dec.func) in _PARTIAL_NAMES and dec.args \
+                and _is_jit_ref(dec.args[0]):
+            return True, any(k.arg in _DONATE_KWARGS
+                             for k in dec.keywords)
+    return False, False
+
+
+def _first_param(fn):
+    args = fn.args.posonlyargs + fn.args.args
+    return args[0].arg if args else None
+
+
+def _loop_targets(fn) -> set:
+    """Names bound as for-loop targets directly in ``fn`` (not in
+    functions nested inside it)."""
+    out = set()
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.For):
+                for t in ast.walk(child.target):
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+            visit(child)
+
+    visit(fn)
+    return out
+
+
+def _bound_names(fn) -> set:
+    """Names the function itself binds (params, assignments, loops) —
+    loads of these are NOT closure captures."""
+    out = set()
+    a = fn.args
+    for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else [])):
+        out.add(arg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            out.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            out.add(node.name)
+    return out
+
+
+class ModuleLinter:
+    def __init__(self, text: str, path: str):
+        self.mod = _Module(text, path)
+        self.findings = []
+        self._emitted = set()
+
+    # ------------------------------------------------------------ plumbing
+    def _add(self, rule: str, message: str, line: int):
+        if self.mod.is_suppressed(rule, line):
+            return
+        # nested jit regions overlap (the outer region's walk includes
+        # the inner root's body) — identical findings collapse to one
+        key = (rule, line, message)
+        if key in self._emitted:
+            return
+        self._emitted.add(key)
+        self.findings.append(Finding(
+            rule, message, path=self.mod.path, line=line))
+
+    # ------------------------------------------------------------ jit roots
+    def _resolve_name(self, call, name):
+        """The FunctionDef ``jax.jit(<name>)`` would bind at ``call``:
+        among same-named defs, only those whose defining scope encloses
+        the call are visible; the innermost such scope wins (plain
+        lexical scoping — a same-named def elsewhere in the module must
+        NOT be marked as a jit region)."""
+        call_chain = self.mod.enclosing_functions(call)  # innermost first
+        visible = []
+        for node in ast.walk(self.mod.tree):
+            if not (isinstance(node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                    and node.name == name):
+                continue
+            defining = self.mod.enclosing_functions(node)
+            scope = defining[0] if defining else None  # None = module
+            if scope is None:
+                visible.append((len(call_chain) + 1, node))
+            elif scope in call_chain:
+                visible.append((call_chain.index(scope), node))
+        if not visible:
+            return None
+        return min(visible, key=lambda entry: entry[0])[1]
+
+    def _jit_roots(self):
+        """[(fn_or_lambda, has_donate, anchor_node)] — every function
+        the module jits, via decorator, named call or inline lambda."""
+        roots = []
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    is_jit, has_donate = _decorator_jit(dec)
+                    if is_jit:
+                        roots.append((node, has_donate, node))
+            elif isinstance(node, ast.Call) and _is_jit_ref(node.func) \
+                    and node.args:
+                target = node.args[0]
+                has_donate = any(k.arg in _DONATE_KWARGS
+                                 for k in node.keywords)
+                if isinstance(target, ast.Lambda):
+                    roots.append((target, has_donate, node))
+                elif isinstance(target, ast.Name):
+                    fn = self._resolve_name(node, target.id)
+                    if fn is not None:
+                        roots.append((fn, has_donate, node))
+        return roots
+
+    # --------------------------------------------------------------- rules
+    def _check_region(self, fn):
+        """Host-sync / debug rules over one jit region (the function and
+        everything nested in it)."""
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == 'item' and not node.args:
+                self._add('jax-host-item',
+                          "'.item()' forces a device->host sync inside "
+                          "a jit region", node.lineno)
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in ('float', 'int', 'bool') \
+                    and len(node.args) == 1 \
+                    and not isinstance(node.args[0], ast.Constant):
+                self._add('jax-host-cast',
+                          f"'{node.func.id}()' on a traced value inside "
+                          f"a jit region", node.lineno)
+            elif dotted and '.' in dotted \
+                    and dotted.split('.')[0] in self.mod.numpy_aliases \
+                    and dotted.split('.')[-1] in _NUMPY_SYNC_ATTRS:
+                self._add('jax-host-numpy',
+                          f"'{dotted}' materializes on host inside a "
+                          f"jit region — use jnp", node.lineno)
+            elif dotted in _DEBUG_CALLS:
+                self._add('jax-debug-print',
+                          f"'{dotted}' left inside a jit region",
+                          node.lineno)
+
+    def _check_scalar_closure(self, fn):
+        if isinstance(fn, ast.Lambda):
+            return
+        loop_vars = set()
+        for enc in self.mod.enclosing_functions(fn):
+            loop_vars |= _loop_targets(enc)
+        if not loop_vars:
+            return
+        captured = loop_vars - _bound_names(fn)
+        if not captured:
+            return
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id in captured \
+                    and isinstance(node.ctx, ast.Load):
+                self._add(
+                    'jax-scalar-closure',
+                    f"jitted '{fn.name}' captures loop variable "
+                    f"'{node.id}' — baked at trace time",
+                    node.lineno)
+                captured.discard(node.id)
+                if not captured:
+                    break
+
+    def _check_donate(self, fn, has_donate, anchor):
+        if has_donate or isinstance(fn, ast.Lambda):
+            return
+        first = _first_param(fn)
+        if first not in _STATE_PARAMS:
+            return
+        names = [fn.name] + [f.name for f in
+                             self.mod.enclosing_functions(anchor)]
+        if not any('train' in n for n in names):
+            return
+        self._add(
+            'jax-donate',
+            f"train-step jit of '{fn.name}' carries '{first}' without "
+            f"donate_argnums", fn.lineno)
+
+    def _check_jit_in_loop(self):
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # decorator form: @jax.jit on a def inside a loop body
+                if not any(_decorator_jit(d)[0]
+                           for d in node.decorator_list):
+                    continue
+            elif not (isinstance(node, ast.Call)
+                      and _is_jit_ref(node.func)):
+                continue
+            cur = self.mod.parent.get(node)
+            while cur is not None and not isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.Module)):
+                if isinstance(cur, (ast.For, ast.While)):
+                    self._add('jax-jit-in-loop',
+                              'jax.jit called inside a loop retraces '
+                              'every iteration', node.lineno)
+                    break
+                cur = self.mod.parent.get(cur)
+
+    # ---------------------------------------------------------------- main
+    def run(self):
+        # group by function: a fn both decorated and re-jitted by name
+        # is ONE region and gets ONE donate verdict (donated anywhere
+        # counts — no duplicate findings)
+        grouped = {}
+        for fn, has_donate, anchor in self._jit_roots():
+            entry = grouped.setdefault(id(fn), [fn, has_donate, anchor])
+            entry[1] = entry[1] or has_donate
+        for fn, has_donate, anchor in grouped.values():
+            self._check_donate(fn, has_donate, anchor)
+            self._check_region(fn)
+            self._check_scalar_closure(fn)
+        self._check_jit_in_loop()
+        self.findings.sort(key=lambda f: (f.path or '', f.line or 0))
+        return self.findings
+
+
+def lint_source(text: str, path: str = '<string>') -> list:
+    try:
+        return ModuleLinter(text, path).run()
+    except SyntaxError:
+        # unparsable user code cannot be linted; the AST import path
+        # skips it too, so resolution rules already cover the fallout
+        return []
+
+
+def lint_sources(sources: dict) -> list:
+    out = []
+    for path in sorted(sources):
+        out.extend(lint_source(sources[path], path))
+    return out
+
+
+def lint_paths(paths) -> list:
+    out = []
+    for path in paths:
+        try:
+            with open(path, encoding='utf-8', errors='ignore') as fh:
+                out.extend(lint_source(fh.read(), path))
+        except OSError:
+            continue
+    return out
+
+
+def package_py_files():
+    """Every .py in the installed mlcomp_tpu package (self-lint scope)."""
+    import mlcomp_tpu
+    root = os.path.dirname(os.path.abspath(mlcomp_tpu.__file__))
+    out = []
+    for dirpath, dirs, files in os.walk(root):
+        dirs[:] = [d for d in dirs if d != '__pycache__']
+        out.extend(os.path.join(dirpath, f) for f in files
+                   if f.endswith('.py'))
+    return sorted(out)
+
+
+def self_lint() -> list:
+    """Lint mlcomp_tpu/ itself — the framework is the first customer."""
+    return lint_paths(package_py_files())
+
+
+__all__ = ['lint_source', 'lint_sources', 'lint_paths', 'self_lint',
+           'package_py_files', 'ModuleLinter', 'parse_suppressions']

@@ -181,7 +181,14 @@ def _v4_telemetry(session: Session):
             session.execute(stmt)
 
 
-MIGRATIONS = [_v1_init, _v2_data, _v3_auth, _v4_telemetry]
+def _v5_preflight(session: Session):
+    """dag_preflight table (static-analysis subsystem, analysis/)."""
+    from mlcomp_tpu.db.models import DagPreflight
+    for stmt in DagPreflight.create_table_ddl():    # IF NOT EXISTS — safe
+        session.execute(stmt)
+
+
+MIGRATIONS = [_v1_init, _v2_data, _v3_auth, _v4_telemetry, _v5_preflight]
 
 
 def migrate(session: Session = None):

@@ -1,7 +1,7 @@
 """DB schema: declarative models (parity: reference db/models/__init__.py:1-19)."""
 
 from mlcomp_tpu.db.models.project import Project
-from mlcomp_tpu.db.models.dag import Dag
+from mlcomp_tpu.db.models.dag import Dag, DagPreflight
 from mlcomp_tpu.db.models.task import Task, TaskDependence, TaskSynced
 from mlcomp_tpu.db.models.computer import Computer, ComputerUsage
 from mlcomp_tpu.db.models.docker import Docker
@@ -21,7 +21,7 @@ ALL_MODELS = [
     Project, Report, ReportLayout, Dag, Task, TaskDependence, TaskSynced,
     Computer, ComputerUsage, Docker, File, DagStorage, DagLibrary, Log, Step,
     ReportImg, ReportSeries, ReportTasks, Model, Auxiliary, QueueMessage,
-    WorkerToken, DbAudit, Metric, TelemetrySpan,
+    WorkerToken, DbAudit, Metric, TelemetrySpan, DagPreflight,
 ]
 
 __all__ = [m.__name__ for m in ALL_MODELS] + ['ALL_MODELS']

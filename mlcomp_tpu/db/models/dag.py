@@ -1,4 +1,5 @@
-"""Dag model (parity: reference db/models/dag.py:9-24)."""
+"""Dag model (parity: reference db/models/dag.py:9-24) + preflight
+findings recorded against a dag."""
 
 from mlcomp_tpu.db.core import Column, DBModel
 
@@ -16,3 +17,24 @@ class Dag(DBModel):
     file_size = Column('INTEGER', default=0)
     type = Column('INTEGER', default=0)       # DagType
     report = Column('INTEGER')                # Report.id
+
+
+class DagPreflight(DBModel):
+    """One static-analysis finding stored against a dag
+    (mlcomp_tpu/analysis/). The submit gate stores warnings (errors
+    reject the dag before any row exists); the supervisor stores the
+    errors that made it refuse dispatch of a dag submitted through a
+    path without the gate."""
+
+    __tablename__ = 'dag_preflight'
+
+    id = Column('INTEGER', primary_key=True)
+    dag = Column('INTEGER', foreign_key='dag.id', index=True,
+                 nullable=False)
+    time = Column('TEXT', dtype='datetime')
+    rule = Column('TEXT', nullable=False)     # findings.RULES id
+    severity = Column('TEXT', nullable=False)  # error|warning
+    path = Column('TEXT')                     # file or config path
+    line = Column('INTEGER')
+    message = Column('TEXT', nullable=False)
+    source = Column('TEXT', default='submit')  # submit|supervisor|api

@@ -2,7 +2,7 @@
 
 from mlcomp_tpu.db.providers.base import BaseDataProvider
 from mlcomp_tpu.db.providers.project import ProjectProvider
-from mlcomp_tpu.db.providers.dag import DagProvider
+from mlcomp_tpu.db.providers.dag import DagPreflightProvider, DagProvider
 from mlcomp_tpu.db.providers.task import TaskProvider
 from mlcomp_tpu.db.providers.computer import ComputerProvider
 from mlcomp_tpu.db.providers.docker import DockerProvider
@@ -28,7 +28,7 @@ from mlcomp_tpu.db.providers.telemetry import (
 
 __all__ = [
     'WorkerTokenProvider', 'DbAuditProvider',
-    'MetricProvider', 'TelemetrySpanProvider',
+    'MetricProvider', 'TelemetrySpanProvider', 'DagPreflightProvider',
     'BaseDataProvider', 'ProjectProvider', 'DagProvider', 'TaskProvider',
     'ComputerProvider', 'DockerProvider', 'FileProvider',
     'DagStorageProvider', 'DagLibraryProvider', 'LogProvider',

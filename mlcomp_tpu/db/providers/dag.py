@@ -1,7 +1,7 @@
 """Dag provider (parity: reference db/providers/dag.py:11-209)."""
 
 from mlcomp_tpu.db.enums import TaskStatus
-from mlcomp_tpu.db.models import Dag, Task
+from mlcomp_tpu.db.models import Dag, DagPreflight, Task
 from mlcomp_tpu.db.providers.base import BaseDataProvider, PaginatorOptions
 from mlcomp_tpu.utils.misc import duration_format
 
@@ -107,7 +107,52 @@ class DagProvider(BaseDataProvider):
         self.session.execute('DELETE FROM dag_storage WHERE dag=?', (dag_id,))
         self.session.execute('DELETE FROM dag_library WHERE dag=?', (dag_id,))
         self.session.execute('DELETE FROM file WHERE dag=?', (dag_id,))
+        self.session.execute(
+            'DELETE FROM dag_preflight WHERE dag=?', (dag_id,))
         self.session.execute('DELETE FROM dag WHERE id=?', (dag_id,))
 
 
-__all__ = ['DagProvider']
+class DagPreflightProvider(BaseDataProvider):
+    """Preflight findings stored against a dag (analysis/ subsystem)."""
+
+    model = DagPreflight
+
+    _INSERT = ('INSERT INTO dag_preflight '
+               '(dag, time, rule, severity, path, line, message, source) '
+               'VALUES (?, ?, ?, ?, ?, ?, ?, ?)')
+
+    def add_findings(self, dag_id: int, findings, source: str = 'submit'):
+        """Batch-store analysis Findings (analysis/findings.py)."""
+        from mlcomp_tpu.utils.misc import now
+        from mlcomp_tpu.db.core import adapt_value
+        ts = adapt_value(now())
+        rows = [(int(dag_id), ts, f.rule, f.severity, f.path, f.line,
+                 f.message, source) for f in findings]
+        if rows:
+            self.session.executemany(self._INSERT, rows)
+        return len(rows)
+
+    def by_dag(self, dag_id: int) -> list:
+        rows = self.session.query(
+            'SELECT * FROM dag_preflight WHERE dag=? '
+            'ORDER BY CASE severity WHEN \'error\' THEN 0 ELSE 1 END, id',
+            (int(dag_id),))
+        return [self.model.from_row(r) for r in rows]
+
+    def has_errors(self, dag_id: int) -> bool:
+        row = self.session.query_one(
+            'SELECT COUNT(*) AS c FROM dag_preflight '
+            'WHERE dag=? AND severity=?', (int(dag_id), 'error'))
+        return bool(row and row['c'])
+
+    def clear(self, dag_id: int, source: str = None):
+        if source is None:
+            self.session.execute(
+                'DELETE FROM dag_preflight WHERE dag=?', (int(dag_id),))
+        else:
+            self.session.execute(
+                'DELETE FROM dag_preflight WHERE dag=? AND source=?',
+                (int(dag_id), source))
+
+
+__all__ = ['DagProvider', 'DagPreflightProvider']

@@ -407,6 +407,66 @@ def api_dag_remove(data, s):
     return {'success': True}
 
 
+#: dag id -> live-engine report (errors/warnings dicts). A dag's config
+#: + code snapshot are immutable after submit, so the AST re-analysis is
+#: the same on every dag-detail view; "stored" rows are NOT cached (the
+#: supervisor may append findings later). Bounded FIFO.
+_PREFLIGHT_CACHE = {}
+_PREFLIGHT_CACHE_MAX = 256
+
+
+def api_dag_preflight(data, s):
+    """Static-analysis report for a DAG (analysis/). Two modes:
+
+    - ``{'id': dag_id}``: run the DAG engine against the STORED config
+      + code snapshot (cached — both are immutable after submit), and
+      return findings recorded at submit/dispatch time alongside
+    - ``{'config': yaml_text}``: preflight a config body that was never
+      submitted (dashboard dry-run)
+    """
+    from mlcomp_tpu.analysis import (
+        preflight_config, snapshot_sources, split_findings,
+    )
+    if data.get('id') is not None:
+        dag_id = _int_arg(data, 'id', required=True)
+        dag = DagProvider(s).by_id(dag_id)
+        if dag is None:
+            raise ApiError('dag not found', status=404)
+        cached = _PREFLIGHT_CACHE.get(dag_id)
+        if cached is None:
+            config = yaml_load(dag.config) if dag.config else {}
+            # lint=False: the submit gate already stored the snapshot's
+            # lint warnings (returned below) — re-linting every view
+            # would repeat the AST work and duplicate each warning
+            findings = preflight_config(
+                config, sources=snapshot_sources(s, dag_id), lint=False)
+            errors, warnings = split_findings(findings)
+            cached = {'ok': not errors,
+                      'errors': [f.to_dict() for f in errors],
+                      'warnings': [f.to_dict() for f in warnings]}
+            while len(_PREFLIGHT_CACHE) >= _PREFLIGHT_CACHE_MAX:
+                _PREFLIGHT_CACHE.pop(next(iter(_PREFLIGHT_CACHE)))
+            _PREFLIGHT_CACHE[dag_id] = cached
+        from mlcomp_tpu.db.providers import DagPreflightProvider
+        stored = [r.to_dict() for r in
+                  DagPreflightProvider(s).by_dag(dag_id)]
+        return {'dag': dag_id, 'stored': stored, **cached}
+    if data.get('config'):
+        try:
+            config = yaml_load(data['config'])
+        except Exception as e:
+            raise ApiError(f'config does not parse: {e}')
+        errors, warnings = split_findings(preflight_config(config))
+        return {
+            'dag': None,
+            'ok': not errors,
+            'errors': [f.to_dict() for f in errors],
+            'warnings': [f.to_dict() for f in warnings],
+            'stored': [],
+        }
+    raise ApiError('id or config required')
+
+
 def api_dag_toggle_report(data, s):
     """Attach/detach every train task of a dag to a report
     (reference app.py:561-572)."""
@@ -734,6 +794,7 @@ _ROUTES = {
     '/api/dag/stop': (api_dag_stop, True),
     '/api/dag/start': (api_dag_start, True),
     '/api/dag/remove': (api_dag_remove, True),
+    '/api/dag/preflight': (api_dag_preflight, True),
     '/api/dag/toogle_report': (api_dag_toggle_report, True),
     '/api/task/toogle_report': (api_task_toggle_report, True),
     '/api/auxiliary': (api_auxiliary, False),
@@ -764,7 +825,8 @@ _READ_ONLY_ROUTES = frozenset({
     '/api/report/add_start', '/api/models', '/api/model/start_begin',
     '/api/img_classify', '/api/img_segment', '/api/config', '/api/graph',
     '/api/dags', '/api/code', '/api/tasks', '/api/task/info',
-    '/api/task/steps', '/api/auxiliary', '/api/logs', '/api/reports',
+    '/api/task/steps', '/api/dag/preflight', '/api/auxiliary',
+    '/api/logs', '/api/reports',
     '/api/report', '/api/report/update_layout_start',
     '/api/telemetry/series', '/api/telemetry/spans',
 })

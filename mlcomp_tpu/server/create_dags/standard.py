@@ -39,16 +39,26 @@ def parse_cores(value):
     return lo, hi
 
 
+from mlcomp_tpu.analysis import PreflightError  # noqa: E402 — re-export
+
+
 class DagStandardBuilder:
     def __init__(self, session, config: dict, debug: bool = False,
                  config_text: str = None, upload_folder: str = None,
-                 logger=None, component=None):
+                 logger=None, component=None, preflight: bool = False,
+                 preflight_params: dict = None, preflight_warnings=None):
         self.session = session
         self.config = config
         self.debug = debug
         self.config_text = config_text
         self.upload_folder = upload_folder
         self.logger = logger
+        self.preflight = preflight
+        self.preflight_params = preflight_params
+        # warnings from a gate the CALLER already ran (the CLI gates the
+        # raw config before merging --params); stored with the dag row
+        # by the same path run_preflight's own findings take
+        self.preflight_warnings = list(preflight_warnings or [])
 
         self.info = config.get('info', {})
         self.project_provider = ProjectProvider(session)
@@ -224,11 +234,33 @@ class DagStandardBuilder:
                     report=report.id, task=task.id))
         return task
 
+    # ----------------------------------------------------------- preflight
+    def run_preflight(self):
+        """Static analysis BEFORE any DB write: errors reject the
+        submission (PreflightError), warnings are kept and stored with
+        the dag row once it exists (store_preflight_warnings). Same
+        gate_config policy the CLI submit path applies."""
+        from mlcomp_tpu.analysis import folder_sources, gate_config
+        sources = folder_sources(self.upload_folder) \
+            if self.upload_folder else None
+        self.preflight_warnings = self.preflight_warnings + gate_config(
+            self.config, sources=sources, params=self.preflight_params)
+
+    def store_preflight_warnings(self):
+        if not self.preflight_warnings:
+            return
+        from mlcomp_tpu.db.providers import DagPreflightProvider
+        DagPreflightProvider(self.session).add_findings(
+            self.dag.id, self.preflight_warnings, source='submit')
+
     # --------------------------------------------------------------- build
     def build(self):
+        if self.preflight:
+            self.run_preflight()
         self.load_base()
         self.create_report()
         self.create_dag()
+        self.store_preflight_warnings()   # no-op when nothing gated
         self.upload()
         self.create_tasks()
         return self.dag, self.tasks
@@ -236,11 +268,15 @@ class DagStandardBuilder:
 
 def dag_standard(session, config: dict, debug: bool = False,
                  config_text: str = None, upload_folder: str = None,
-                 logger=None, component=None):
+                 logger=None, component=None, preflight: bool = False,
+                 preflight_params: dict = None, preflight_warnings=None):
     builder = DagStandardBuilder(
         session, config, debug=debug, config_text=config_text,
-        upload_folder=upload_folder, logger=logger, component=component)
+        upload_folder=upload_folder, logger=logger, component=component,
+        preflight=preflight, preflight_params=preflight_params,
+        preflight_warnings=preflight_warnings)
     return builder.build()
 
 
-__all__ = ['dag_standard', 'DagStandardBuilder', 'parse_cores']
+__all__ = ['dag_standard', 'DagStandardBuilder', 'PreflightError',
+           'parse_cores']

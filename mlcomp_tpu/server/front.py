@@ -649,9 +649,44 @@ function layerGraph(nodes, edges) {
   return svg + '</svg>';
 }
 
+function preflightCard(pf) {
+  // static-analysis report (POST /api/dag/preflight): live findings
+  // from the stored config+snapshot, plus what submit/dispatch recorded
+  const live = (pf.errors||[]).concat(pf.warnings||[]);
+  const sev = f => f.severity==='error'
+    ? '<span class="status s-Failed">error</span>'
+    : `<span class="status"
+        style="background:#3d3118;color:#d9a13c">warning</span>`;
+  const row = f => `<tr><td>${sev(f)}</td><td>${esc(f.rule)}</td>
+    <td class="dim">${esc(f.path||'')}${f.line?':'+f.line:''}</td>
+    <td>${esc(f.message)}</td></tr>`;
+  let html = `<h3>preflight ${pf.ok
+    ? '<span class="status s-Success">ok</span>'
+    : '<span class="status s-Failed">failing</span>'}</h3>`;
+  if (!live.length && !(pf.stored||[]).length)
+    return html + '<p class="dim">no findings</p>';
+  if (live.length)
+    html += `<table><tr><th></th><th>rule</th><th>where</th>
+      <th>message</th></tr>${live.map(row).join('')}</table>`;
+  if ((pf.stored||[]).length)
+    html += `<p class="dim">recorded earlier
+      (${esc(pf.stored.map(s=>s.source).filter((v,i,a)=>a.indexOf(v)===i)
+        .join(', '))}):</p>
+      <table><tr><th></th><th>rule</th><th>where</th><th>message</th></tr>
+      ${pf.stored.map(row).join('')}</table>`;
+  return html;
+}
 async function viewDagDetail(el, id) {
   const [g, cfg, code] = await Promise.all([
     api('graph',{id}), api('config',{id}), api('code',{id})]);
+  // sequential await (not in the Promise.all): the test interpreter's
+  // promises are plain values with no .catch, and a failure here must
+  // degrade to a note instead of killing the whole detail view
+  let pf = null;
+  try { pf = await api('dag/preflight',{id}); } catch(e) {}
+  // a handler error resolves to {success:false,...} (api() only throws
+  // on 401) — that is "report unavailable", not "preflight failing"
+  if (pf && pf.success === false) pf = null;
   el.appendChild(h(`<p><a href="#" onclick="detail=null;render();return false">
     &larr; back</a> &nbsp; <b>dag ${id}</b> &nbsp;
     <a href="/api/code_download?id=${id}&token=${encodeURIComponent(token)}"
@@ -666,6 +701,8 @@ async function viewDagDetail(el, id) {
       >remove files</button></p>`));
   el.appendChild(h('<div class="card" style="overflow:auto" id="dagraph">'
     + layerGraph(g.nodes, g.edges) + '</div>'));
+  el.appendChild(h('<div>'+(pf ? preflightCard(pf) :
+    '<h3>preflight</h3><p class="dim">report unavailable</p>')+'</div>'));
   el.appendChild(h('<h3>config</h3><pre>'+esc(cfg.data)+'</pre>'));
   const tree = (items) => '<div class="tree">' + items.map(it =>
     it.children.length ? `<div>&#128193; ${esc(it.name)}${tree(it.children)}</div>`

@@ -62,6 +62,9 @@ class SupervisorBuilder:
             session=self.session, component='supervisor',
             flush_every=60)
         self._last_claim_ts = now()
+        # dag id -> [error findings] ([] = passed); filled lazily the
+        # first time a NotRan task of that dag reaches placement
+        self._preflight_cache = {}
 
     # ----------------------------------------------------------- base state
     def create_base(self):
@@ -414,14 +417,65 @@ class SupervisorBuilder:
                  'cores': cores, 'rank': rank})
         self.provider.change_status(task, TaskStatus.Queued)
 
+    # ------------------------------------------------------------ preflight
+    def dag_preflight_errors(self, dag_id: int) -> list:
+        """Error findings for a dag, computed once per supervisor
+        lifetime from the STORED config + code snapshot (analysis/).
+        The submit gate already rejects these, so anything caught here
+        arrived through a path without the gate (old client, direct DB
+        insert, /api/db) — refusing dispatch keeps a doomed task off a
+        scheduled TPU slot. Analyzer failures never block ([] on any
+        exception): preflight is a gate for bad DAGs, not a new single
+        point of failure for good ones."""
+        if dag_id in self._preflight_cache:
+            return self._preflight_cache[dag_id]
+        errors = []
+        try:
+            from mlcomp_tpu.analysis import (
+                preflight_config, snapshot_sources, split_findings,
+            )
+            dag = self.dag_provider.by_id(dag_id)
+            config = yaml_load(dag.config) if dag and dag.config else None
+            if isinstance(config, dict):
+                findings = preflight_config(
+                    config, sources=snapshot_sources(self.session, dag_id),
+                    lint=False)
+                errors, _ = split_findings(findings)
+            if errors:
+                from mlcomp_tpu.db.providers import DagPreflightProvider
+                provider = DagPreflightProvider(self.session)
+                provider.clear(dag_id, source='supervisor')
+                provider.add_findings(dag_id, errors, source='supervisor')
+                if self.logger:
+                    self.logger.error(
+                        f'dag {dag_id} failed preflight; refusing to '
+                        f'dispatch its tasks: '
+                        + '; '.join(f'[{f.rule}] {f.message}'
+                                    for f in errors),
+                        ComponentType.Supervisor)
+        except Exception:
+            errors = []
+            if self.logger:
+                self.logger.error(
+                    f'preflight of dag {dag_id} crashed (not blocking):\n'
+                    f'{traceback.format_exc()}', ComponentType.Supervisor)
+        self._preflight_cache[dag_id] = errors
+        return errors
+
     def process_tasks(self):
-        """Dependency gating then placement
+        """Preflight + dependency gating then placement
         (reference supervisor.py:319-340)."""
         bad = {int(TaskStatus.Failed), int(TaskStatus.Stopped),
                int(TaskStatus.Skipped)}
         unfinished = {int(TaskStatus.NotRan), int(TaskStatus.Queued),
                       int(TaskStatus.InProgress)}
         for task in self.tasks:
+            preflight_errors = self.dag_preflight_errors(task.dag)
+            if preflight_errors:
+                self.provider.change_status(task, TaskStatus.Skipped)
+                self.aux.setdefault('preflight_blocked', {})[task.id] = [
+                    f'[{f.rule}] {f.message}' for f in preflight_errors]
+                continue
             deps = self.dep_status.get(task.id, set())
             if deps & bad:
                 self.provider.change_status(task, TaskStatus.Skipped)

@@ -182,6 +182,9 @@ class MetricRecorder:
                              float(np.asarray(value)), ts,
                              self.component, None))
             except (TypeError, ValueError):
+                # e.g. an unreduced per-device array: the sample is
+                # unusable, but its loss must still be visible
+                self.dropped_count += 1
                 continue
         for name, total in counters.items():
             rows.append((self.task, name, 'counter', None, float(total),

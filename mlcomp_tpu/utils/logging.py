@@ -9,6 +9,7 @@ truncated to 16,000 chars (reference utils/logging.py:93).
 import logging
 import os
 import socket
+import threading
 import traceback
 from logging.handlers import RotatingFileHandler
 
@@ -112,7 +113,7 @@ class _Logger(logging.Logger):
 
 
 _loggers = {}
-_loggers_lock = __import__('threading').Lock()
+_loggers_lock = threading.Lock()
 
 
 def create_logger(session=None, name: str = 'mlcomp_tpu'):

@@ -15,6 +15,7 @@ a persistent worker keeps the device client alive between tasks when
 ``exit=False``.
 """
 
+import importlib
 import json
 import os
 import sys
@@ -137,9 +138,11 @@ class ExecuteBuilder:
             .get(executor_name, {})
             .get('type', executor_name))
         self.storage.import_executor(folder, executor_type)
-        self.executor = __import__(
-            'mlcomp_tpu.worker.executors', fromlist=['Executor']
-        ).Executor.from_config(
+        # deferred import: the executors package is only pulled once the
+        # task actually runs (import_module, not dotted __import__ whose
+        # return value is the top-level package)
+        executors = importlib.import_module('mlcomp_tpu.worker.executors')
+        self.executor = executors.Executor.from_config(
             executor_name, config, additional_info=info,
             session=self.session, logger=self.logger)
 

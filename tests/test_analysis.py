@@ -1,0 +1,344 @@
+"""Static-analysis subsystem (mlcomp_tpu/analysis/): the DAG preflight
+engine, the JAX hot-path linter, and the four wiring layers (CLI gate,
+dag builder, API endpoint, supervisor refusal).
+
+Acceptance contract: ``mlcomp_tpu check`` exits non-zero with
+rule-tagged findings on every config in tests/configs/broken/, zero on
+every shipped examples/ config, and the self-lint of mlcomp_tpu/ itself
+is clean.
+"""
+
+import glob
+import os
+
+import pytest
+
+from mlcomp_tpu.analysis import (
+    folder_sources, format_report, preflight_config, split_findings,
+)
+from mlcomp_tpu.analysis.jax_lint import lint_source, self_lint
+from mlcomp_tpu.utils.io import yaml_load
+
+TESTS_DIR = os.path.dirname(__file__)
+BROKEN_DIR = os.path.join(TESTS_DIR, 'configs', 'broken')
+EXAMPLES_DIR = os.path.join(TESTS_DIR, '..', 'examples')
+
+#: corpus file -> rule id its preflight report must contain
+BROKEN_EXPECTED = {
+    'unknown_executor.yml': 'dag-executor-unknown',
+    'cycle.yml': 'dag-cycle',
+    'oversized_mesh.yml': 'dag-mesh',
+    'ambiguous_override.yml': 'dag-ambiguous-override',
+    'dangling_depends.yml': 'dag-depends-unknown',
+}
+
+
+def _preflight_file(path, **kw):
+    return preflight_config(yaml_load(file=path), **kw)
+
+
+class TestBrokenCorpus:
+    def test_corpus_is_complete(self):
+        files = {os.path.basename(p)
+                 for p in glob.glob(os.path.join(BROKEN_DIR, '*.yml'))}
+        assert files == set(BROKEN_EXPECTED)
+
+    @pytest.mark.parametrize('name,rule', sorted(BROKEN_EXPECTED.items()))
+    def test_broken_config_reports_rule(self, name, rule):
+        findings = _preflight_file(os.path.join(BROKEN_DIR, name))
+        errors, _ = split_findings(findings)
+        assert rule in {f.rule for f in errors}, format_report(findings)
+
+    @pytest.mark.parametrize('name', sorted(BROKEN_EXPECTED))
+    def test_check_cli_exits_nonzero(self, name):
+        from click.testing import CliRunner
+        from mlcomp_tpu.__main__ import main
+        result = CliRunner().invoke(
+            main, ['check', os.path.join(BROKEN_DIR, name)])
+        assert result.exit_code != 0
+        assert BROKEN_EXPECTED[name] in result.output
+
+
+class TestExamplesPassPreflight:
+    CONFIGS = sorted(glob.glob(os.path.join(EXAMPLES_DIR, '*', '*.yml')))
+
+    @pytest.mark.parametrize(
+        'path', CONFIGS,
+        ids=['/'.join(p.split(os.sep)[-2:]) for p in CONFIGS])
+    def test_example_has_no_errors(self, path):
+        findings = _preflight_file(
+            path, sources=folder_sources(os.path.dirname(path)))
+        errors, _ = split_findings(findings)
+        assert not errors, format_report(errors)
+
+    def test_check_cli_exits_zero(self):
+        from click.testing import CliRunner
+        from mlcomp_tpu.__main__ import main
+        path = os.path.join(EXAMPLES_DIR, 'cifar10', 'config.yml')
+        result = CliRunner().invoke(main, ['check', path])
+        assert result.exit_code == 0, result.output
+
+
+class TestDagPreflightRules:
+    def test_params_ambiguity_is_rule_tagged(self):
+        config = {
+            'info': {'name': 'x', 'project': 'p'},
+            'executors': {
+                'a': {'type': 'valid_classify', 'y': '1',
+                      'opt': {'lr': 0.1}},
+                'b': {'type': 'valid_classify', 'y': '1',
+                      'opt': {'lr': 0.2}},
+            },
+        }
+        findings = preflight_config(config, params={'lr': 0.5})
+        assert 'dag-ambiguous-override' in {f.rule for f in findings}
+
+    def test_snapshot_class_resolves_executor(self):
+        config = {'info': {'name': 'x', 'project': 'p'},
+                  'executors': {'job': {'type': 'my_custom_thing'}}}
+        bad = preflight_config(config)
+        assert 'dag-executor-unknown' in {f.rule for f in bad}
+        ok = preflight_config(config, sources={
+            'executors.py': 'class MyCustomThing:\n    pass\n'})
+        assert 'dag-executor-unknown' not in {f.rule for f in ok}
+
+    def test_in_process_registry_resolves(self):
+        """A class registered via @Executor.register counts, matching
+        the worker's import semantics."""
+        from mlcomp_tpu.worker.executors import Executor
+
+        @Executor.register
+        class PreflightProbeExec(Executor):  # noqa
+            def work(self):
+                return {}
+
+        config = {'info': {'name': 'x', 'project': 'p'},
+                  'executors': {'j': {'type': 'preflight_probe_exec'}}}
+        assert not [f for f in preflight_config(config) if f.is_error]
+
+    def test_missing_project_and_bad_cores(self):
+        config = {'executors': {
+            'a': {'type': 'valid_classify', 'y': '1', 'cores': '4-2'}}}
+        rules = {f.rule for f in preflight_config(config)}
+        assert 'dag-project-missing' in rules
+        assert 'dag-cores' in rules
+
+    def test_pipes_config_skipped(self):
+        assert preflight_config({'pipes': {'p': {}}}) == []
+
+    def test_non_dict_config(self):
+        findings = preflight_config('not a dict')
+        assert [f.rule for f in findings] == ['dag-config']
+
+
+LINT_FIXTURE = '''
+import jax
+import numpy as np
+
+@jax.jit
+def train_step(state, x):
+    y = float(x.sum())
+    z = x.item()
+    w = np.asarray(x)
+    jax.debug.print("x={}", x)
+    return state
+
+def make_outer():
+    for lr in [0.1, 0.2]:
+        @jax.jit
+        def step(state, x):
+            return state * lr
+    return step
+'''
+
+
+class TestJaxLint:
+    def test_all_rules_fire(self):
+        rules = {f.rule for f in lint_source(LINT_FIXTURE, 'fix.py')}
+        assert rules == {
+            'jax-donate', 'jax-host-cast', 'jax-host-item',
+            'jax-host-numpy', 'jax-debug-print', 'jax-scalar-closure',
+            'jax-jit-in-loop'}
+
+    def test_findings_carry_location_and_why(self):
+        f = lint_source(LINT_FIXTURE, 'fix.py')[0]
+        assert f.path == 'fix.py' and f.line
+        assert f.why
+        assert f.rule in f.format()
+
+    def test_outside_jit_not_flagged(self):
+        src = ('import numpy as np\n'
+               'def host_side(x):\n'
+               '    return float(np.asarray(x).item())\n')
+        assert lint_source(src) == []
+
+    def test_named_jit_call_form(self):
+        src = ('import jax\n'
+               'def make_train_step():\n'
+               '    def step(state):\n'
+               '        return state.item()\n'
+               '    return jax.jit(step)\n')
+        rules = {f.rule for f in lint_source(src)}
+        assert 'jax-host-item' in rules
+        assert 'jax-donate' in rules  # enclosing name has "train"
+
+    def test_donate_satisfied(self):
+        src = ('import jax\n'
+               'def make_train_step():\n'
+               '    def step(state):\n'
+               '        return state\n'
+               '    return jax.jit(step, donate_argnums=(0,))\n')
+        assert lint_source(src) == []
+
+    def test_eval_step_not_donate_flagged(self):
+        """Eval steps reuse their state — no donation wanted."""
+        src = ('import jax\n'
+               'def make_eval_step():\n'
+               '    def step(state, x):\n'
+               '        return state\n'
+               '    return jax.jit(step)\n')
+        assert lint_source(src) == []
+
+    def test_suppression_same_line(self):
+        src = ('import jax\n'
+               '@jax.jit\n'
+               'def f(x):\n'
+               '    return x.item()  # preflight: disable=jax-host-item\n')
+        assert lint_source(src) == []
+
+    def test_suppression_line_above(self):
+        src = ('import jax\n'
+               '@jax.jit\n'
+               'def f(x):\n'
+               '    # preflight: disable=all\n'
+               '    return x.item()\n')
+        assert lint_source(src) == []
+
+    def test_suppression_wrong_rule_keeps_finding(self):
+        src = ('import jax\n'
+               '@jax.jit\n'
+               'def f(x):\n'
+               '    return x.item()  # preflight: disable=jax-donate\n')
+        assert [f.rule for f in lint_source(src)] == ['jax-host-item']
+
+    def test_syntax_error_is_silent(self):
+        assert lint_source('def broken(:', 'b.py') == []
+
+    def test_self_lint_clean(self):
+        """The framework is the linter's first customer: every finding
+        in mlcomp_tpu/ is fixed or carries an inline suppression."""
+        findings = self_lint()
+        assert not findings, format_report(findings)
+
+
+class TestBuilderGate:
+    def test_errors_reject_before_any_insert(self, session):
+        from mlcomp_tpu.server.create_dags.standard import (
+            PreflightError, dag_standard,
+        )
+        config = {'info': {'name': 'x', 'project': 'p_gate'},
+                  'executors': {'a': {'type': 'definitely_missing'}}}
+        with pytest.raises(PreflightError) as err:
+            dag_standard(session, config, preflight=True)
+        assert any(f.rule == 'dag-executor-unknown'
+                   for f in err.value.findings)
+        row = session.query_one('SELECT COUNT(*) AS c FROM dag')
+        assert row['c'] == 0
+
+    def test_warnings_stored_with_dag_row(self, session, tmp_path):
+        from mlcomp_tpu.db.providers import DagPreflightProvider
+        from mlcomp_tpu.server.create_dags.standard import dag_standard
+        folder = tmp_path / 'exp'
+        folder.mkdir()
+        (folder / 'executors.py').write_text(
+            'import jax\n'
+            'from mlcomp_tpu.worker.executors import Executor\n'
+            '@Executor.register\n'
+            'class LeakyDebug(Executor):\n'
+            '    def work(self):\n'
+            '        @jax.jit\n'
+            '        def step(x):\n'
+            '            jax.debug.print("{}", x)\n'
+            '            return x\n'
+            '        return {}\n')
+        config = {'info': {'name': 'x', 'project': 'p_gate2'},
+                  'executors': {'j': {'type': 'leaky_debug'}}}
+        dag, _ = dag_standard(session, config, preflight=True,
+                              upload_folder=str(folder))
+        rows = DagPreflightProvider(session).by_dag(dag.id)
+        assert [r.rule for r in rows] == ['jax-debug-print']
+        assert rows[0].severity == 'warning'
+        assert not DagPreflightProvider(session).has_errors(dag.id)
+
+
+class TestApiEndpoint:
+    def test_preflight_by_dag_id(self, session):
+        from mlcomp_tpu.server.api import api_dag_preflight
+        from mlcomp_tpu.server.create_dags.standard import dag_standard
+        config = {'info': {'name': 'x', 'project': 'p_api'},
+                  'executors': {'v': {'type': 'valid_classify',
+                                      'y': '1'}}}
+        dag, _ = dag_standard(session, config)
+        out = api_dag_preflight({'id': dag.id}, session)
+        assert out['ok'] and out['errors'] == []
+
+    def test_preflight_config_dry_run(self, session):
+        from mlcomp_tpu.server.api import api_dag_preflight
+        out = api_dag_preflight(
+            {'config': 'info: {project: p}\n'
+                       'executors:\n  a: {type: zzz, depends: ghost}\n'},
+            session)
+        assert not out['ok']
+        rules = {e['rule'] for e in out['errors']}
+        assert {'dag-executor-unknown', 'dag-depends-unknown'} <= rules
+
+    def test_missing_dag_404(self, session):
+        from mlcomp_tpu.server.api import ApiError, api_dag_preflight
+        with pytest.raises(ApiError):
+            api_dag_preflight({'id': 424242}, session)
+
+
+class TestSupervisorRefusal:
+    def test_bad_dag_tasks_skipped_not_dispatched(self, session):
+        """A dag inserted around the submit gate (old client, raw DB
+        write) is caught at dispatch: tasks -> Skipped, findings stored."""
+        from mlcomp_tpu.db.enums import TaskStatus
+        from mlcomp_tpu.db.models import Dag, Task
+        from mlcomp_tpu.db.providers import (
+            DagPreflightProvider, ProjectProvider, TaskProvider,
+        )
+        from mlcomp_tpu.server.supervisor import SupervisorBuilder
+        from mlcomp_tpu.utils.misc import now
+
+        p = ProjectProvider(session).add_project('p_refuse')
+        dag = Dag(name='bad', project=p.id, created=now(),
+                  config='info: {project: p_refuse}\n'
+                         'executors:\n  job: {type: not_real}\n')
+        session.add(dag)
+        task = Task(name='job', executor='job', dag=dag.id,
+                    status=int(TaskStatus.NotRan), last_activity=now())
+        TaskProvider(session).add(task)
+
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        refreshed = TaskProvider(session).by_id(task.id)
+        assert refreshed.status == int(TaskStatus.Skipped)
+        assert task.id in sup.aux.get('preflight_blocked', {})
+        assert DagPreflightProvider(session).has_errors(dag.id)
+
+    def test_good_dag_unaffected(self, session):
+        from mlcomp_tpu.db.enums import TaskStatus
+        from mlcomp_tpu.db.providers import TaskProvider
+        from mlcomp_tpu.server.create_dags.standard import dag_standard
+        from mlcomp_tpu.server.supervisor import SupervisorBuilder
+        from test_supervisor import add_computer
+
+        config = {'info': {'name': 'ok', 'project': 'p_refuse2'},
+                  'executors': {'noop_exec': {'type': 'noop_exec'}}}
+        dag, tasks = dag_standard(session, config)
+        add_computer(session)
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        refreshed = TaskProvider(session).by_id(tasks['noop_exec'][0])
+        assert refreshed.status == int(TaskStatus.Queued)
+        assert not sup.aux.get('preflight_blocked')

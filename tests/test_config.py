@@ -57,6 +57,68 @@ class TestMergeDictsSmart:
         assert out['model']['num_classes'] == 10
 
 
+class TestMergeDictsSmartErrorPaths:
+    """The load-bearing failure semantics grid search and --params
+    depend on (and the preflight dag-ambiguous-override rule dry-runs):
+    ambiguity raises, unmatched keys re-anchor, nested sources expand
+    leaf-by-leaf before matching."""
+
+    def test_ambiguous_error_lists_all_matches(self):
+        t = {'a': {'lr': 1}, 'b': {'lr': 2}, 'c': {'lr': 3}}
+        with pytest.raises(ValueError) as err:
+            merge_dicts_smart(t, {'lr': 9})
+        msg = str(err.value)
+        assert 'a/lr' in msg and 'b/lr' in msg and 'c/lr' in msg
+
+    def test_nested_source_expansion_can_be_ambiguous(self):
+        """A dict-valued source expands to suffix keys BEFORE matching,
+        so {'opt': {'lr': ...}} trips on two opt subtrees."""
+        t = {'warm': {'opt': {'lr': 0.1}}, 'main': {'opt': {'lr': 0.2}}}
+        with pytest.raises(ValueError, match='ambiguous'):
+            merge_dicts_smart(t, {'opt': {'lr': 0.5}})
+
+    def test_longer_suffix_still_ambiguous_raises(self):
+        t = {'x': {'opt': {'lr': 1}}, 'y': {'opt': {'lr': 2}}}
+        with pytest.raises(ValueError, match='ambiguous'):
+            merge_dicts_smart(t, {'opt/lr': 3})
+
+    def test_target_unchanged_shape_after_ambiguity(self):
+        """The ambiguity check happens before the write — rerunning
+        with a disambiguated path works on the same target."""
+        t = {'a': {'lr': 1}, 'b': {'lr': 2}}
+        with pytest.raises(ValueError):
+            merge_dicts_smart(t, {'lr': 9})
+        out = merge_dicts_smart(t, {'a/lr': 9})
+        assert out['a']['lr'] == 9 and out['b']['lr'] == 2
+
+    def test_unmatched_attaches_at_deepest_anchor(self):
+        """Two interior paths share the 'opt' suffix head — the deeper
+        one wins the re-anchor."""
+        t = {'train': {'stage': {'opt': {'lr': 0.1}}}}
+        out = merge_dicts_smart(t, {'opt/beta': 0.9})
+        assert out['train']['stage']['opt']['beta'] == 0.9
+        assert out['train']['stage']['opt']['lr'] == 0.1
+
+    def test_unmatched_without_anchor_lands_top_level(self):
+        t = {'model': {'name': 'mlp'}}
+        out = merge_dicts_smart(t, {'totally/new/path': 1})
+        assert out['totally']['new']['path'] == 1
+        assert out['model'] == {'name': 'mlp'}
+
+    def test_nested_source_expands_into_sibling_preserving_merge(self):
+        t = {'stages': {'warm': {'lr': 1, 'epochs': 5}}}
+        out = merge_dicts_smart(t, {'warm': {'lr': 2}})
+        assert out['stages']['warm'] == {'lr': 2, 'epochs': 5}
+
+    def test_empty_source_dict_value_is_plain_leaf(self):
+        """An EMPTY dict value is not expandable: it is matched as a
+        single-segment key, and single segments never re-anchor — it
+        lands top-level instead of clobbering the populated subtree."""
+        t = {'a': {'cfg': {'x': 1}}}
+        out = merge_dicts_smart(t, {'cfg': {}})
+        assert out == {'a': {'cfg': {'x': 1}}, 'cfg': {}}
+
+
 class TestDictFromListStr:
     def test_type_coercion(self):
         out = dict_from_list_str(
