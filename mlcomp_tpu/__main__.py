@@ -12,6 +12,9 @@
   sequentially in topological order with all local TPU cores assigned
 - ``mlcomp_tpu init``           — create folders + migrate the DB
 - ``mlcomp_tpu sync``           — manual data/model sync
+- ``mlcomp_tpu alerts``         — watchdog findings (telemetry/watchdog.py):
+  list open alerts (``--all`` includes resolved history), ``--resolve ID``
+  acks one, ``--json`` for scripts
 """
 
 import json
@@ -189,6 +192,47 @@ def sync(computer):
     from mlcomp_tpu.worker.sync import FileSync
     FileSync().sync_manual(computer)
     click.echo('sync complete')
+
+
+@main.command()
+@click.option('--all', 'show_all', is_flag=True,
+              help='include resolved alerts')
+@click.option('--task', type=int, default=None, help='filter by task id')
+@click.option('--rule', default=None,
+              help='filter by rule (task-stall, step-regression, ...)')
+@click.option('--resolve', 'resolve_id', type=int, default=None,
+              help='resolve (ack) the alert with this id')
+@click.option('--json', 'as_json', is_flag=True,
+              help='machine-readable output')
+def alerts(show_all, task, rule, resolve_id, as_json):
+    """Watchdog alerts: stalled tasks, step-time regressions,
+    stragglers, HBM pressure (telemetry/watchdog.py)."""
+    from mlcomp_tpu.db.providers import AlertProvider
+    session = Session.create_session()
+    migrate(session)
+    provider = AlertProvider(session)
+    if resolve_id is not None:
+        ok = provider.resolve(resolve_id)
+        click.echo(f'alert {resolve_id}: '
+                   + ('resolved' if ok else 'not open / not found'))
+        if not ok:
+            raise SystemExit(1)
+        return
+    rows = provider.get(status=None if show_all else 'open',
+                        task=task, rule=rule)
+    if as_json:
+        click.echo(json.dumps([provider.serialize(r) for r in rows]))
+        return
+    if not rows:
+        click.echo('no ' + ('' if show_all else 'open ') + 'alerts')
+        return
+    for a in rows:
+        where = f' task={a.task}' if a.task is not None else ''
+        where += f' on {a.computer}' if a.computer else ''
+        flag = '!' if a.severity == 'critical' else '~'
+        state = '' if a.status == 'open' else f' [{a.status}]'
+        click.echo(f'{flag} #{a.id} [{a.rule}]{where}{state} '
+                   f'({a.time}): {a.message}')
 
 
 if __name__ == '__main__':

@@ -188,7 +188,33 @@ def _v5_preflight(session: Session):
         session.execute(stmt)
 
 
-MIGRATIONS = [_v1_init, _v2_data, _v3_auth, _v4_telemetry, _v5_preflight]
+def _v6_tracing_alerts(session: Session):
+    """trace_id/process_role columns on telemetry_span (cross-process
+    trace propagation) + the alert table (watchdog findings). A fresh
+    DB's _v1 already created telemetry_span with the new columns, so
+    the ALTERs are guarded by a live pragma check."""
+    have = {r['name'] for r in
+            session.query('PRAGMA table_info(telemetry_span)')}
+    for column in ('trace_id', 'process_role'):
+        if column not in have:
+            session.execute(
+                f'ALTER TABLE telemetry_span ADD COLUMN "{column}" TEXT')
+    session.execute(
+        'CREATE INDEX IF NOT EXISTS idx_telemetry_span_trace_id '
+        'ON telemetry_span("trace_id")')
+    # composite (task, name): the watchdog reads small per-(task,name)
+    # windows every evaluation — without this, each read sorts the
+    # task's whole series
+    session.execute(
+        'CREATE INDEX IF NOT EXISTS idx_metric_task_name '
+        'ON metric("task", "name")')
+    from mlcomp_tpu.db.models import Alert
+    for stmt in Alert.create_table_ddl():   # IF NOT EXISTS — safe
+        session.execute(stmt)
+
+
+MIGRATIONS = [_v1_init, _v2_data, _v3_auth, _v4_telemetry, _v5_preflight,
+              _v6_tracing_alerts]
 
 
 def migrate(session: Session = None):

@@ -15,13 +15,13 @@ from mlcomp_tpu.db.models.model import Model
 from mlcomp_tpu.db.models.auxiliary import Auxiliary
 from mlcomp_tpu.db.models.queue import QueueMessage
 from mlcomp_tpu.db.models.auth import DbAudit, WorkerToken
-from mlcomp_tpu.db.models.telemetry import Metric, TelemetrySpan
+from mlcomp_tpu.db.models.telemetry import Alert, Metric, TelemetrySpan
 
 ALL_MODELS = [
     Project, Report, ReportLayout, Dag, Task, TaskDependence, TaskSynced,
     Computer, ComputerUsage, Docker, File, DagStorage, DagLibrary, Log, Step,
     ReportImg, ReportSeries, ReportTasks, Model, Auxiliary, QueueMessage,
-    WorkerToken, DbAudit, Metric, TelemetrySpan, DagPreflight,
+    WorkerToken, DbAudit, Metric, TelemetrySpan, DagPreflight, Alert,
 ]
 
 __all__ = [m.__name__ for m in ALL_MODELS] + ['ALL_MODELS']

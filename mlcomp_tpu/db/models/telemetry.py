@@ -9,8 +9,17 @@ in batches. Two tables:
 - ``metric``: one row per sample. ``task`` is nullable — supervisor
   tick timings and serving latency summaries belong to no task.
 - ``telemetry_span``: one row per finished span. ``span_id``/
-  ``parent_id`` are client-generated (pid-scoped) so nesting survives
-  batch insertion without a DB round trip per span.
+  ``parent_id`` are client-generated (process-scoped) so nesting survives
+  batch insertion without a DB round trip per span. ``trace_id`` /
+  ``process_role`` (migration v6) join spans ACROSS processes: one DAG
+  submission's trace id rides the queue payload and the worker env, so
+  supervisor/worker/train spans of the same task assemble into one
+  cross-process tree (telemetry/spans.py trace context).
+
+Plus ``alert``: one row per watchdog finding (telemetry/watchdog.py) —
+a stalled task, a step-time regression, a straggler worker, HBM
+pressure. Alerts are deduplicated per (rule, task) while open; the
+supervisor re-touches rather than re-inserts on every tick.
 """
 
 from mlcomp_tpu.db.core import Column, DBModel
@@ -42,6 +51,25 @@ class TelemetrySpan(DBModel):
     duration = Column('REAL')               # seconds (monotonic diff)
     status = Column('TEXT', default='ok')   # ok|error
     tags = Column('TEXT')                   # json dict or None
+    trace_id = Column('TEXT', index=True)   # cross-process trace (v6)
+    process_role = Column('TEXT')           # supervisor|worker|train|...
 
 
-__all__ = ['Metric', 'TelemetrySpan']
+class Alert(DBModel):
+    __tablename__ = 'alert'
+
+    id = Column('INTEGER', primary_key=True)
+    time = Column('TEXT', dtype='datetime')
+    rule = Column('TEXT', nullable=False, index=True)
+    # task-stall | step-regression | straggler | hbm-pressure
+    severity = Column('TEXT', default='warning')  # warning|critical
+    task = Column('INTEGER', index=True)    # nullable: host-level alerts
+    dag = Column('INTEGER')
+    computer = Column('TEXT')
+    message = Column('TEXT', nullable=False)
+    details = Column('TEXT')                # json dict or None
+    status = Column('TEXT', default='open', index=True)  # open|resolved
+    resolved_time = Column('TEXT', dtype='datetime')
+
+
+__all__ = ['Metric', 'TelemetrySpan', 'Alert']

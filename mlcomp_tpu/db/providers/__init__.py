@@ -23,11 +23,11 @@ from mlcomp_tpu.db.providers.auth import (
     DbAuditProvider, WorkerTokenProvider
 )
 from mlcomp_tpu.db.providers.telemetry import (
-    MetricProvider, TelemetrySpanProvider
+    AlertProvider, MetricProvider, TelemetrySpanProvider
 )
 
 __all__ = [
-    'WorkerTokenProvider', 'DbAuditProvider',
+    'WorkerTokenProvider', 'DbAuditProvider', 'AlertProvider',
     'MetricProvider', 'TelemetrySpanProvider', 'DagPreflightProvider',
     'BaseDataProvider', 'ProjectProvider', 'DagProvider', 'TaskProvider',
     'ComputerProvider', 'DockerProvider', 'FileProvider',

@@ -4,15 +4,29 @@ Replaces the reference's Celery-over-Redis dispatch (reference
 worker/app.py:10-17; queue naming {host}_{docker}, {host}_{docker}_{n},
 {host}_{docker}_supervisor, worker/__main__.py:130-181). Capability parity:
 named queues, at-most-once claim, revoke, result status. Claims are atomic
-via a single conditional UPDATE ... RETURNING, so any number of worker
-processes can poll the same queue safely.
+via a single conditional UPDATE ... RETURNING; on sqlite < 3.35 (no
+RETURNING — e.g. Debian bullseye ships 3.34) the same at-most-once
+semantics come from a SELECT-candidate + conditional-UPDATE loop: any
+number of workers may SELECT the same pending id, but the UPDATE's
+``AND status='pending'`` guard lets exactly one win (rowcount 1); losers
+move to the next candidate.
 """
 
 import json
+import sqlite3
 
 from mlcomp_tpu.db.models import QueueMessage
 from mlcomp_tpu.db.providers.base import BaseDataProvider
 from mlcomp_tpu.utils.misc import now
+
+#: RETURNING landed in sqlite 3.35.0. Start from the local library's
+#: capability; a remote (server-proxied) session executing against an
+#: older server downgrades at first syntax error (claim/revoke catch).
+_RETURNING_OK = sqlite3.sqlite_version_info >= (3, 35, 0)
+
+
+def _is_returning_syntax_error(e: Exception) -> bool:
+    return 'RETURNING' in str(e).upper()
 
 
 class QueueProvider(BaseDataProvider):
@@ -30,6 +44,20 @@ class QueueProvider(BaseDataProvider):
         Returns (msg_id, payload dict) or None."""
         if not queues:
             return None
+        global _RETURNING_OK
+        if _RETURNING_OK:
+            try:
+                return self._claim_returning(queues, worker)
+            except (sqlite3.OperationalError, RuntimeError) as e:
+                # RuntimeError: a RemoteSession surfaces the SERVER
+                # sqlite's syntax error as 'remote db error: ...' —
+                # the downgrade must fire for that deployment too
+                if not _is_returning_syntax_error(e):
+                    raise
+                _RETURNING_OK = False
+        return self._claim_fallback(queues, worker)
+
+    def _claim_returning(self, queues, worker: str):
         marks = ','.join('?' * len(queues))
         cur = self.session.execute(
             f"UPDATE queue_message SET status='claimed', claimed_by=?, "
@@ -42,6 +70,35 @@ class QueueProvider(BaseDataProvider):
         if row is None:
             return None
         return row['id'], json.loads(row['payload'])
+
+    def _claim_fallback(self, queues, worker: str):
+        """sqlite < 3.35: pick a candidate, then claim it with a
+        conditional UPDATE. The status='pending' guard keeps the claim
+        at-most-once under concurrent pollers — a raced-away candidate
+        shows rowcount 0 and the loop moves to the next oldest."""
+        marks = ','.join('?' * len(queues))
+        skip = []
+        while True:
+            not_in = ''
+            params = list(queues)
+            if skip:
+                not_in = (' AND id NOT IN ('
+                          + ','.join('?' * len(skip)) + ')')
+                params += skip
+            row = self.session.query_one(
+                f"SELECT id, payload FROM queue_message "
+                f"WHERE queue IN ({marks}) AND status='pending'"
+                f"{not_in} ORDER BY id LIMIT 1", tuple(params))
+            if row is None:
+                return None
+            cur = self.session.execute(
+                "UPDATE queue_message SET status='claimed', "
+                "claimed_by=?, claimed_at=? "
+                "WHERE id=? AND status='pending'",
+                (worker, now(), row['id']))
+            if cur.rowcount == 1:
+                return row['id'], json.loads(row['payload'])
+            skip.append(row['id'])      # raced away — try the next one
 
     def find_active(self, queue: str, payload: dict):
         """id of a PENDING message with exactly this payload on this
@@ -71,11 +128,13 @@ class QueueProvider(BaseDataProvider):
     def revoke(self, msg_id: int) -> bool:
         """Revoke a pending message (celery revoke parity,
         reference worker/tasks.py:336-343). Claimed messages must be killed
-        via the worker kill path instead."""
+        via the worker kill path instead. The conditional UPDATE's
+        rowcount already says whether we won — RETURNING added nothing
+        here, so one statement serves every sqlite version."""
         cur = self.session.execute(
             "UPDATE queue_message SET status='revoked' "
-            "WHERE id=? AND status='pending' RETURNING id", (msg_id,))
-        return cur.fetchone() is not None
+            "WHERE id=? AND status='pending'", (msg_id,))
+        return cur.rowcount > 0
 
     def status(self, msg_id: int):
         row = self.session.query_one(

@@ -7,8 +7,9 @@ would pay a commit each.
 
 import json
 
-from mlcomp_tpu.db.models import Metric, TelemetrySpan
+from mlcomp_tpu.db.models import Alert, Metric, TelemetrySpan
 from mlcomp_tpu.db.providers.base import BaseDataProvider
+from mlcomp_tpu.utils.misc import now
 
 
 class MetricProvider(BaseDataProvider):
@@ -26,7 +27,7 @@ class MetricProvider(BaseDataProvider):
         return len(rows)
 
     def series(self, task_id=None, name=None, component=None,
-               limit: int = 100000):
+               limit: int = 100000, offset: int = 0):
         """Samples grouped by metric name, each ordered by (step, id):
         ``{name: [{'step':, 'value':, 'time':, 'kind':}, ...]}``."""
         where, params = [], []
@@ -42,8 +43,9 @@ class MetricProvider(BaseDataProvider):
         sql = 'SELECT * FROM metric'
         if where:
             sql += ' WHERE ' + ' AND '.join(where)
-        sql += ' ORDER BY name, COALESCE(step, id), id LIMIT ?'
+        sql += ' ORDER BY name, COALESCE(step, id), id LIMIT ? OFFSET ?'
         params.append(int(limit))
+        params.append(int(offset))
         out = {}
         for r in self.session.query(sql, tuple(params)):
             out.setdefault(r['name'], []).append({
@@ -51,12 +53,61 @@ class MetricProvider(BaseDataProvider):
                 'time': r['time'], 'kind': r['kind']})
         return out
 
-    def names(self, task_id=None):
-        where = ' WHERE task=?' if task_id is not None else ''
-        params = (int(task_id),) if task_id is not None else ()
+    def names(self, task_id=None, like: str = None):
+        """Distinct metric names, optionally restricted to a task
+        and/or a LIKE pattern. With the (task, name) composite index
+        (migration v6) the task-scoped form is an index skip, not a
+        table scan — the watchdog calls this per running task."""
+        where, params = [], []
+        if task_id is not None:
+            where.append('task=?')
+            params.append(int(task_id))
+        if like is not None:
+            where.append('name LIKE ?')
+            params.append(like)
+        sql = 'SELECT DISTINCT name FROM metric'
+        if where:
+            sql += ' WHERE ' + ' AND '.join(where)
         return [r['name'] for r in self.session.query(
-            f'SELECT DISTINCT name FROM metric{where} ORDER BY name',
-            params)]
+            sql + ' ORDER BY name', tuple(params))]
+
+    def recent_values(self, task_id: int, name: str, limit: int = 32):
+        """Latest ``limit`` values of one metric, NEWEST FIRST — the
+        small fixed-size window the watchdog rules read per task.
+        Ordered by insertion (id DESC): appends are chronological per
+        (task, name), and unlike ``COALESCE(step, id)`` a bare id sort
+        rides the composite index instead of sorting the full
+        series."""
+        rows = self.session.query(
+            'SELECT value FROM metric WHERE task=? AND name=? '
+            'ORDER BY id DESC LIMIT ?',
+            (int(task_id), name, int(limit)))
+        return [r['value'] for r in rows if r['value'] is not None]
+
+    def recent_step_values(self, task_id: int, name: str,
+                           limit: int = 32):
+        """Latest ``limit`` (step, value) pairs of one metric, NEWEST
+        FIRST — for consumers that must JOIN two series on step (the
+        watchdog's hbm_used/hbm_limit pairing; aligning two
+        independently-fetched windows by index would garble on any
+        dropped sample)."""
+        rows = self.session.query(
+            'SELECT step, value FROM metric WHERE task=? AND name=? '
+            'ORDER BY id DESC LIMIT ?',
+            (int(task_id), name, int(limit)))
+        return [(r['step'], r['value']) for r in rows
+                if r['value'] is not None]
+
+    def last_sample_time(self, task_id: int):
+        """Wall-clock of the newest sample of a task (datetime or
+        None) — heartbeat evidence for the stall rule. Newest row by
+        insertion order, not MAX(time) over every row of the task."""
+        from mlcomp_tpu.db.core import parse_datetime
+        row = self.session.query_one(
+            'SELECT time FROM metric WHERE task=? '
+            'ORDER BY id DESC LIMIT 1', (int(task_id),))
+        return parse_datetime(row['time']) if row and row['time'] \
+            else None
 
 
 class TelemetrySpanProvider(BaseDataProvider):
@@ -64,7 +115,8 @@ class TelemetrySpanProvider(BaseDataProvider):
 
     _INSERT = ('INSERT INTO telemetry_span '
                '(span_id, parent_id, task, name, started, duration, '
-               'status, tags) VALUES (?, ?, ?, ?, ?, ?, ?, ?)')
+               'status, tags, trace_id, process_role) '
+               'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)')
 
     def add_many(self, rows):
         rows = list(rows)
@@ -72,19 +124,28 @@ class TelemetrySpanProvider(BaseDataProvider):
             self.session.executemany(self._INSERT, rows)
         return len(rows)
 
-    def by_task(self, task_id: int):
+    def by_task(self, task_id: int, limit: int = 100000,
+                offset: int = 0):
         rows = self.session.query(
             'SELECT * FROM telemetry_span WHERE task=? '
-            'ORDER BY started, id', (int(task_id),))
+            'ORDER BY started, id LIMIT ? OFFSET ?',
+            (int(task_id), int(limit), int(offset)))
         return [TelemetrySpan.from_row(r) for r in rows]
 
-    def tree(self, task_id: int):
-        """Spans of a task as a parent→children forest of dicts (tags
-        decoded), ordered by start time — the shape the dashboard and
-        ``GET /telemetry/spans`` serve."""
-        spans = []
-        by_id = {}
-        for s in self.by_task(task_id):
+    def by_trace(self, trace_id: str, limit: int = 100000):
+        rows = self.session.query(
+            'SELECT * FROM telemetry_span WHERE trace_id=? '
+            'ORDER BY started, id LIMIT ?', (trace_id, int(limit)))
+        return [TelemetrySpan.from_row(r) for r in rows]
+
+    @staticmethod
+    def _forest(spans):
+        """Parent→children forest of span dicts (tags decoded), start
+        order preserved. Span ids are process-scoped, so a parent_id minted
+        in another process never resolves — those spans become roots,
+        which is exactly the cross-process seam the trace view shows."""
+        nodes, by_id = [], {}
+        for s in spans:
             node = s.to_dict()
             try:
                 node['tags'] = json.loads(node['tags']) \
@@ -93,9 +154,9 @@ class TelemetrySpanProvider(BaseDataProvider):
                 pass
             node['children'] = []
             by_id[node['span_id']] = node
-            spans.append(node)
+            nodes.append(node)
         roots = []
-        for node in spans:
+        for node in nodes:
             parent = by_id.get(node['parent_id'])
             if parent is not None and parent is not node:
                 parent['children'].append(node)
@@ -103,5 +164,119 @@ class TelemetrySpanProvider(BaseDataProvider):
                 roots.append(node)
         return roots
 
+    def tree(self, task_id: int, limit: int = 100000,
+             offset: int = 0):
+        """Spans of a task as a parent→children forest of dicts (tags
+        decoded), ordered by start time — the shape the dashboard and
+        ``GET /telemetry/spans`` serve."""
+        return self._forest(self.by_task(task_id, limit=limit,
+                                         offset=offset))
 
-__all__ = ['MetricProvider', 'TelemetrySpanProvider']
+    def trace_tree(self, trace_id: str):
+        """The assembled cross-process trace: every span carrying this
+        trace_id, grouped into per-process root forests (one root per
+        (pid-prefix, process_role) seam), plus the wall-clock envelope
+        the dashboard waterfall scales against."""
+        spans = self.by_trace(trace_id)
+        roots = self._forest(spans)
+        processes = []
+        seen = set()
+        for s in spans:
+            # the full '{pid}.{rand}' prefix, not the bare pid: two
+            # hosts/containers can both run pid 42 in one trace
+            prefix = (s.span_id or '').rsplit('-', 1)[0]
+            key = (prefix, s.process_role)
+            if key not in seen:
+                seen.add(key)
+                processes.append(
+                    {'pid': prefix, 'role': s.process_role})
+        started = [s.started for s in spans if s.started is not None]
+        t0 = min(started) if started else None
+        t1 = max((s.started + (s.duration or 0) for s in spans
+                  if s.started is not None), default=None)
+        return {'trace_id': trace_id, 'span_count': len(spans),
+                'processes': processes, 'started': t0, 'finished': t1,
+                'spans': roots}
+
+
+class AlertProvider(BaseDataProvider):
+    model = Alert
+
+    def raise_alert(self, rule: str, message: str, task=None, dag=None,
+                    computer=None, severity: str = 'warning',
+                    details: dict = None):
+        """Insert an alert, deduplicating against an OPEN alert of the
+        same (rule, task): the watchdog re-finds a live condition every
+        evaluation, and one condition must stay one row (re-touched)
+        instead of one row per tick."""
+        existing = self.session.query_one(
+            'SELECT id FROM alert WHERE rule=? AND status=\'open\' '
+            'AND task IS ?', (rule, task if task is None else int(task)))
+        payload = json.dumps(details) if details else None
+        if existing is not None:
+            self.session.execute(
+                'UPDATE alert SET time=?, message=?, severity=?, '
+                'details=? WHERE id=?',
+                (now(), message, severity, payload, existing['id']))
+            return self.by_id(existing['id'])
+        alert = Alert(time=now(), rule=rule, severity=severity,
+                      task=task, dag=dag, computer=computer,
+                      message=message, details=payload, status='open')
+        self.add(alert)
+        return alert
+
+    def get(self, status: str = 'open', task=None, rule=None,
+            limit: int = 200, offset: int = 0):
+        where, params = [], []
+        if status:
+            where.append('status=?')
+            params.append(status)
+        if task is not None:
+            where.append('task=?')
+            params.append(int(task))
+        if rule is not None:
+            where.append('rule=?')
+            params.append(rule)
+        sql = 'SELECT * FROM alert'
+        if where:
+            sql += ' WHERE ' + ' AND '.join(where)
+        sql += ' ORDER BY time DESC, id DESC LIMIT ? OFFSET ?'
+        params.append(int(limit))
+        params.append(int(offset))
+        return [Alert.from_row(r)
+                for r in self.session.query(sql, tuple(params))]
+
+    @staticmethod
+    def serialize(alert):
+        """Alert as a jsonable dict with ``details`` DECODED — the
+        shape /api/alerts and the CLI serve (same convention as span
+        ``tags`` in _forest; a raw JSON string inside JSON would make
+        every consumer double-decode)."""
+        out = alert.to_dict()
+        if out.get('details'):
+            try:
+                out['details'] = json.loads(out['details'])
+            except ValueError:
+                pass
+        return out
+
+    def resolve(self, alert_id: int) -> bool:
+        cur = self.session.execute(
+            "UPDATE alert SET status='resolved', resolved_time=? "
+            "WHERE id=? AND status='open'", (now(), int(alert_id)))
+        return cur.rowcount > 0
+
+    def resolve_for_task(self, task_id: int, rule: str = None) -> int:
+        """Close every open alert of a task (optionally one rule) —
+        called when the condition clears or the task leaves the
+        running state."""
+        sql = ("UPDATE alert SET status='resolved', resolved_time=? "
+               "WHERE task=? AND status='open'")
+        params = [now(), int(task_id)]
+        if rule is not None:
+            sql += ' AND rule=?'
+            params.append(rule)
+        return self.session.execute(sql, tuple(params)).rowcount
+
+
+__all__ = ['MetricProvider', 'TelemetrySpanProvider', 'AlertProvider']

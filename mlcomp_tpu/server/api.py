@@ -24,9 +24,12 @@ the behavior is subtle):
   ``resume{master_computer, master_task_id, load_last}`` attached,
   including distributed-master discovery (app.py:488-552)
 - ``/api/auxiliary`` supervisor introspection, no auth (app.py:555-558)
-- ``/api/telemetry/series|spans`` (also GET ``/telemetry/series`` +
-  ``/telemetry/spans``, no auth) and ``/api/telemetry/profile`` —
-  telemetry subsystem reads + on-demand profiler toggle (telemetry/)
+- ``/api/telemetry/series|spans|trace`` (also GET ``/telemetry/series``,
+  ``/telemetry/spans``, ``/telemetry/trace/<id>``, no auth) and
+  ``/api/telemetry/profile`` — telemetry subsystem reads, the
+  assembled cross-process trace, and the on-demand profiler toggle
+- ``/api/alerts`` (GET or POST, no auth) + ``/api/alert/resolve``
+  (auth) — watchdog findings (telemetry/watchdog.py)
 - ``/api/logs``, ``/api/reports``, ``/api/report``,
   ``/api/report/update_layout_start|update_layout_end``
 - ``/api/remove_imgs``, ``/api/remove_files`` (app.py:672-688)
@@ -525,6 +528,28 @@ def _int_arg(data, key, required=False):
         raise ApiError(f'{key} must be an integer', status=400)
 
 
+#: hard ceiling on telemetry page size — a runaway `limit` must not
+#: let one anonymous GET materialize the whole metric table
+_TELEMETRY_LIMIT_CAP = 100000
+
+
+def _limit_offset(data, default_limit=_TELEMETRY_LIMIT_CAP):
+    """Validated (limit, offset) for the telemetry reads: garbage and
+    negatives are the caller's 400 (not raw values handed to the
+    provider's SQL), and limit is capped."""
+    limit = _int_arg(data, 'limit')
+    offset = _int_arg(data, 'offset')
+    if limit is None:
+        limit = default_limit
+    elif limit < 0:
+        raise ApiError('limit must be >= 0', status=400)
+    if offset is None:
+        offset = 0
+    elif offset < 0:
+        raise ApiError('offset must be >= 0', status=400)
+    return min(limit, _TELEMETRY_LIMIT_CAP), offset
+
+
 def api_telemetry_series(data, s):
     """Metric series recorded from inside the system (telemetry/):
     per-step loss/throughput from the train loop, supervisor tick
@@ -532,12 +557,14 @@ def api_telemetry_series(data, s):
     component; GET and POST serve the same payload."""
     from mlcomp_tpu.db.providers import MetricProvider
     task = _int_arg(data, 'task')
+    limit, offset = _limit_offset(data)
     provider = MetricProvider(s)
     return {
         'task': task,
         'series': provider.series(
             task_id=task, name=data.get('name'),
-            component=data.get('component')),
+            component=data.get('component'),
+            limit=limit, offset=offset),
     }
 
 
@@ -546,7 +573,51 @@ def api_telemetry_spans(data, s):
     executor import, run) with durations — where the wall-clock went."""
     from mlcomp_tpu.db.providers import TelemetrySpanProvider
     task = _int_arg(data, 'task', required=True)
-    return {'task': task, 'spans': TelemetrySpanProvider(s).tree(task)}
+    limit, offset = _limit_offset(data)
+    return {'task': task,
+            'spans': TelemetrySpanProvider(s).tree(
+                task, limit=limit, offset=offset)}
+
+
+def api_telemetry_trace(data, s):
+    """The assembled CROSS-PROCESS trace of one DAG submission:
+    supervisor dispatch spans, worker pipeline spans and train-loop
+    spans joined by the trace id that rode the queue payload and the
+    task environment (telemetry/spans.py). Served at
+    ``GET /telemetry/trace/<id>`` and ``POST /api/telemetry/trace``."""
+    from mlcomp_tpu.db.providers import TelemetrySpanProvider
+    trace_id = data.get('id') or data.get('trace')
+    if not trace_id or not isinstance(trace_id, str):
+        raise ApiError('trace id required')
+    return TelemetrySpanProvider(s).trace_tree(trace_id)
+
+
+def api_alerts(data, s):
+    """Watchdog findings (telemetry/watchdog.py): stalled tasks,
+    step-time regressions, stragglers, HBM pressure. Default shows
+    OPEN alerts; ``status: all`` includes resolved history. Same
+    no-auth introspection tier as /api/auxiliary."""
+    from mlcomp_tpu.db.providers import AlertProvider
+    status = data.get('status', 'open')
+    if status == 'all':
+        status = None
+    elif status not in (None, 'open', 'resolved'):
+        raise ApiError('status must be open|resolved|all', status=400)
+    limit, offset = _limit_offset(data, default_limit=200)
+    provider = AlertProvider(s)
+    rows = provider.get(
+        status=status, task=_int_arg(data, 'task'),
+        rule=data.get('rule'), limit=max(1, limit), offset=offset)
+    return {'data': [provider.serialize(r) for r in rows]}
+
+
+def api_alert_resolve(data, s):
+    """Close an open alert (dashboard/CLI ack). Mutates state — token
+    required, unlike the alert reads."""
+    from mlcomp_tpu.db.providers import AlertProvider
+    alert_id = _int_arg(data, 'id', required=True)
+    return {'success': True,
+            'resolved': AlertProvider(s).resolve(alert_id)}
 
 
 def api_telemetry_profile(data, s):
@@ -803,6 +874,9 @@ _ROUTES = {
     # state and needs the token
     '/api/telemetry/series': (api_telemetry_series, False),
     '/api/telemetry/spans': (api_telemetry_spans, False),
+    '/api/telemetry/trace': (api_telemetry_trace, False),
+    '/api/alerts': (api_alerts, False),
+    '/api/alert/resolve': (api_alert_resolve, True),
     '/api/telemetry/profile': (api_telemetry_profile, True),
     '/api/logs': (api_logs, True),
     '/api/reports': (api_reports, True),
@@ -829,6 +903,7 @@ _READ_ONLY_ROUTES = frozenset({
     '/api/logs', '/api/reports',
     '/api/report', '/api/report/update_layout_start',
     '/api/telemetry/series', '/api/telemetry/spans',
+    '/api/telemetry/trace', '/api/alerts',
 })
 
 
@@ -989,15 +1064,24 @@ class ApiHandler(BaseHTTPRequestHandler):
                     {'success': False,
                      'reason': traceback.format_exc()}, 500)
             return
-        if parsed.path in ('/telemetry/series', '/telemetry/spans'):
+        if parsed.path in ('/telemetry/series', '/telemetry/spans',
+                           '/api/alerts') \
+                or parsed.path.startswith('/telemetry/trace/'):
             # GET mirrors of the POST routes (curl-friendly:
-            # /telemetry/series?task=7&name=loss); same no-auth
-            # introspection tier as /api/auxiliary
+            # /telemetry/series?task=7&name=loss,
+            # /telemetry/trace/<id>, /api/alerts?status=all); same
+            # no-auth introspection tier as /api/auxiliary
             qs = parse_qs(parsed.query)
             data = {k: v[0] for k, v in qs.items()}
-            handler = api_telemetry_series \
-                if parsed.path == '/telemetry/series' \
-                else api_telemetry_spans
+            if parsed.path == '/telemetry/series':
+                handler = api_telemetry_series
+            elif parsed.path == '/telemetry/spans':
+                handler = api_telemetry_spans
+            elif parsed.path == '/api/alerts':
+                handler = api_alerts
+            else:
+                data['id'] = parsed.path[len('/telemetry/trace/'):]
+                handler = api_telemetry_trace
             try:
                 try:
                     res = handler(data, _session())

@@ -73,6 +73,13 @@ class DagStandardBuilder:
         self.dag = None
         self.dag_report_id = None
         self.tasks = {}  # executor name -> [task ids]
+        # one trace per submission (telemetry/spans.py): every task of
+        # this dag carries the id in additional_info; the supervisor
+        # puts it on the queue payload, the worker exports it into the
+        # task environment — supervisor/worker/train spans join into
+        # one cross-process tree (GET /telemetry/trace/<id>)
+        from mlcomp_tpu.telemetry import new_trace_id
+        self.trace_id = new_trace_id()
 
     # ------------------------------------------------------------- phases
     def load_base(self):
@@ -175,7 +182,7 @@ class DagStandardBuilder:
         if cell_name_str:
             task_name = f'{name} {cell_name_str}'
 
-        additional_info = {}
+        additional_info = {'trace_id': self.trace_id}
         if cell is not None:
             additional_info['grid_cell'] = cell_index
             additional_info['grid'] = cell
@@ -210,8 +217,7 @@ class DagStandardBuilder:
             debug=self.debug,
             gpu_requirement=str(spec.get('cores', spec.get('gpu', '')) or ''),
             single_node=bool(spec.get('single_node', True)),
-            additional_info=yaml_dump(additional_info)
-            if additional_info else None,
+            additional_info=yaml_dump(additional_info),
             last_activity=now(),
         )
         self.task_provider.add(task)

@@ -17,7 +17,11 @@ inline SVG) covering the same surfaces:
 - task detail: step tree + logs (front/src/app/task/), plus the
   telemetry surfaces this build records from inside the hot paths
   (telemetry/): per-step metric series charts, gauge table, the span
-  forest with durations, and on-demand profiler start/stop buttons
+  forest with durations, a cross-process trace waterfall (supervisor/
+  worker/train legs on one wall-clock axis), and on-demand profiler
+  start/stop buttons
+- supervisor tab: watchdog alerts card (open alerts + resolve button,
+  telemetry/watchdog.py) above the decision trace
 - report detail: LAYOUT-DRIVEN rendering (reference
   db/report_info/info.py:28-129 consumed by the SPA's report renderer):
   panels of metric series, img_classify gallery with confusion-matrix
@@ -522,17 +526,47 @@ async function layoutRemove(name) {
   render();
 }
 
+async function resolveAlert(id) {
+  await api('alert/resolve', {id}); render();
+}
+function alertsCard(alerts) {
+  // watchdog findings (telemetry/watchdog.py): open alerts newest
+  // first, with an ack button (auth'd resolve)
+  const sevBadge = a => a.severity === 'critical'
+    ? '<span class="status s-Failed">critical</span>'
+    : `<span class="status"
+        style="background:#3d3118;color:#d9a13c">warning</span>`;
+  if (!alerts.length)
+    return '<h3>alerts</h3><p class="dim">no open alerts</p>';
+  return '<h3>alerts (' + alerts.length + ' open)</h3><table>'
+    + '<tr><th></th><th>rule</th><th>task</th><th>computer</th>'
+    + '<th>message</th><th>time</th><th></th></tr>'
+    + alerts.map(a => `<tr>
+      <td>${sevBadge(a)}</td><td>${esc(a.rule)}</td>
+      <td>${a.task != null
+        ? `<a href="#" onclick="open_('task',${a.task});return false">${a.task}</a>`
+        : ''}</td>
+      <td>${esc(a.computer||'')}</td><td>${esc(a.message)}</td>
+      <td class="dim">${esc(a.time||'')}</td>
+      <td><button class="btn" onclick="resolveAlert(${a.id})"
+        >resolve</button></td></tr>`).join('') + '</table>';
+}
+
 async function viewSupervisor(el) {
   const res = await api('auxiliary');
   // db_audit needs auth while auxiliary does not — don't let a 401
   // take the whole tab down
   let audit = {data: []};
   try { audit = await api('db_audit', {limit: 50}); } catch (e) {}
+  let alerts = {data: []};
+  try { alerts = await api('alerts', {status: 'open'}); } catch (e) {}
+  if (alerts && alerts.success === false) alerts = {data: []};
   el.appendChild(h(`<div class="pager"><button class="btn"
     onclick="if(confirm('stop worker daemons on this host?'))
       api('stop').then(render)">stop workers</button></div>`));
   // structured decision trace (reference auxiliary/supervisor page)
   const sup = (res && res.supervisor) || res || {};
+  el.appendChild(h('<div>' + alertsCard(alerts.data||[]) + '</div>'));
   el.appendChild(h(`<div class="cards">
     <div class="card"><h3>tick</h3>
       <div class="dim">${esc(sup.time||'no tick yet')}</div>
@@ -778,10 +812,67 @@ async function viewTaskDetail(el, id) {
      ${spanTree(s.children||[])}</div>`).join('') + '</div>';
   if ((spans.spans||[]).length)
     el.appendChild(h('<h3>telemetry spans</h3>' + spanTree(spans.spans)));
+  // cross-process trace waterfall: this task's spans carry the trace
+  // id minted at DAG submission — the assembled view shows the
+  // supervisor dispatch, worker pipeline and train-loop legs on one
+  // wall-clock axis (GET /telemetry/trace/<id>)
+  const traceId = (spans.spans||[]).map(s => s.trace_id)
+    .filter(t => t)[0];
+  if (traceId) {
+    let tr = null;
+    try { tr = await api('telemetry/trace', {id: traceId}); }
+    catch (e) {}
+    if (tr && tr.success !== false && (tr.spans||[]).length)
+      el.appendChild(h('<h3>trace <span class="dim">' + esc(traceId)
+        + '</span></h3>' + traceWaterfall(tr)));
+  }
   el.appendChild(h('<h3>logs</h3><table>' + logs.data.map(l =>
     `<tr><td class="dim">${esc(l.time)}</td><td>${esc(l.level_name)}</td>
      <td><pre style="margin:0">${esc(l.message)}</pre></td></tr>`).join('')
     + '</table>'));
+}
+
+function traceWaterfall(tr) {
+  // one row per span across EVERY process of the trace, positioned on
+  // the shared wall-clock axis; bar color = process role
+  const t0 = tr.started || 0;
+  const total = Math.max((tr.finished||t0) - t0, 1e-6);
+  const rows = [];
+  const walk = (nodes, depth) => nodes.forEach(n => {
+    rows.push({n: n, depth: depth});
+    walk(n.children||[], depth+1);
+  });
+  walk(tr.spans||[], 0);
+  const roleColor = {supervisor:'#d9a13c', worker:'#4da3ff',
+                     train:'#41c07c'};
+  const bar = r => {
+    const n = r.n;
+    const left = Math.max(0, (n.started - t0)/total*100);
+    const width = Math.max(0.4,
+      Math.min((n.duration||0)/total*100, 100-left));
+    const color = roleColor[n.process_role] || '#7b8894';
+    return `<div style="display:flex;align-items:center;gap:8px;
+        font-size:12px;margin:1px 0">
+      <span style="width:250px;overflow:hidden;white-space:nowrap;
+        padding-left:${r.depth*12}px">${esc(n.name)}
+        <span class="dim">${esc(n.process_role||'')}</span></span>
+      <span style="flex:1;position:relative;height:14px;
+        background:#101418;border-radius:3px">
+        <span style="position:absolute;left:${left.toFixed(2)}%;
+          width:${width.toFixed(2)}%;top:2px;bottom:2px;
+          background:${color};border-radius:2px"></span></span>
+      <span class="dim" style="width:90px;text-align:right">
+        ${((n.duration||0)*1000).toFixed(1)} ms</span></div>`;
+  };
+  return '<div class="card" style="min-width:680px">'
+    + rows.map(bar).join('')
+    + `<div class="dim" style="font-size:11px;margin-top:6px">
+       ${tr.span_count} spans &middot;
+       ${(tr.processes||[]).length} process(es) &middot;
+       <span style="color:#d9a13c">supervisor</span> &middot;
+       <span style="color:#4da3ff">worker</span> &middot;
+       <span style="color:#41c07c">train</span> &middot;
+       ${(total*1000).toFixed(1)} ms total</div></div>`;
 }
 
 // per-chart zoom windows survive re-renders (keyed by series name);

@@ -57,10 +57,14 @@ class SupervisorBuilder:
         # tick/dispatch telemetry: gauges buffered in memory, one DB
         # batch per flush_every samples (~1/min at the 1 Hz tick) so
         # observability never competes with the scheduling hot path
-        from mlcomp_tpu.telemetry import MetricRecorder
+        from mlcomp_tpu.telemetry import MetricRecorder, Watchdog
         self.telemetry = MetricRecorder(
             session=self.session, component='supervisor',
             flush_every=60)
+        # health watchdog (telemetry/watchdog.py): consumes heartbeats,
+        # span durations and metric series; rate-limits itself inside
+        # the tick, so the scheduling hot path pays a clock read
+        self.watchdog = Watchdog(self.session, logger=logger)
         self._last_claim_ts = now()
         # dag id -> [error findings] ([] = passed); filled lazily the
         # first time a NotRan task of that dag reaches placement
@@ -224,25 +228,43 @@ class SupervisorBuilder:
         raise RuntimeError(f'no free port on {comp["name"]}')
 
     # ------------------------------------------------------------- dispatch
+    def task_trace_id(self, task: Task):
+        """The trace id minted for this task's DAG submission
+        (create_dags/standard.py stores it in additional_info); legacy
+        rows without one simply stay traceless."""
+        info = yaml_load(task.additional_info) \
+            if task.additional_info else {}
+        return (info or {}).get('trace_id')
+
     def dispatch(self, task: Task, comp, cores):
         """Assign cores and enqueue to {computer}_{docker}
-        (reference process_to_celery, supervisor.py:113-129)."""
+        (reference process_to_celery, supervisor.py:113-129). The
+        dispatch is wrapped in a trace-context span, and the trace id
+        rides the queue payload so the claiming worker joins the same
+        trace — the supervisor→queue→worker leg of the propagation."""
+        from mlcomp_tpu.telemetry import span
         task.computer_assigned = comp['name']
         task.cores_assigned = json.dumps(cores)
         docker = task.docker_assigned or 'default'
         queue = f'{comp["name"]}_{docker}'
+        trace_id = self.task_trace_id(task)
         # idempotent against a supervisor death between queue-put and
         # the Queued status write: the task re-loads as NotRan on
         # restart, but its execute message may already be out — reuse
         # it instead of enqueueing a second execution
         payload = {'action': 'execute', 'task_id': task.id}
-        msg_id = self.queue_provider.find_active(queue, payload)
-        if msg_id is None:
-            msg_id = self.queue_provider.enqueue(queue, payload)
-        task.queue_id = msg_id
-        self.provider.update(
-            task, ['computer_assigned', 'cores_assigned', 'queue_id'])
-        self.provider.change_status(task, TaskStatus.Queued)
+        if trace_id:
+            payload['trace_id'] = trace_id
+        with span('supervisor.dispatch', task=task.id,
+                  trace_id=trace_id, role='supervisor',
+                  tags={'queue': queue, 'cores': len(cores)}):
+            msg_id = self.queue_provider.find_active(queue, payload)
+            if msg_id is None:
+                msg_id = self.queue_provider.enqueue(queue, payload)
+            task.queue_id = msg_id
+            self.provider.update(
+                task, ['computer_assigned', 'cores_assigned', 'queue_id'])
+            self.provider.change_status(task, TaskStatus.Queued)
         for core in cores:
             comp['cores'][core] = True
         comp['cpu'] -= task.cpu or 0
@@ -530,6 +552,55 @@ class SupervisorBuilder:
                 latest = claimed
         if latest is not None:
             self._last_claim_ts = latest
+        # the dispatch trace spans buffered this tick — one batched
+        # insert, a no-op on ticks that dispatched nothing
+        from mlcomp_tpu.telemetry import flush_spans
+        flush_spans(self.session)
+
+    # ------------------------------------------------------------ watchdog
+    def run_watchdog(self):
+        """Evaluate the health rules (rate-limited inside the watchdog)
+        and ACT on the stall findings: a stalled task is killed and
+        marked Failed — with its alert row as the paper trail — instead
+        of holding its TPU slot forever. Watchdog crashes never take
+        the tick down; alerting is a consumer of telemetry, not a new
+        single point of failure for scheduling."""
+        try:
+            findings = self.watchdog.maybe_evaluate()
+        except Exception:
+            if self.logger:
+                self.logger.error(
+                    f'watchdog evaluation failed:\n'
+                    f'{traceback.format_exc()}', ComponentType.Supervisor)
+            return
+        if not findings:
+            return
+        self.aux['watchdog'] = [
+            {k: f.get(k) for k in ('rule', 'task', 'severity',
+                                   'message')}
+            for f in findings]
+        from mlcomp_tpu.worker.tasks import kill_task
+        for finding in findings:
+            if finding['rule'] != 'task-stall':
+                continue
+            task_id = finding['task']
+            try:
+                kill_task(task_id, session=self.session)
+                task = self.provider.by_id(task_id)
+                if task is not None and \
+                        task.status != int(TaskStatus.Failed):
+                    self.provider.change_status(task, TaskStatus.Failed)
+                if self.logger:
+                    self.logger.error(
+                        f'watchdog: {finding["message"]} — task marked '
+                        f'Failed (alert {finding.get("alert_id")})',
+                        ComponentType.Supervisor, None, task_id)
+            except Exception:
+                if self.logger:
+                    self.logger.error(
+                        f'watchdog failed stopping stalled task '
+                        f'{task_id}:\n{traceback.format_exc()}',
+                        ComponentType.Supervisor)
 
     # ---------------------------------------------------------------- main
     def build(self):
@@ -540,6 +611,7 @@ class SupervisorBuilder:
             self.load_tasks()
             self.load_computers()
             self.process_tasks()
+            self.run_watchdog()
             self.aux['duration'] = (now() - start).total_seconds()
             self.write_auxiliary()
             self.record_tick_telemetry()

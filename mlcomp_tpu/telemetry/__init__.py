@@ -6,36 +6,56 @@ package wired through every layer of this framework:
 
 - ``spans``    — context-manager tracing spans (worker task pipeline,
   executor phases), buffered in a thread-safe ring, batch-flushed.
+  Carries the cross-process trace context (``trace_id`` +
+  ``process_role``) minted per DAG submission and propagated through
+  the queue payload and the worker environment, so supervisor, worker
+  and train-loop spans of one task assemble into one trace
+  (``GET /telemetry/trace/<id>``).
 - ``metrics``  — per-step counters/gauges/histograms whose hot-path
   cost is a host-side append; device values pull at flush time.
 - ``device``   — HBM occupancy + compiled-step FLOPs from inside the
   training process (MFU computed in the loop, not in bench.py).
 - ``profiler`` — on-demand ``jax.profiler`` traces toggled per task
   through ``POST /api/telemetry/profile``.
+- ``watchdog`` — rule engine over the recorded signals, evaluated from
+  the supervisor tick: stalled tasks, step-time regressions vs a
+  per-task rolling baseline, straggler workers, HBM-pressure trends —
+  persisted as ``alert`` rows and served via ``GET /api/alerts`` and
+  ``mlcomp_tpu alerts``.
 
-Query side: ``GET /telemetry/series?task=<id>`` and
-``GET /telemetry/spans?task=<id>`` (server/api.py), backed by the
-``metric``/``telemetry_span`` tables (db/models/telemetry.py).
+Query side: ``GET /telemetry/series?task=<id>``,
+``GET /telemetry/spans?task=<id>`` and ``GET /telemetry/trace/<id>``
+(server/api.py), backed by the ``metric``/``telemetry_span``/``alert``
+tables (db/models/telemetry.py).
 The overhead budget is <1% of step time — bench.py measures and
-publishes ``telemetry_overhead_pct`` every round.
+publishes ``telemetry_overhead_pct`` (plus the propagation+watchdog
+cost, ``observability_overhead_pct``) every round.
 """
 
 from mlcomp_tpu.telemetry.device import (
     compiled_cost, device_memory_stats, mfu, record_device_stats,
 )
-from mlcomp_tpu.telemetry.metrics import Histogram, MetricRecorder
+from mlcomp_tpu.telemetry.metrics import (
+    Histogram, MetricRecorder, flush_live_recorders,
+)
 from mlcomp_tpu.telemetry.profiler import (
     TaskProfiler, request_stop, request_trace, trace_status,
 )
 from mlcomp_tpu.telemetry.spans import (
-    DEFAULT_BUFFER, SpanBuffer, current_span_id, flush_spans, span,
+    DEFAULT_BUFFER, PROCESS_ROLE_ENV, TRACE_ID_ENV, SpanBuffer,
+    current_span_id, flush_spans, get_trace_context, new_trace_id,
+    record_span, set_trace_context, span, trace_context_env,
 )
+from mlcomp_tpu.telemetry.watchdog import Watchdog, WatchdogConfig
 
 __all__ = [
-    'span', 'flush_spans', 'SpanBuffer', 'DEFAULT_BUFFER',
-    'current_span_id',
-    'MetricRecorder', 'Histogram',
+    'span', 'record_span', 'flush_spans', 'SpanBuffer',
+    'DEFAULT_BUFFER', 'current_span_id',
+    'new_trace_id', 'set_trace_context', 'get_trace_context',
+    'trace_context_env', 'TRACE_ID_ENV', 'PROCESS_ROLE_ENV',
+    'MetricRecorder', 'Histogram', 'flush_live_recorders',
     'device_memory_stats', 'compiled_cost', 'mfu',
     'record_device_stats',
     'TaskProfiler', 'request_trace', 'request_stop', 'trace_status',
+    'Watchdog', 'WatchdogConfig',
 ]

@@ -19,9 +19,30 @@ import itertools
 import json
 import sys
 import threading
+import weakref
 from collections import deque
 
 import numpy as np
+
+#: session-bound recorders alive in this process — the crash-time flush
+#: (worker/tasks.py installs atexit + SIGTERM handlers) drains these so
+#: the telemetry of a FAILED task, the rows the watchdog most needs,
+#: is not lost with the process. WeakSet: registration must not keep a
+#: finished executor's recorder (and its session ref) alive.
+_LIVE_RECORDERS = weakref.WeakSet()
+
+
+def flush_live_recorders() -> int:
+    """Best-effort synchronous flush of every live session-bound
+    recorder; returns rows written. Never raises — this runs on the
+    interpreter's way down."""
+    total = 0
+    for recorder in list(_LIVE_RECORDERS):
+        try:
+            total += recorder.flush()
+        except Exception:
+            pass
+    return total
 
 
 class Histogram:
@@ -88,6 +109,8 @@ class MetricRecorder:
         self._steps = itertools.count()
         self.dropped_count = 0
         self.flushed_count = 0
+        if session is not None:
+            _LIVE_RECORDERS.add(self)
 
     # ------------------------------------------------------------ hot path
     def _maybe_flush(self):
@@ -214,6 +237,16 @@ class MetricRecorder:
             self.dropped_count += len(rows)
             return 0
         self.flushed_count += n
+        if self.task is not None:
+            # heartbeat: a flush IS proof of life — touch the task row
+            # so the watchdog's stall rule sees instrumented tasks as
+            # alive without any extra plumbing (one UPDATE per flush
+            # window, off the hot path)
+            try:
+                from mlcomp_tpu.db.providers.task import TaskProvider
+                TaskProvider(session).update_last_activity(self.task)
+            except Exception:
+                pass
         return n
 
     def close(self) -> int:
@@ -226,4 +259,4 @@ class MetricRecorder:
         return self.flush()
 
 
-__all__ = ['MetricRecorder', 'Histogram']
+__all__ = ['MetricRecorder', 'Histogram', 'flush_live_recorders']

@@ -1,0 +1,326 @@
+"""Health watchdog: turn telemetry into actionable alerts.
+
+PR 1 made the system observable — spans, metric series, device stats —
+but nobody CONSUMED the signals: a stalled task ran forever, a 2x
+step-time regression was only visible to a human staring at the
+dashboard. This module is the consumer, in the spirit of MegaScale's
+straggler/stall diagnosis practice (Jiang et al., 2024): a small rule
+engine evaluated from the supervisor tick that reads heartbeats, span
+durations and metric series already in the DB and persists findings as
+``alert`` rows (db/models/telemetry.py).
+
+Rules (all thresholds tunable via WatchdogConfig):
+
+- **task-stall** — an InProgress task whose newest evidence of life
+  (task.last_activity, started, OR its newest metric sample) is older
+  than ``stall_deadline_s``. Severity critical; the supervisor acts on
+  these by failing the task (see SupervisorBuilder.run_watchdog) so a
+  wedged TPU slot frees instead of leaking forever.
+- **step-regression** — a running task whose recent median
+  ``step_time_ms`` exceeds ``regression_factor`` x its own rolling
+  baseline (the older part of the same window). Per-task baseline:
+  different models have wildly different step times, a global
+  threshold would be noise.
+- **straggler** — among the service-task children of one distributed
+  parent, a child whose recent median step time exceeds
+  ``straggler_factor`` x the sibling median. Needs >= 3 children with
+  data (a median of two is meaningless).
+- **hbm-pressure** — a running task whose latest
+  ``device<i>.hbm_used/hbm_limit`` occupancy crosses
+  ``hbm_threshold``, or climbed monotonically through the recent
+  window above ``hbm_trend_floor`` (heading for an OOM even though it
+  has not crossed the line yet).
+
+Cost: a handful of indexed SELECTs over the few InProgress tasks per
+evaluation, and evaluations are rate-limited to ``evaluate_every_s``
+inside the 1 Hz supervisor tick — the scheduler hot path never pays
+more than a clock read on the off ticks. Alerts dedup per (rule, task)
+while open (AlertProvider.raise_alert), and rules whose condition
+cleared resolve their open alert so the dashboard shows live truth.
+"""
+
+import statistics
+import traceback
+
+from mlcomp_tpu.db.core import parse_datetime
+from mlcomp_tpu.db.enums import ComponentType, TaskStatus
+from mlcomp_tpu.utils.misc import now
+
+
+class WatchdogConfig:
+    """Thresholds; construct with keyword overrides
+    (``WatchdogConfig(stall_deadline_s=60)``)."""
+
+    #: seconds without heartbeat/metric progress before a task stalls.
+    #: The deadline must exceed the longest LEGITIMATE quiet period —
+    #: first jit compile of a big model, a checkpoint restore, an
+    #: epoch_scan epoch, a task running with telemetry disabled (whose
+    #: only life signal is status-transition last_activity) — which is
+    #: why the default is conservative. The metric-flush heartbeat
+    #: (MetricRecorder.flush touches task.last_activity) keeps
+    #: instrumented tasks far inside it.
+    stall_deadline_s = 1800.0
+    #: recent median step time must exceed factor x baseline median
+    regression_factor = 2.0
+    #: samples: baseline window (older) and recent window (newer)
+    baseline_window = 20
+    recent_window = 5
+    #: child recent median vs sibling median
+    straggler_factor = 1.5
+    straggler_min_children = 3
+    #: alert when HBM occupancy crosses this
+    hbm_threshold = 0.92
+    #: rising-trend alerts only above this floor
+    hbm_trend_floor = 0.75
+    #: min seconds between evaluations (rate limit inside the tick)
+    evaluate_every_s = 10.0
+
+    def __init__(self, **overrides):
+        for key, value in overrides.items():
+            if not hasattr(type(self), key):
+                raise TypeError(f'unknown watchdog option {key!r}')
+            setattr(self, key, float(value))
+
+
+class Watchdog:
+    """Evaluate the rules against the DB; persist findings as alerts.
+
+    ``evaluate()`` returns the list of findings raised THIS pass — the
+    supervisor uses the task-stall entries to transition tasks out of
+    the running state. ``maybe_evaluate()`` is the rate-limited entry
+    the tick calls."""
+
+    def __init__(self, session, config: WatchdogConfig = None,
+                 logger=None):
+        self.session = session
+        self.config = config or WatchdogConfig()
+        self.logger = logger
+        self._last_eval = None
+
+    # ------------------------------------------------------------ plumbing
+    def _providers(self):
+        from mlcomp_tpu.db.providers import (
+            AlertProvider, MetricProvider, TaskProvider,
+        )
+        return (TaskProvider(self.session), MetricProvider(self.session),
+                AlertProvider(self.session))
+
+    def maybe_evaluate(self, now_dt=None):
+        """Rate-limited evaluate: a no-op (one clock read) until
+        ``evaluate_every_s`` elapsed since the last pass."""
+        now_dt = now_dt or now()
+        if self._last_eval is not None and \
+                (now_dt - self._last_eval).total_seconds() < \
+                self.config.evaluate_every_s:
+            return []
+        self._last_eval = now_dt
+        return self.evaluate(now_dt=now_dt)
+
+    def evaluate(self, now_dt=None):
+        """One full pass over every rule. Returns finding dicts:
+        ``{'rule', 'task', 'message', 'severity', 'alert_id', ...}``.
+        A crashing rule is logged and skipped — it must not silence
+        the other rules."""
+        now_dt = now_dt or now()
+        tasks, metrics, alerts = self._providers()
+        running = tasks.by_status(TaskStatus.InProgress)
+        findings = []
+        for rule in (
+                lambda: self._check_stalls(running, metrics, alerts,
+                                           now_dt),
+                lambda: self._check_regressions(running, metrics,
+                                                alerts),
+                lambda: self._check_stragglers(running, metrics,
+                                               alerts),
+                lambda: self._check_hbm(running, metrics, alerts),
+                lambda: self._sweep_finished(running, alerts)):
+            try:
+                findings += rule() or []
+            except Exception:
+                if self.logger:
+                    self.logger.error(
+                        f'watchdog rule failed:\n'
+                        f'{traceback.format_exc()}',
+                        ComponentType.Supervisor)
+        return findings
+
+    def _sweep_finished(self, running, alerts):
+        """Auto-resolve condition alerts whose task is no longer
+        running: regression/straggler/HBM alerts describe a LIVE
+        condition, and the condition cannot outlive the task. Stall
+        alerts stay open — they are the paper trail of a kill."""
+        running_ids = {t.id for t in running}
+        for alert in alerts.get(status='open', limit=1000):
+            if alert.rule == 'task-stall' or alert.task is None:
+                continue
+            if alert.task not in running_ids:
+                alerts.resolve(alert.id)
+        return []
+
+    def _raise(self, alerts, rule, message, task, severity='warning',
+               details=None):
+        alert = alerts.raise_alert(
+            rule, message, task=task.id, dag=task.dag,
+            computer=task.computer_assigned, severity=severity,
+            details=details)
+        return {'rule': rule, 'task': task.id, 'message': message,
+                'severity': severity, 'alert_id': alert.id,
+                'details': details}
+
+    # --------------------------------------------------------------- rules
+    def _check_stalls(self, running, metrics, alerts, now_dt):
+        newest = {}
+        for task in running:
+            latest = None
+            for candidate in (task.last_activity, task.started,
+                              metrics.last_sample_time(task.id)):
+                candidate = parse_datetime(candidate)
+                if candidate and (latest is None or candidate > latest):
+                    latest = candidate
+            newest[task.id] = latest
+        # group pooling: only rank 0 of a distributed job writes
+        # metric series (one writer per task), so a non-rank-0 service
+        # child's own evidence goes quiet during healthy training, and
+        # the PARENT row never executes at all — its clock freezes at
+        # the InProgress transition. Any member's life counts for the
+        # whole family (siblings AND the parent): the group stalls
+        # together or not at all.
+        group = {}
+        for task in running:
+            if task.parent and newest.get(task.id):
+                prev = group.get(task.parent)
+                if prev is None or newest[task.id] > prev:
+                    group[task.parent] = newest[task.id]
+        out = []
+        for task in running:
+            latest = newest.get(task.id)
+            pooled = (group.get(task.parent) if task.parent else None,
+                      group.get(task.id))   # children of THIS parent
+            for candidate in pooled:
+                if candidate and (latest is None or candidate > latest):
+                    latest = candidate
+            if latest is None:
+                continue        # no clock evidence at all — can't judge
+            age = (now_dt - latest).total_seconds()
+            if age > self.config.stall_deadline_s:
+                out.append(self._raise(
+                    alerts, 'task-stall',
+                    f'task {task.id} ({task.name}): no heartbeat or '
+                    f'metric progress for {age:.0f}s '
+                    f'(deadline {self.config.stall_deadline_s:.0f}s)',
+                    task, severity='critical',
+                    details={'age_s': round(age, 1)}))
+        return out
+
+    def _window(self, metrics, task_id, name='step_time_ms'):
+        """(recent, baseline) medians of a task's step-time series, or
+        None when the window is too shallow for a verdict."""
+        need = int(self.config.baseline_window +
+                   self.config.recent_window)
+        values = metrics.recent_values(task_id, name, limit=need)
+        if len(values) < need:
+            return None
+        recent = values[:int(self.config.recent_window)]   # newest first
+        baseline = values[int(self.config.recent_window):]
+        return (statistics.median(recent), statistics.median(baseline))
+
+    def _check_regressions(self, running, metrics, alerts):
+        out = []
+        for task in running:
+            window = self._window(metrics, task.id)
+            if window is None:
+                continue
+            recent, baseline = window
+            if baseline > 0 and \
+                    recent > self.config.regression_factor * baseline:
+                out.append(self._raise(
+                    alerts, 'step-regression',
+                    f'task {task.id} ({task.name}): recent step time '
+                    f'{recent:.1f}ms is {recent / baseline:.1f}x its '
+                    f'rolling baseline {baseline:.1f}ms',
+                    task, details={'recent_ms': round(recent, 2),
+                                   'baseline_ms': round(baseline, 2)}))
+            elif baseline > 0:
+                alerts.resolve_for_task(task.id, rule='step-regression')
+        return out
+
+    def _check_stragglers(self, running, metrics, alerts):
+        out = []
+        by_parent = {}
+        for task in running:
+            if task.parent:
+                by_parent.setdefault(task.parent, []).append(task)
+        for children in by_parent.values():
+            recents = {}
+            for child in children:
+                values = metrics.recent_values(
+                    child.id, 'step_time_ms',
+                    limit=int(self.config.recent_window))
+                if values:
+                    recents[child.id] = statistics.median(values)
+            if len(recents) < int(self.config.straggler_min_children):
+                continue
+            sibling_median = statistics.median(recents.values())
+            if sibling_median <= 0:
+                continue
+            for child in children:
+                mine = recents.get(child.id)
+                if mine is None:
+                    continue
+                if mine > self.config.straggler_factor * sibling_median:
+                    out.append(self._raise(
+                        alerts, 'straggler',
+                        f'task {child.id} ({child.name}) on '
+                        f'{child.computer_assigned}: step time '
+                        f'{mine:.1f}ms vs sibling median '
+                        f'{sibling_median:.1f}ms '
+                        f'({mine / sibling_median:.2f}x)',
+                        child,
+                        details={'mine_ms': round(mine, 2),
+                                 'sibling_median_ms':
+                                     round(sibling_median, 2)}))
+                else:
+                    alerts.resolve_for_task(child.id, rule='straggler')
+        return out
+
+    def _check_hbm(self, running, metrics, alerts):
+        out = []
+        for task in running:
+            names = metrics.names(task.id, like='device%.hbm_used')
+            worst = None         # (occupancy history newest-first, dev)
+            for used_name in names:
+                limit_name = used_name.replace('.hbm_used', '.hbm_limit')
+                used = metrics.recent_step_values(task.id, used_name,
+                                                  limit=6)
+                limits = dict(metrics.recent_step_values(
+                    task.id, limit_name, limit=6))
+                # join on STEP: the two windows are fetched
+                # independently and one side may have dropped a sample
+                occ = [value / limits[step] for step, value in used
+                       if limits.get(step)]
+                if occ and (worst is None or occ[0] > worst[0][0]):
+                    worst = (occ, used_name)
+            if worst is None:
+                continue
+            occ, dev = worst
+            rising = len(occ) >= 4 and all(
+                a > b for a, b in zip(occ, occ[1:]))  # newest first
+            if occ[0] > self.config.hbm_threshold or \
+                    (rising and occ[0] > self.config.hbm_trend_floor):
+                out.append(self._raise(
+                    alerts, 'hbm-pressure',
+                    f'task {task.id} ({task.name}): HBM occupancy '
+                    f'{occ[0]:.0%} on {dev.split(".")[0]}'
+                    + (' and rising' if rising else '')
+                    + f' (threshold {self.config.hbm_threshold:.0%})',
+                    task,
+                    severity='critical'
+                    if occ[0] > self.config.hbm_threshold else 'warning',
+                    details={'occupancy': round(occ[0], 4),
+                             'rising': rising}))
+            else:
+                alerts.resolve_for_task(task.id, rule='hbm-pressure')
+        return out
+
+
+__all__ = ['Watchdog', 'WatchdogConfig']

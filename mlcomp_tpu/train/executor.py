@@ -238,11 +238,37 @@ class JaxTrain(Executor):
         self._telemetry = None
         self._profiler = None
         ok = False
+        # the train loop's leg of the cross-process trace: a
+        # `train.work` root (role='train') with per-epoch child spans
+        # (record_span below), joined to the supervisor dispatch and
+        # worker pipeline spans by the trace id the task environment /
+        # additional_info carries (telemetry/spans.py trace context)
+        self._span_cm = None
+        if self.telemetry_spec is not None and self.session is not None \
+                and getattr(self, 'task', None) is not None:
+            from mlcomp_tpu.telemetry import span
+            info = dict(getattr(self, 'additional_info', None) or {})
+            self._span_cm = span(
+                'train.work', task=self.task.id, role='train',
+                trace_id=info.get('trace_id') or None,
+                tags={'model': self.model_spec.get('name')})
+            self._span_cm.__enter__()
         try:
             result = self._work()
             ok = True
             return result
         finally:
+            if self._span_cm is not None:
+                import sys as _sys
+                try:
+                    self._span_cm.__exit__(*_sys.exc_info())
+                except BaseException:
+                    pass       # the span re-raises the active error
+                from mlcomp_tpu.telemetry import flush_spans
+                try:
+                    flush_spans(self.session)
+                except Exception:
+                    pass
             if self._profiler is not None:
                 try:
                     self._profiler.close()
@@ -718,6 +744,17 @@ class JaxTrain(Executor):
                     from mlcomp_tpu.telemetry import record_device_stats
                     record_device_stats(tel)
                     tel.flush()
+                    # per-epoch child span under train.work — the
+                    # epoch timer already measured the interval, so
+                    # this is a buffered append, not a re-indent of
+                    # the whole epoch body
+                    from mlcomp_tpu.telemetry import record_span
+                    record_span(
+                        'train.epoch', started=t_ep,
+                        duration=time.time() - t_ep,
+                        task=self.task.id, role='train',
+                        tags={'epoch': global_epoch,
+                              'stage': stage_name})
                 if self._profiler is not None:
                     self._profiler.poll()
                 self.info(

@@ -99,12 +99,26 @@ def queue_names(index: int = None):
 
 
 # --------------------------------------------------------------- consumer
-def _run_subprocess(task_id: int, index: int, logger, session) -> bool:
+def _run_subprocess(task_id: int, index: int, logger, session,
+                    trace_id: str = None) -> bool:
     """Execute a task in a child process; returns success."""
     env = dict(os.environ)
     # exec-time marker read back via /proc/<pid>/environ by kill_task's
     # pid-reuse guard
     env['MLCOMP_TASK_ID'] = str(task_id)
+    from mlcomp_tpu.telemetry import PROCESS_ROLE_ENV, TRACE_ID_ENV
+    if trace_id:
+        # queue payload → task environment: the child's spans join the
+        # submission's trace with no plumbing inside the task code
+        from mlcomp_tpu.telemetry import trace_context_env
+        env.update(trace_context_env(trace_id=trace_id,
+                                     process_role='worker'))
+    else:
+        # no trace on this dispatch: strip anything inherited from the
+        # daemon's own environment so a PREVIOUS task's trace id can't
+        # mislabel this child's spans
+        env.pop(TRACE_ID_ENV, None)
+        env.pop(PROCESS_ROLE_ENV, None)
     cmd = [sys.executable, '-m', 'mlcomp_tpu.worker', 'run-task',
            str(task_id), '--index', str(index)]
     proc = subprocess.Popen(cmd, env=env)
@@ -121,12 +135,13 @@ def _consume_one(session, queue_provider, logger, index: int,
     msg_id, payload = claim
     action = payload.get('action')
     task_id = payload.get('task_id')
+    trace_id = payload.get('trace_id')
     try:
         if action == 'execute':
             if in_process:
                 from mlcomp_tpu.worker.tasks import execute_by_id
                 execute_by_id(task_id, exit=False, worker_index=index,
-                              session=session)
+                              session=session, trace_id=trace_id)
                 ok = True
                 # this process holds the live TPU client — it is the
                 # only one that can report HBM telemetry (worker_usage
@@ -138,7 +153,8 @@ def _consume_one(session, queue_provider, logger, index: int,
                     except Exception:
                         pass
             else:
-                ok = _run_subprocess(task_id, index, logger, session)
+                ok = _run_subprocess(task_id, index, logger, session,
+                                     trace_id=trace_id)
             if ok:
                 queue_provider.complete(msg_id)
             else:

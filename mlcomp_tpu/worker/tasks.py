@@ -34,15 +34,71 @@ from mlcomp_tpu.utils.misc import now, set_global_seed
 from mlcomp_tpu.worker.storage import Storage
 
 
+#: once-per-process guard for the crash-time telemetry drain
+_crash_flush_installed = False
+
+
+def _install_crash_flush(session):
+    """Make the telemetry of a DYING task survive it: an atexit hook
+    drains the span ring and every live MetricRecorder, and a SIGTERM
+    handler converts the signal into SystemExit so ``finally`` blocks
+    (span exits, recorder close) actually run before the drain. The
+    spans of a failed/killed task are the ones the watchdog and the
+    trace view most need — without this they die with the process,
+    because SIGTERM's default disposition skips ``finally``."""
+    global _crash_flush_installed
+    if _crash_flush_installed:
+        return
+    _crash_flush_installed = True
+    import atexit
+    import signal
+    import threading
+
+    def _drain():
+        from mlcomp_tpu.telemetry import (
+            flush_live_recorders, flush_spans,
+        )
+        try:
+            flush_spans(session)
+        except Exception:
+            pass
+        try:
+            flush_live_recorders()
+        except Exception:
+            pass
+
+    atexit.register(_drain)
+    if threading.current_thread() is not threading.main_thread():
+        return                  # signal API is main-thread only
+    try:
+        previous = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            if callable(previous):
+                try:
+                    previous(signum, frame)
+                except (SystemExit, KeyboardInterrupt):
+                    raise
+                except Exception:
+                    pass
+            raise SystemExit(143)
+
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):
+        pass
+
+
 class ExecuteBuilder:
     def __init__(self, task_id: int, repeat_count: int = 1,
                  exit_on_finish: bool = False, worker_index: int = -1,
-                 folder: str = None, session: Session = None):
+                 folder: str = None, session: Session = None,
+                 trace_id: str = None):
         self.task_id = task_id
         self.repeat_count = repeat_count
         self.exit_on_finish = exit_on_finish
         self.worker_index = worker_index
         self.folder = folder  # pre-existing code folder (debug mode)
+        self.trace_id = trace_id  # from the queue payload (else env/info)
         self.session = session or Session.create_session(key='worker')
         self.logger = create_logger(self.session)
         self.provider = TaskProvider(self.session)
@@ -67,6 +123,26 @@ class ExecuteBuilder:
         info = self.additional_info()
         for k, v in (info.get('env') or {}).items():
             os.environ[str(k)] = str(v)
+        # join the submission's trace: payload arg wins (queued
+        # dispatch), else the task's own additional_info (stored at
+        # submission — covers the run-task subprocess AND debug
+        # in-process mode). Deliberately NOT the process context as a
+        # fallback: in a persistent in-process worker it may still
+        # hold the PREVIOUS task's trace, and resurrecting it would
+        # mislabel this task's spans. The context is resolved at span
+        # EXIT, so the already-open task.pipeline root still lands in
+        # the trace.
+        from mlcomp_tpu.telemetry import (
+            get_trace_context, set_trace_context,
+        )
+        trace_id = self.trace_id or info.get('trace_id')
+        if trace_id:
+            set_trace_context(trace_id,
+                              get_trace_context()[1] or 'worker')
+        else:
+            # traceless task: clear any previous task's context (and
+            # the exported env) so nothing inherits a stale trace
+            set_trace_context(None)
 
     def additional_info(self) -> dict:
         if not self.task.additional_info:
@@ -246,6 +322,7 @@ class ExecuteBuilder:
         # task's wall-clock go?" (code download vs executor import vs
         # the run itself) is answerable from GET /telemetry/spans
         from mlcomp_tpu.telemetry.spans import flush_spans, span
+        _install_crash_flush(self.session)
         try:
             with span('task.pipeline', task=self.task_id):
                 with span('task.load'):
@@ -288,18 +365,23 @@ class ExecuteBuilder:
 
 
 def execute_by_id(task_id: int, exit: bool = False, folder: str = None,
-                  worker_index: int = -1, session: Session = None):
+                  worker_index: int = -1, session: Session = None,
+                  trace_id: str = None):
     builder = ExecuteBuilder(
         task_id, exit_on_finish=exit, folder=folder,
-        worker_index=worker_index, session=session)
+        worker_index=worker_index, session=session, trace_id=trace_id)
     return builder.build()
 
 
-def _pid_is_task_process(pid: int, task_id: int = None) -> bool:
+def _pid_is_task_process(pid: int, task_id: int = None,
+                         require_marker: bool = False) -> bool:
     """Guard against pid reuse: only SIGTERM a process that carries the
     MLCOMP_TASK_ID exec-time env marker for this task (set by the worker
     when spawning the task subprocess) or that is an mlcomp_tpu process
-    (in-process worker daemon mode)."""
+    (in-process worker daemon mode). ``require_marker`` disables the
+    daemon-cmdline fallback — used for already-finished statuses where
+    killing the persistent daemon itself would be worse than leaking
+    the process."""
     try:
         import psutil
         proc = psutil.Process(pid)
@@ -313,6 +395,8 @@ def _pid_is_task_process(pid: int, task_id: int = None) -> bool:
                 # a marker naming a DIFFERENT task means the pid was
                 # reused by another task's subprocess — never kill it
                 return marker == str(task_id)
+        if require_marker:
+            return False
         # no marker readable: in-process daemon mode (the daemon itself
         # runs the task) — match on the daemon cmdline
         return 'mlcomp_tpu' in ' '.join(proc.cmdline())
@@ -335,14 +419,23 @@ def kill_task(task_id: int, session: Session = None):
         return False
     if task.queue_id is not None:
         QueueProvider(session).revoke(task.queue_id)
-    # Stopped included: a remote-routed kill arrives AFTER the initiator
-    # already flipped the status, but the process is still alive
+    # Stopped/Failed included: a remote-routed kill arrives AFTER the
+    # initiator already flipped the status — Stopped by a plain stop,
+    # Failed by the watchdog's stall handling — but the process is
+    # still alive. For Failed the pid-kill additionally requires the
+    # MLCOMP_TASK_ID marker to name THIS task (no daemon-cmdline
+    # fallback): a user stopping an already-failed task in in-process
+    # daemon mode must not terminate the daemon.
     if task.status in (int(TaskStatus.InProgress),
-                       int(TaskStatus.Stopped)) and task.pid:
+                       int(TaskStatus.Stopped),
+                       int(TaskStatus.Failed)) and task.pid:
         from mlcomp_tpu.utils.misc import hostname
         local = task.computer_assigned in (None, '', hostname())
         if local:
-            if _pid_is_task_process(task.pid, task.id):
+            if _pid_is_task_process(
+                    task.pid, task.id,
+                    require_marker=task.status ==
+                    int(TaskStatus.Failed)):
                 from mlcomp_tpu.utils.misc import kill_child_processes
                 import signal
                 kill_child_processes(task.pid)

@@ -127,6 +127,121 @@ class TestQueue:
         assert q.revoke(m) is False  # already revoked
 
 
+class TestQueueReturningFallback:
+    """The atomic claim on sqlite < 3.35 (no UPDATE ... RETURNING —
+    this class exercises BOTH code paths explicitly so the suite
+    covers them regardless of the host's sqlite)."""
+
+    def _flow(self, session):
+        q = QueueProvider(session)
+        m1 = q.enqueue('hq', {'action': 'execute', 'task_id': 1})
+        m2 = q.enqueue('hq', {'action': 'execute', 'task_id': 2})
+        first = q.claim(['hq'], 'w1')
+        second = q.claim(['hq'], 'w2')
+        assert first is not None and second is not None
+        # at-most-once: oldest first, never the same message twice
+        assert first[0] == m1 and first[1]['task_id'] == 1
+        assert second[0] == m2
+        assert q.claim(['hq'], 'w3') is None
+        assert q.status(m1) == 'claimed'
+
+    def test_fallback_path_claims_at_most_once(self, session,
+                                               monkeypatch):
+        import mlcomp_tpu.db.providers.queue as qmod
+        monkeypatch.setattr(qmod, '_RETURNING_OK', False)
+        self._flow(session)
+
+    def test_returning_path_or_live_downgrade(self, session,
+                                              monkeypatch):
+        """With the flag forced on, claim() either runs the RETURNING
+        statement (sqlite >= 3.35) or hits the syntax error ONCE,
+        downgrades the module flag and serves the claim through the
+        fallback — the caller never sees a difference."""
+        import sqlite3
+
+        import mlcomp_tpu.db.providers.queue as qmod
+        monkeypatch.setattr(qmod, '_RETURNING_OK', True)
+        self._flow(session)
+        expected = sqlite3.sqlite_version_info >= (3, 35, 0)
+        assert qmod._RETURNING_OK is expected
+
+    def test_fallback_skips_raced_away_candidate(self, session,
+                                                 monkeypatch):
+        """Two pollers SELECT the same oldest pending id; the loser's
+        conditional UPDATE hits rowcount 0 and must move on to the
+        next message instead of returning a message someone else
+        owns."""
+        import mlcomp_tpu.db.providers.queue as qmod
+        monkeypatch.setattr(qmod, '_RETURNING_OK', False)
+        q = QueueProvider(session)
+        m1 = q.enqueue('rq', {'action': 'execute', 'task_id': 1})
+        m2 = q.enqueue('rq', {'action': 'execute', 'task_id': 2})
+
+        real_query_one = type(session).query_one
+        stolen = {'done': False}
+
+        def steal_between_select_and_update(self_s, sql, params=()):
+            row = real_query_one(self_s, sql, params)
+            if not stolen['done'] and row is not None \
+                    and 'queue_message' in sql and 'pending' in sql:
+                stolen['done'] = True
+                # another worker wins the candidate mid-flight
+                session.execute(
+                    "UPDATE queue_message SET status='claimed', "
+                    "claimed_by='rival' WHERE id=?", (row['id'],))
+            return row
+
+        monkeypatch.setattr(type(session), 'query_one',
+                            steal_between_select_and_update)
+        claimed = q.claim(['rq'], 'slow-worker')
+        monkeypatch.setattr(type(session), 'query_one', real_query_one)
+        assert claimed is not None
+        assert claimed[0] == m2          # m1 was stolen — moved on
+        assert q.status(m1) == 'claimed'
+        assert q.status(m2) == 'claimed'
+
+
+class TestMigrationV6:
+    def test_v5_db_upgrades_in_place(self, session, tmp_path):
+        """A pre-v6 DB (telemetry_span without trace columns, no alert
+        table) must upgrade via the guarded ALTERs and accept the new
+        insert shape."""
+        from mlcomp_tpu.db.core import Session
+        from mlcomp_tpu.db.migration import migrate
+        from mlcomp_tpu.db.providers.telemetry import (
+            TelemetrySpanProvider,
+        )
+        old = Session(f'sqlite:///{tmp_path}/old.db', key='v5_upgrade')
+        try:
+            # v5-era schema: the old column set, version pinned to 5
+            old.execute(
+                'CREATE TABLE telemetry_span ('
+                'id INTEGER PRIMARY KEY AUTOINCREMENT, span_id TEXT, '
+                'parent_id TEXT, task INTEGER, name TEXT, started REAL, '
+                'duration REAL, status TEXT, tags TEXT)')
+            old.execute(
+                'CREATE TABLE metric ('
+                'id INTEGER PRIMARY KEY AUTOINCREMENT, task INTEGER, '
+                'name TEXT, kind TEXT, step INTEGER, value REAL, '
+                'time TEXT, component TEXT, tags TEXT)')
+            old.execute(
+                'CREATE TABLE migration_version (version INTEGER)')
+            old.execute(
+                'INSERT INTO migration_version (version) VALUES (5)')
+            migrate(old)
+            cols = {r['name'] for r in
+                    old.query('PRAGMA table_info(telemetry_span)')}
+            assert {'trace_id', 'process_role'} <= cols
+            provider = TelemetrySpanProvider(old)
+            provider.add_many([('a-1', None, 1, 'x', 0.0, 0.1, 'ok',
+                                None, 'tr1', 'worker')])
+            (row,) = provider.by_trace('tr1')
+            assert row.process_role == 'worker'
+            assert old.query('SELECT * FROM alert') == []
+        finally:
+            Session.cleanup('v5_upgrade')
+
+
 class TestLayouts:
     def test_seeded(self, session):
         lp = ReportLayoutProvider(session)

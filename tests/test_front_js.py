@@ -304,6 +304,54 @@ class TestDashboardRenders:
         assert 'STALE' not in live_row
 
 
+class TestObservabilityCards:
+    def test_task_detail_renders_trace_waterfall(self, browser,
+                                                 session):
+        """Spans carrying a trace id make the task detail fetch the
+        assembled cross-process trace and render the waterfall —
+        executed in the real JS interpreter against the real API."""
+        from mlcomp_tpu.telemetry import (
+            SpanBuffer, flush_spans, new_trace_id, span,
+        )
+        task_id = browser.seeded['task']
+        tid = new_trace_id()
+        buf = SpanBuffer()
+        with span('supervisor.dispatch', task=task_id, buffer=buf,
+                  trace_id=tid, role='supervisor'):
+            pass
+        with span('task.pipeline', task=task_id, buffer=buf,
+                  trace_id=tid, role='worker'):
+            with span('task.execute', buffer=buf, trace_id=tid,
+                      role='worker'):
+                pass
+        flush_spans(session, buf)
+        browser.call('open_', 'task', task_id)
+        html = browser.html('#main')
+        assert 'telemetry spans' in html
+        assert 'trace <span' in html and tid in html
+        assert 'supervisor.dispatch' in html
+        # the waterfall legend names all three roles
+        assert '>supervisor</span>' in html
+        assert '>train</span>' in html
+        assert 'process(es)' in html
+
+    def test_supervisor_tab_alerts_card(self, browser, session):
+        from mlcomp_tpu.db.providers import AlertProvider
+        AlertProvider(session).raise_alert(
+            'task-stall', 'task 7 stuck for 400s', task=7,
+            severity='critical', computer='host9')
+        browser.call('go', 'supervisor')
+        html = browser.html('#main')
+        assert 'alerts (1 open)' in html
+        assert 'task-stall' in html
+        assert 'stuck for 400s' in html
+        assert 'critical' in html
+        # resolve button acks through the real API and re-renders
+        browser.click_text('resolve')
+        html = browser.html('#main')
+        assert 'no open alerts' in html
+
+
 class TestJsrtRegressions:
     def test_return_multiline_template_no_asi(self):
         """The bug class that silently broke every renderer: a template

@@ -291,6 +291,66 @@ class TestWorkerConsume:
         assert msg_status == 'done'
 
 
+class TestTracePropagation:
+    def test_dispatch_to_consume_joins_one_trace(self, session,
+                                                 tmp_path, monkeypatch):
+        """The real path end to end: dag_standard mints the trace id →
+        the supervisor's dispatch span + queue payload carry it → the
+        consuming worker's pipeline spans land in the SAME trace."""
+        from mlcomp_tpu.db.providers import TelemetrySpanProvider
+        from mlcomp_tpu.server.create_dags.standard import dag_standard
+        from mlcomp_tpu.utils.io import yaml_load
+        from mlcomp_tpu.utils.logging import create_logger
+        import mlcomp_tpu.worker.__main__ as wmain
+
+        folder = tmp_path / 'exp'
+        folder.mkdir()
+        (folder / 'executors.py').write_text(
+            'from mlcomp_tpu.worker.executors import Executor\n'
+            '@Executor.register\n'
+            'class TraceNoop(Executor):\n'
+            '    def __init__(self, **kw):\n'
+            '        pass\n'
+            '    def work(self):\n'
+            '        return {"done": 1}\n')
+        config = {
+            'info': {'name': 'trace_dag', 'project': 'p_trace'},
+            'executors': {'job': {'type': 'trace_noop'}},
+        }
+        dag, tasks = dag_standard(session, config,
+                                  upload_folder=str(folder))
+        task_id = tasks['job'][0]
+        task = TaskProvider(session).by_id(task_id)
+        trace_id = yaml_load(task.additional_info)['trace_id']
+        assert trace_id
+
+        monkeypatch.setattr(wmain, 'HOSTNAME', 'host1')
+        add_computer(session, name='host1')
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+
+        # the queue payload carries the trace id
+        pending = QueueProvider(session).pending('host1_default')
+        payload = json.loads(pending[0].payload)
+        assert payload['trace_id'] == trace_id
+
+        logger = create_logger(session)
+        assert wmain._consume_one(session, QueueProvider(session),
+                                  logger, 0, in_process=True)
+
+        spans = TelemetrySpanProvider(session).by_task(task_id)
+        by_name = {s.name: s for s in spans}
+        dispatch = by_name['supervisor.dispatch']
+        assert dispatch.trace_id == trace_id
+        assert dispatch.process_role == 'supervisor'
+        pipeline = by_name['task.pipeline']
+        assert pipeline.trace_id == trace_id
+        tree = TelemetrySpanProvider(session).trace_tree(trace_id)
+        roles = {p['role'] for p in tree['processes']}
+        assert 'supervisor' in roles
+        assert tree['span_count'] >= len(spans)
+
+
 class TestKill:
     def test_remote_kill_routes_through_queue(self, session, dag_id):
         """A kill for a task InProgress on ANOTHER host must not os.kill
@@ -427,6 +487,61 @@ class TestKill:
         finally:
             if proc.poll() is None:
                 proc.kill()
+
+    def test_failed_task_kill_still_kills_marked_pid(self, session,
+                                                     dag_id):
+        """The watchdog handoff: the supervisor flips a stalled task to
+        Failed right after routing the kill — when the owning host's
+        agent finally processes it, the pid (verified by the task
+        marker) must still die."""
+        import os
+        import socket
+        import subprocess
+        import sys
+        import time
+        from mlcomp_tpu.worker.tasks import kill_task
+        task = add_task(session, dag_id, name='failed_job')
+        proc = subprocess.Popen(
+            [sys.executable, '-c', 'import time; time.sleep(300)'],
+            env={**os.environ, 'MLCOMP_TASK_ID': str(task.id)})
+        try:
+            tp = TaskProvider(session)
+            task.computer_assigned = socket.gethostname()
+            task.pid = proc.pid
+            tp.update(task, ['computer_assigned', 'pid'])
+            tp.change_status(task, TaskStatus.InProgress)
+            tp.change_status(task, TaskStatus.Failed)
+            assert kill_task(task.id, session=session)
+            deadline = time.time() + 10
+            while proc.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            assert proc.poll() is not None
+            # status stays Failed (kill_task never downgrades it)
+            assert tp.by_id(task.id).status == int(TaskStatus.Failed)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+    def test_failed_task_kill_never_matches_markerless_daemon(self):
+        """In-process daemon mode the task pid IS the daemon — a kill
+        on an already-Failed task must NOT fall back to the cmdline
+        match and terminate the daemon."""
+        import os
+        import subprocess
+        import sys
+        from mlcomp_tpu.worker.tasks import _pid_is_task_process
+        proc = subprocess.Popen(
+            [sys.executable, '-c',
+             'import time; time.sleep(60)  # mlcomp_tpu daemon stand-in'],
+            env={k: v for k, v in os.environ.items()
+                 if k != 'MLCOMP_TASK_ID'})
+        try:
+            # markerless: InProgress/Stopped kills may use the cmdline
+            # fallback, Failed kills (require_marker) must not
+            assert not _pid_is_task_process(proc.pid, 42,
+                                            require_marker=True)
+        finally:
+            proc.kill()
 
     def test_distr_false_stays_single_node(self, session, dag_id):
         """cores_max>1 with distr:false must take the single-node path

@@ -489,3 +489,192 @@ class TestSupervisorTelemetry:
         series = MetricProvider(session).series(component='supervisor')
         assert 'supervisor.tick_ms' in series
         assert series['supervisor.tick_ms'][0]['value'] >= 0
+
+
+class TestApiLimits:
+    """GET/POST /telemetry/series|spans: limit/offset are validated
+    (negative/garbage -> 400) and capped, never handed raw to SQL."""
+
+    def _seed(self, session):
+        task = make_task(session)
+        rec = MetricRecorder(session=session, task=task.id,
+                             component='train', flush_every=10 ** 9)
+        for i in range(6):
+            rec.series('loss', 1.0 - 0.1 * i, step=i)
+        rec.flush()
+        buf = SpanBuffer()
+        with span('task.pipeline', task=task.id, buffer=buf):
+            with span('task.execute', buffer=buf):
+                pass
+        flush_spans(session, buf)
+        return task
+
+    def test_negative_limit_is_400(self, api, session):
+        import urllib.error
+        task = self._seed(session)
+        for url in (f'/telemetry/series?task={task.id}&limit=-1',
+                    f'/telemetry/spans?task={task.id}&offset=-5'):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                api(url, method='GET', token=None)
+            assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/api/telemetry/series',
+                {'task': task.id, 'limit': 'lots'})
+        assert e.value.code == 400
+
+    def test_limit_and_offset_page_series(self, api, session):
+        task = self._seed(session)
+        out = api(f'/telemetry/series?task={task.id}&limit=2',
+                  method='GET', token=None)
+        assert sum(len(v) for v in out['series'].values()) == 2
+        page2 = api(
+            f'/telemetry/series?task={task.id}&limit=2&offset=2',
+            method='GET', token=None)
+        steps = [p['step'] for p in page2['series']['loss']]
+        assert steps == [2, 3]
+
+    def test_spans_limit(self, api, session):
+        task = self._seed(session)
+        out = api(f'/telemetry/spans?task={task.id}&limit=1',
+                  method='GET', token=None)
+        assert len(out['spans']) == 1
+        assert out['spans'][0]['children'] == []
+
+    def test_huge_limit_is_capped_not_error(self, api, session):
+        task = self._seed(session)
+        out = api(f'/telemetry/series?task={task.id}&limit=999999999',
+                  method='GET', token=None)
+        assert len(out['series']['loss']) == 6
+
+
+class TestTraceContext:
+    def test_span_records_trace_and_role(self, session):
+        from mlcomp_tpu.telemetry import new_trace_id
+        task = make_task(session)
+        tid = new_trace_id()
+        buf = SpanBuffer()
+        with span('outer', task=task.id, buffer=buf, trace_id=tid,
+                  role='supervisor'):
+            # nested spans do NOT auto-inherit the explicit arg — they
+            # read the process context, unset here
+            with span('inner', buffer=buf, trace_id=tid, role='worker'):
+                pass
+        flush_spans(session, buf)
+        from mlcomp_tpu.db.providers import TelemetrySpanProvider
+        rows = {r.name: r for r in
+                TelemetrySpanProvider(session).by_task(task.id)}
+        assert rows['outer'].trace_id == tid
+        assert rows['outer'].process_role == 'supervisor'
+        assert rows['inner'].trace_id == tid
+        assert rows['inner'].process_role == 'worker'
+
+    def test_context_env_round_trip(self):
+        from mlcomp_tpu.telemetry import trace_context_env
+        env = trace_context_env(trace_id='abc123',
+                                process_role='train')
+        assert env == {'MLCOMP_TRACE_ID': 'abc123',
+                       'MLCOMP_PROCESS_ROLE': 'train'}
+
+    def test_trace_tree_assembles_across_processes(self, api, session):
+        """Acceptance: one trace_id joins spans from 3 DISTINCT
+        processes — supervisor (this process), worker and train (real
+        subprocess entries that pick the context up from the
+        environment) — and GET /telemetry/trace/<id> returns the
+        assembled tree."""
+        import os
+        import subprocess
+        import sys
+        from mlcomp_tpu.db.providers import TelemetrySpanProvider
+        from mlcomp_tpu.telemetry import new_trace_id, trace_context_env
+
+        task = make_task(session)
+        tid = new_trace_id()
+        buf = SpanBuffer()
+        with span('supervisor.dispatch', task=task.id, buffer=buf,
+                  trace_id=tid, role='supervisor'):
+            pass
+        flush_spans(session, buf)
+
+        child_src = (
+            'import sys\n'
+            'from mlcomp_tpu.db.core import Session\n'
+            'from mlcomp_tpu.telemetry import span, flush_spans\n'
+            's = Session.create_session()\n'
+            'with span(sys.argv[1], task=int(sys.argv[2])):\n'
+            '    pass\n'
+            'raise SystemExit(0 if flush_spans(s) == 1 else 1)\n')
+        for name, role in (('task.pipeline', 'worker'),
+                           ('train.work', 'train')):
+            env = {**os.environ,
+                   'MLCOMP_TPU_KEEP_ROOT': '1',  # don't wipe the
+                   # parent's sandbox on child import
+                   **trace_context_env(trace_id=tid,
+                                       process_role=role)}
+            subprocess.run(
+                [sys.executable, '-c', child_src, name, str(task.id)],
+                env=env, check=True, timeout=120)
+
+        tree = TelemetrySpanProvider(session).trace_tree(tid)
+        assert tree['span_count'] == 3
+        assert {p['role'] for p in tree['processes']} == \
+            {'supervisor', 'worker', 'train'}
+        # three DISTINCT pids — the span-id prefix is the pid
+        assert len({p['pid'] for p in tree['processes']}) == 3
+
+        out = api(f'/telemetry/trace/{tid}', method='GET', token=None)
+        assert out['span_count'] == 3
+        assert {s['name'] for s in out['spans']} == \
+            {'supervisor.dispatch', 'task.pipeline', 'train.work'}
+        for s in out['spans']:
+            assert s['trace_id'] == tid
+
+    def test_trace_api_requires_id(self, api):
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/api/telemetry/trace', {})
+        assert e.value.code == 400
+
+    def test_unknown_trace_is_empty_not_error(self, api):
+        out = api('/telemetry/trace/nope', method='GET', token=None)
+        assert out['span_count'] == 0
+        assert out['spans'] == []
+
+
+class TestCrashFlush:
+    def test_sigterm_flushes_spans_and_metrics(self, session):
+        """The satellite: a SIGTERM'd task process must not take its
+        telemetry down with it — the handler converts the signal into
+        SystemExit (so the open span exits with status=error) and the
+        atexit drain lands both buffers in the DB."""
+        import os
+        import subprocess
+        import sys
+        from mlcomp_tpu.db.providers import TelemetrySpanProvider
+
+        task = make_task(session)
+        child_src = (
+            'import os, signal, sys, time\n'
+            'from mlcomp_tpu.db.core import Session\n'
+            'from mlcomp_tpu.telemetry import MetricRecorder, span\n'
+            'from mlcomp_tpu.worker.tasks import _install_crash_flush\n'
+            's = Session.create_session()\n'
+            'task = int(sys.argv[1])\n'
+            'rec = MetricRecorder(session=s, task=task,\n'
+            '                     component="train",\n'
+            '                     flush_every=10 ** 9)\n'
+            'rec.series("loss", 0.5, step=0)\n'
+            '_install_crash_flush(s)\n'
+            'with span("doomed", task=task):\n'
+            '    os.kill(os.getpid(), signal.SIGTERM)\n'
+            '    time.sleep(60)\n')
+        proc = subprocess.run(
+            [sys.executable, '-c', child_src, str(task.id)],
+            env={**os.environ, 'MLCOMP_TPU_KEEP_ROOT': '1'},
+            timeout=120)
+        assert proc.returncode == 143        # SystemExit(143), not -15
+
+        (row,) = TelemetrySpanProvider(session).by_task(task.id)
+        assert row.name == 'doomed'
+        assert row.status == 'error'         # SIGTERM mid-span
+        series = MetricProvider(session).series(task_id=task.id)
+        assert series['loss'][0]['value'] == pytest.approx(0.5)

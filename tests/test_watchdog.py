@@ -1,0 +1,352 @@
+"""Watchdog + alerting tests: each rule against synthetic stalled/
+regressed/straggler/HBM series, alert dedup + resolution, the
+supervisor failing a stalled task, the alerts API and CLI."""
+
+import datetime
+import json
+import urllib.request
+
+import pytest
+
+from mlcomp_tpu.db.enums import TaskStatus
+from mlcomp_tpu.db.models import Dag, Task
+from mlcomp_tpu.db.providers import (
+    AlertProvider, DagProvider, MetricProvider, TaskProvider,
+)
+from mlcomp_tpu.telemetry import Watchdog, WatchdogConfig
+from mlcomp_tpu.utils.misc import now
+
+from tests.test_telemetry import api  # noqa: F401  (live-server fixture)
+
+
+def make_task(session, name='t', status=TaskStatus.InProgress,
+              age_s=0.0, parent=None, computer=None):
+    from mlcomp_tpu.db.providers import ProjectProvider
+    provider = ProjectProvider(session)
+    project = provider.by_name('p_watchdog')
+    if project is None:
+        provider.add_project('p_watchdog')
+        project = provider.by_name('p_watchdog')
+    dag = Dag(name='d', project=project.id, config='', created=now(),
+              docker_img='default')
+    DagProvider(session).add(dag)
+    ts = now() - datetime.timedelta(seconds=age_s)
+    task = Task(name=name, executor='e', dag=dag.id,
+                status=int(status), parent=parent,
+                computer_assigned=computer,
+                started=ts, last_activity=ts)
+    TaskProvider(session).add(task)
+    return task
+
+
+def add_series(session, task_id, name, values, component='train',
+               start_step=0):
+    """Insert a metric series in chronological order (step = index)."""
+    ts = now()
+    MetricProvider(session).add_many([
+        (task_id, name, 'series', start_step + i, float(v), ts,
+         component, None)
+        for i, v in enumerate(values)])
+
+
+def fast_config(**overrides):
+    base = dict(evaluate_every_s=0.0, baseline_window=4,
+                recent_window=2)
+    base.update(overrides)
+    return WatchdogConfig(**base)
+
+
+class TestStallRule:
+    def test_stalled_task_raises_critical_alert(self, session):
+        task = make_task(session, age_s=120)
+        wd = Watchdog(session, fast_config(stall_deadline_s=30))
+        findings = wd.evaluate()
+        assert [f['rule'] for f in findings] == ['task-stall']
+        assert findings[0]['task'] == task.id
+        assert findings[0]['severity'] == 'critical'
+        (alert,) = AlertProvider(session).get()
+        assert alert.rule == 'task-stall'
+        assert alert.status == 'open'
+
+    def test_live_heartbeat_suppresses(self, session):
+        make_task(session, age_s=0)
+        wd = Watchdog(session, fast_config(stall_deadline_s=30))
+        assert wd.evaluate() == []
+
+    def test_fresh_metric_sample_counts_as_life(self, session):
+        # stale task row but a metric sample just landed: not stalled —
+        # the train loop is alive even if nothing updated the task row
+        task = make_task(session, age_s=120)
+        add_series(session, task.id, 'loss', [0.5])
+        wd = Watchdog(session, fast_config(stall_deadline_s=30))
+        assert wd.evaluate() == []
+
+    def test_sibling_evidence_pools_for_distributed_children(
+            self, session):
+        """Only rank 0 of a distributed job writes metrics — a healthy
+        non-rank-0 child goes quiet. Any sibling's life must count for
+        the whole group, or the watchdog kills healthy workers."""
+        parent = make_task(session, name='parent',
+                           status=TaskStatus.Queued)
+        quiet = make_task(session, name='rank1', parent=parent.id,
+                          age_s=600)
+        rank0 = make_task(session, name='rank0', parent=parent.id,
+                          age_s=600)
+        add_series(session, rank0.id, 'loss', [0.5])  # fresh heartbeat
+        wd = Watchdog(session, fast_config(stall_deadline_s=60))
+        assert [f for f in wd.evaluate()
+                if f['rule'] == 'task-stall'] == []
+        # the whole group stalling together still fires
+        session.execute('DELETE FROM metric WHERE task=?', (rank0.id,))
+        stalled = {f['task'] for f in wd.evaluate()
+                   if f['rule'] == 'task-stall'}
+        assert stalled == {quiet.id, rank0.id}
+
+    def test_child_evidence_pools_into_distributed_parent(self,
+                                                          session):
+        """The parent row of a multi-host job never executes — its
+        clock freezes at the InProgress transition while rank 0
+        heartbeats its own service-task id. The children's evidence
+        must keep the parent alive."""
+        parent = make_task(session, name='parent', age_s=600)
+        child = make_task(session, name='rank0', parent=parent.id,
+                          age_s=600)
+        add_series(session, child.id, 'loss', [0.5])
+        wd = Watchdog(session, fast_config(stall_deadline_s=60))
+        assert [f for f in wd.evaluate()
+                if f['rule'] == 'task-stall'] == []
+
+    def test_metric_flush_heartbeats_task_row(self, session):
+        from mlcomp_tpu.telemetry import MetricRecorder
+        task = make_task(session, age_s=600)
+        stale = TaskProvider(session).by_id(task.id).last_activity
+        rec = MetricRecorder(session=session, task=task.id,
+                             component='train', flush_every=10 ** 9)
+        rec.series('loss', 0.5, step=0)
+        rec.flush()
+        fresh = TaskProvider(session).by_id(task.id).last_activity
+        assert fresh > stale
+
+    def test_dedup_one_open_row_per_condition(self, session):
+        make_task(session, age_s=120)
+        wd = Watchdog(session, fast_config(stall_deadline_s=30))
+        wd.evaluate()
+        wd.evaluate()
+        assert len(AlertProvider(session).get()) == 1
+
+    def test_rate_limit_skips_inside_window(self, session):
+        make_task(session, age_s=120)
+        wd = Watchdog(session, fast_config(stall_deadline_s=30,
+                                           evaluate_every_s=3600))
+        assert len(wd.maybe_evaluate()) == 1     # first pass runs
+        assert wd.maybe_evaluate() == []         # rate-limited no-op
+
+
+class TestRegressionRule:
+    def test_2x_step_time_regression_flags(self, session):
+        task = make_task(session)
+        add_series(session, task.id, 'step_time_ms',
+                   [100, 100, 100, 100, 300, 310])
+        wd = Watchdog(session, fast_config(stall_deadline_s=3600))
+        findings = wd.evaluate()
+        assert [f['rule'] for f in findings] == ['step-regression']
+        details = findings[0]['details']
+        assert details['recent_ms'] == pytest.approx(305)
+        assert details['baseline_ms'] == pytest.approx(100)
+
+    def test_steady_series_does_not_flag(self, session):
+        task = make_task(session)
+        add_series(session, task.id, 'step_time_ms', [100] * 6)
+        wd = Watchdog(session, fast_config(stall_deadline_s=3600))
+        assert wd.evaluate() == []
+
+    def test_shallow_window_withholds_verdict(self, session):
+        task = make_task(session)
+        add_series(session, task.id, 'step_time_ms', [100, 900])
+        wd = Watchdog(session, fast_config(stall_deadline_s=3600))
+        assert wd.evaluate() == []
+
+    def test_finished_task_sweeps_condition_alerts(self, session):
+        """A regression alert must not outlive its task: when the task
+        leaves the running state the sweep resolves it — stall alerts
+        stay open as the kill's paper trail."""
+        task = make_task(session)
+        stalled = make_task(session, name='dead',
+                            status=TaskStatus.Failed)
+        provider = AlertProvider(session)
+        provider.raise_alert('step-regression', 'slow', task=task.id)
+        provider.raise_alert('task-stall', 'stuck', task=stalled.id)
+        TaskProvider(session).change_status(task, TaskStatus.Success)
+        wd = Watchdog(session, fast_config(stall_deadline_s=3600))
+        wd.evaluate()
+        open_rules = {a.rule for a in provider.get(status='open')}
+        assert open_rules == {'task-stall'}
+        (swept,) = provider.get(status='resolved')
+        assert swept.rule == 'step-regression'
+
+    def test_recovery_resolves_open_alert(self, session):
+        task = make_task(session)
+        add_series(session, task.id, 'step_time_ms',
+                   [100, 100, 100, 100, 300, 310])
+        wd = Watchdog(session, fast_config(stall_deadline_s=3600))
+        assert wd.evaluate()
+        # recovery: recent window back at baseline
+        add_series(session, task.id, 'step_time_ms', [100] * 6,
+                   start_step=6)
+        assert wd.evaluate() == []
+        alerts = AlertProvider(session)
+        assert alerts.get(status='open') == []
+        (resolved,) = alerts.get(status='resolved')
+        assert resolved.rule == 'step-regression'
+
+
+class TestStragglerRule:
+    def test_slow_sibling_flags(self, session):
+        parent = make_task(session, name='parent',
+                           status=TaskStatus.Queued)
+        speeds = {'c0': 100, 'c1': 105, 'c2': 300}
+        children = {}
+        for name, ms in speeds.items():
+            child = make_task(session, name=name, parent=parent.id,
+                              computer=f'host_{name}')
+            add_series(session, child.id, 'step_time_ms', [ms] * 3)
+            children[name] = child
+        wd = Watchdog(session, fast_config(stall_deadline_s=3600))
+        findings = [f for f in wd.evaluate() if f['rule'] == 'straggler']
+        assert len(findings) == 1
+        assert findings[0]['task'] == children['c2'].id
+        assert 'host_c2' in findings[0]['message']
+
+    def test_two_children_is_not_enough(self, session):
+        parent = make_task(session, name='parent',
+                           status=TaskStatus.Queued)
+        for name, ms in (('c0', 100), ('c1', 400)):
+            child = make_task(session, name=name, parent=parent.id)
+            add_series(session, child.id, 'step_time_ms', [ms] * 3)
+        wd = Watchdog(session, fast_config(stall_deadline_s=3600))
+        assert [f for f in wd.evaluate()
+                if f['rule'] == 'straggler'] == []
+
+
+class TestHbmRule:
+    def test_over_threshold_is_critical(self, session):
+        task = make_task(session)
+        add_series(session, task.id, 'device0.hbm_used', [9.5e9])
+        add_series(session, task.id, 'device0.hbm_limit', [1e10])
+        wd = Watchdog(session, fast_config(stall_deadline_s=3600))
+        findings = [f for f in wd.evaluate()
+                    if f['rule'] == 'hbm-pressure']
+        assert len(findings) == 1
+        assert findings[0]['severity'] == 'critical'
+        assert findings[0]['details']['occupancy'] == \
+            pytest.approx(0.95)
+
+    def test_rising_trend_warns_before_threshold(self, session):
+        task = make_task(session)
+        add_series(session, task.id, 'device0.hbm_used',
+                   [7.6e9, 7.8e9, 8.0e9, 8.2e9])
+        add_series(session, task.id, 'device0.hbm_limit', [1e10] * 4)
+        wd = Watchdog(session, fast_config(stall_deadline_s=3600))
+        findings = [f for f in wd.evaluate()
+                    if f['rule'] == 'hbm-pressure']
+        assert len(findings) == 1
+        assert findings[0]['severity'] == 'warning'
+        assert findings[0]['details']['rising'] is True
+
+    def test_flat_low_occupancy_is_quiet(self, session):
+        task = make_task(session)
+        add_series(session, task.id, 'device0.hbm_used', [5e9] * 4)
+        add_series(session, task.id, 'device0.hbm_limit', [1e10] * 4)
+        wd = Watchdog(session, fast_config(stall_deadline_s=3600))
+        assert [f for f in wd.evaluate()
+                if f['rule'] == 'hbm-pressure'] == []
+
+
+class TestSupervisorIntegration:
+    def test_supervisor_fails_stalled_task_with_alert(self, session):
+        """The acceptance path: a stalled InProgress task transitions
+        OUT of the running state on the supervisor tick, with the
+        alert row as the paper trail."""
+        from mlcomp_tpu.server.supervisor import SupervisorBuilder
+        task = make_task(session, age_s=120)
+        sup = SupervisorBuilder(session=session)
+        sup.watchdog.config = fast_config(stall_deadline_s=30)
+        sup.build()
+        refreshed = TaskProvider(session).by_id(task.id)
+        assert refreshed.status == int(TaskStatus.Failed)
+        (alert,) = AlertProvider(session).get(rule='task-stall')
+        assert alert.task == task.id
+        assert sup.aux['watchdog'][0]['rule'] == 'task-stall'
+
+    def test_watchdog_crash_never_breaks_the_tick(self, session,
+                                                  monkeypatch):
+        from mlcomp_tpu.server.supervisor import SupervisorBuilder
+        sup = SupervisorBuilder(session=session)
+        monkeypatch.setattr(
+            sup.watchdog, 'maybe_evaluate',
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError('boom')))
+        sup.build()                       # must not raise
+        assert sup.aux['duration'] is not None
+
+
+class TestAlertProviderAndApi:
+    def test_resolve_and_history(self, session):
+        task = make_task(session)
+        provider = AlertProvider(session)
+        alert = provider.raise_alert('task-stall', 'm', task=task.id)
+        assert provider.resolve(alert.id) is True
+        assert provider.resolve(alert.id) is False     # already closed
+        assert provider.get(status='open') == []
+        assert len(provider.get(status=None)) == 1
+
+    def test_api_alerts_get_and_resolve(self, api, session):
+        task = make_task(session)
+        AlertProvider(session).raise_alert(
+            'step-regression', 'slow', task=task.id)
+        out = api('/api/alerts?status=open', method='GET', token=None)
+        assert len(out['data']) == 1
+        assert out['data'][0]['rule'] == 'step-regression'
+        alert_id = out['data'][0]['id']
+        res = api('/api/alert/resolve', {'id': alert_id})
+        assert res['resolved'] is True
+        out = api('/api/alerts', {'status': 'open'})
+        assert out['data'] == []
+        out = api('/api/alerts', {'status': 'all'})
+        assert len(out['data']) == 1
+
+    def test_api_alerts_bad_status_is_400(self, api):
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/api/alerts', {'status': 'bogus'})
+        assert e.value.code == 400
+
+    def test_api_resolve_requires_auth(self, api, session):
+        import urllib.error
+        task = make_task(session)
+        alert = AlertProvider(session).raise_alert(
+            'straggler', 'm', task=task.id)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/api/alert/resolve', {'id': alert.id}, token='wrong')
+        assert e.value.code == 401
+
+
+class TestCli:
+    def test_alerts_command_lists_and_resolves(self, session):
+        from click.testing import CliRunner
+        from mlcomp_tpu.__main__ import main as cli
+        task = make_task(session)
+        alert = AlertProvider(session).raise_alert(
+            'task-stall', 'stuck for 400s', task=task.id,
+            severity='critical')
+        runner = CliRunner()
+        out = runner.invoke(cli, ['alerts'])
+        assert out.exit_code == 0
+        assert 'task-stall' in out.output
+        assert 'stuck for 400s' in out.output
+        out = runner.invoke(cli, ['alerts', '--json'])
+        rows = json.loads(out.output)
+        assert rows[0]['rule'] == 'task-stall'
+        out = runner.invoke(cli, ['alerts', '--resolve', str(alert.id)])
+        assert out.exit_code == 0 and 'resolved' in out.output
+        out = runner.invoke(cli, ['alerts'])
+        assert 'no open alerts' in out.output
