@@ -53,6 +53,24 @@ class MetricProvider(BaseDataProvider):
                 'time': r['time'], 'kind': r['kind']})
         return out
 
+    def tail_series(self, task_id: int, per_name: int = 64):
+        """Latest ``per_name`` samples of EVERY metric name of a task,
+        each series ascending within its window — the bounded
+        "what is happening NOW" read. The plain ``series()`` ascending
+        LIMIT walks names alphabetically, so on a long run it
+        truncates the NEWEST samples of later-sorting names; this one
+        takes each name's indexed id-DESC tail instead."""
+        out = {}
+        for name in self.names(task_id):
+            rows = self.session.query(
+                'SELECT step, value, time, kind FROM metric '
+                'WHERE task=? AND name=? ORDER BY id DESC LIMIT ?',
+                (int(task_id), name, int(per_name)))
+            out[name] = [{'step': r['step'], 'value': r['value'],
+                          'time': r['time'], 'kind': r['kind']}
+                         for r in reversed(rows)]
+        return out
+
     def names(self, task_id=None, like: str = None):
         """Distinct metric names, optionally restricted to a task
         and/or a LIKE pattern. With the (task, name) composite index
@@ -97,6 +115,17 @@ class MetricProvider(BaseDataProvider):
             (int(task_id), name, int(limit)))
         return [(r['step'], r['value']) for r in rows
                 if r['value'] is not None]
+
+    def recent_samples(self, task_id: int, name: str, limit: int = 32):
+        """Latest ``limit`` (step, value, time) triples of one metric,
+        NEWEST FIRST — for rules that need BOTH the series position and
+        the wall-clock of each sample (the recompile-storm window is
+        time-bounded, its warmup is step-bounded)."""
+        rows = self.session.query(
+            'SELECT step, value, time FROM metric WHERE task=? AND '
+            'name=? ORDER BY id DESC LIMIT ?',
+            (int(task_id), name, int(limit)))
+        return [(r['step'], r['value'], r['time']) for r in rows]
 
     def last_sample_time(self, task_id: int):
         """Wall-clock of the newest sample of a task (datetime or

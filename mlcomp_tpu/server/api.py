@@ -30,6 +30,10 @@ the behavior is subtle):
   assembled cross-process trace, and the on-demand profiler toggle
 - ``/api/alerts`` (GET or POST, no auth) + ``/api/alert/resolve``
   (auth) — watchdog findings (telemetry/watchdog.py)
+- GET ``/metrics`` (no auth) — OpenMetrics export for any Prometheus
+  scraper (telemetry/export.py): queue depth, dispatch latency, task
+  counts, slot occupancy, open alerts, step phase attribution,
+  serving latency buckets
 - ``/api/logs``, ``/api/reports``, ``/api/report``,
   ``/api/report/update_layout_start|update_layout_end``
 - ``/api/remove_imgs``, ``/api/remove_files`` (app.py:672-688)
@@ -554,11 +558,24 @@ def api_telemetry_series(data, s):
     """Metric series recorded from inside the system (telemetry/):
     per-step loss/throughput from the train loop, supervisor tick
     gauges, serving latency summaries. Filter by task / name /
-    component; GET and POST serve the same payload."""
+    component; GET and POST serve the same payload. ``tail=N`` (task
+    required) returns the NEWEST N samples of every metric name
+    instead — the bounded read the dashboard's performance card uses
+    (a plain ascending limit truncates the newest samples of
+    later-sorting names on long runs)."""
     from mlcomp_tpu.db.providers import MetricProvider
     task = _int_arg(data, 'task')
-    limit, offset = _limit_offset(data)
     provider = MetricProvider(s)
+    tail = _int_arg(data, 'tail')
+    if tail is not None:
+        if tail <= 0:
+            raise ApiError('tail must be > 0', status=400)
+        if task is None:
+            raise ApiError('tail requires task', status=400)
+        return {'task': task,
+                'series': provider.tail_series(
+                    task, per_name=min(tail, 1000))}
+    limit, offset = _limit_offset(data)
     return {
         'task': task,
         'series': provider.series(
@@ -1063,6 +1080,39 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self._send_json(
                     {'success': False,
                      'reason': traceback.format_exc()}, 500)
+            return
+        if parsed.path == '/metrics':
+            # OpenMetrics export (telemetry/export.py): everything a
+            # stock Prometheus scraper needs from a deployment — queue
+            # depth, dispatch latency, task counts, slot occupancy,
+            # open alerts, step phase attribution, serving latency
+            # buckets. Same no-auth introspection tier as the
+            # telemetry reads (metric names + floats, no secrets).
+            from mlcomp_tpu.telemetry.export import (
+                OPENMETRICS_CONTENT_TYPE, render_server_metrics,
+            )
+
+            def scrape():
+                # probe OUTSIDE the defensive collectors (which
+                # swallow everything into mlcomp_scrape_errors): a
+                # broken session must RAISE here or the heal/retry
+                # below never fires and every later scrape stays empty
+                s = _session()
+                s.query_one('SELECT 1')
+                return render_server_metrics(s)
+
+            try:
+                try:
+                    body = scrape()
+                except sqlite3.ProgrammingError:
+                    body = scrape()       # healed mid-request: retry
+                self._send_bytes(OPENMETRICS_CONTENT_TYPE,
+                                 body.encode())
+            except Exception as exc:
+                if isinstance(exc, sqlite3.Error):
+                    _heal_session()
+                self._send_json(
+                    {'success': False, 'reason': 'internal error'}, 500)
             return
         if parsed.path in ('/telemetry/series', '/telemetry/spans',
                            '/api/alerts') \

@@ -16,10 +16,12 @@ inline SVG) covering the same surfaces:
   code browser, code zip download
 - task detail: step tree + logs (front/src/app/task/), plus the
   telemetry surfaces this build records from inside the hot paths
-  (telemetry/): per-step metric series charts, gauge table, the span
-  forest with durations, a cross-process trace waterfall (supervisor/
-  worker/train legs on one wall-clock axis), and on-demand profiler
-  start/stop buttons
+  (telemetry/): per-step metric series charts, gauge table, a
+  performance card (step phase breakdown + pipeline efficiency +
+  recompile timeline, telemetry/attribution.py), the span forest with
+  durations, a cross-process trace waterfall (supervisor/worker/train
+  legs on one wall-clock axis), and on-demand profiler start/stop
+  buttons
 - supervisor tab: watchdog alerts card (open alerts + resolve button,
   telemetry/watchdog.py) above the decision trace
 - report detail: LAYOUT-DRIVEN rendering (reference
@@ -750,6 +752,60 @@ function showCode(c) {
   document.getElementById('codeview').textContent = decodeURIComponent(c);
 }
 
+function performanceCard(series) {
+  // step attribution + recompile timeline (telemetry/attribution.py,
+  // telemetry/compile_events.py): latest per-phase breakdown bar,
+  // pipeline efficiency / recompile / host-sync top-lines — why the
+  // step is slow, next to the trace waterfall that shows where the
+  // task's wall-clock went
+  const phases = ['data_wait','h2d','compute','telemetry'];
+  const colors = {data_wait:'#d9a13c', h2d:'#b07fe8',
+                  compute:'#41c07c', telemetry:'#4da3ff'};
+  const last = n => { const pts = series[n]||[];
+    return pts.length ? pts[pts.length-1].value : null; };
+  const vals = {};
+  let total = 0;
+  phases.forEach(p => { const v = last('step.phase.'+p+'_ms');
+    if (v != null) { vals[p] = v; total += v; } });
+  const eff = last('step.pipeline_efficiency');
+  const compiles = series['compile.backend_ms']||[];
+  const syncs = (series['host_sync.suspect_ms']||[]).length;
+  if (!total && eff == null && !compiles.length && !syncs) return '';
+  let html = '<h3>performance</h3><div class="card">'
+    + '<div style="display:flex;gap:18px;margin-bottom:8px">';
+  if (eff != null)
+    html += `<div><b>${(eff*100).toFixed(1)}%</b>
+      <span class="dim">pipeline efficiency</span></div>`;
+  html += `<div><b>${compiles.length}</b>
+    <span class="dim">recompiles</span></div>`;
+  if (syncs)
+    html += `<div><b>${syncs}</b>
+      <span class="dim">host-sync suspects</span></div>`;
+  html += '</div>';
+  if (total) {
+    html += '<div style="display:flex;height:16px;border-radius:4px;'
+      + 'overflow:hidden">'
+      + phases.filter(p => vals[p] != null).map(p =>
+        `<span title="${p}" style="width:${
+          (vals[p]/total*100).toFixed(2)}%;background:${
+          colors[p]}"></span>`).join('')
+      + '</div>'
+      + '<div class="dim" style="font-size:11px;margin-top:4px">'
+      + phases.filter(p => vals[p] != null).map(p =>
+        `<span style="color:${colors[p]}">${p}</span> ${
+          vals[p].toFixed(2)} ms`).join(' &middot; ')
+      + ' (latest step)</div>';
+  }
+  if (compiles.length)
+    html += '<div class="dim" style="font-size:11px;margin-top:6px">'
+      + 'recompile timeline: '
+      + compiles.slice(-8).map(p => 'step '
+        + (p.step == null ? '?' : p.step) + ': '
+        + (+p.value).toFixed(0) + ' ms').join(' &middot; ')
+      + '</div>';
+  return html + '</div>';
+}
+
 async function profileToggle(id, action) {
   // on-demand jax.profiler trace on a RUNNING task; the training
   // process polls the request at epoch boundaries
@@ -758,10 +814,15 @@ async function profileToggle(id, action) {
 }
 
 async function viewTaskDetail(el, id) {
-  const [info, steps, logs, tel, spans] = await Promise.all([
+  const [info, steps, logs, tel, perfTel, spans] = await Promise.all([
     api('task/info',{id}), api('task/steps',{id}),
     api('logs',{task:id, paginator:{page_number:0,page_size:50}}),
     api('telemetry/series',{task:id}),
+    // tail fetch: newest N samples of EVERY name — on long runs the
+    // plain ascending-limit fetch above truncates the newest samples
+    // of later-sorting names, and the performance card must show the
+    // genuinely latest step, not a stale early window
+    api('telemetry/series',{task:id, tail:64}),
     api('telemetry/spans',{task:id})]);
   el.appendChild(h(`<p><a href="#" onclick="detail=null;render();return false">
     &larr; back</a> &nbsp; <b>task ${id}</b> &nbsp;
@@ -802,6 +863,11 @@ async function viewTaskDetail(el, id) {
         <td class="dim">${esc(p ? p.kind : '')}</td>
         <td class="dim">${esc(p ? p.time||'' : '')}</td></tr>`).join('')
       + '</table>'));
+  // performance card: phase breakdown + recompile timeline for the
+  // selected task (telemetry attribution + compile events), from the
+  // tail fetch so 'latest step' is true however long the run
+  const perf = performanceCard(perfTel.series || {});
+  if (perf) el.appendChild(h('<div>' + perf + '</div>'));
   // span forest: where the task's wall-clock went (worker pipeline
   // phases + executor internals), durations in ms
   const spanTree = nodes => '<div class="tree">' + nodes.map(s =>

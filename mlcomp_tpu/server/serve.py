@@ -10,7 +10,11 @@ loads the self-describing msgpack export ONCE, builds the jitted
 predictor at a static batch shape (exactly one XLA compile — warmed at
 startup when the export's meta carries ``input_shape``), and serves:
 
-- ``GET  /health``   (no auth) — model names, platform, request counts
+- ``GET  /health``   (no auth) — model names, platform, request counts,
+  latency percentiles + cumulative bucket counts
+- ``GET  /metrics``  (no auth) — OpenMetrics export of the in-process
+  registries (request totals, queue depth, cumulative latency
+  histogram buckets) for a stock Prometheus scraper
 - ``POST /predict``  ``{"x": [[...]]}`` → ``{"y": [...], "ms": ...}``
   (token auth, same header contract as the JSON API)
 
@@ -52,6 +56,13 @@ class Backpressure(RuntimeError):
     """Raised when a model's pending-request bound is hit; the HTTP
     layer maps it to 429 so load balancers and clients back off instead
     of piling threads onto the device lock."""
+
+
+#: latency bucket upper bounds (ms) for the serving histograms — the
+#: spread covers a warmed single-batch apply (~1-10 ms) through a
+#: coalesced/backpressured tail; +Inf is implicit (telemetry Histogram)
+LATENCY_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                      500.0, 1000.0, 2500.0)
 
 
 def resolve_model(name_or_path: str, project: str = None) -> str:
@@ -219,8 +230,11 @@ class _ServedModel:
         # the stats track CURRENT behavior, not the process lifetime
         self.latencies_ms = deque(maxlen=1024)
         # telemetry histogram (assigned by ModelServer): per-request
-        # observe is an in-memory aggregate; summary rows
-        # (p50/p99/count/…) flush with the registry heartbeat
+        # observe is an in-memory aggregate. BUCKETED histograms are
+        # cumulative in the recorder (they survive heartbeat flushes;
+        # each flush emits a monotone snapshot — the shape Prometheus
+        # rate() needs), so the same registry serves /health,
+        # /metrics AND the flushed DB rows the API server re-exports
         self.telemetry = None
         self.coalescer = _Coalescer(
             self._predict_padded, batch_size, coalesce_ms / 1e3) \
@@ -272,7 +286,7 @@ class _ServedModel:
         self.latencies_ms.append(ms)
         if self.telemetry is not None:
             self.telemetry.observe(f'serving.{self.name}.latency_ms',
-                                   ms)
+                                   ms, buckets=LATENCY_BUCKETS_MS)
         return {'y': np.asarray(y).tolist(), 'ms': ms}
 
     def _predict_padded(self, x: np.ndarray) -> np.ndarray:
@@ -303,7 +317,26 @@ class _ServedModel:
                 'requests': self.requests,
                 'queue_depth': depth,
                 'max_pending': self.max_pending,
-                'latency_ms': stats}
+                'latency_ms': stats,
+                # cumulative [(le_ms, count)] over the process lifetime
+                # — the same counts /metrics exports as _bucket samples
+                'latency_buckets':
+                    [[le, n] for le, n in self._hist_snapshot()[0]]}
+
+    def _hist_snapshot(self):
+        """(bucket_counts, count, total) from the recorder's
+        cumulative bucketed histogram — one locked, consistent view
+        for /health and /metrics (a mid-observe read would break the
+        +Inf-bucket == _count invariant). Zeroed buckets before the
+        first request (or without a recorder)."""
+        snap = self.telemetry.histogram_snapshot(
+            f'serving.{self.name}.latency_ms') \
+            if self.telemetry is not None else None
+        if snap is None:
+            empty = [(b, 0) for b in LATENCY_BUCKETS_MS] + \
+                [('+Inf', 0)]
+            return empty, 0, 0.0
+        return snap
 
 
 class ModelServer:
@@ -435,6 +468,21 @@ class ModelServer:
                 self.wfile.write(blob)
 
             def do_GET(self):
+                if self.path == '/metrics':
+                    # OpenMetrics from the in-process registries — no
+                    # DB, no auth (introspection tier like /health):
+                    # a stock scraper watches a serving box directly
+                    from mlcomp_tpu.telemetry.export import (
+                        OPENMETRICS_CONTENT_TYPE,
+                    )
+                    blob = server.render_metrics().encode()
+                    self.send_response(200)
+                    self.send_header('Content-Type',
+                                     OPENMETRICS_CONTENT_TYPE)
+                    self.send_header('Content-Length', str(len(blob)))
+                    self.end_headers()
+                    self.wfile.write(blob)
+                    return
                 if self.path != '/health':
                     return self._send(404, {'error': 'not found'})
                 import jax
@@ -481,6 +529,45 @@ class ModelServer:
                     self._send(500, {'error': str(e)})
 
         return Handler
+
+    def render_metrics(self) -> str:
+        """OpenMetrics families from the in-process state: cumulative
+        per-model latency buckets, request totals, live queue depth —
+        the serving half of the fleet's /metrics surface (the API
+        server re-exports the heartbeat-flushed summaries for boxes a
+        scraper can't reach directly)."""
+        from mlcomp_tpu.telemetry.export import (
+            family, render_openmetrics,
+        )
+        requests, depth, buckets = [], [], []
+        for name, m in self.models.items():
+            requests.append(('_total', {'model': name}, m.requests))
+            # queue depth directly (health() would also sort a 1024-
+            # sample percentile window per scrape just to be thrown
+            # away)
+            depth_val = m.pending
+            if m.coalescer is not None:
+                with m.coalescer.cv:
+                    depth_val = max(depth_val, len(m.coalescer.queue))
+            depth.append(('', {'model': name}, depth_val))
+            hist_buckets, count, total = m._hist_snapshot()
+            for le, n in hist_buckets:
+                buckets.append(('_bucket', {'model': name, 'le': le},
+                                n))
+            buckets.append(('_count', {'model': name}, count))
+            buckets.append(('_sum', {'model': name}, total))
+        return render_openmetrics([
+            family('mlcomp_serving_up', 'gauge',
+                   'serving process is accepting requests',
+                   [('', None, 0 if self._draining else 1)]),
+            family('mlcomp_serving_requests', 'counter',
+                   'predict requests served per model', requests),
+            family('mlcomp_serving_queue_depth', 'gauge',
+                   'pending requests per model', depth),
+            family('mlcomp_serving_latency_ms', 'histogram',
+                   'per-request latency, cumulative process-lifetime '
+                   'buckets', buckets),
+        ])
 
     def bind(self):
         """Bind the listening socket (resolves ``port 0`` to the real

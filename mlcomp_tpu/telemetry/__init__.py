@@ -19,9 +19,21 @@ package wired through every layer of this framework:
   through ``POST /api/telemetry/profile``.
 - ``watchdog`` — rule engine over the recorded signals, evaluated from
   the supervisor tick: stalled tasks, step-time regressions vs a
-  per-task rolling baseline, straggler workers, HBM-pressure trends —
-  persisted as ``alert`` rows and served via ``GET /api/alerts`` and
-  ``mlcomp_tpu alerts``.
+  per-task rolling baseline, straggler workers, HBM-pressure trends,
+  recompile storms — persisted as ``alert`` rows and served via
+  ``GET /api/alerts`` and ``mlcomp_tpu alerts``.
+- ``attribution`` — per-step phase split (data-wait / h2d / compute /
+  telemetry) around boundaries the loop already crosses, persisted as
+  ``step.phase.*`` series plus the derived
+  ``step.pipeline_efficiency`` — bench's number, for every real run.
+- ``compile_events`` — jax.monitoring compile listeners (recompiles
+  land as ``compile.backend_ms`` with the triggering step) and the
+  runtime host-sync tripwire, the dynamic counterpart of the
+  preflight linter's host-sync rules.
+- ``export`` — OpenMetrics renderer + minimal validating parser
+  behind ``GET /metrics`` (server/api.py, server/serve.py): queue
+  depth, dispatch latency, slots, alerts, step phases, serving
+  latency buckets for any Prometheus scraper.
 
 Query side: ``GET /telemetry/series?task=<id>``,
 ``GET /telemetry/spans?task=<id>`` and ``GET /telemetry/trace/<id>``
@@ -32,8 +44,16 @@ publishes ``telemetry_overhead_pct`` (plus the propagation+watchdog
 cost, ``observability_overhead_pct``) every round.
 """
 
+from mlcomp_tpu.telemetry.attribution import PHASES, StepAttribution
+from mlcomp_tpu.telemetry.compile_events import (
+    COMPILE_EVENTS, CompileEventRecorder, HostSyncTripwire,
+)
 from mlcomp_tpu.telemetry.device import (
     compiled_cost, device_memory_stats, mfu, record_device_stats,
+)
+from mlcomp_tpu.telemetry.export import (
+    OPENMETRICS_CONTENT_TYPE, parse_openmetrics, render_openmetrics,
+    render_server_metrics,
 )
 from mlcomp_tpu.telemetry.metrics import (
     Histogram, MetricRecorder, flush_live_recorders,
@@ -58,4 +78,8 @@ __all__ = [
     'record_device_stats',
     'TaskProfiler', 'request_trace', 'request_stop', 'trace_status',
     'Watchdog', 'WatchdogConfig',
+    'StepAttribution', 'PHASES',
+    'CompileEventRecorder', 'HostSyncTripwire', 'COMPILE_EVENTS',
+    'render_openmetrics', 'parse_openmetrics', 'render_server_metrics',
+    'OPENMETRICS_CONTENT_TYPE',
 ]

@@ -1,0 +1,465 @@
+"""OpenMetrics export: the first externally-consumable observability
+surface.
+
+Everything the system already records — queue depth, dispatch latency,
+task counts, worker slot occupancy, open alerts, step phase
+attribution, serving latency buckets — lived behind bespoke JSON
+routes; a stock Prometheus/Grafana/alertmanager stack could scrape
+none of it. This module renders those signals as an OpenMetrics text
+payload (no external deps — the format is lines), served at
+``GET /metrics`` on the API server (server/api.py) and on a serving
+process (server/serve.py renders its in-process registries the same
+way).
+
+Three parts:
+
+- ``render_openmetrics(families)`` — family dicts → the wire text
+  (``# TYPE``/``# HELP`` headers, label-escaped samples, the
+  mandatory ``# EOF`` trailer);
+- ``parse_openmetrics(text)`` — a minimal validating line parser,
+  shared by the unit tests and the CI smoke job so an export-format
+  regression fails fast in BOTH;
+- ``collect_server_families(session)`` — the API server's collector:
+  each family reads the DB defensively (a failing collector yields an
+  empty family plus a ``mlcomp_scrape_errors`` count, never a 500 —
+  a monitoring endpoint that dies when the system is sick is useless
+  exactly when it matters).
+"""
+
+import json
+import re
+
+#: the content type Prometheus negotiates for OpenMetrics 1.0
+OPENMETRICS_CONTENT_TYPE = \
+    'application/openmetrics-text; version=1.0.0; charset=utf-8'
+
+#: families GET /metrics always declares (headers render even with no
+#: samples) — the CI smoke job and the unit tests assert this cover
+REQUIRED_FAMILIES = (
+    'mlcomp_up', 'mlcomp_tasks', 'mlcomp_queue_depth',
+    'mlcomp_worker_slots', 'mlcomp_alerts_open',
+    'mlcomp_dispatch_latency_seconds', 'mlcomp_step_phase_ms',
+    'mlcomp_pipeline_efficiency', 'mlcomp_compile_events',
+    'mlcomp_serving_latency_ms', 'mlcomp_scrape_errors',
+)
+
+
+# ---------------------------------------------------------------- render
+def _escape_label(value) -> str:
+    return str(value).replace('\\', r'\\').replace('"', r'\"') \
+        .replace('\n', r'\n')
+
+
+def _format_value(value) -> str:
+    if value is None:
+        return 'NaN'
+    f = float(value)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def family(name, mtype, help_text, samples=None):
+    """One metric family. ``samples``: ``[(suffix, labels, value)]`` —
+    suffix '' for plain gauges, '_total'/'_bucket'/'_count'/'_sum' for
+    counter/histogram/summary parts."""
+    return {'name': name, 'type': mtype, 'help': help_text,
+            'samples': list(samples or [])}
+
+
+def render_openmetrics(families) -> str:
+    out = []
+    for fam in families:
+        name = fam['name']
+        out.append(f'# TYPE {name} {fam["type"]}')
+        if fam.get('help'):
+            out.append(f'# HELP {name} {fam["help"]}')
+        for suffix, labels, value in fam['samples']:
+            label_str = ''
+            if labels:
+                inner = ','.join(
+                    f'{k}="{_escape_label(v)}"'
+                    for k, v in labels.items())
+                label_str = '{' + inner + '}'
+            out.append(
+                f'{name}{suffix}{label_str} {_format_value(value)}')
+    out.append('# EOF')
+    return '\n'.join(out) + '\n'
+
+
+# ----------------------------------------------------------------- parse
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'       # metric name
+    r'(?:\{(.*)\})?'                     # optional label block
+    r'\s+(\S+)'                          # value
+    r'(?:\s+(\S+))?$')                   # optional timestamp
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+#: sample-name suffixes that still belong to the declaring family
+_FAMILY_SUFFIXES = ('_total', '_bucket', '_count', '_sum', '_created')
+
+
+def _unescape_label(value: str) -> str:
+    # one left-to-right scan — chained str.replace would decode the
+    # 'n' of a literal backslash-escaped '\\n' as a newline
+    out, i = [], 0
+    while i < len(value):
+        ch = value[i]
+        if ch == '\\' and i + 1 < len(value):
+            nxt = value[i + 1]
+            if nxt == 'n':
+                out.append('\n')
+                i += 2
+                continue
+            if nxt in ('\\', '"'):
+                out.append(nxt)
+                i += 2
+                continue
+        out.append(ch)
+        i += 1
+    return ''.join(out)
+
+
+def _parse_labels(blob: str, lineno: int) -> dict:
+    """Strict sequential parse of a label blob — findall would
+    silently skip malformed segments (`{le=+Inf}` parsing as zero
+    labels), and this parser exists to REJECT what a real scraper
+    would reject."""
+    labels = {}
+    i = 0
+    while i < len(blob):
+        m = _LABEL_RE.match(blob, i)
+        if m is None:
+            raise ValueError(
+                f'line {lineno}: malformed label block: {blob!r}')
+        labels[m.group(1)] = _unescape_label(m.group(2))
+        i = m.end()
+        if i < len(blob):
+            if blob[i] != ',':
+                raise ValueError(
+                    f'line {lineno}: malformed label block: {blob!r}')
+            i += 1
+            while i < len(blob) and blob[i] == ' ':
+                i += 1
+    return labels
+
+
+def _family_of(sample_name: str, declared) -> str:
+    if sample_name in declared:
+        return sample_name
+    for suffix in _FAMILY_SUFFIXES:
+        if sample_name.endswith(suffix) and \
+                sample_name[:-len(suffix)] in declared:
+            return sample_name[:-len(suffix)]
+    raise ValueError(
+        f'sample {sample_name!r} references no declared family')
+
+
+def parse_openmetrics(text: str) -> dict:
+    """Validate + parse an OpenMetrics payload into
+    ``{family: {'type', 'help', 'samples': [(name, labels, value)]}}``.
+    Raises ``ValueError`` on: a missing ``# EOF`` trailer, a sample
+    whose family was never declared (``# TYPE``), an unparsable value,
+    a malformed label block, or a line that is neither comment, blank,
+    nor sample."""
+    declared = {}
+    lines = text.split('\n')
+    saw_eof = False
+    for lineno, line in enumerate(lines, 1):
+        line = line.rstrip('\r')
+        if saw_eof and line.strip():
+            raise ValueError(f'line {lineno}: content after # EOF')
+        if not line.strip():
+            continue
+        if line == '# EOF':
+            saw_eof = True
+            continue
+        if line.startswith('# TYPE '):
+            parts = line.split(' ', 3)
+            if len(parts) < 4:
+                raise ValueError(f'line {lineno}: malformed TYPE')
+            declared[parts[2]] = {'type': parts[3], 'help': None,
+                                  'samples': []}
+            continue
+        if line.startswith('# HELP '):
+            parts = line.split(' ', 3)
+            if len(parts) < 3:
+                raise ValueError(f'line {lineno}: malformed HELP')
+            fam = declared.get(parts[2])
+            if fam is not None:
+                fam['help'] = parts[3] if len(parts) > 3 else ''
+            continue
+        if line.startswith('#'):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f'line {lineno}: unparsable: {line!r}')
+        sample_name, label_blob, raw_value, _ts = m.groups()
+        fam_name = _family_of(sample_name, declared)
+        labels = _parse_labels(label_blob or '', lineno)
+        try:
+            value = float(raw_value)
+        except ValueError:
+            if raw_value not in ('NaN', '+Inf', '-Inf'):
+                raise ValueError(
+                    f'line {lineno}: bad value {raw_value!r}')
+            value = float(raw_value.replace('Inf', 'inf'))
+        declared[fam_name]['samples'].append(
+            (sample_name, labels, value))
+    if not saw_eof:
+        raise ValueError('payload does not end with # EOF')
+    return declared
+
+
+# --------------------------------------------------- server-side collect
+def _collect_tasks(session, samples):
+    from mlcomp_tpu.db.enums import TaskStatus
+    from mlcomp_tpu.utils.misc import to_snake
+    counts = {int(s): 0 for s in TaskStatus}
+    for r in session.query(
+            'SELECT status, COUNT(*) AS n FROM task GROUP BY status'):
+        if r['status'] in counts:
+            counts[r['status']] = r['n']
+    for status, n in counts.items():
+        samples.append(
+            ('', {'status': to_snake(TaskStatus(status).name)}, n))
+
+
+def _collect_queue_depth(session, samples):
+    for r in session.query(
+            "SELECT queue, COUNT(*) AS n FROM queue_message "
+            "WHERE status='pending' GROUP BY queue"):
+        samples.append(('', {'queue': r['queue']}, r['n']))
+
+
+def _collect_worker_slots(session, samples):
+    from mlcomp_tpu.db.enums import TaskStatus
+    busy = {}
+    for r in session.query(
+            'SELECT computer_assigned, cores_assigned FROM task '
+            'WHERE status IN (?, ?) AND computer_assigned IS NOT NULL',
+            (int(TaskStatus.Queued), int(TaskStatus.InProgress))):
+        try:
+            n = len(json.loads(r['cores_assigned'] or '[]'))
+        except (TypeError, ValueError):
+            n = 0
+        busy[r['computer_assigned']] = \
+            busy.get(r['computer_assigned'], 0) + n
+    for r in session.query('SELECT name, cores FROM computer'):
+        samples.append(('', {'computer': r['name'], 'state': 'total'},
+                        r['cores'] or 0))
+        samples.append(('', {'computer': r['name'], 'state': 'busy'},
+                        busy.get(r['name'], 0)))
+
+
+def _collect_alerts(session, samples):
+    for r in session.query(
+            "SELECT rule, severity, COUNT(*) AS n FROM alert "
+            "WHERE status='open' GROUP BY rule, severity"):
+        samples.append(('', {'rule': r['rule'],
+                             'severity': r['severity'] or 'warning'},
+                        r['n']))
+
+
+def _latest_metric(session, name, component=None):
+    sql = 'SELECT value FROM metric WHERE name=?'
+    params = [name]
+    if component:
+        sql += ' AND component=?'
+        params.append(component)
+    row = session.query_one(sql + ' ORDER BY id DESC LIMIT 1',
+                            tuple(params))
+    return row['value'] if row else None
+
+
+def _collect_dispatch_latency(session, samples):
+    # the supervisor's enqueue→claim histogram summaries (seconds),
+    # re-shaped as an OpenMetrics summary: latest row per stat.
+    # Quantiles ONLY — the source histogram resets every supervisor
+    # flush window, so a _count/_sum derived from it would DECREASE
+    # between scrapes and Prometheus would misread every dip as a
+    # counter reset (quantile-only summaries are valid OpenMetrics)
+    base = 'supervisor.dispatch_latency_s'
+    p50 = _latest_metric(session, f'{base}.p50', 'supervisor')
+    p99 = _latest_metric(session, f'{base}.p99', 'supervisor')
+    if p50 is not None:
+        samples.append(('', {'quantile': '0.5'}, p50))
+    if p99 is not None:
+        samples.append(('', {'quantile': '0.99'}, p99))
+
+
+#: per-task families cover the newest this-many running tasks — a
+#: bound so one scrape can't fan out per-task queries without limit.
+#: Documented in the family help; the total running count
+#: (mlcomp_tasks{status="in_progress"}) is always exact, so a scraper
+#: can SEE when the per-task detail is truncated.
+_RUNNING_TASKS_CAP = 256
+
+
+def _running_task_ids(session, limit=_RUNNING_TASKS_CAP):
+    from mlcomp_tpu.db.enums import TaskStatus
+    return [r['id'] for r in session.query(
+        'SELECT id FROM task WHERE status=? ORDER BY id DESC LIMIT ?',
+        (int(TaskStatus.InProgress), int(limit)))]
+
+
+def _collect_step_phases(session, running, phase_samples, eff_samples):
+    from mlcomp_tpu.telemetry.attribution import PHASES
+    if not running:
+        return
+    names = [f'step.phase.{p}_ms' for p in PHASES] \
+        + ['step.pipeline_efficiency']
+    marks = ','.join('?' * len(running))
+    name_marks = ','.join('?' * len(names))
+    # bare `value` rides the MAX(id) row (documented sqlite behavior):
+    # one query yields the LATEST sample per (task, name)
+    for r in session.query(
+            f'SELECT task, name, value, MAX(id) AS latest FROM metric '
+            f'WHERE task IN ({marks}) AND name IN ({name_marks}) '
+            f'GROUP BY task, name',
+            tuple(running) + tuple(names)):
+        if r['name'] == 'step.pipeline_efficiency':
+            eff_samples.append(('', {'task': r['task']}, r['value']))
+        else:
+            phase = r['name'][len('step.phase.'):-len('_ms')]
+            phase_samples.append(
+                ('', {'task': r['task'], 'phase': phase}, r['value']))
+
+
+def _collect_compile_events(session, running, samples):
+    if not running:
+        return
+    marks = ','.join('?' * len(running))
+    for r in session.query(
+            f'SELECT task, COUNT(*) AS n FROM metric '
+            f"WHERE task IN ({marks}) AND name='compile.backend_ms' "
+            f'GROUP BY task', tuple(running)):
+        samples.append(('_total', {'task': r['task']}, r['n']))
+
+
+#: rows scanned per scrape for the serving re-export: the latest
+#: heartbeat's bucket/count/mean rows live at the table's tail, so a
+#: bounded id window keeps the scrape O(window) however old the
+#: deployment gets. Snapshots older than the window simply drop out of
+#: the family (the serving process's own /metrics stays authoritative).
+_SERVING_SCAN_WINDOW = 100000
+
+
+def _collect_serving_latency(session, samples):
+    """Latest flushed bucket/count/mean rows per served model → one
+    OpenMetrics histogram family. The serving recorder's bucketed
+    histograms are CUMULATIVE across flushes (telemetry/metrics.py),
+    so the latest snapshot is monotone scrape-over-scrape — real
+    Prometheus histogram semantics, same as the serving process's own
+    /metrics."""
+    pattern = re.compile(
+        r'^serving\.(.+)\.latency_ms\.(bucket|count|mean)$')
+    latest = {}      # (model, stat, le) -> (id, value)
+    for r in session.query(
+            "SELECT id, name, value, tags FROM metric "
+            "WHERE id > (SELECT COALESCE(MAX(id), 0) FROM metric) - ? "
+            "AND kind='histogram' AND ("
+            "name LIKE 'serving.%.latency_ms.bucket' OR "
+            "name LIKE 'serving.%.latency_ms.count' OR "
+            "name LIKE 'serving.%.latency_ms.mean')",
+            (_SERVING_SCAN_WINDOW,)):
+        m = pattern.match(r['name'])
+        if m is None:
+            continue
+        model, stat = m.group(1), m.group(2)
+        le = None
+        if stat == 'bucket':
+            try:
+                le = json.loads(r['tags'] or '{}').get('le')
+            except ValueError:
+                continue
+            if le is None:
+                continue
+        key = (model, stat, str(le))
+        if key not in latest or r['id'] > latest[key][0]:
+            latest[key] = (r['id'], r['value'])
+    models = sorted({model for model, _, _ in latest})
+    for model in models:
+        buckets = sorted(
+            ((le, v) for (m2, stat, le), (_, v) in latest.items()
+             if m2 == model and stat == 'bucket'),
+            key=lambda kv: float('inf') if kv[0] == '+Inf'
+            else float(kv[0]))
+        for le, value in buckets:
+            samples.append(('_bucket', {'model': model, 'le': le},
+                            value))
+        count = latest.get((model, 'count', 'None'))
+        if count is not None:
+            samples.append(('_count', {'model': model}, count[1]))
+            mean = latest.get((model, 'mean', 'None'))
+            if mean is not None:
+                samples.append(('_sum', {'model': model},
+                                mean[1] * count[1]))
+
+
+def collect_server_families(session):
+    """The API server's /metrics families, each collected defensively
+    from the DB (+ the scrape-error count so a sick collector is
+    visible to the scraper instead of silently absent)."""
+    errors = [0]
+
+    def guarded(fn, *args):
+        try:
+            fn(*args)
+        except Exception:
+            errors[0] += 1
+
+    tasks, queues, slots, alerts = [], [], [], []
+    dispatch, phases, eff, compiles, serving = [], [], [], [], []
+    guarded(_collect_tasks, session, tasks)
+    guarded(_collect_queue_depth, session, queues)
+    guarded(_collect_worker_slots, session, slots)
+    guarded(_collect_alerts, session, alerts)
+    guarded(_collect_dispatch_latency, session, dispatch)
+    running = []
+    try:
+        running = _running_task_ids(session)
+    except Exception:
+        errors[0] += 1
+    guarded(_collect_step_phases, session, running, phases, eff)
+    guarded(_collect_compile_events, session, running, compiles)
+    guarded(_collect_serving_latency, session, serving)
+    return [
+        family('mlcomp_up', 'gauge',
+               'API server is serving this scrape', [('', None, 1)]),
+        family('mlcomp_tasks', 'gauge',
+               'tasks by status', tasks),
+        family('mlcomp_queue_depth', 'gauge',
+               'pending queue messages per queue', queues),
+        family('mlcomp_worker_slots', 'gauge',
+               'TPU core slots per computer (state=total|busy)',
+               slots),
+        family('mlcomp_alerts_open', 'gauge',
+               'open watchdog alerts by rule and severity', alerts),
+        family('mlcomp_dispatch_latency_seconds', 'summary',
+               'supervisor enqueue-to-claim latency (latest flush '
+               'window)', dispatch),
+        family('mlcomp_step_phase_ms', 'gauge',
+               'latest per-step phase attribution (newest '
+               f'{_RUNNING_TASKS_CAP} running tasks)', phases),
+        family('mlcomp_pipeline_efficiency', 'gauge',
+               'compute share of attributed step time (newest '
+               f'{_RUNNING_TASKS_CAP} running tasks)', eff),
+        family('mlcomp_compile_events', 'counter',
+               'recorded XLA compile events (newest '
+               f'{_RUNNING_TASKS_CAP} running tasks)', compiles),
+        family('mlcomp_serving_latency_ms', 'histogram',
+               'served-model request latency (cumulative buckets, '
+               'latest heartbeat snapshot)', serving),
+        family('mlcomp_scrape_errors', 'gauge',
+               'collectors that failed during this scrape',
+               [('', None, errors[0])]),
+    ]
+
+
+def render_server_metrics(session) -> str:
+    return render_openmetrics(collect_server_families(session))
+
+
+__all__ = ['render_openmetrics', 'parse_openmetrics', 'family',
+           'collect_server_families', 'render_server_metrics',
+           'OPENMETRICS_CONTENT_TYPE', 'REQUIRED_FAMILIES']

@@ -46,16 +46,27 @@ def flush_live_recorders() -> int:
 
 
 class Histogram:
-    """Streaming aggregate + bounded reservoir for percentiles."""
+    """Streaming aggregate + bounded reservoir for percentiles.
 
-    __slots__ = ('count', 'total', 'min', 'max', '_reservoir')
+    With ``buckets`` (sorted upper bounds), fixed-boundary counts are
+    kept alongside — the cumulative ``le`` buckets an OpenMetrics
+    scraper wants (telemetry/export.py renders them; a reservoir can
+    only approximate quantiles, bucket counts are exact)."""
 
-    def __init__(self, reservoir: int = 1024):
+    __slots__ = ('count', 'total', 'min', 'max', '_reservoir',
+                 'bucket_bounds', '_bucket_counts')
+
+    def __init__(self, reservoir: int = 1024, buckets=None):
         self.count = 0
         self.total = 0.0
         self.min = None
         self.max = None
         self._reservoir = deque(maxlen=reservoir)
+        self.bucket_bounds = sorted(float(b) for b in buckets) \
+            if buckets else None
+        # one count per bound plus the implicit +Inf overflow bucket
+        self._bucket_counts = [0] * (len(self.bucket_bounds) + 1) \
+            if self.bucket_bounds else None
 
     def observe(self, value: float):
         value = float(value)
@@ -64,6 +75,23 @@ class Histogram:
         self.min = value if self.min is None else min(self.min, value)
         self.max = value if self.max is None else max(self.max, value)
         self._reservoir.append(value)
+        if self.bucket_bounds is not None:
+            import bisect
+            self._bucket_counts[
+                bisect.bisect_left(self.bucket_bounds, value)] += 1
+
+    def bucket_counts(self):
+        """CUMULATIVE ``[(le, count)]`` ending with ``('+Inf', total)``
+        — the OpenMetrics histogram convention — or None when this
+        histogram was built without buckets."""
+        if self._bucket_counts is None:
+            return None
+        out, running = [], 0
+        for bound, n in zip(self.bucket_bounds, self._bucket_counts):
+            running += n
+            out.append((bound, running))
+        out.append(('+Inf', running + self._bucket_counts[-1]))
+        return out
 
     def summary(self) -> dict:
         if not self.count:
@@ -105,6 +133,7 @@ class MetricRecorder:
         self._counters = {}
         self._histograms = {}
         self._mutate_lock = threading.Lock()
+        self._hist_flushed_counts = {}   # name -> count at last flush
         self._flush_thread = None
         self._steps = itertools.count()
         self.dropped_count = 0
@@ -143,12 +172,31 @@ class MetricRecorder:
         with self._mutate_lock:
             self._counters[name] = self._counters.get(name, 0.0) + inc
 
-    def observe(self, name: str, value: float):
+    def observe(self, name: str, value: float, buckets=None):
+        """Histogram sample. ``buckets`` (upper bounds) apply on the
+        FIRST observe of a name — later calls reuse the open
+        histogram's boundaries (mixed bounds would corrupt the
+        cumulative counts). Bucketed histograms are CUMULATIVE: they
+        survive flushes (each flush emits a monotone snapshot — the
+        shape Prometheus ``rate()`` needs), while bucket-less ones
+        emit their window's summary and reset."""
         with self._mutate_lock:
             hist = self._histograms.get(name)
             if hist is None:
-                hist = self._histograms[name] = Histogram()
+                hist = self._histograms[name] = Histogram(
+                    buckets=buckets)
             hist.observe(value)
+
+    def histogram_snapshot(self, name: str):
+        """``(bucket_counts, count, total)`` of one open histogram
+        under the lock — ONE consistent view (serving /health and
+        /metrics read this; a mid-observe read would break the
+        +Inf-bucket == count invariant). None when absent."""
+        with self._mutate_lock:
+            hist = self._histograms.get(name)
+            if hist is None:
+                return None
+            return hist.bucket_counts(), hist.count, hist.total
 
     def next_step(self) -> int:
         return next(self._steps)
@@ -183,7 +231,27 @@ class MetricRecorder:
         with self._mutate_lock:
             pending, self._pending = self._pending, []
             counters, self._counters = self._counters, {}
-            hists, self._histograms = self._histograms, {}
+            hists = self._histograms
+            # bucketed histograms stay registered and keep
+            # aggregating — their flushed rows must be monotone across
+            # flushes (cumulative Prometheus semantics); summary-only
+            # histograms emit their window and reset
+            self._histograms = {
+                name: h for name, h in hists.items()
+                if h.bucket_bounds is not None}
+            # snapshot INSIDE the lock: the retained histograms are
+            # still being observed by other threads. A retained
+            # histogram that saw NO new samples since its last flush
+            # emits nothing — an idle serving heartbeat must not grow
+            # the metric table with identical snapshots forever.
+            hist_snapshots = {}
+            for name, h in hists.items():
+                if h.bucket_bounds is not None and \
+                        self._hist_flushed_counts.get(name) == h.count:
+                    continue
+                self._hist_flushed_counts[name] = h.count
+                hist_snapshots[name] = (h.summary(),
+                                        h.bucket_counts())
         if len(pending) > self.capacity:
             self.dropped_count += len(pending) - self.capacity
             pending = pending[-self.capacity:]
@@ -212,12 +280,20 @@ class MetricRecorder:
         for name, total in counters.items():
             rows.append((self.task, name, 'counter', None, float(total),
                          ts, self.component, None))
-        for name, hist in hists.items():
-            summary = hist.summary()
+        for name, (summary, buckets) in hist_snapshots.items():
             for stat, v in summary.items():
                 rows.append((self.task, f'{name}.{stat}', 'histogram',
                              None, float(v), ts, self.component,
                              json.dumps({'of': name})))
+            if buckets:
+                # one row per cumulative le bucket, bound in the tags —
+                # the shape /metrics re-renders as an OpenMetrics
+                # histogram (telemetry/export.py)
+                for le, count in buckets:
+                    rows.append((self.task, f'{name}.bucket',
+                                 'histogram', None, float(count), ts,
+                                 self.component,
+                                 json.dumps({'of': name, 'le': le})))
         return rows
 
     def flush(self, session=None) -> int:

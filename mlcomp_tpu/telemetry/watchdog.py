@@ -30,6 +30,10 @@ Rules (all thresholds tunable via WatchdogConfig):
   ``hbm_threshold``, or climbed monotonically through the recent
   window above ``hbm_trend_floor`` (heading for an OOM even though it
   has not crossed the line yet).
+- **recompile-storm** — ``recompile_storm_count`` XLA compile events
+  past ``recompile_warmup_steps`` within ``recompile_window_s``
+  (telemetry/compile_events.py records them); time-windowed so the
+  alert auto-resolves when the storm stops.
 
 Cost: a handful of indexed SELECTs over the few InProgress tasks per
 evaluation, and evaluations are rate-limited to ``evaluate_every_s``
@@ -72,6 +76,14 @@ class WatchdogConfig:
     hbm_threshold = 0.92
     #: rising-trend alerts only above this floor
     hbm_trend_floor = 0.75
+    #: recompile storm: this many compile events past warmup inside
+    #: the window → alert. Warmup compiles are FREE (every stage's
+    #: first steps legitimately compile train/eval programs); the
+    #: window is wall-clock so the alert auto-resolves once the storm
+    #: stops even though the rows stay in the DB.
+    recompile_storm_count = 3
+    recompile_warmup_steps = 20
+    recompile_window_s = 600.0
     #: min seconds between evaluations (rate limit inside the tick)
     evaluate_every_s = 10.0
 
@@ -133,6 +145,8 @@ class Watchdog:
                 lambda: self._check_stragglers(running, metrics,
                                                alerts),
                 lambda: self._check_hbm(running, metrics, alerts),
+                lambda: self._check_recompiles(running, metrics,
+                                               alerts, now_dt),
                 lambda: self._sweep_finished(running, alerts)):
             try:
                 findings += rule() or []
@@ -281,6 +295,48 @@ class Watchdog:
                                      round(sibling_median, 2)}))
                 else:
                     alerts.resolve_for_task(child.id, rule='straggler')
+        return out
+
+    def _check_recompiles(self, running, metrics, alerts, now_dt):
+        """Recompile storm: repeated XLA compiles AFTER warmup inside
+        a wall-clock window (telemetry/compile_events.py records each
+        as ``compile.backend_ms`` with its triggering step) — the
+        signature of a shape-varying input or weak-type flip
+        retracing the step every iteration. Time-windowed so the
+        alert resolves on its own once the storm stops."""
+        out = []
+        warmup = int(self.config.recompile_warmup_steps)
+        window = float(self.config.recompile_window_s)
+        need = int(self.config.recompile_storm_count)
+        for task in running:
+            samples = metrics.recent_samples(
+                task.id, 'compile.backend_ms', limit=max(need * 4, 32))
+            if not samples:
+                continue      # uninstrumented task — no verdict
+            storm = []
+            for step, value, ts in samples:
+                if step is None or step <= warmup:
+                    continue  # warmup compiles are expected
+                ts = parse_datetime(ts)
+                if ts is None or (now_dt - ts).total_seconds() > window:
+                    continue
+                storm.append((step, value))
+            if len(storm) >= need:
+                total_ms = sum(v for _, v in storm if v is not None)
+                out.append(self._raise(
+                    alerts, 'recompile-storm',
+                    f'task {task.id} ({task.name}): {len(storm)} XLA '
+                    f'recompiles after warmup within '
+                    f'{window:.0f}s ({total_ms:.0f}ms spent '
+                    f'compiling, last at step {storm[0][0]}) — likely '
+                    f'a shape-varying input or weak-type flip '
+                    f'retracing the step',
+                    task,
+                    details={'compiles': len(storm),
+                             'compile_ms': round(total_ms, 1),
+                             'last_step': storm[0][0]}))
+            else:
+                alerts.resolve_for_task(task.id, rule='recompile-storm')
         return out
 
     def _check_hbm(self, running, metrics, alerts):

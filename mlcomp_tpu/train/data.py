@@ -297,14 +297,29 @@ def iterate_batches(x: np.ndarray, y: Optional[np.ndarray],
 
 
 def prefetch_batches(batch_iter, mesh, seq_dim: Optional[int] = None,
-                     depth: int = 2):
+                     depth: int = 2, attribution=None):
     """Double-buffering: device_put the NEXT batch(es) while the current
     one computes. jax transfers are async — keeping ``depth`` batches in
     flight hides host→device latency behind the step itself (the classic
-    flax prefetch pattern, on shardings instead of per-device stacks)."""
+    flax prefetch pattern, on shardings instead of per-device stacks).
+
+    ``attribution`` (telemetry/attribution.py) marks the two input
+    phases around boundaries this generator already crosses: pulling
+    the next host batch (shuffle + augment) is ``data_wait``, the
+    ``place_batch`` dispatch is ``h2d`` — one clock read each, so the
+    production loop attributes its input pipeline for free."""
     from collections import deque
     buf = deque()
-    for batch in batch_iter:
+    done = object()
+    it = iter(batch_iter)
+    while True:
+        if attribution is not None:
+            attribution.begin('data_wait')
+        batch = next(it, done)
+        if batch is done:
+            break
+        if attribution is not None:
+            attribution.begin('h2d')
         buf.append(place_batch(batch, mesh, seq_dim=seq_dim))
         if len(buf) >= depth:
             yield buf.popleft()

@@ -237,6 +237,9 @@ class JaxTrain(Executor):
         self._profile_open = False
         self._telemetry = None
         self._profiler = None
+        self._attribution = None
+        self._tripwire = None
+        self._compile_events = None
         ok = False
         # the train loop's leg of the cross-process trace: a
         # `train.work` root (role='train') with per-epoch child spans
@@ -267,6 +270,14 @@ class JaxTrain(Executor):
                 from mlcomp_tpu.telemetry import flush_spans
                 try:
                     flush_spans(self.session)
+                except Exception:
+                    pass
+            if self._compile_events is not None:
+                # a persistent worker's NEXT task must not inherit this
+                # task's compile listener (it would record into a
+                # closed recorder under a stale task id)
+                try:
+                    self._compile_events.uninstall()
                 except Exception:
                     pass
             if self._profiler is not None:
@@ -401,6 +412,20 @@ class JaxTrain(Executor):
                     self.telemetry_spec.get('flush_every', 100)))
             self._profiler = TaskProfiler(self.session, self.task.id,
                                           ck_dir)
+            # step-time attribution + runtime recompile/host-sync
+            # detection ride the same recorder: phase marks are clock
+            # reads at boundaries the loop already crosses, the
+            # compile listener fires only when XLA actually compiles
+            # (no-op install on builds without jax.monitoring)
+            from mlcomp_tpu.telemetry import (
+                CompileEventRecorder, HostSyncTripwire, StepAttribution,
+            )
+            self._attribution = StepAttribution(
+                recorder=self._telemetry)
+            self._tripwire = HostSyncTripwire(recorder=self._telemetry)
+            self._compile_events = CompileEventRecorder(
+                recorder=self._telemetry)
+            self._compile_events.install()
 
         def _telemetry_step_flops(step_fn, *abstract_args):
             """XLA cost analysis of the compiled step, once per run —
@@ -598,7 +623,10 @@ class JaxTrain(Executor):
                 from mlcomp_tpu.train.loop import instrumented_step
                 train_step = instrumented_step(
                     train_step, self._telemetry,
-                    batch_size=self.batch_size)
+                    batch_size=self.batch_size,
+                    attribution=self._attribution,
+                    tripwire=self._tripwire,
+                    compile_events=self._compile_events)
             eval_step = make_eval_step(
                 model, loss_fn, mesh=mesh,
                 self_supervised=self_supervised)
@@ -646,9 +674,19 @@ class JaxTrain(Executor):
                             for k, v in metric_arrays.items()}
                     else:
                         train_metrics = []
+                        attr = self._attribution
                         for s in range(steps_per_epoch):
+                            # device-data path attribution: permutation
+                            # slicing is the data wait, the index
+                            # device_put is the h2d leg (the batch
+                            # itself is already HBM-resident)
+                            if attr is not None:
+                                attr.begin('data_wait')
+                            idx_host = perm[s]
+                            if attr is not None:
+                                attr.begin('h2d')
                             idx = jax.device_put(
-                                perm[s], batch_sharding(mesh, 1))
+                                idx_host, batch_sharding(mesh, 1))
                             state, metrics = train_step(
                                 state, x_all, y_all, idx)
                             train_metrics.append(metrics)
@@ -663,7 +701,8 @@ class JaxTrain(Executor):
                         epochs_done_global else None)
                     for x, y in prefetch_batches(
                             batches, mesh, seq_dim=seq_dim,
-                            depth=self.prefetch):
+                            depth=self.prefetch,
+                            attribution=self._attribution):
                         state, metrics = train_step(state, x, y)
                         train_metrics.append(metrics)
                         images_seen += self.batch_size
@@ -743,6 +782,13 @@ class JaxTrain(Executor):
                             len(mesh.devices.flat), peak))
                     from mlcomp_tpu.telemetry import record_device_stats
                     record_device_stats(tel)
+                    if self._attribution is not None \
+                            and self._attribution.steps:
+                        # bench's pipeline_efficiency, from inside the
+                        # real run (per-step step.phase.* series landed
+                        # already; this is the per-epoch derived gauge)
+                        self._attribution.emit_epoch(
+                            tel, epoch=global_epoch)
                     tel.flush()
                     # per-epoch child span under train.work — the
                     # epoch timer already measured the interval, so

@@ -376,7 +376,8 @@ def make_eval_step(model, loss_fn: Callable,
 
 
 def instrumented_step(step_fn, recorder, batch_size: int = None,
-                      metric_keys=('loss',)):
+                      metric_keys=('loss',), attribution=None,
+                      tripwire=None, compile_events=None):
     """Wrap a jit'd train step with per-step telemetry recording
     (telemetry/metrics.py). Hot-path cost per step: a perf_counter
     read and 2-3 list appends — the device arrays in ``metrics`` are
@@ -390,25 +391,50 @@ def instrumented_step(step_fn, recorder, batch_size: int = None,
     time. ``throughput`` (samples/sec) derives from the same interval.
     The first call records no timing (no previous dispatch to diff
     against).
+
+    Optional observability hooks (telemetry/attribution.py,
+    telemetry/compile_events.py), each a clock read or a comparison:
+
+    - ``attribution`` marks the compute/telemetry phases and closes
+      each step (``step.phase.*`` series);
+    - ``compile_events`` gets ``.step`` stamped so a compile fired
+      inside this step lands with its triggering step number;
+    - ``tripwire`` sees the same inter-dispatch interval and flags
+      host-sync suspects — except on steps whose interval contains a
+      recorded compile (slow for a known reason).
     """
     import time as _time
     last = [None]
 
     def wrapped(state, *args):
+        # step number FIRST so a compile fired inside this dispatch is
+        # labeled with the step that triggered it
+        step = recorder.next_step()
+        if compile_events is not None:
+            compile_events.step = step
+        if attribution is not None:
+            attribution.begin('compute')
         out = step_fn(state, *args)
         t = _time.perf_counter()
-        step = recorder.next_step()
+        if attribution is not None:
+            attribution.begin('telemetry', now=t)
         metrics = out[1] if isinstance(out, tuple) else {}
         for key in metric_keys:
             if key in metrics:
                 recorder.series(key, metrics[key], step=step)
         prev, last[0] = last[0], t
+        compiled = compile_events.consume_dirty() \
+            if compile_events is not None else False
         if prev is not None:
             dt = t - prev
             recorder.series('step_time_ms', dt * 1e3, step=step)
             if batch_size and dt > 0:
                 recorder.series('throughput', batch_size / dt,
                                 step=step)
+            if tripwire is not None and not compiled:
+                tripwire.observe(dt * 1e3, step=step)
+        if attribution is not None:
+            attribution.step_end(step=step)
         return out
 
     return wrapped

@@ -4,6 +4,11 @@ Parity: reference utils/logging.py:16-150 — ``create_logger`` fans out to
 three handlers; the DB handler writes `Log` rows carrying
 (component, computer, task, step, module:function, line); messages are
 truncated to 16,000 chars (reference utils/logging.py:93).
+
+Trace correlation: every record is stamped with the process's active
+cross-process trace context (``TraceContextFilter`` →
+``[trace=<id> role=<role>]`` in the console/file format), so the logs
+of one dispatch grep out by the same trace id that joins its spans.
 """
 
 import logging
@@ -24,6 +29,27 @@ _LEVEL_TO_STATUS = {
     logging.ERROR: LogStatus.Error,
     logging.CRITICAL: LogStatus.Error,
 }
+
+
+class TraceContextFilter(logging.Filter):
+    """Stamp the active cross-process trace context
+    (telemetry/spans.py) onto every record as ``record.trace``, so the
+    console/file formatter prints ``[trace=<id> role=<role>]`` on each
+    worker/train line — grepping one trace id finds the logs of that
+    dispatch alongside its spans. Traceless processes (API, CLI) pay a
+    dict read and print nothing extra."""
+
+    def filter(self, record):
+        if not hasattr(record, 'trace'):
+            try:
+                from mlcomp_tpu.telemetry.spans import get_trace_context
+                trace_id, role = get_trace_context()
+            except Exception:
+                trace_id = role = None
+            record.trace = (
+                f' [trace={trace_id} role={role or "?"}]'
+                if trace_id else '')
+        return True
 
 
 class DbHandler(logging.Handler):
@@ -130,9 +156,13 @@ def create_logger(session=None, name: str = 'mlcomp_tpu'):
         if logger is None:
             logger = _Logger(name)
             logger.setLevel(logging.DEBUG)
+            # %(trace)s is stamped by TraceContextFilter — empty
+            # outside a traced dispatch, ' [trace=.. role=..]' inside
+            logger.addFilter(TraceContextFilter())
             fmt = logging.Formatter(
                 '%(asctime)s [%(levelname)s] '
-                '%(module)s:%(funcName)s:%(lineno)d %(message)s')
+                '%(module)s:%(funcName)s:%(lineno)d%(trace)s '
+                '%(message)s')
 
             console = logging.StreamHandler()
             console.setLevel(os.getenv('CONSOLE_LOG_LEVEL', 'DEBUG'))
@@ -164,4 +194,5 @@ def create_logger(session=None, name: str = 'mlcomp_tpu'):
     return logger
 
 
-__all__ = ['create_logger', 'DbHandler', 'MESSAGE_LIMIT']
+__all__ = ['create_logger', 'DbHandler', 'TraceContextFilter',
+           'MESSAGE_LIMIT']

@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""CI smoke: boot the API server against a temp sqlite DB, scrape
+``GET /metrics``, and validate the payload with the same minimal
+OpenMetrics parser the unit tests use (telemetry/export.py) — an
+export-format regression fails this job fast, without jax and without
+a TPU.
+
+Seeds one of each signal source (running task with step-phase series,
+pending queue message, open alert, dispatch-latency summary rows,
+serving bucket rows) so the scrape exercises the live collectors, not
+just the empty-family headers.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+os.environ.setdefault(
+    'MLCOMP_TPU_ROOT', tempfile.mkdtemp(prefix='metrics_smoke_'))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # repo root, wherever CI runs from
+
+from mlcomp_tpu.db.core import Session                       # noqa: E402
+from mlcomp_tpu.db.enums import TaskStatus                   # noqa: E402
+from mlcomp_tpu.db.migration import migrate                  # noqa: E402
+from mlcomp_tpu.telemetry.export import (                    # noqa: E402
+    OPENMETRICS_CONTENT_TYPE, REQUIRED_FAMILIES, parse_openmetrics,
+)
+
+
+def seed(session):
+    from mlcomp_tpu.db.models import Computer, Task
+    from mlcomp_tpu.db.providers import (
+        AlertProvider, ComputerProvider, MetricProvider, QueueProvider,
+        TaskProvider,
+    )
+    from mlcomp_tpu.utils.misc import now
+    ComputerProvider(session).create_or_update(
+        Computer(name='smoke', cpu=8, memory=16, cores=4,
+                 ip='127.0.0.1', port=0), 'name')
+    task = Task(name='smoke_train', executor='jax_train',
+                status=int(TaskStatus.InProgress),
+                computer_assigned='smoke',
+                cores_assigned=json.dumps([0, 1]),
+                started=now(), last_activity=now())
+    TaskProvider(session).add(task)
+    QueueProvider(session).enqueue(
+        'smoke_default', {'action': 'execute', 'task_id': task.id})
+    AlertProvider(session).raise_alert(
+        'step-regression', 'smoke alert', task=task.id)
+    ts = now()
+    MetricProvider(session).add_many(
+        [(task.id, f'step.phase.{p}_ms', 'series', 10, v, ts, 'train',
+          None) for p, v in (('data_wait', 1.0), ('h2d', 0.5),
+                             ('compute', 12.0), ('telemetry', 0.1))]
+        + [(task.id, 'step.pipeline_efficiency', 'gauge', 0, 0.88, ts,
+            'train', None),
+           (task.id, 'compile.backend_ms', 'series', 3, 250.0, ts,
+            'train', None),
+           (None, 'supervisor.dispatch_latency_s.p50', 'histogram',
+            None, 0.4, ts, 'supervisor', None),
+           (None, 'supervisor.dispatch_latency_s.p99', 'histogram',
+            None, 1.2, ts, 'supervisor', None),
+           (None, 'supervisor.dispatch_latency_s.count', 'histogram',
+            None, 6.0, ts, 'supervisor', None),
+           (None, 'supervisor.dispatch_latency_s.mean', 'histogram',
+            None, 0.5, ts, 'supervisor', None)]
+        + [(None, 'serving.m.latency_ms.bucket', 'histogram', None, n,
+            ts, 'serving', json.dumps({'of': 'serving.m.latency_ms',
+                                       'le': le}))
+           for le, n in ((5.0, 2), (50.0, 5), ('+Inf', 5))]
+        + [(None, 'serving.m.latency_ms.count', 'histogram', None,
+            5.0, ts, 'serving', None),
+           (None, 'serving.m.latency_ms.mean', 'histogram', None,
+            12.0, ts, 'serving', None)])
+    return task.id
+
+
+def main():
+    session = Session.create_session(key='server_api')
+    migrate(session)
+    task_id = seed(session)
+
+    from mlcomp_tpu.server.api import ApiServer
+    server = ApiServer(host='127.0.0.1', port=0).start_background()
+    try:
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{server.port}/metrics',
+                timeout=30) as resp:
+            ctype = resp.headers.get('Content-Type', '')
+            body = resp.read().decode()
+    finally:
+        server.shutdown()
+
+    if ctype != OPENMETRICS_CONTENT_TYPE:
+        print(f'FAIL: content type {ctype!r}')
+        return 1
+    doc = parse_openmetrics(body)     # raises on format violations
+    missing = [f for f in REQUIRED_FAMILIES if f not in doc]
+    if missing:
+        print(f'FAIL: families missing from /metrics: {missing}')
+        return 1
+
+    def sample_labels(fam):
+        return [labels for _, labels, _ in doc[fam]['samples']]
+
+    checks = [
+        ('mlcomp_queue_depth',
+         any(l.get('queue') == 'smoke_default'
+             for l in sample_labels('mlcomp_queue_depth'))),
+        ('mlcomp_tasks in_progress', any(
+            l.get('status') == 'in_progress' and v == 1
+            for _, l, v in doc['mlcomp_tasks']['samples'])),
+        ('mlcomp_worker_slots', any(
+            l.get('computer') == 'smoke'
+            for l in sample_labels('mlcomp_worker_slots'))),
+        ('mlcomp_alerts_open', any(
+            l.get('rule') == 'step-regression'
+            for l in sample_labels('mlcomp_alerts_open'))),
+        ('mlcomp_dispatch_latency_seconds quantiles', any(
+            l.get('quantile') == '0.99' for l in
+            sample_labels('mlcomp_dispatch_latency_seconds'))),
+        ('mlcomp_step_phase_ms', any(
+            l.get('phase') == 'compute' and str(task_id) ==
+            str(l.get('task'))
+            for l in sample_labels('mlcomp_step_phase_ms'))),
+        ('mlcomp_pipeline_efficiency',
+         len(doc['mlcomp_pipeline_efficiency']['samples']) == 1),
+        ('mlcomp_serving_latency_ms buckets', any(
+            l.get('le') == '+Inf'
+            for l in sample_labels('mlcomp_serving_latency_ms'))),
+        ('mlcomp_scrape_errors == 0',
+         doc['mlcomp_scrape_errors']['samples'][0][2] == 0),
+    ]
+    failed = [name for name, ok in checks if not ok]
+    if failed:
+        print(f'FAIL: {failed}')
+        print(body)
+        return 1
+    n_samples = sum(len(f['samples']) for f in doc.values())
+    print(f'OK: /metrics valid OpenMetrics — {len(doc)} families, '
+          f'{n_samples} samples')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

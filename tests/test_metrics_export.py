@@ -1,0 +1,282 @@
+"""OpenMetrics export tests: renderer↔parser round trip, parser
+rejections, the live GET /metrics endpoint covering every required
+family, and the serving-bucket re-export path."""
+
+import json
+import urllib.request
+
+import pytest
+
+from mlcomp_tpu.db.enums import TaskStatus
+from mlcomp_tpu.db.models import Computer, Dag, Task
+from mlcomp_tpu.db.providers import (
+    AlertProvider, ComputerProvider, DagProvider, MetricProvider,
+    ProjectProvider, QueueProvider, TaskProvider,
+)
+from mlcomp_tpu.telemetry import MetricRecorder
+from mlcomp_tpu.telemetry.export import (
+    OPENMETRICS_CONTENT_TYPE, REQUIRED_FAMILIES, family,
+    parse_openmetrics, render_openmetrics, render_server_metrics,
+)
+from mlcomp_tpu.utils.misc import now
+
+from tests.test_telemetry import api  # noqa: F401  (live-server fixture)
+
+
+def make_task(session, name='t', status=TaskStatus.InProgress,
+              computer=None, cores=None):
+    provider = ProjectProvider(session)
+    project = provider.by_name('p_metrics')
+    if project is None:
+        provider.add_project('p_metrics')
+        project = provider.by_name('p_metrics')
+    dag = Dag(name='d', project=project.id, config='', created=now(),
+              docker_img='default')
+    DagProvider(session).add(dag)
+    task = Task(name=name, executor='e', dag=dag.id,
+                status=int(status), computer_assigned=computer,
+                cores_assigned=json.dumps(cores) if cores else None,
+                started=now(), last_activity=now())
+    TaskProvider(session).add(task)
+    return task
+
+
+class TestRenderer:
+    def test_round_trip(self):
+        families = [
+            family('mlcomp_up', 'gauge', 'liveness', [('', None, 1)]),
+            family('mlcomp_tasks', 'gauge', 'by status',
+                   [('', {'status': 'in_progress'}, 3),
+                    ('', {'status': 'failed'}, 0)]),
+            family('mlcomp_requests', 'counter', 'served',
+                   [('_total', {'model': 'm'}, 12)]),
+            family('mlcomp_lat', 'histogram', 'latency',
+                   [('_bucket', {'le': 5.0}, 2),
+                    ('_bucket', {'le': '+Inf'}, 4),
+                    ('_count', None, 4), ('_sum', None, 17.5)]),
+        ]
+        text = render_openmetrics(families)
+        assert text.endswith('# EOF\n')
+        doc = parse_openmetrics(text)
+        assert doc['mlcomp_up']['type'] == 'gauge'
+        assert doc['mlcomp_up']['help'] == 'liveness'
+        assert doc['mlcomp_tasks']['samples'] == [
+            ('mlcomp_tasks', {'status': 'in_progress'}, 3.0),
+            ('mlcomp_tasks', {'status': 'failed'}, 0.0)]
+        assert doc['mlcomp_requests']['samples'][0][0] == \
+            'mlcomp_requests_total'
+        lat = doc['mlcomp_lat']['samples']
+        assert ('mlcomp_lat_bucket', {'le': '+Inf'}, 4.0) in lat
+        assert ('mlcomp_lat_sum', {}, 17.5) in lat
+
+    def test_label_escaping_round_trips(self):
+        nasty = 'a"b\\c\nd'
+        text = render_openmetrics(
+            [family('m', 'gauge', 'h', [('', {'k': nasty}, 1)])])
+        (sample,) = parse_openmetrics(text)['m']['samples']
+        assert sample[1]['k'] == nasty
+
+    def test_backslash_n_literal_round_trips(self):
+        # 'weights\net1' (a literal backslash then 'n') must NOT decode
+        # as a newline: unescaping is a single left-to-right scan
+        nasty = 'weights\\net1'
+        text = render_openmetrics(
+            [family('m', 'gauge', 'h', [('', {'k': nasty}, 1)])])
+        (sample,) = parse_openmetrics(text)['m']['samples']
+        assert sample[1]['k'] == nasty
+        assert '\n' not in sample[1]['k']
+
+    def test_empty_family_renders_header_only(self):
+        text = render_openmetrics(
+            [family('mlcomp_queue_depth', 'gauge', 'depth')])
+        doc = parse_openmetrics(text)
+        assert doc['mlcomp_queue_depth']['samples'] == []
+
+
+class TestParserRejections:
+    def test_missing_eof(self):
+        with pytest.raises(ValueError, match='EOF'):
+            parse_openmetrics('# TYPE m gauge\nm 1\n')
+
+    def test_undeclared_family(self):
+        with pytest.raises(ValueError, match='no declared family'):
+            parse_openmetrics('# TYPE m gauge\nother 1\n# EOF\n')
+
+    def test_bad_value(self):
+        with pytest.raises(ValueError, match='bad value'):
+            parse_openmetrics('# TYPE m gauge\nm up\n# EOF\n')
+
+    def test_garbage_line(self):
+        with pytest.raises(ValueError, match='unparsable'):
+            parse_openmetrics('# TYPE m gauge\n}{ nope\n# EOF\n')
+
+    def test_content_after_eof(self):
+        with pytest.raises(ValueError, match='after # EOF'):
+            parse_openmetrics('# TYPE m gauge\n# EOF\nm 1\n')
+
+    def test_malformed_label_block_rejected(self):
+        # findall-style parsing would return zero labels and pass —
+        # the validator must reject what a real scraper rejects
+        with pytest.raises(ValueError, match='malformed label'):
+            parse_openmetrics(
+                '# TYPE m gauge\nm{le=+Inf, bad} 4\n# EOF\n')
+        with pytest.raises(ValueError, match='malformed label'):
+            parse_openmetrics(
+                '# TYPE m gauge\nm{k="v" j="w"} 4\n# EOF\n')
+
+
+def seed_everything(session):
+    """One of each signal the collectors read."""
+    ComputerProvider(session).create_or_update(
+        Computer(name='box', cpu=8, memory=16, cores=4,
+                 ip='127.0.0.1', port=22), 'name')
+    task = make_task(session, computer='box', cores=[0, 1])
+    QueueProvider(session).enqueue(
+        'box_default', {'action': 'execute', 'task_id': task.id})
+    AlertProvider(session).raise_alert(
+        'hbm-pressure', 'high', task=task.id, severity='critical')
+    ts = now()
+    MetricProvider(session).add_many(
+        [(task.id, f'step.phase.{p}_ms', 'series', 5, v, ts, 'train',
+          None) for p, v in (('data_wait', 2.0), ('h2d', 1.0),
+                             ('compute', 20.0), ('telemetry', 0.2))]
+        + [(task.id, 'step.pipeline_efficiency', 'gauge', 0, 0.86,
+            ts, 'train', None),
+           (task.id, 'compile.backend_ms', 'series', 30, 140.0, ts,
+            'train', None),
+           (None, 'supervisor.dispatch_latency_s.p50', 'histogram',
+            None, 0.3, ts, 'supervisor', None),
+           (None, 'supervisor.dispatch_latency_s.p99', 'histogram',
+            None, 0.9, ts, 'supervisor', None),
+           (None, 'supervisor.dispatch_latency_s.count', 'histogram',
+            None, 4.0, ts, 'supervisor', None),
+           (None, 'supervisor.dispatch_latency_s.mean', 'histogram',
+            None, 0.5, ts, 'supervisor', None)])
+    # serving buckets arrive through the REAL path: a bucketed
+    # recorder flush, exactly what ModelServer's heartbeat does
+    rec = MetricRecorder(session=session, component='serving',
+                         flush_every=10 ** 9)
+    for ms in (2.0, 8.0, 40.0, 900.0):
+        rec.observe('serving.digits.latency_ms', ms,
+                    buckets=(5.0, 50.0, 500.0))
+    rec.flush()
+    return task
+
+
+class TestServerCollector:
+    def test_all_required_families_present_even_on_empty_db(
+            self, session):
+        doc = parse_openmetrics(render_server_metrics(session))
+        for fam in REQUIRED_FAMILIES:
+            assert fam in doc, fam
+        # empty DB: zero scrape errors, task counts all zero
+        assert doc['mlcomp_scrape_errors']['samples'][0][2] == 0
+        assert all(v == 0 for _, _, v in
+                   doc['mlcomp_tasks']['samples'])
+
+    def test_seeded_db_covers_the_acceptance_list(self, session):
+        task = seed_everything(session)
+        doc = parse_openmetrics(render_server_metrics(session))
+        by = {f: doc[f]['samples'] for f in doc}
+        assert ('mlcomp_queue_depth', {'queue': 'box_default'}, 1.0) \
+            in by['mlcomp_queue_depth']
+        assert any(l == {'status': 'in_progress'} and v == 1
+                   for _, l, v in by['mlcomp_tasks'])
+        slots = {(l['computer'], l['state']): v
+                 for _, l, v in by['mlcomp_worker_slots']}
+        assert slots[('box', 'total')] == 4
+        assert slots[('box', 'busy')] == 2
+        assert any(l.get('rule') == 'hbm-pressure'
+                   and l.get('severity') == 'critical'
+                   for _, l, v in by['mlcomp_alerts_open'])
+        lat = {l.get('quantile'): v for n, l, v in
+               by['mlcomp_dispatch_latency_seconds'] if l}
+        assert lat['0.5'] == pytest.approx(0.3)
+        assert lat['0.99'] == pytest.approx(0.9)
+        # quantiles ONLY: the source summaries reset per flush window,
+        # so a _count/_sum here would decrease between scrapes and
+        # read as counter resets
+        assert not any(n.endswith(('_count', '_sum')) for n, _, _ in
+                       by['mlcomp_dispatch_latency_seconds'])
+        phases = {(str(l['task']), l['phase']): v
+                  for _, l, v in by['mlcomp_step_phase_ms']}
+        assert phases[(str(task.id), 'compute')] == pytest.approx(20.0)
+        assert len(phases) == 4
+        (eff,) = by['mlcomp_pipeline_efficiency']
+        assert eff[2] == pytest.approx(0.86)
+        assert ('mlcomp_compile_events_total',
+                {'task': str(task.id)}, 1.0) \
+            in by['mlcomp_compile_events']
+        buckets = {l['le']: v for n, l, v in
+                   by['mlcomp_serving_latency_ms']
+                   if n.endswith('_bucket')}
+        assert buckets['5.0'] == 1      # 2.0 only
+        assert buckets['500.0'] == 3    # +8, +40
+        assert buckets['+Inf'] == 4     # +900
+        assert doc['mlcomp_scrape_errors']['samples'][0][2] == 0
+
+    def test_finished_task_drops_out_of_phase_families(self, session):
+        task = seed_everything(session)
+        TaskProvider(session).change_status(task, TaskStatus.Success)
+        doc = parse_openmetrics(render_server_metrics(session))
+        assert doc['mlcomp_step_phase_ms']['samples'] == []
+        assert doc['mlcomp_pipeline_efficiency']['samples'] == []
+
+
+class TestMetricsEndpoint:
+    def _scrape(self, base):
+        # the api fixture serves JSON; /metrics is text — fetch raw
+        req = urllib.request.Request(base + '/metrics')
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.headers.get('Content-Type'), \
+                resp.read().decode()
+
+    def test_get_metrics_serves_valid_openmetrics(self, api, session):
+        seed_everything(session)
+        ctype, body = self._scrape(api.base)
+        assert ctype == OPENMETRICS_CONTENT_TYPE
+        doc = parse_openmetrics(body)
+        for fam in REQUIRED_FAMILIES:
+            assert fam in doc, fam
+        assert doc['mlcomp_up']['samples'][0][2] == 1
+
+    def test_metrics_needs_no_auth(self, api):
+        # no Authorization header at all — same introspection tier as
+        # the other telemetry reads
+        req = urllib.request.Request(api.base + '/metrics')
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.status == 200
+
+
+class TestCumulativeBucketSemantics:
+    def test_bucketed_histograms_survive_flushes_monotone(
+            self, session):
+        """The API re-export promises Prometheus histogram semantics:
+        flushed bucket rows must be cumulative (monotone) across
+        flush windows, and an idle window must emit nothing."""
+        rec = MetricRecorder(session=session, component='serving',
+                             flush_every=10 ** 9)
+        name = 'serving.m.latency_ms'
+        rec.observe(name, 2.0, buckets=(5.0, 50.0))
+        rec.observe(name, 8.0, buckets=(5.0, 50.0))
+        rec.flush()
+        rec.observe(name, 900.0)
+        rec.observe(name, 1.0)
+        rec.flush()
+        rec.flush()                     # idle: no new rows
+        rows = session.query(
+            "SELECT id, value, tags FROM metric "
+            "WHERE name='serving.m.latency_ms.bucket' ORDER BY id")
+        inf_counts = [r['value'] for r in rows
+                      if json.loads(r['tags'])['le'] == '+Inf']
+        assert inf_counts == [2.0, 4.0]      # cumulative, idle silent
+        # the collector re-exports the LATEST (largest) snapshot
+        samples = []
+        from mlcomp_tpu.telemetry.export import (
+            _collect_serving_latency,
+        )
+        _collect_serving_latency(session, samples)
+        buckets = {l['le']: v for n, l, v in samples
+                   if n == '_bucket'}
+        assert buckets['+Inf'] == 4.0
+        assert buckets['5.0'] == 2.0         # 2.0 + 1.0

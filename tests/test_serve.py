@@ -641,3 +641,82 @@ class TestRobustness:
         time.sleep(0.2)
         assert srv.graceful_shutdown(drain_timeout_s=0.2) is False
         t.join(timeout=30)
+
+class TestServingMetrics:
+    """Satellite: per-request latencies feed REAL histogram buckets,
+    exposed on /health and an OpenMetrics GET /metrics."""
+
+    def _get(self, srv, path):
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{srv.port}{path}',
+                timeout=30) as resp:
+            return resp.headers.get('Content-Type'), resp.read()
+
+    def test_health_exposes_cumulative_buckets(self, server):
+        for _ in range(3):
+            _post(server, {'x': np.zeros((1, 4, 4, 1)).tolist()})
+        _, raw = self._get(server, '/health')
+        body = json.loads(raw)['models']['m']
+        buckets = body['latency_buckets']
+        assert buckets[-1][0] == '+Inf'
+        assert buckets[-1][1] >= 3            # cumulative total
+        # cumulative: monotone non-decreasing counts
+        counts = [n for _, n in buckets]
+        assert counts == sorted(counts)
+
+    def test_metrics_endpoint_is_valid_openmetrics(self, server):
+        from mlcomp_tpu.telemetry.export import (
+            OPENMETRICS_CONTENT_TYPE, parse_openmetrics,
+        )
+        for _ in range(4):
+            _post(server, {'x': np.zeros((2, 4, 4, 1)).tolist()})
+        ctype, raw = self._get(server, '/metrics')
+        assert ctype == OPENMETRICS_CONTENT_TYPE
+        doc = parse_openmetrics(raw.decode())
+        assert doc['mlcomp_serving_up']['samples'][0][2] == 1
+        reqs = doc['mlcomp_serving_requests']['samples']
+        assert reqs[0][0] == 'mlcomp_serving_requests_total'
+        assert reqs[0][1] == {'model': 'm'}
+        assert reqs[0][2] >= 4
+        lat = doc['mlcomp_serving_latency_ms']['samples']
+        inf_bucket = [v for n, l, v in lat
+                      if l.get('le') == '+Inf' and l['model'] == 'm']
+        count = [v for n, l, v in lat
+                 if n.endswith('_count') and l['model'] == 'm']
+        assert inf_bucket and count
+        assert inf_bucket[0] == count[0] >= 4
+        depth = doc['mlcomp_serving_queue_depth']['samples']
+        assert depth[0][1] == {'model': 'm'}
+
+    def test_heartbeat_flushes_bucket_rows(self, export, session):
+        """The serving→DB leg the API server's /metrics re-exports:
+        the registry heartbeat flushes bucketed histogram rows."""
+        from mlcomp_tpu.db.providers import MetricProvider
+        srv = ModelServer(export, batch_size=8, activation='softmax',
+                          port=0)
+        srv.warmup()
+        srv.bind()
+        threading.Thread(target=srv.serve_forever,
+                         daemon=True).start()
+        try:
+            srv.start_heartbeat(session, interval_s=3600)
+            for _ in range(3):
+                _post(srv, {'x': np.zeros((1, 4, 4, 1)).tolist()})
+            srv.telemetry.flush(session)
+            rows = session.query(
+                "SELECT name, value, tags FROM metric "
+                "WHERE name='serving.m.latency_ms.bucket' "
+                "ORDER BY id")
+            assert rows, 'no bucket rows flushed'
+            import json as _json
+            les = {_json.loads(r['tags'])['le'] for r in rows}
+            assert '+Inf' in les
+            # the heartbeat's first beat may race the predicts and
+            # flush a partial snapshot first — buckets are CUMULATIVE,
+            # so the LATEST +Inf row is the lifetime total
+            inf_counts = [r['value'] for r in rows
+                          if _json.loads(r['tags'])['le'] == '+Inf']
+            assert inf_counts[-1] == 3
+            assert inf_counts == sorted(inf_counts)   # monotone
+        finally:
+            srv.shutdown()

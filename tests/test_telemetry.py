@@ -171,6 +171,7 @@ def api(session):
         with urllib.request.urlopen(req, timeout=30) as resp:
             return json.loads(resp.read())
 
+    call.base = base    # raw-fetch routes (text /metrics) need the url
     yield call
     server.shutdown()
 
@@ -546,6 +547,24 @@ class TestApiLimits:
                   method='GET', token=None)
         assert len(out['series']['loss']) == 6
 
+    def test_tail_returns_newest_window_per_name(self, api, session):
+        """tail=N: the newest N samples of EVERY name, each ascending
+        — the dashboard performance card's read (a plain ascending
+        limit truncates the newest samples of later-sorting names)."""
+        task = self._seed(session)
+        out = api(f'/telemetry/series?task={task.id}&tail=2',
+                  method='GET', token=None)
+        steps = [p['step'] for p in out['series']['loss']]
+        assert steps == [4, 5]          # newest two, ascending
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/telemetry/series?tail=2', method='GET', token=None)
+        assert e.value.code == 400      # tail requires task
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api(f'/telemetry/series?task={task.id}&tail=0',
+                method='GET', token=None)
+        assert e.value.code == 400
+
 
 class TestTraceContext:
     def test_span_records_trace_and_role(self, session):
@@ -678,3 +697,384 @@ class TestCrashFlush:
         assert row.status == 'error'         # SIGTERM mid-span
         series = MetricProvider(session).series(task_id=task.id)
         assert series['loss'][0]['value'] == pytest.approx(0.5)
+
+class TestStepAttribution:
+    def test_phase_split_and_series_emission(self, session):
+        from mlcomp_tpu.telemetry import StepAttribution
+        task = make_task(session)
+        rec = MetricRecorder(session=session, task=task.id,
+                             component='train', flush_every=10 ** 9)
+        attr = StepAttribution(recorder=rec)
+        for step in range(3):
+            attr.begin('data_wait')
+            time.sleep(0.002)
+            attr.begin('h2d')
+            attr.begin('compute')
+            time.sleep(0.005)
+            attr.begin('telemetry')
+            attr.step_end(step=step)
+        assert attr.steps == 3
+        totals = attr.totals_ms()
+        assert totals['compute'] > totals['data_wait'] > 0
+        eff = attr.efficiency()
+        assert 0.0 < eff < 1.0
+        assert eff > 0.5            # compute slept longer
+        rec.flush()
+        series = MetricProvider(session).series(task_id=task.id)
+        for phase in ('data_wait', 'h2d', 'compute', 'telemetry'):
+            pts = series[f'step.phase.{phase}_ms']
+            assert [p['step'] for p in pts] == [0, 1, 2]
+
+    def test_emit_epoch_gauges_efficiency_and_resets(self, session):
+        from mlcomp_tpu.telemetry import StepAttribution
+        task = make_task(session)
+        rec = MetricRecorder(session=session, task=task.id,
+                             component='train', flush_every=10 ** 9)
+        attr = StepAttribution(recorder=rec)
+        attr.begin('compute')
+        time.sleep(0.002)
+        attr.step_end(step=0)
+        out = attr.emit_epoch(epoch=0)
+        assert out['efficiency'] == pytest.approx(1.0)
+        assert out['steps'] == 1
+        assert attr.steps == 0 and attr.totals_ms() == {}
+        rec.flush()
+        series = MetricProvider(session).series(task_id=task.id)
+        (pt,) = series['step.pipeline_efficiency']
+        assert pt['value'] == pytest.approx(1.0)
+        assert pt['step'] == 0
+
+    def test_no_steps_means_no_verdict(self):
+        from mlcomp_tpu.telemetry import StepAttribution
+        attr = StepAttribution()
+        assert attr.efficiency() is None
+        assert attr.emit_epoch()['efficiency'] is None
+
+    def test_instrumented_step_emits_phases(self, session):
+        """The production wiring: instrumented_step marks compute/
+        telemetry and closes each step — step.phase.* series appear
+        without the executor doing anything per-step."""
+        from mlcomp_tpu.telemetry import StepAttribution
+        from mlcomp_tpu.train.loop import instrumented_step
+        task = make_task(session)
+        rec = MetricRecorder(session=session, task=task.id,
+                             component='train', flush_every=10 ** 9)
+        attr = StepAttribution(recorder=rec)
+        instr = instrumented_step(
+            lambda s, x, y: (s, {'loss': np.float32(0.1)}), rec,
+            batch_size=8, attribution=attr)
+        for _ in range(4):
+            attr.begin('data_wait')
+            instr(None, None, None)
+        rec.flush()
+        series = MetricProvider(session).series(task_id=task.id)
+        assert len(series['step.phase.compute_ms']) == 4
+        assert len(series['step.phase.data_wait_ms']) == 4
+        assert 'step.phase.telemetry_ms' in series
+
+    def test_prefetch_batches_marks_input_phases(self):
+        from mlcomp_tpu.parallel import mesh_from_spec
+        from mlcomp_tpu.telemetry import StepAttribution
+        from mlcomp_tpu.train.data import (
+            iterate_batches, prefetch_batches,
+        )
+        mesh = mesh_from_spec({'dp': -1})
+        attr = StepAttribution()
+        x = np.random.RandomState(0).rand(32, 8, 8, 1).astype(
+            np.float32)
+        y = np.zeros(32, np.int32)
+        n = 0
+        for bx, by in prefetch_batches(
+                iterate_batches(x, y, 8), mesh, attribution=attr):
+            attr.begin('compute')
+            n += 1
+        attr.step_end()
+        assert n == 4
+        totals = attr.totals_ms()
+        assert totals.get('data_wait', 0) > 0
+        assert totals.get('h2d', 0) > 0
+
+
+class TestCompileEvents:
+    def test_shape_varying_jit_records_compiles_with_steps(
+            self, session):
+        """Shape-varying jit calls after install land as
+        compile.backend_ms samples carrying the stamped step."""
+        import jax
+        import jax.numpy as jnp
+
+        from mlcomp_tpu.telemetry import CompileEventRecorder
+        task = make_task(session)
+        rec = MetricRecorder(session=session, task=task.id,
+                             component='train', flush_every=10 ** 9)
+        comp = CompileEventRecorder(recorder=rec)
+        if not comp.install():
+            pytest.skip('jax.monitoring hooks unavailable')
+        try:
+            @jax.jit
+            def f(x):
+                return x * 2 + 1
+
+            for i, n in enumerate((3, 5, 7)):
+                comp.step = 100 + i
+                f(jnp.ones((n,)))       # new shape → recompile
+        finally:
+            comp.uninstall()
+        assert len(comp.events) >= 3
+        rec.flush()
+        series = MetricProvider(session).series(task_id=task.id)
+        pts = series['compile.backend_ms']
+        assert len(pts) >= 3
+        steps = {p['step'] for p in pts}
+        assert {100, 101, 102} <= steps
+        assert all(p['value'] > 0 for p in pts)
+
+    def test_uninstall_stops_recording(self):
+        import jax
+        import jax.numpy as jnp
+
+        from mlcomp_tpu.telemetry import CompileEventRecorder
+        comp = CompileEventRecorder()
+        if not comp.install():
+            pytest.skip('jax.monitoring hooks unavailable')
+        comp.uninstall()
+
+        @jax.jit
+        def g(x):
+            return x + 3
+
+        g(jnp.ones((11,)))
+        assert len(comp.events) == 0
+
+    def test_reinstall_after_uninstall_records_again(self):
+        import jax
+        import jax.numpy as jnp
+
+        from mlcomp_tpu.telemetry import CompileEventRecorder
+        comp = CompileEventRecorder()
+        if not comp.install():
+            pytest.skip('jax.monitoring hooks unavailable')
+        comp.uninstall()
+        assert comp.install() is True    # re-arm resets the dead flag
+
+        @jax.jit
+        def h(x):
+            return x - 7
+
+        try:
+            h(jnp.ones((13,)))
+            assert len(comp.events) >= 1
+        finally:
+            comp.uninstall()
+
+    def test_install_without_jax_monitoring_is_noop(self, monkeypatch):
+        import sys as _sys
+
+        from mlcomp_tpu.telemetry import CompileEventRecorder
+        monkeypatch.setitem(_sys.modules, 'jax.monitoring', None)
+        comp = CompileEventRecorder()
+        assert comp.install() is False
+        assert comp.installed is False
+
+    def test_tripwire_flags_outlier_not_baseline(self, session):
+        from mlcomp_tpu.telemetry import HostSyncTripwire
+        task = make_task(session)
+        rec = MetricRecorder(session=session, task=task.id,
+                             component='train', flush_every=10 ** 9)
+        wire = HostSyncTripwire(recorder=rec, factor=10.0, min_ms=50.0,
+                                warmup_steps=5)
+        for step in range(8):
+            assert wire.observe(10.0, step=step) is False
+        assert wire.observe(900.0, step=8) is True     # 90x median
+        assert wire.observe(10.0, step=9) is False     # baseline clean
+        assert wire.suspects == 1
+        rec.flush()
+        series = MetricProvider(session).series(task_id=task.id)
+        (pt,) = series['host_sync.suspect_ms']
+        assert pt['step'] == 8 and pt['value'] == pytest.approx(900.0)
+
+    def test_tripwire_quiet_during_warmup(self):
+        from mlcomp_tpu.telemetry import HostSyncTripwire
+        wire = HostSyncTripwire(warmup_steps=10)
+        # huge first interval (the compile step) must not flag: the
+        # baseline is not established yet
+        assert wire.observe(5000.0) is False
+
+    def test_instrumented_step_exempts_compile_steps(self):
+        """A step whose interval contains a recorded compile is slow
+        for a KNOWN reason — the tripwire must not double-report it."""
+        from mlcomp_tpu.telemetry import (
+            CompileEventRecorder, HostSyncTripwire,
+        )
+        from mlcomp_tpu.train.loop import instrumented_step
+        rec = MetricRecorder(flush_every=10 ** 9)
+        comp = CompileEventRecorder()
+        flagged = []
+
+        class Wire(HostSyncTripwire):
+            def observe(self, dt_ms, step=None):
+                flagged.append(step)
+                return False
+
+        instr = instrumented_step(
+            lambda s, x: (s, {}), rec, attribution=None,
+            tripwire=Wire(), compile_events=comp)
+        instr(None, None)               # first step: no interval
+        comp._dirty = True              # a compile landed mid-step
+        instr(None, None)               # exempt
+        instr(None, None)               # observed again
+        assert flagged == [2]
+
+
+class TestTraceCorrelatedLogs:
+    def test_formatter_injects_trace_context(self):
+        import logging
+
+        from mlcomp_tpu.telemetry import set_trace_context
+        from mlcomp_tpu.utils.logging import create_logger
+        logger = create_logger(name='mlcomp_tpu_tracetest')
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(self.format(record))
+
+        cap = Capture()
+        cap.setFormatter(logging.Formatter('%(trace)s %(message)s'))
+        logger.addHandler(cap)
+        try:
+            set_trace_context('feedbeef12345678', 'train')
+            logger.info('inside the dispatch')
+            set_trace_context(None)
+            logger.info('outside any trace')
+        finally:
+            set_trace_context(None)
+            logger.removeHandler(cap)
+        assert '[trace=feedbeef12345678 role=train]' in records[0]
+        assert 'trace=' not in records[1]
+
+    def test_grep_by_trace_id_finds_the_line(self):
+        """The satellite's contract: one trace id greps out the log
+        lines of that dispatch from the standard formatter."""
+        import logging
+
+        from mlcomp_tpu.telemetry import new_trace_id, set_trace_context
+        from mlcomp_tpu.utils.logging import create_logger
+        logger = create_logger(name='mlcomp_tpu_greptest')
+        lines = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                lines.append(self.format(record))
+
+        cap = Capture()
+        cap.setFormatter(logging.Formatter(
+            '%(module)s:%(lineno)d%(trace)s %(message)s'))
+        logger.addHandler(cap)
+        tid = new_trace_id()
+        try:
+            set_trace_context(tid, 'worker')
+            logger.info('claimed task 7')
+            logger.error('task 7 failed')
+        finally:
+            set_trace_context(None)
+            logger.removeHandler(cap)
+        hits = [ln for ln in lines if tid in ln]
+        assert len(hits) == 2
+
+
+class TestProfilerEdgeCases:
+    """Satellite: the injectable-tracer lifecycle paths that were
+    untested — a failing tracer, a sessionless profiler, polling
+    after done."""
+
+    def test_tracer_start_failure_writes_failed_status(self, session,
+                                                       tmp_path):
+        task = make_task(session)
+
+        def boom(d):
+            raise RuntimeError('no backend')
+
+        prof = TaskProfiler(session, task.id, str(tmp_path),
+                            tracer_start=boom,
+                            tracer_stop=lambda: None)
+        request_trace(session, task.id)
+        assert prof.poll() is False
+        assert prof.tracing is False
+        status = trace_status(session, task.id)
+        assert status['status'] == 'failed'
+        assert 'no backend' in status['error']
+
+    def test_sessionless_profiler_is_inert(self, tmp_path):
+        prof = TaskProfiler(None, 1, str(tmp_path),
+                            tracer_start=lambda d: None,
+                            tracer_stop=lambda: None)
+        assert prof.poll() is False
+        prof.close()                    # must not raise
+
+    def test_poll_after_done_stays_off(self, session, tmp_path):
+        task = make_task(session)
+        calls = []
+        prof = TaskProfiler(session, task.id, str(tmp_path),
+                            tracer_start=lambda d: calls.append('s'),
+                            tracer_stop=lambda: calls.append('e'))
+        request_trace(session, task.id, max_epochs=1)
+        assert prof.poll() is True
+        assert prof.poll() is False     # max_epochs expired → done
+        assert prof.poll() is False     # done row does NOT restart
+        assert calls == ['s', 'e']
+
+class TestAttributionInRealRun:
+    def test_jax_train_persists_phase_and_efficiency_series(
+            self, session, tmp_path):
+        """Acceptance: a real jax_train run records step.phase.* for
+        every step and a per-epoch step.pipeline_efficiency gauge —
+        bench's number, from inside production."""
+        from mlcomp_tpu.train import JaxTrain
+
+        class DummyStep:
+            def start(self, *a, **k):
+                pass
+
+            def info(self, m):
+                pass
+
+            def debug(self, m):
+                pass
+
+            def error(self, m):
+                pass
+
+            def end_all(self):
+                pass
+
+        task = make_task(session)
+        ex = JaxTrain(
+            model={'name': 'mlp', 'hidden': [16], 'num_classes': 4},
+            dataset={'name': 'synthetic_images', 'n_train': 256,
+                     'n_valid': 64, 'image_size': 8, 'channels': 1,
+                     'num_classes': 4},
+            loss='softmax_ce', batch_size=32, epochs=2,
+            telemetry={'flush_every': 16},
+            checkpoint_dir=str(tmp_path / 'ck'))
+        ex.step = DummyStep()
+        ex.task = task
+        ex.dag = DagProvider(session).by_id(task.dag)
+        ex.session = session
+        ex.additional_info = {}
+        ex.work()
+
+        series = MetricProvider(session).series(task_id=task.id)
+        # 2 epochs x 8 steps of per-step phase attribution
+        for phase in ('data_wait', 'h2d', 'compute', 'telemetry'):
+            pts = series[f'step.phase.{phase}_ms']
+            assert len(pts) == 16, phase
+            assert all(p['value'] >= 0 for p in pts)
+        eff = series['step.pipeline_efficiency']
+        assert [p['step'] for p in eff] == [0, 1]   # one per epoch
+        assert all(0.0 < p['value'] <= 1.0 for p in eff)
+        # the compile listener saw the first-step compiles (skipped
+        # quietly if this jax build has no monitoring hooks)
+        from mlcomp_tpu.telemetry import CompileEventRecorder
+        if CompileEventRecorder().install():
+            assert 'compile.backend_ms' in series

@@ -350,3 +350,91 @@ class TestCli:
         assert out.exit_code == 0 and 'resolved' in out.output
         out = runner.invoke(cli, ['alerts'])
         assert 'no open alerts' in out.output
+
+class TestRecompileStormRule:
+    def _storm(self, session, task, steps, age_s=0.0):
+        """Insert compile.backend_ms samples at the given steps."""
+        ts = now() - datetime.timedelta(seconds=age_s)
+        MetricProvider(session).add_many([
+            (task.id, 'compile.backend_ms', 'series', s, 120.0, ts,
+             'train', None) for s in steps])
+
+    def test_synthetic_storm_from_shape_varying_jit(self, session):
+        """The acceptance path end-to-end: real shape-varying jit
+        calls after warmup → CompileEventRecorder samples → a deduped
+        recompile-storm Alert that auto-resolves when the window
+        passes."""
+        import jax
+        import jax.numpy as jnp
+
+        from mlcomp_tpu.telemetry import (
+            CompileEventRecorder, MetricRecorder,
+        )
+        task = make_task(session)
+        rec = MetricRecorder(session=session, task=task.id,
+                             component='train', flush_every=10 ** 9)
+        comp = CompileEventRecorder(recorder=rec)
+        if not comp.install():
+            pytest.skip('jax.monitoring hooks unavailable')
+        try:
+            @jax.jit
+            def f(x):
+                return x * 3 - 1
+
+            for i, n in enumerate((2, 4, 6, 9)):
+                comp.step = 50 + i      # past warmup (default 20)
+                f(jnp.ones((n,)))       # each shape recompiles
+        finally:
+            comp.uninstall()
+        rec.flush()
+        wd = Watchdog(session, fast_config(stall_deadline_s=3600))
+        findings = [f for f in wd.evaluate()
+                    if f['rule'] == 'recompile-storm']
+        assert len(findings) == 1
+        assert findings[0]['task'] == task.id
+        assert findings[0]['details']['compiles'] >= 3
+        # dedup: the storm re-detected next pass touches the SAME row
+        wd.evaluate()
+        open_alerts = AlertProvider(session).get(
+            rule='recompile-storm')
+        assert len(open_alerts) == 1
+        # auto-resolve: evaluating past the window closes the alert
+        future = now() + datetime.timedelta(
+            seconds=wd.config.recompile_window_s + 60)
+        assert [f for f in wd.evaluate(now_dt=future)
+                if f['rule'] == 'recompile-storm'] == []
+        assert AlertProvider(session).get(rule='recompile-storm') == []
+        (resolved,) = AlertProvider(session).get(
+            status='resolved', rule='recompile-storm')
+        assert resolved.task == task.id
+
+    def test_warmup_compiles_are_free(self, session):
+        task = make_task(session)
+        self._storm(session, task, steps=[1, 3, 5, 8])   # all <= 20
+        wd = Watchdog(session, fast_config(stall_deadline_s=3600))
+        assert [f for f in wd.evaluate()
+                if f['rule'] == 'recompile-storm'] == []
+
+    def test_below_count_threshold_is_quiet(self, session):
+        task = make_task(session)
+        self._storm(session, task, steps=[30, 45])       # only 2
+        wd = Watchdog(session, fast_config(stall_deadline_s=3600))
+        assert [f for f in wd.evaluate()
+                if f['rule'] == 'recompile-storm'] == []
+
+    def test_old_storm_outside_window_is_quiet(self, session):
+        task = make_task(session)
+        self._storm(session, task, steps=[30, 31, 32, 33],
+                    age_s=3600)                          # long past
+        wd = Watchdog(session, fast_config(stall_deadline_s=7200))
+        assert [f for f in wd.evaluate()
+                if f['rule'] == 'recompile-storm'] == []
+
+    def test_threshold_overrides(self, session):
+        task = make_task(session)
+        self._storm(session, task, steps=[30, 45])
+        wd = Watchdog(session, fast_config(
+            stall_deadline_s=3600, recompile_storm_count=2))
+        findings = [f for f in wd.evaluate()
+                    if f['rule'] == 'recompile-storm']
+        assert len(findings) == 1
