@@ -15,6 +15,9 @@
 - ``mlcomp_tpu alerts``         — watchdog findings (telemetry/watchdog.py):
   list open alerts (``--all`` includes resolved history), ``--resolve ID``
   acks one, ``--json`` for scripts
+- ``mlcomp_tpu recovery``       — automatic-recovery state
+  (mlcomp_tpu/recovery.py): tasks with retries consumed or scheduled,
+  their failure taxonomy verdicts, ``--json`` for scripts
 """
 
 import json
@@ -233,6 +236,54 @@ def alerts(show_all, task, rule, resolve_id, as_json):
         state = '' if a.status == 'open' else f' [{a.status}]'
         click.echo(f'{flag} #{a.id} [{a.rule}]{where}{state} '
                    f'({a.time}): {a.message}')
+
+
+@main.command()
+@click.option('--json', 'as_json', is_flag=True,
+              help='machine-readable output')
+@click.option('--limit', type=int, default=200)
+def recovery(as_json, limit):
+    """Automatic-recovery state (mlcomp_tpu/recovery.py): tasks that
+    consumed retries, are scheduled for one, or failed with a recorded
+    taxonomy reason."""
+    from mlcomp_tpu.recovery import is_transient
+    session = Session.create_session()
+    migrate(session)
+    rows = session.query(
+        'SELECT id, name, status, attempt, max_retries, next_retry_at, '
+        'failure_reason, computer_assigned FROM task '
+        'WHERE COALESCE(attempt, 0) > 0 OR next_retry_at IS NOT NULL '
+        'OR failure_reason IS NOT NULL ORDER BY id DESC LIMIT ?',
+        (int(limit),))
+    items = [{
+        'id': r['id'], 'name': r['name'],
+        'status': TaskStatus(r['status']).name,
+        'attempt': r['attempt'] or 0,
+        'max_retries': r['max_retries'],
+        'next_retry_at': r['next_retry_at'],
+        'failure_reason': r['failure_reason'],
+        'transient': is_transient(r['failure_reason']),
+        'computer': r['computer_assigned'],
+    } for r in rows]
+    if as_json:
+        click.echo(json.dumps(items))
+        return
+    if not items:
+        click.echo('no recovery activity')
+        return
+    for it in items:
+        parts = [f"#{it['id']} [{it['status']}] {it['name']}",
+                 f"retries {it['attempt']}"
+                 + (f"/{it['max_retries']}"
+                    if it['max_retries'] is not None else '')]
+        if it['failure_reason']:
+            kind = 'transient' if it['transient'] else 'permanent'
+            parts.append(f"last failure {it['failure_reason']} ({kind})")
+        if it['next_retry_at']:
+            parts.append(f"next retry {it['next_retry_at']}")
+        if it['computer']:
+            parts.append(f"on {it['computer']}")
+        click.echo(' — '.join(parts))
 
 
 if __name__ == '__main__':

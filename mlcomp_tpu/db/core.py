@@ -19,10 +19,27 @@ import json
 import os
 import sqlite3
 import threading
+import time
 
 import numpy as np
 
+from mlcomp_tpu.testing.faults import fault_point
+
 _SQLITE_PREFIX = 'sqlite:///'
+
+#: bounded retry on sqlite 'database is locked' (SQLITE_BUSY). The
+#: 30 s busy_timeout below handles most contention, but WAL writers
+#: can still surface an immediate lock error (e.g. a read transaction
+#: upgrading to write against a concurrent writer). Before this, one
+#: locked commit during a worker-side metric flush surfaced as a task
+#: failure; now it costs at most ~1.5 s of backoff before giving up.
+_BUSY_RETRIES = 5
+_BUSY_BASE_SLEEP_S = 0.05
+
+
+def _is_busy_error(e) -> bool:
+    return isinstance(e, sqlite3.OperationalError) and (
+        'locked' in str(e).lower() or 'busy' in str(e).lower())
 
 
 def adapt_value(v):
@@ -265,30 +282,55 @@ class Session:
                     except Exception:
                         pass
 
+    def _retry_busy(self, op):
+        """Run one statement op with bounded backoff on SQLITE_BUSY.
+        The lock is NOT held across the sleeps (each attempt acquires
+        it inside ``op``), so a blocked writer doesn't freeze the
+        other threads sharing this session. Statements here are
+        single-statement transactions, so a retry never replays a
+        half-applied batch."""
+        for attempt in range(_BUSY_RETRIES + 1):
+            try:
+                return op()
+            except sqlite3.OperationalError as e:
+                if not _is_busy_error(e) or attempt >= _BUSY_RETRIES:
+                    raise
+            time.sleep(_BUSY_BASE_SLEEP_S * (2 ** attempt))
+
     def execute(self, sql, params=()):
         params = tuple(adapt_value(p) for p in params)
-        with self._lock:
-            try:
-                cur = self._conn.execute(sql, params)
-                # consume RETURNING rows before commit
-                rows = cur.fetchall() if cur.description else []
-                result = _Result(rows, cur.lastrowid, cur.rowcount)
-                self._conn.commit()
-                return result
-            except Exception:
-                self._conn.rollback()
-                raise
+
+        def op():
+            with self._lock:
+                try:
+                    fault_point('db.execute', sql=sql)  # chaos: outage
+                    cur = self._conn.execute(sql, params)
+                    # consume RETURNING rows before commit
+                    rows = cur.fetchall() if cur.description else []
+                    result = _Result(rows, cur.lastrowid, cur.rowcount)
+                    self._conn.commit()
+                    return result
+                except Exception:
+                    self._conn.rollback()
+                    raise
+
+        return self._retry_busy(op)
 
     def executemany(self, sql, seq):
         seq = [tuple(adapt_value(p) for p in row) for row in seq]
-        with self._lock:
-            try:
-                cur = self._conn.executemany(sql, seq)
-                self._conn.commit()
-                return cur
-            except Exception:
-                self._conn.rollback()
-                raise
+
+        def op():
+            with self._lock:
+                try:
+                    fault_point('db.execute', sql=sql)  # chaos: outage
+                    cur = self._conn.executemany(sql, seq)
+                    self._conn.commit()
+                    return cur
+                except Exception:
+                    self._conn.rollback()
+                    raise
+
+        return self._retry_busy(op)
 
     def query(self, sql, params=()):
         params = tuple(adapt_value(p) for p in params)
@@ -304,17 +346,30 @@ class Session:
     def add(self, obj, commit=True):
         sql, raw_vals = insert_sql(obj)
         vals = [adapt_value(v) for v in raw_vals]
-        with self._lock:
-            try:
-                cur = self._conn.execute(sql, vals)
-                if hasattr(obj, 'id') and getattr(obj, 'id', None) is None:
-                    obj.id = cur.lastrowid
-                if commit:
-                    self._conn.commit()
-                return obj
-            except Exception:
-                self._conn.rollback()
-                raise
+        # decided BEFORE the first attempt: a busy-retried INSERT must
+        # overwrite the id a rolled-back attempt stamped on the object
+        # (that row never committed — keeping its id would alias
+        # whatever another writer inserts there in the meantime)
+        assign_id = hasattr(obj, 'id') and \
+            getattr(obj, 'id', None) is None
+
+        def op():
+            with self._lock:
+                try:
+                    cur = self._conn.execute(sql, vals)
+                    if assign_id:
+                        obj.id = cur.lastrowid
+                    if commit:
+                        self._conn.commit()
+                    return obj
+                except Exception:
+                    self._conn.rollback()
+                    raise
+
+        # commit=False rides inside a caller-managed batch (add_all):
+        # retrying one INSERT there would replay into a transaction the
+        # rollback just discarded — only self-committing adds retry
+        return self._retry_busy(op) if commit else op()
 
     def add_all(self, objs):
         for o in objs:

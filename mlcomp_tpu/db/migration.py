@@ -213,8 +213,32 @@ def _v6_tracing_alerts(session: Session):
         session.execute(stmt)
 
 
+def _v7_recovery(session: Session):
+    """Automatic failure recovery (mlcomp_tpu/recovery.py): retry
+    bookkeeping columns on task + the exactly-once re-delivery flag on
+    queue_message. A fresh DB's _v1 already created both tables with
+    the new columns, so the ALTERs are guarded by live pragma checks.
+    DEFAULTs matter: legacy rows must read attempt=0/redelivered=0,
+    not NULL, for the supervisor's arithmetic and the reclaim guard."""
+    have = {r['name'] for r in session.query('PRAGMA table_info(task)')}
+    if have:        # an empty pragma = table absent (partial legacy DB)
+        for column, ddl in (
+                ('attempt', '"attempt" INTEGER DEFAULT 0'),
+                ('max_retries', '"max_retries" INTEGER'),
+                ('next_retry_at', '"next_retry_at" TEXT'),
+                ('failure_reason', '"failure_reason" TEXT')):
+            if column not in have:
+                session.execute(f'ALTER TABLE task ADD COLUMN {ddl}')
+    have = {r['name'] for r in
+            session.query('PRAGMA table_info(queue_message)')}
+    if have and 'redelivered' not in have:
+        session.execute(
+            'ALTER TABLE queue_message ADD COLUMN '
+            '"redelivered" INTEGER DEFAULT 0')
+
+
 MIGRATIONS = [_v1_init, _v2_data, _v3_auth, _v4_telemetry, _v5_preflight,
-              _v6_tracing_alerts]
+              _v6_tracing_alerts, _v7_recovery]
 
 
 def migrate(session: Session = None):

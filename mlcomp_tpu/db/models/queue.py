@@ -18,6 +18,11 @@ class QueueMessage(DBModel):
     status = Column('TEXT', default='pending', index=True)
     # pending | claimed | done | failed | revoked
     created = Column('TEXT', dtype='datetime')
+    # lease timestamp: stamped at claim AND at reclaim (where it times
+    # the re-delivery window instead of the original lease)
     claimed_at = Column('TEXT', dtype='datetime')
     claimed_by = Column('TEXT')                # worker identity
     result = Column('TEXT')
+    # lease reclaim happened once already (migration v7): the exactly-
+    # once re-delivery guard — a twice-expired message fails instead
+    redelivered = Column('INTEGER', default=0)

@@ -41,6 +41,13 @@ class Task(DBModel):
     debug = Column('INTEGER', default=0, dtype='bool')
     gpu_requirement = Column('TEXT')      # raw spec string e.g. "2-4"
     single_node = Column('INTEGER', default=1, dtype='bool')
+    # automatic failure recovery (mlcomp_tpu/recovery.py, migration v7):
+    # retries consumed so far / per-task budget (None = policy default)
+    attempt = Column('INTEGER', default=0)
+    max_retries = Column('INTEGER')
+    # when the supervisor may requeue a transiently-Failed task
+    next_retry_at = Column('TEXT', dtype='datetime')
+    failure_reason = Column('TEXT')       # taxonomy code, e.g. 'db-error'
 
 
 class TaskDependence(DBModel):

@@ -10,13 +10,21 @@ semantics come from a SELECT-candidate + conditional-UPDATE loop: any
 number of workers may SELECT the same pending id, but the UPDATE's
 ``AND status='pending'`` guard lets exactly one win (rowcount 1); losers
 move to the next candidate.
+
+A claim is a LEASE (``claimed_at``), not a tombstone: the supervisor's
+recovery pass reclaims claimed-but-expired messages of dead-heartbeat
+workers back to pending — exactly once per message (``redelivered``) —
+so a SIGKILL'd worker no longer strands its dispatch
+(server/supervisor.py ``process_recovery``, docs/robustness.md).
 """
 
+import datetime
 import json
 import sqlite3
 
 from mlcomp_tpu.db.models import QueueMessage
 from mlcomp_tpu.db.providers.base import BaseDataProvider
+from mlcomp_tpu.testing.faults import fault_point
 from mlcomp_tpu.utils.misc import now
 
 #: RETURNING landed in sqlite 3.35.0. Start from the local library's
@@ -33,6 +41,7 @@ class QueueProvider(BaseDataProvider):
     model = QueueMessage
 
     def enqueue(self, queue: str, payload: dict) -> int:
+        fault_point('queue.enqueue', queue=queue)   # chaos: slow-dispatch
         msg = QueueMessage(
             queue=queue, payload=json.dumps(payload), status='pending',
             created=now())
@@ -91,6 +100,10 @@ class QueueProvider(BaseDataProvider):
                 f"{not_in} ORDER BY id LIMIT 1", tuple(params))
             if row is None:
                 return None
+            # chaos: the claim-race window — a rival may steal the
+            # candidate between the SELECT above and the UPDATE below
+            fault_point('queue.claim', msg_id=row['id'],
+                        session=self.session)
             cur = self.session.execute(
                 "UPDATE queue_message SET status='claimed', "
                 "claimed_by=?, claimed_at=? "
@@ -105,10 +118,11 @@ class QueueProvider(BaseDataProvider):
         queue, or None. Lets dispatch be idempotent: a supervisor that
         died between queue-put and the task's status write must not
         enqueue a SECOND execution on restart. Deliberately excludes
-        'claimed': a claimed message may belong to a dead worker (the
-        reaper fails its task; a restart must get a FRESH message —
-        claim() never re-delivers claimed ids) and the worker-side
-        status guard already refuses duplicate execution of live ones."""
+        'claimed': a claimed message may belong to a dead worker
+        (``claim()`` never re-delivers claimed ids — only the lease
+        reclaim does, and then the message IS pending again) and the
+        worker-side status guard already refuses duplicate execution
+        of live ones."""
         row = self.session.query_one(
             "SELECT id FROM queue_message WHERE queue=? AND payload=? "
             "AND status='pending' ORDER BY id LIMIT 1",
@@ -135,6 +149,72 @@ class QueueProvider(BaseDataProvider):
             "UPDATE queue_message SET status='revoked' "
             "WHERE id=? AND status='pending'", (msg_id,))
         return cur.rowcount > 0
+
+    # ------------------------------------------------------------- leases
+    def claimed_expired(self, lease_seconds: float):
+        """Claimed messages whose lease (claimed_at) expired — the
+        supervisor's reclaim candidates. The claim paths (RETURNING and
+        sqlite fallback alike) stamp claimed_at, so both feed this."""
+        cutoff = now() - datetime.timedelta(seconds=float(lease_seconds))
+        rows = self.session.query(
+            "SELECT * FROM queue_message WHERE status='claimed' "
+            "AND claimed_at IS NOT NULL AND claimed_at < ? ORDER BY id",
+            (cutoff,))
+        return [QueueMessage.from_row(r) for r in rows]
+
+    def reclaim(self, msg_id: int) -> bool:
+        """Return an expired claim to pending — EXACTLY ONCE: the
+        ``redelivered=0`` guard makes a second reclaim of the same
+        message impossible, however many supervisors race on it.
+        ``claimed_at`` is re-stamped to NOW: it times the re-delivery
+        window (``stranded_redelivered``) from the reclaim — keeping
+        the original claim time would strand the message instantly,
+        the old stamp being already a full lease in the past."""
+        cur = self.session.execute(
+            "UPDATE queue_message SET status='pending', "
+            "claimed_by=NULL, claimed_at=?, redelivered=1 "
+            "WHERE id=? AND status='claimed' "
+            "AND COALESCE(redelivered, 0)=0", (now(), msg_id))
+        return cur.rowcount > 0
+
+    def expire_claim(self, msg_id: int) -> bool:
+        """Fail a CLAIMED message that already spent its one
+        re-delivery (the reviving host claimed it, then died again).
+        Conditional on status+redelivered so a racing complete()/
+        reclaim() wins cleanly."""
+        cur = self.session.execute(
+            "UPDATE queue_message SET status='failed', "
+            "result='lease expired twice' "
+            "WHERE id=? AND status='claimed' "
+            "AND COALESCE(redelivered, 0)=1", (msg_id,))
+        return cur.rowcount > 0
+
+    def fail_stranded(self, msg_id: int) -> bool:
+        """Fail a re-delivered message nobody claimed for a full lease
+        window — conditionally: a worker on a reviving host may claim
+        it between the supervisor's SELECT and this write, and the
+        claim must win (failing a just-claimed message would seed a
+        duplicate execution through the retry path)."""
+        cur = self.session.execute(
+            "UPDATE queue_message SET status='failed', "
+            "result='lease expired; queue dead after redelivery' "
+            "WHERE id=? AND status='pending' "
+            "AND COALESCE(redelivered, 0)=1", (msg_id,))
+        return cur.rowcount > 0
+
+    def stranded_redelivered(self, lease_seconds: float):
+        """Re-delivered messages still pending a full lease window
+        after their reclaim — nobody came back for them. The
+        supervisor fails these (and their task, reason
+        ``lease-expired``) so the task-level retry machinery can
+        re-place the work on a live computer."""
+        cutoff = now() - datetime.timedelta(seconds=float(lease_seconds))
+        rows = self.session.query(
+            "SELECT * FROM queue_message WHERE status='pending' "
+            "AND COALESCE(redelivered, 0)=1 "
+            "AND claimed_at IS NOT NULL AND claimed_at < ? ORDER BY id",
+            (cutoff,))
+        return [QueueMessage.from_row(r) for r in rows]
 
     def status(self, msg_id: int):
         row = self.session.query_one(

@@ -77,14 +77,31 @@ class TaskProvider(BaseDataProvider):
     # -------------------------------------------------------------- status
     def change_status(self, task, status: TaskStatus):
         task.status = int(status)
+        fields = ['status', 'started', 'finished', 'last_activity']
         if status == TaskStatus.InProgress:
             task.started = now()
         elif status in TaskStatus.finished():
             if task.started is None:
                 task.started = now()
             task.finished = now()
+            if status == TaskStatus.Success:
+                # a succeeded task carries no failure verdict — a stale
+                # reason from a retried-and-recovered attempt would
+                # read as a live problem in the UI
+                task.failure_reason = None
+                fields.append('failure_reason')
         task.last_activity = now()
-        self.update(task, ['status', 'started', 'finished', 'last_activity'])
+        self.update(task, fields)
+
+    def fail_with_reason(self, task, reason: str):
+        """Mark Failed with a recovery-taxonomy reason
+        (mlcomp_tpu/recovery.py) — the supervisor's retry pass reads
+        ``failure_reason`` to decide transient-vs-permanent. Every
+        failure site should come through here; a bare Failed (no
+        reason) is never retried."""
+        task.failure_reason = reason
+        self.update(task, ['failure_reason'])
+        self.change_status(task, TaskStatus.Failed)
 
     def by_status(self, *statuses, computer: str = None):
         marks = ','.join('?' * len(statuses))

@@ -50,8 +50,22 @@ class MetricProvider(BaseDataProvider):
         for r in self.session.query(sql, tuple(params)):
             out.setdefault(r['name'], []).append({
                 'step': r['step'], 'value': r['value'],
-                'time': r['time'], 'kind': r['kind']})
+                'time': r['time'], 'kind': r['kind'],
+                'tags': self._decode_tags(r['tags'])})
         return out
+
+    @staticmethod
+    def _decode_tags(raw):
+        """Decoded sample tags (or None) — the convention every JSON
+        surface uses (span tags, alert details): consumers must not
+        double-decode. The retry-history card reads the per-event
+        ``reason`` from here."""
+        if not raw:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None
 
     def tail_series(self, task_id: int, per_name: int = 64):
         """Latest ``per_name`` samples of EVERY metric name of a task,
@@ -63,11 +77,12 @@ class MetricProvider(BaseDataProvider):
         out = {}
         for name in self.names(task_id):
             rows = self.session.query(
-                'SELECT step, value, time, kind FROM metric '
+                'SELECT step, value, time, kind, tags FROM metric '
                 'WHERE task=? AND name=? ORDER BY id DESC LIMIT ?',
                 (int(task_id), name, int(per_name)))
             out[name] = [{'step': r['step'], 'value': r['value'],
-                          'time': r['time'], 'kind': r['kind']}
+                          'time': r['time'], 'kind': r['kind'],
+                          'tags': self._decode_tags(r['tags'])}
                          for r in reversed(rows)]
         return out
 

@@ -5,22 +5,35 @@ with jax.sharding over a named device mesh; adds TP/SP capabilities the
 reference never had.
 """
 
-from mlcomp_tpu.parallel.mesh import (
-    AXIS_ORDER, DATA_AXES, mesh_from_spec, normalize_mesh_spec,
-    single_device_mesh, mesh_axis_size,
-)
-from mlcomp_tpu.parallel.sharding import (
-    DEFAULT_LOGICAL_RULES, logical_rules, logical_to_sharding,
-    batch_sharding, replicated, data_parallel_size,
-    with_sharding_constraint,
-)
-from mlcomp_tpu.parallel.ring import ring_attention, make_ring_attention
-from mlcomp_tpu.parallel.distributed import (
-    initialize_from_distr_info, process_index, process_count,
-    is_main_process, host_replicated_copy,
-)
+import importlib.util as _importlib_util
 
-__all__ = [
+#: jax-free deployment (server/supervisor image, the CI chaos-smoke
+#: job): only the pure meshspec arithmetic is importable — which is
+#: exactly what the scheduler's placement path needs. Gated on jax's
+#: ABSENCE specifically (not a blanket except): with jax installed, a
+#: genuine import failure in these submodules must stay loud, not
+#: surface later as an opaque "cannot import name" at a call site.
+_MESHSPEC_ONLY = _importlib_util.find_spec('jax') is None
+
+if not _MESHSPEC_ONLY:
+    from mlcomp_tpu.parallel.mesh import (
+        AXIS_ORDER, DATA_AXES, mesh_from_spec, normalize_mesh_spec,
+        single_device_mesh, mesh_axis_size,
+    )
+    from mlcomp_tpu.parallel.sharding import (
+        DEFAULT_LOGICAL_RULES, logical_rules, logical_to_sharding,
+        batch_sharding, replicated, data_parallel_size,
+        with_sharding_constraint,
+    )
+    from mlcomp_tpu.parallel.ring import (
+        ring_attention, make_ring_attention,
+    )
+    from mlcomp_tpu.parallel.distributed import (
+        initialize_from_distr_info, process_index, process_count,
+        is_main_process, host_replicated_copy,
+    )
+
+__all__ = [] if _MESHSPEC_ONLY else [
     'initialize_from_distr_info', 'process_index', 'process_count',
     'is_main_process', 'host_replicated_copy',
     'AXIS_ORDER', 'DATA_AXES', 'mesh_from_spec', 'normalize_mesh_spec',

@@ -343,6 +343,13 @@ def api_task_info(data, s):
         'queue_id': task.queue_id,
         'additional_info': task.additional_info or '',
         'result': task.result or '',
+        # recovery bookkeeping (mlcomp_tpu/recovery.py): the dashboard
+        # task detail renders these as the retry-history card
+        'attempt': task.attempt or 0,
+        'max_retries': task.max_retries,
+        'next_retry_at': str(task.next_retry_at)
+        if task.next_retry_at else None,
+        'failure_reason': task.failure_reason,
     }
 
 
@@ -361,47 +368,28 @@ def api_dag_stop(data, s):
 def api_dag_start(data, s):
     """Restart-with-resume (reference app.py:488-552): reset every
     Failed/Stopped/Skipped non-service task to NotRan and attach
-    ``resume`` info pointing at the checkpoint's master task."""
+    ``resume`` info pointing at the checkpoint's master task. Shares
+    ``find_resume_info``/``reset_for_requeue`` with the supervisor's
+    automatic retry (mlcomp_tpu/recovery.py) — a human restart is the
+    same requeue with the attempt counter forgiven and no computer
+    excluded. The reset also detaches the previous attempt's finished
+    service children, so a restarted distributed master isn't
+    instantly re-failed by parent aggregation over stale rows."""
+    from mlcomp_tpu.recovery import find_resume_info, reset_for_requeue
     provider = TaskProvider(s)
     dag_id = int(data['id'])
     can_start = {int(TaskStatus.Failed), int(TaskStatus.Skipped),
                  int(TaskStatus.Stopped)}
     restarted = []
-
-    def find_resume(task):
-        children = sorted(provider.children(task.id),
-                          key=lambda c: c.id, reverse=True)
-        if children:
-            for c in children:
-                info = yaml_load(c.additional_info) \
-                    if c.additional_info else {}
-                distr = info.get('distr_info')
-                if not distr:
-                    continue
-                if distr.get('process_index', distr.get('rank')) == 0:
-                    return {'master_computer': c.computer_assigned,
-                            'master_task_id': c.id,
-                            'load_last': True}
-            raise ApiError('master task not found', status=500)
-        return {'master_computer': task.computer_assigned,
-                'master_task_id': task.id,
-                'load_last': True}
-
     for t in provider.by_dag(dag_id):
         if t.status not in can_start or t.parent:
             continue
-        info = yaml_load(t.additional_info) if t.additional_info else {}
-        info['resume'] = find_resume(t)
-        t.additional_info = yaml_dump(info)
-        t.status = int(TaskStatus.NotRan)
-        t.pid = None
-        t.started = None
-        t.finished = None
-        t.computer_assigned = None
-        t.queue_id = None
-        t.worker_index = None
-        t.docker_assigned = None
-        provider.update(t)
+        try:
+            resume = find_resume_info(provider, t)
+        except LookupError:
+            raise ApiError('master task not found', status=500)
+        reset_for_requeue(provider, t, resume=resume,
+                          reset_attempts=True)
         restarted.append(t.id)
     return {'restarted': restarted}
 

@@ -20,8 +20,10 @@ inline SVG) covering the same surfaces:
   performance card (step phase breakdown + pipeline efficiency +
   recompile timeline, telemetry/attribution.py), the span forest with
   durations, a cross-process trace waterfall (supervisor/worker/train
-  legs on one wall-clock axis), and on-demand profiler start/stop
-  buttons
+  legs on one wall-clock axis), a recovery card (retries used vs
+  budget, failure taxonomy verdict, next-retry time, the task.retry
+  event timeline — mlcomp_tpu/recovery.py), and on-demand profiler
+  start/stop buttons
 - supervisor tab: watchdog alerts card (open alerts + resolve button,
   telemetry/watchdog.py) above the decision trace
 - report detail: LAYOUT-DRIVEN rendering (reference
@@ -806,6 +808,35 @@ function performanceCard(series) {
   return html + '</div>';
 }
 
+function recoveryCard(info, series) {
+  // automatic-recovery history (mlcomp_tpu/recovery.py): retries
+  // consumed vs budget, the taxonomy verdict of the last failure, the
+  // scheduled next retry, and the per-event task.retry timeline the
+  // supervisor writes on each requeue
+  const events = series['task.retry'] || [];
+  if (!(info.attempt) && !events.length && !info.failure_reason)
+    return '';
+  let html = '<h3>recovery</h3><div class="card">'
+    + '<div style="display:flex;gap:18px;margin-bottom:8px">'
+    + `<div><b>${info.attempt || 0}${info.max_retries != null
+        ? '/' + info.max_retries : ''}</b>
+       <span class="dim">retries used</span></div>`;
+  if (info.failure_reason)
+    html += `<div><b>${esc(info.failure_reason)}</b>
+      <span class="dim">last failure</span></div>`;
+  if (info.next_retry_at)
+    html += `<div><b>${esc(info.next_retry_at)}</b>
+      <span class="dim">next retry</span></div>`;
+  html += '</div>';
+  if (events.length)
+    html += '<div class="dim" style="font-size:11px">'
+      + events.map(p => 'retry ' + (p.step == null ? '?' : p.step)
+        + (p.tags && p.tags.reason ? ' (' + esc(p.tags.reason) + ')' : '')
+        + ' at ' + esc(p.time || '')).join(' &middot; ')
+      + '</div>';
+  return html + '</div>';
+}
+
 async function profileToggle(id, action) {
   // on-demand jax.profiler trace on a RUNNING task; the training
   // process polls the request at epoch boundaries
@@ -833,6 +864,11 @@ async function viewTaskDetail(el, id) {
     <button class="btn" onclick="profileToggle(${id},'stop')"
       >stop profile</button></p>`));
   el.appendChild(h('<pre>'+esc(JSON.stringify(info,null,2))+'</pre>'));
+  // recovery card: retry history for tasks the supervisor auto-
+  // requeued (or is about to) — next to the raw info so a Failed
+  // task's "why" and "what happens next" read together
+  const rec = recoveryCard(info, tel.series || {});
+  if (rec) el.appendChild(h('<div>' + rec + '</div>'));
   const tree = (nodes) => '<div class="tree">' + nodes.map(s =>
     `<div>&#9656; ${esc(s.name)} <span class="dim">${esc(s.started||'')}
      ${s.finished?'&rarr; '+esc(s.finished):''}</span>

@@ -38,10 +38,13 @@ from mlcomp_tpu.utils.misc import now
 
 class SupervisorBuilder:
     def __init__(self, session: Session = None, logger=None,
-                 queue_liveness_window: float = 15.0):
+                 queue_liveness_window: float = 15.0,
+                 recovery_config=None):
+        from mlcomp_tpu.recovery import RecoveryConfig
         self.session = session or Session.create_session(key='supervisor')
         self.logger = logger
         self.queue_liveness_window = queue_liveness_window
+        self.recovery_config = recovery_config or RecoveryConfig()
         self.provider = TaskProvider(self.session)
         self.computer_provider = ComputerProvider(self.session)
         self.docker_provider = DockerProvider(self.session)
@@ -50,6 +53,7 @@ class SupervisorBuilder:
         self.auxiliary_provider = AuxiliaryProvider(self.session)
 
         self.queues = []
+        self.alive_computers = set()
         self.tasks = []
         self.dep_status = {}
         self.computers = []
@@ -77,6 +81,12 @@ class SupervisorBuilder:
         self.aux = {'time': str(now()), 'duration': None}
         alive = self.docker_provider.alive(self.queue_liveness_window)
         self.queues = [f'{d.computer}_{d.name}' for d in alive]
+        # host liveness for the lease reclaim: a claimed message whose
+        # worker's host still heartbeats is NOT reclaimed (its own
+        # reaper owns local failures); computer names may contain
+        # underscores, so this set — not queue-name parsing — is the
+        # liveness source
+        self.alive_computers = {d.computer for d in alive}
         self.aux['queues'] = list(self.queues)
 
     # -------------------------------------------------------- parent tasks
@@ -103,10 +113,36 @@ class SupervisorBuilder:
                     parent_task.status != int(new_status):
                 if new_status == TaskStatus.Failed:
                     self.stop_children(parent_task.id)
+                    # propagate the failure taxonomy UP so the retry
+                    # pass can judge the parent (service children are
+                    # never retried directly): all-transient child
+                    # failures make the parent retryable; any
+                    # permanent (or reasonless) child failure pins it
+                    # Failed — and overwrites a stale transient reason
+                    # from an earlier attempt, which would otherwise
+                    # retry a now-deterministic bug
+                    parent_task.failure_reason = \
+                        self._aggregate_failure_reason(parent_task.id)
+                    self.provider.update(parent_task,
+                                         ['failure_reason'])
                 self.provider.change_status(parent_task, new_status)
                 processed.append(
                     {'parent': parent_task.id, 'status': new_status.name})
         self.aux['parent_tasks'] = processed
+
+    def _aggregate_failure_reason(self, parent_id: int):
+        """The failure reason a distributed parent inherits from its
+        Failed service children, or None (= never auto-retried) when
+        no child carries a transient verdict."""
+        from mlcomp_tpu.recovery import is_transient
+        reasons = [c.failure_reason for c in self.provider.children(
+            parent_id, statuses=[TaskStatus.Failed])]
+        if reasons and all(r and is_transient(r) for r in reasons):
+            return reasons[0]
+        for reason in reasons:
+            if reason and not is_transient(reason):
+                return reason       # surface the permanent verdict
+        return None
 
     def stop_children(self, parent_id: int):
         from mlcomp_tpu.worker.tasks import kill_task
@@ -212,6 +248,21 @@ class SupervisorBuilder:
                 reasons[comp['name']] = reason
             else:
                 fits.append(comp)
+        # retry placement exclusion (mlcomp_tpu/recovery.py): the
+        # computer that just failed this task is skipped — SOFTLY. On
+        # a one-computer cluster the excluded host is still better
+        # than parking the retry forever, so the filter only applies
+        # when another candidate remains.
+        info = yaml_load(task.additional_info) \
+            if task.additional_info else {}
+        exclude = set((info or {}).get('retry_exclude') or [])
+        if exclude:
+            kept = [c for c in fits if c['name'] not in exclude]
+            if kept:
+                for c in fits:
+                    if c['name'] in exclude:
+                        reasons[c['name']] = 'excluded after failure'
+                fits = kept
         # most-free-cores first (single-node packing,
         # reference supervisor.py:200-226)
         fits.sort(key=lambda c: -len(self._free_cores(c)))
@@ -439,6 +490,233 @@ class SupervisorBuilder:
                  'cores': cores, 'rank': rank})
         self.provider.change_status(task, TaskStatus.Queued)
 
+    # ------------------------------------------------------------- recovery
+    def process_recovery(self):
+        """Automatic failure recovery (mlcomp_tpu/recovery.py), three
+        sweeps per tick, each cheap (indexed scans over claimed/failed
+        rows only):
+
+        1. **lease reclaim** — claimed messages whose lease expired and
+           whose worker's host lost its docker heartbeat go back to
+           pending, exactly once, so a SIGKILL'd worker no longer
+           strands its dispatch (db/providers/queue.py documents the
+           old behavior this replaces);
+        2. **strand sweep** — a re-delivered message nobody claimed for
+           another lease window fails, with its task marked
+           ``lease-expired``, handing over to sweep 3;
+        3. **retry** — Failed tasks with a transient ``failure_reason``
+           requeue after exponential backoff with the same ``resume``
+           info as restart-with-resume (training continues from the
+           last checkpoint) and the failed computer excluded from the
+           next placement; an exhausted budget raises a
+           ``retry-exhausted`` alert instead.
+
+        Crashes here must never take the tick down — recovery is a
+        repair crew, not a new single point of failure."""
+        try:
+            self._reclaim_leases()
+            self._retry_failed()
+        except Exception:
+            if self.logger:
+                self.logger.error(
+                    f'recovery pass failed:\n{traceback.format_exc()}',
+                    ComponentType.Supervisor)
+
+    def _message_task(self, msg):
+        try:
+            task_id = json.loads(msg.payload).get('task_id')
+        except (ValueError, TypeError):
+            return None
+        return self.provider.by_id(task_id) if task_id else None
+
+    def _reclaim_leases(self):
+        from mlcomp_tpu.db.core import parse_datetime
+        lease = float(self.recovery_config.lease_seconds)
+        qp = self.queue_provider
+        for msg in qp.claimed_expired(lease):
+            host = (msg.claimed_by or '').rsplit(':', 1)[0]
+            if host and host in self.alive_computers:
+                # host agent still heartbeats: its reaper handles local
+                # deaths; reclaiming under a live worker would risk a
+                # duplicate execution
+                continue
+            # a claimed message spans the whole task run, so a dead
+            # HEARTBEAT alone (a 15 s gap during a daemon upgrade, a
+            # stalled agent loop) must not be enough: an InProgress
+            # task is reclaimed only once its own silence exceeds the
+            # watchdog's stall deadline — the system's definition of
+            # "dead quiet", sized for the longest LEGITIMATE gap
+            # (first XLA compile, dataset download) during which no
+            # metric flush touches last_activity. A quieter horizon
+            # (the bare lease) would duplicate a live run mid-compile;
+            # a run dead past the stall deadline is killed by the
+            # watchdog at that same horizon anyway.
+            task = self._message_task(msg)
+            if task is not None and task.queue_id == msg.id and \
+                    task.status == int(TaskStatus.InProgress):
+                # queue_id guard: a later attempt's life must not keep
+                # a STALE message (no longer the task's dispatch)
+                # claimed forever — stale ones fall through to the
+                # reclaim/strand cleanup, whose task side-effects are
+                # queue_id-guarded themselves
+                last = parse_datetime(task.last_activity)
+                horizon = max(
+                    lease, float(self.watchdog.config.stall_deadline_s))
+                if last is not None and \
+                        (now() - last).total_seconds() < horizon:
+                    continue
+            if not qp.reclaim(msg.id):
+                # already re-delivered once: the reviving host claimed
+                # it and died AGAIN — no third delivery; fail it into
+                # the task-retry path (expire_claim is conditional, so
+                # a racing complete() wins cleanly). The queue_id guard
+                # keeps a stale message from failing a task whose
+                # CURRENT dispatch rides a different message.
+                if qp.expire_claim(msg.id):
+                    if task is not None and task.queue_id == msg.id \
+                            and task.status in (
+                                int(TaskStatus.Queued),
+                                int(TaskStatus.InProgress)):
+                        self.provider.fail_with_reason(
+                            task, 'lease-expired')
+                    self.aux.setdefault('lease_stranded', []).append(
+                        {'msg': msg.id, 'queue': msg.queue,
+                         'second_death': True})
+                continue
+            if task is not None and task.queue_id == msg.id and \
+                    task.status in (int(TaskStatus.Queued),
+                                    int(TaskStatus.InProgress)):
+                # the dead worker may have marked it InProgress before
+                # dying — reset to Queued so the re-delivered execute
+                # passes the worker's status guard
+                task.pid = None
+                self.provider.update(task, ['pid'])
+                if task.status == int(TaskStatus.InProgress):
+                    self.provider.change_status(task, TaskStatus.Queued)
+            self.telemetry.count('supervisor.lease_reclaimed')
+            self.aux.setdefault('lease_reclaimed', []).append(
+                {'msg': msg.id, 'queue': msg.queue,
+                 'worker': msg.claimed_by})
+            if self.logger:
+                self.logger.warning(
+                    f'queue message {msg.id} ({msg.queue}): lease '
+                    f'expired on dead worker {msg.claimed_by!r} — '
+                    f're-delivered', ComponentType.Supervisor)
+        for msg in qp.stranded_redelivered(lease):
+            if msg.queue in self.queues:
+                continue        # queue is alive — a claim will come
+            if not qp.fail_stranded(msg.id):
+                continue        # claimed meanwhile — the claim wins
+            task = self._message_task(msg)
+            self.aux.setdefault('lease_stranded', []).append(
+                {'msg': msg.id, 'queue': msg.queue})
+            if task is not None and task.queue_id == msg.id and \
+                    task.status in (int(TaskStatus.Queued),
+                                    int(TaskStatus.InProgress)):
+                self.provider.fail_with_reason(task, 'lease-expired')
+                if self.logger:
+                    self.logger.error(
+                        f'task {task.id} ({task.name}): re-delivered '
+                        f'dispatch stranded on dead queue {msg.queue} '
+                        f'— failed for retry elsewhere',
+                        ComponentType.Supervisor, None, task.id)
+
+    def _retry_failed(self):
+        from mlcomp_tpu.recovery import (
+            TRANSIENT_REASONS, retry_delay_s,
+        )
+        import datetime
+        cfg = self.recovery_config
+        now_dt = now()
+        # filter in SQL: permanent failures and reasonless legacy rows
+        # accumulate forever in a long-lived deployment — only the
+        # transient-Failed set (bounded by live incidents) may load
+        reasons = sorted(TRANSIENT_REASONS)
+        marks = ','.join('?' * len(reasons))
+        rows = self.session.query(
+            f'SELECT * FROM task WHERE status=? AND parent IS NULL '
+            f'AND failure_reason IN ({marks})',
+            (int(TaskStatus.Failed), *reasons))
+        for task in [Task.from_row(r) for r in rows]:
+            reason = task.failure_reason
+            attempt = task.attempt or 0
+            budget = task.max_retries if task.max_retries is not None \
+                else int(cfg.max_retries)
+            if attempt >= budget:
+                # raise ONCE per exhaustion: any alert (open OR
+                # resolved) newer than the task's final failure means
+                # this exhaustion is already on record — re-raising
+                # every tick would resurrect the alert seconds after
+                # an operator resolves it, forever. A later NEW
+                # exhaustion (human restart → fresh failures) has a
+                # newer finished stamp and alerts again.
+                prior = self.session.query_one(
+                    "SELECT id FROM alert WHERE rule='retry-exhausted' "
+                    "AND task=? AND time >= ? LIMIT 1",
+                    (task.id, task.finished or task.last_activity))
+                if prior is None:
+                    from mlcomp_tpu.db.providers import AlertProvider
+                    AlertProvider(self.session).raise_alert(
+                        'retry-exhausted',
+                        f'task {task.id} ({task.name}): {attempt} '
+                        f'retr{"y" if attempt == 1 else "ies"} '
+                        f'exhausted (last failure: {reason})',
+                        task=task.id, dag=task.dag,
+                        computer=task.computer_assigned,
+                        severity='critical',
+                        details={'attempt': attempt, 'reason': reason})
+                    self.aux.setdefault('retry_exhausted',
+                                        []).append(task.id)
+                continue
+            if task.next_retry_at is None:
+                delay = retry_delay_s(attempt, cfg, task_id=task.id)
+                task.next_retry_at = now_dt + \
+                    datetime.timedelta(seconds=delay)
+                self.provider.update(task, ['next_retry_at'])
+                self.aux.setdefault('retry_scheduled', {})[task.id] = \
+                    str(task.next_retry_at)
+                continue
+            from mlcomp_tpu.db.core import parse_datetime
+            due = parse_datetime(task.next_retry_at)
+            if due is not None and due > now_dt:
+                continue
+            self.retry_task(task, reason)
+
+    def retry_task(self, task: Task, reason: str):
+        """Requeue one transiently-Failed task: attempt+1, resume info
+        attached (training restores the last checkpoint), the failing
+        computer excluded, and the retry made observable — a
+        ``task.retry`` metric row (immediate, not buffered: retries
+        are rare and the dashboard/exporter must see them now)."""
+        from mlcomp_tpu.recovery import find_resume_info, reset_for_requeue
+        failed_on = task.computer_assigned
+        try:
+            resume = find_resume_info(self.provider, task)
+        except LookupError:
+            resume = None       # no rank-0 child — restart from scratch
+        task.attempt = (task.attempt or 0) + 1
+        # reset_for_requeue's full-row update persists the increment
+        reset_for_requeue(self.provider, task, resume=resume,
+                          exclude_computer=failed_on)
+        from mlcomp_tpu.db.providers import MetricProvider
+        try:
+            MetricProvider(self.session).add_many([
+                (task.id, 'task.retry', 'counter', task.attempt, 1.0,
+                 now(), 'supervisor', json.dumps({'reason': reason}))])
+        except Exception:
+            pass                # observability must not block the retry
+        self.telemetry.count('supervisor.task_retries')
+        self.aux.setdefault('retried', []).append(
+            {'task': task.id, 'attempt': task.attempt,
+             'reason': reason, 'excluded': failed_on})
+        if self.logger:
+            self.logger.warning(
+                f'task {task.id} ({task.name}): retry '
+                f'{task.attempt} after {reason} — requeued with '
+                f'resume' + (f', excluding {failed_on}'
+                             if failed_on else ''),
+                ComponentType.Supervisor, None, task.id)
+
     # ------------------------------------------------------------ preflight
     def dag_preflight_errors(self, dag_id: int) -> list:
         """Error findings for a dag, computed once per supervisor
@@ -589,7 +867,11 @@ class SupervisorBuilder:
                 task = self.provider.by_id(task_id)
                 if task is not None and \
                         task.status != int(TaskStatus.Failed):
-                    self.provider.change_status(task, TaskStatus.Failed)
+                    # stall-killed is TRANSIENT in the recovery
+                    # taxonomy: the retry pass requeues it (from the
+                    # last checkpoint, off this computer) instead of
+                    # leaving the kill as the end of the story
+                    self.provider.fail_with_reason(task, 'stall-killed')
                 if self.logger:
                     self.logger.error(
                         f'watchdog: {finding["message"]} — task marked '
@@ -608,6 +890,9 @@ class SupervisorBuilder:
         try:
             self.create_base()
             self.process_parent_tasks()
+            # recovery BEFORE load_tasks: a task requeued this tick
+            # re-loads as NotRan below and can re-dispatch immediately
+            self.process_recovery()
             self.load_tasks()
             self.load_computers()
             self.process_tasks()
@@ -631,7 +916,8 @@ class SupervisorBuilder:
                 from mlcomp_tpu.utils.logging import create_logger
                 self.logger = create_logger(self.session)
             self.__init__(session=self.session, logger=self.logger,
-                          queue_liveness_window=self.queue_liveness_window)
+                          queue_liveness_window=self.queue_liveness_window,
+                          recovery_config=self.recovery_config)
 
 
 def register_supervisor(session: Session = None, logger=None,

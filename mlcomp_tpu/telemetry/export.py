@@ -40,7 +40,8 @@ REQUIRED_FAMILIES = (
     'mlcomp_worker_slots', 'mlcomp_alerts_open',
     'mlcomp_dispatch_latency_seconds', 'mlcomp_step_phase_ms',
     'mlcomp_pipeline_efficiency', 'mlcomp_compile_events',
-    'mlcomp_serving_latency_ms', 'mlcomp_scrape_errors',
+    'mlcomp_task_retries', 'mlcomp_serving_latency_ms',
+    'mlcomp_scrape_errors',
 )
 
 
@@ -336,6 +337,38 @@ def _collect_compile_events(session, running, samples):
         samples.append(('_total', {'task': r['task']}, r['n']))
 
 
+#: rows scanned per scrape for the retry counter: task.retry rows are
+#: written by the supervisor on each automatic retry (one per event),
+#: so the newest window covers every live deployment's recent history
+#: without an unbounded name scan over the metric table
+_RETRY_SCAN_WINDOW = 100000
+
+
+def _collect_task_retries(session, samples):
+    """``mlcomp_task_retries_total{task,reason}`` from the per-event
+    ``task.retry`` metric rows (supervisor retry_task). Counter
+    semantics hold scrape-over-scrape as long as the events stay
+    inside the id window — beyond it the count would dip, which
+    Prometheus reads as a counter reset and absorbs."""
+    counts = {}
+    for r in session.query(
+            "SELECT task, tags FROM metric "
+            "WHERE id > (SELECT COALESCE(MAX(id), 0) FROM metric) - ? "
+            "AND name='task.retry'", (_RETRY_SCAN_WINDOW,)):
+        reason = 'unknown'
+        try:
+            reason = json.loads(r['tags'] or '{}').get('reason') \
+                or 'unknown'
+        except ValueError:
+            pass
+        key = (r['task'], reason)
+        counts[key] = counts.get(key, 0) + 1
+    for (task, reason), n in sorted(counts.items(),
+                                    key=lambda kv: (str(kv[0][0]),
+                                                    kv[0][1])):
+        samples.append(('_total', {'task': task, 'reason': reason}, n))
+
+
 #: rows scanned per scrape for the serving re-export: the latest
 #: heartbeat's bucket/count/mean rows live at the table's tail, so a
 #: bounded id window keeps the scrape O(window) however old the
@@ -410,11 +443,13 @@ def collect_server_families(session):
 
     tasks, queues, slots, alerts = [], [], [], []
     dispatch, phases, eff, compiles, serving = [], [], [], [], []
+    retries = []
     guarded(_collect_tasks, session, tasks)
     guarded(_collect_queue_depth, session, queues)
     guarded(_collect_worker_slots, session, slots)
     guarded(_collect_alerts, session, alerts)
     guarded(_collect_dispatch_latency, session, dispatch)
+    guarded(_collect_task_retries, session, retries)
     running = []
     try:
         running = _running_task_ids(session)
@@ -447,6 +482,9 @@ def collect_server_families(session):
         family('mlcomp_compile_events', 'counter',
                'recorded XLA compile events (newest '
                f'{_RUNNING_TASKS_CAP} running tasks)', compiles),
+        family('mlcomp_task_retries', 'counter',
+               'automatic task retries by failure reason '
+               '(recovery subsystem; recent event window)', retries),
         family('mlcomp_serving_latency_ms', 'histogram',
                'served-model request latency (cumulative buckets, '
                'latest heartbeat snapshot)', serving),

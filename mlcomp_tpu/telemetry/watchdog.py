@@ -162,10 +162,13 @@ class Watchdog:
         """Auto-resolve condition alerts whose task is no longer
         running: regression/straggler/HBM alerts describe a LIVE
         condition, and the condition cannot outlive the task. Stall
-        alerts stay open — they are the paper trail of a kill."""
+        alerts stay open — they are the paper trail of a kill — and so
+        do retry-exhausted alerts (supervisor recovery pass): both
+        describe a task that is precisely NOT running anymore."""
+        keep_open = ('task-stall', 'retry-exhausted')
         running_ids = {t.id for t in running}
         for alert in alerts.get(status='open', limit=1000):
-            if alert.rule == 'task-stall' or alert.task is None:
+            if alert.rule in keep_open or alert.task is None:
                 continue
             if alert.task not in running_ids:
                 alerts.resolve(alert.id)

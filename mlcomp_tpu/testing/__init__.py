@@ -1,0 +1,16 @@
+"""Test harnesses that ship with the framework (not the tests).
+
+``faults`` — the deterministic fault-injection registry the chaos
+suite (tests/test_recovery.py, scripts/chaos_smoke.py) drives to prove
+every automatic-recovery path end-to-end. Production code calls
+``fault_point(name)`` at a handful of failure seams; with no faults
+configured the call is a module-global check and nothing else.
+"""
+
+from mlcomp_tpu.testing.faults import (
+    FAULTS_ENV, clear_faults, configure_faults, fault_point, fault_state,
+    register_handler,
+)
+
+__all__ = ['fault_point', 'configure_faults', 'clear_faults',
+           'register_handler', 'fault_state', 'FAULTS_ENV']

@@ -1,0 +1,168 @@
+"""Deterministic fault injection — chaos testing without chaos.
+
+The recovery subsystem (queue lease reclaim, checkpoint-aware retry,
+docs/robustness.md) claims to survive worker SIGKILLs, DB outages and
+torn checkpoint writes. Claims like that rot unless they are exercised,
+so a handful of production seams call ``fault_point(name)`` and this
+registry decides — deterministically — whether that hit fails.
+
+Design constraints, in order:
+
+1. **Zero overhead when disabled.** With no faults configured the
+   registry dict is ``None`` and ``fault_point`` returns after one
+   module-global check. No env read, no dict lookup, no allocation —
+   bench.py measures and publishes this (``recovery_overhead_pct``).
+2. **Deterministic.** A fault fires on the Nth *hit* of its point
+   (``after``), for ``times`` hits — counters, never wall-clock or
+   ``random``. A chaos test that seeds ``{'after': 2}`` kills the
+   second epoch on every run, on every machine.
+3. **Cross-process.** Specs travel in the ``MLCOMP_FAULTS`` env var
+   (JSON) so a worker *subprocess* — the thing actually being killed —
+   arms itself at import with no plumbing through the task code.
+
+Spec format (``configure_faults`` dict or ``MLCOMP_FAULTS`` JSON)::
+
+    {"train.epoch":  {"action": "exit",  "after": 2, "code": 137},
+     "db.execute":   {"action": "raise", "exc": "operational",
+                      "after": 5, "times": 3},
+     "queue.enqueue": {"action": "sleep", "ms": 50, "times": null}}
+
+Actions:
+
+- ``exit``  — ``os._exit(code)`` (default 137, SIGKILL's shell code):
+  the unclean death of a preempted/OOM-killed worker. No ``finally``
+  blocks run, exactly like the real thing.
+- ``raise`` — raise an exception: ``exc`` is ``operational`` (sqlite
+  ``database is locked`` — the DB-outage window), ``oserror``
+  (connection trouble) or ``runtime``.
+- ``sleep`` — ``time.sleep(ms/1000)`` (slow dispatch / slow disk).
+- ``call``  — invoke a handler registered in-process via
+  ``register_handler(point, fn)`` with the site's context kwargs (the
+  claim-race steal needs a live session, which can't ride an env var).
+
+``after`` (default 1) is the 1-based hit index of the first firing;
+``times`` (default 1) the number of consecutive firing hits, ``None``
+meaning every hit from ``after`` on.
+
+Injection points shipped in the framework (grep ``fault_point(``):
+
+- ``db.execute``                — Session statement seam (db/core.py)
+- ``queue.enqueue``             — dispatch seam (providers/queue.py)
+- ``queue.claim``               — between candidate SELECT and claim
+  UPDATE in the sqlite fallback path (the claim race window)
+- ``checkpoint.between_writes`` — between the blob ``os.replace`` and
+  the meta ``os.replace`` (the torn-pair crash)
+- ``train.epoch``               — end of each training epoch
+  (kill-worker-mid-epoch)
+- ``task.execute``              — just before the executor runs
+"""
+
+import json
+import os
+import sqlite3
+import time
+
+FAULTS_ENV = 'MLCOMP_FAULTS'
+
+#: point -> spec dict (with a mutable '_hits' counter). None = armed
+#: with nothing = the disabled fast path.
+_ACTIVE = None
+#: point -> callable, for action 'call' (in-process only)
+_HANDLERS = {}
+
+_EXCEPTIONS = {
+    'operational': lambda msg: sqlite3.OperationalError(
+        msg or 'database is locked (injected)'),
+    'oserror': lambda msg: OSError(msg or 'connection reset (injected)'),
+    'runtime': lambda msg: RuntimeError(msg or 'injected fault'),
+}
+
+
+def configure_faults(specs: dict):
+    """Arm the registry with ``{point: spec}``. Replaces any previous
+    configuration and resets every hit counter."""
+    global _ACTIVE
+    if not specs:
+        _ACTIVE = None
+        return
+    active = {}
+    for point, spec in specs.items():
+        spec = dict(spec or {})
+        spec.setdefault('action', 'raise')
+        spec.setdefault('after', 1)
+        spec.setdefault('times', 1)
+        spec['_hits'] = 0
+        active[point] = spec
+    _ACTIVE = active
+
+
+def clear_faults():
+    global _ACTIVE
+    _ACTIVE = None
+    _HANDLERS.clear()
+
+
+def register_handler(point: str, fn):
+    """In-process handler for action ``call`` — receives the site's
+    context kwargs. Arm the point too if it isn't configured yet."""
+    _HANDLERS[point] = fn
+    global _ACTIVE
+    if _ACTIVE is None:
+        _ACTIVE = {}
+    if point not in _ACTIVE:
+        _ACTIVE[point] = {'action': 'call', 'after': 1, 'times': None,
+                          '_hits': 0}
+
+
+def fault_state() -> dict:
+    """Introspection for tests: ``{point: hits}`` of the armed specs."""
+    if _ACTIVE is None:
+        return {}
+    return {point: spec['_hits'] for point, spec in _ACTIVE.items()}
+
+
+def fault_point(name: str, **ctx):
+    """A production seam announces a hit. Disabled: one global check."""
+    if _ACTIVE is None:
+        return
+    spec = _ACTIVE.get(name)
+    if spec is None:
+        return
+    spec['_hits'] += 1
+    hit = spec['_hits']
+    after = int(spec.get('after') or 1)
+    times = spec.get('times')
+    if hit < after:
+        return
+    if times is not None and hit >= after + int(times):
+        return
+    action = spec.get('action')
+    if action == 'exit':
+        os._exit(int(spec.get('code', 137)))  # noqa — simulated SIGKILL
+    if action == 'raise':
+        raise _EXCEPTIONS.get(spec.get('exc', 'runtime'),
+                              _EXCEPTIONS['runtime'])(spec.get('message'))
+    if action == 'sleep':
+        time.sleep(float(spec.get('ms', 10)) / 1000.0)
+        return
+    if action == 'call':
+        handler = _HANDLERS.get(name)
+        if handler is not None:
+            handler(**ctx)
+        return
+    raise ValueError(f'unknown fault action {action!r} for {name!r}')
+
+
+# Arm from the environment at import: the worker subprocess the chaos
+# suite kills gets its faults with zero plumbing. An empty/absent var
+# keeps _ACTIVE None — the permanent fast path.
+_env = os.environ.get(FAULTS_ENV)
+if _env:
+    try:
+        configure_faults(json.loads(_env))
+    except (ValueError, TypeError):
+        _ACTIVE = None
+
+
+__all__ = ['fault_point', 'configure_faults', 'clear_faults',
+           'register_handler', 'fault_state', 'FAULTS_ENV']

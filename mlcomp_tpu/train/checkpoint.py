@@ -21,6 +21,7 @@ Two wire formats share the ``last``/``best`` naming and this module's
 """
 
 import json
+import logging
 import os
 import queue
 import shutil
@@ -31,9 +32,53 @@ from typing import Any, Optional, Tuple
 import jax
 from flax import serialization
 
+from mlcomp_tpu.testing.faults import fault_point
+
+logger = logging.getLogger(__name__)
+
 
 def _meta_path(path: str) -> str:
     return path + '.meta.json'
+
+
+def _write_durable(path: str, data, mode: str = 'wb'):
+    """Write + flush + fsync. ``os.replace`` makes the rename atomic
+    against crashes of THIS process, but without the fsync a power
+    loss can still leave a torn file behind the new name — the
+    checkpoint would then poison every later resume."""
+    with open(path, mode) as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+def _copy_durable(src: str, dst: str):
+    """tmp + fsync + os.replace copy: ``best`` is the torn-``last``
+    fallback target (restore_checkpoint), so it must be committed at
+    least as durably as ``last`` — a plain copyfile could leave a
+    truncated blob behind the final name on power loss, tearing the
+    very file the fallback relies on."""
+    tmp = dst + '.tmp'
+    with open(src, 'rb') as s, open(tmp, 'wb') as d:
+        shutil.copyfileobj(s, d)
+        d.flush()
+        os.fsync(d.fileno())
+    os.replace(tmp, dst)
+
+
+def _fsync_dir(directory: str):
+    """Persist the renames themselves (the directory entry is data
+    too). Best-effort: not every filesystem exposes a dir fd."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def save_checkpoint(directory: str, state: Any, meta: dict,
@@ -47,13 +92,16 @@ def save_checkpoint(directory: str, state: Any, meta: dict,
     meta = dict(meta, time=time.time())
     last = os.path.join(directory, 'last.msgpack')
     tmp = last + '.tmp'
-    with open(tmp, 'wb') as fh:
-        fh.write(blob)
+    _write_durable(tmp, blob)
     os.replace(tmp, last)
+    # chaos: crash between the two commits — blob new, meta old. The
+    # restore path tolerates the torn pair (resume redoes at most one
+    # epoch; it never crashes)
+    fault_point('checkpoint.between_writes', path=last)
     meta_tmp = _meta_path(last) + '.tmp'
-    with open(meta_tmp, 'w') as fh:
-        json.dump(meta, fh)
+    _write_durable(meta_tmp, json.dumps(meta), mode='w')
     os.replace(meta_tmp, _meta_path(last))
+    _fsync_dir(directory)
     # mirror of ckpt_shard's cleanup: a format switch back to msgpack
     # must not leave a stale sharded dir shadowing this save. Only the
     # kinds being WRITTEN are stale — an old-format best may remain the
@@ -67,8 +115,9 @@ def save_checkpoint(directory: str, state: Any, meta: dict,
     _drop_stale_dir('last')
     if best:
         best_path = os.path.join(directory, 'best.msgpack')
-        shutil.copyfile(last, best_path)
-        shutil.copyfile(_meta_path(last), _meta_path(best_path))
+        _copy_durable(last, best_path)
+        _copy_durable(_meta_path(last), _meta_path(best_path))
+        _fsync_dir(directory)
         _drop_stale_dir('best')
     return last
 
@@ -211,9 +260,22 @@ def restore_checkpoint(directory: str, target: Any,
             restore_checkpoint_sharded,
         )
         return restore_checkpoint_sharded(directory, target, kind)
-    with open(path, 'rb') as fh:
-        blob = fh.read()
-    state = serialization.from_bytes(target, blob)
+    try:
+        with open(path, 'rb') as fh:
+            blob = fh.read()
+        state = serialization.from_bytes(target, blob)
+    except Exception as e:
+        # torn `last` (truncated blob from a crash/power loss the
+        # fsync path couldn't cover, or a pre-fsync checkpoint): fall
+        # back to the previous surviving checkpoint — `best` — with a
+        # warning, instead of crashing the resume. Epochs since that
+        # best are redone, not lost to a wedged task.
+        if kind == 'last' and checkpoint_exists(directory, 'best'):
+            logger.warning(
+                'checkpoint %s is unreadable (%s); falling back to the '
+                'best checkpoint', path, e)
+            return restore_checkpoint(directory, target, kind='best')
+        raise
     # read the blob's own sidecar directly — load_meta would re-run the
     # format pick (and re-parse the sharded index) a second time
     meta = _load_json(_meta_path(path)) or {}

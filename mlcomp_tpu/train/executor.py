@@ -878,6 +878,13 @@ class JaxTrain(Executor):
                 if profiling:
                     self._stop_profile(global_epoch)
                 global_epoch += 1
+                # chaos seam (mlcomp_tpu/testing/faults.py): the
+                # kill-worker-mid-epoch fault dies HERE, after epoch
+                # N's checkpoint submit — one module-global check when
+                # no faults are armed
+                from mlcomp_tpu.testing.faults import fault_point
+                fault_point('train.epoch', epoch=global_epoch,
+                            task=self.task.id if self.task else None)
             if (dispatch_stage is not None or self.stage_per_dispatch) \
                     and stage_name != stage_names[-1]:
                 # return for requeue: next dispatch runs the next stage.

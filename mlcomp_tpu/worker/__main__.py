@@ -100,8 +100,9 @@ def queue_names(index: int = None):
 
 # --------------------------------------------------------------- consumer
 def _run_subprocess(task_id: int, index: int, logger, session,
-                    trace_id: str = None) -> bool:
-    """Execute a task in a child process; returns success."""
+                    trace_id: str = None) -> int:
+    """Execute a task in a child process; returns the exit status
+    (0 = success; negative = killed by that signal)."""
     env = dict(os.environ)
     # exec-time marker read back via /proc/<pid>/environ by kill_task's
     # pid-reuse guard
@@ -123,7 +124,7 @@ def _run_subprocess(task_id: int, index: int, logger, session,
            str(task_id), '--index', str(index)]
     proc = subprocess.Popen(cmd, env=env)
     proc.wait()
-    return proc.returncode == 0
+    return proc.returncode
 
 
 def _consume_one(session, queue_provider, logger, index: int,
@@ -153,18 +154,26 @@ def _consume_one(session, queue_provider, logger, index: int,
                     except Exception:
                         pass
             else:
-                ok = _run_subprocess(task_id, index, logger, session,
-                                     trace_id=trace_id)
+                returncode = _run_subprocess(task_id, index, logger,
+                                             session, trace_id=trace_id)
+                ok = returncode == 0
             if ok:
                 queue_provider.complete(msg_id)
             else:
-                queue_provider.fail(msg_id, 'subprocess failed')
-                # the subprocess may have died before marking the task
+                queue_provider.fail(
+                    msg_id, f'subprocess failed (rc={returncode})')
+                # the subprocess may have died before marking the task;
+                # classify the death for the retry pass: a signal kill
+                # (SIGTERM/SIGKILL) is a preemption and retries, a
+                # crash that never wrote its own reason is worker-lost
                 provider = TaskProvider(session)
                 task = provider.by_id(task_id)
                 if task is not None and \
                         task.status < int(TaskStatus.Failed):
-                    provider.change_status(task, TaskStatus.Failed)
+                    from mlcomp_tpu.recovery import classify_returncode
+                    provider.fail_with_reason(
+                        task,
+                        classify_returncode(returncode) or 'worker-lost')
         elif action == 'kill':
             from mlcomp_tpu.worker.tasks import kill_task
             kill_task(task_id, session=session)
@@ -239,9 +248,11 @@ def stop_processes_not_exist(session, logger):
         if grace_ok:
             logger.error(
                 f'task {task.id}: pid {task.pid} no longer exists — '
-                f'marking Failed', ComponentType.WorkerSupervisor,
-                HOSTNAME, task.id)
-            provider.change_status(task, TaskStatus.Failed)
+                f'marking Failed (worker-lost)',
+                ComponentType.WorkerSupervisor, HOSTNAME, task.id)
+            # worker-lost is transient: the supervisor's retry pass
+            # requeues it from the last checkpoint
+            provider.fail_with_reason(task, 'worker-lost')
 
 
 def worker_usage(session, logger):

@@ -223,6 +223,8 @@ class ExecuteBuilder:
             session=self.session, logger=self.logger)
 
     def execute(self, folder: str):
+        from mlcomp_tpu.testing.faults import fault_point
+        fault_point('task.execute', task=self.task_id)
         cwd = os.getcwd()
         os.chdir(folder)
         try:
@@ -353,7 +355,13 @@ class ExecuteBuilder:
                 task = self.provider.by_id(self.task_id)
                 if task is not None and task.status < int(
                         TaskStatus.Failed):
-                    self.provider.change_status(task, TaskStatus.Failed)
+                    # classify for the supervisor's retry pass
+                    # (mlcomp_tpu/recovery.py): a DB hiccup or
+                    # connection drop retries from the last
+                    # checkpoint, an executor bug fails for good
+                    from mlcomp_tpu.recovery import classify_exception
+                    self.provider.fail_with_reason(
+                        task, classify_exception(e))
             raise
         finally:
             try:

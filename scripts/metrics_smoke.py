@@ -59,6 +59,8 @@ def seed(session):
             'train', None),
            (task.id, 'compile.backend_ms', 'series', 3, 250.0, ts,
             'train', None),
+           (task.id, 'task.retry', 'counter', 1, 1.0, ts,
+            'supervisor', json.dumps({'reason': 'worker-lost'})),
            (None, 'supervisor.dispatch_latency_s.p50', 'histogram',
             None, 0.4, ts, 'supervisor', None),
            (None, 'supervisor.dispatch_latency_s.p99', 'histogram',
@@ -128,6 +130,9 @@ def main():
             for l in sample_labels('mlcomp_step_phase_ms'))),
         ('mlcomp_pipeline_efficiency',
          len(doc['mlcomp_pipeline_efficiency']['samples']) == 1),
+        ('mlcomp_task_retries reason label', any(
+            l.get('reason') == 'worker-lost' and v == 1
+            for _, l, v in doc['mlcomp_task_retries']['samples'])),
         ('mlcomp_serving_latency_ms buckets', any(
             l.get('le') == '+Inf'
             for l in sample_labels('mlcomp_serving_latency_ms'))),
