@@ -935,6 +935,44 @@ def main():
         watchdog.evaluate()
     watchdog_eval_cost = (time.perf_counter() - t0) / n_eval
 
+    # ---- gang-recovery overhead (elastic gang scheduling): the only
+    # per-tick cost the gang machinery adds to a HEALTHY deployment is
+    # the watchdog's gang-stall scan (indexed gang rows + one docker
+    # heartbeat GROUP BY). Timed against seeded live gang ranks — an
+    # empty scan would certify nothing — and amortized over the steps
+    # between evaluations like the watchdog number above. The failure
+    # paths (abort, generation bump, reshaped re-placement) run only
+    # when a gang is already dying, so they are not steady-state cost.
+    from mlcomp_tpu.db.models import Computer as _Computer
+    from mlcomp_tpu.db.providers import (
+        ComputerProvider as _ComputerP, DockerProvider as _DockerP,
+    )
+    _gang_parent = Task(name='bench_gang', executor='e',
+                        status=int(TaskStatus.InProgress),
+                        started=_ts, last_activity=_ts,
+                        gang_id='bench_g', gang_generation=1)
+    _tp.add(_gang_parent)
+    for i in range(3):
+        _ComputerP(tele_session).create_or_update(
+            _Computer(name=f'bench_gang_host{i}', cores=4, cpu=8,
+                      memory=16, ip='127.0.0.1',
+                      can_process_tasks=True), 'name')
+        _DockerP(tele_session).heartbeat(f'bench_gang_host{i}',
+                                         'default')
+        _tp.add(Task(
+            name=f'bench_gang_{i}', executor='e',
+            status=int(TaskStatus.InProgress), started=_ts,
+            last_activity=_ts, parent=_gang_parent.id,
+            computer_assigned=f'bench_gang_host{i}',
+            gang_id='bench_g', gang_generation=1))
+    from mlcomp_tpu.db.providers import AlertProvider as _AlertP
+    _alerts = _AlertP(tele_session)
+    n_gang_eval = 50
+    t0 = time.perf_counter()
+    for _ in range(n_gang_eval):
+        watchdog._check_gang_stalls(_alerts, _db_now())
+    gang_sweep_cost = (time.perf_counter() - t0) / n_gang_eval
+
     # ---- recovery-machinery overhead (same isolated accounting; the
     # acceptance bar is ~0). With no faults armed, a fault_point() is
     # one module-global check — the train loop pays exactly one per
@@ -1020,6 +1058,16 @@ def main():
             f'ns/call, charged per step though the loop pays one per '
             f'EPOCH) vs the measured compute step — the recovery '
             f'machinery is off the hot path; budget ~0 (<1%)',
+        'gang_recovery_overhead_pct':
+            round(100.0 * (gang_sweep_cost / steps_per_eval)
+                  / step_time, 6),
+        'gang_recovery_overhead_note':
+            f'gang-stall watchdog sweep over live seeded gang ranks '
+            f'({gang_sweep_cost * 1e3:.3f} ms/eval amortized over '
+            f'{steps_per_eval:.0f} steps) vs the measured compute '
+            f'step — the only steady-state cost of elastic gang '
+            f'scheduling; abort/requeue/reshape run only on a dying '
+            f'gang; budget ~0 (<1%)',
     }
     result.update(grid_result)
 

@@ -18,6 +18,10 @@
 - ``mlcomp_tpu recovery``       — automatic-recovery state
   (mlcomp_tpu/recovery.py): tasks with retries consumed or scheduled,
   their failure taxonomy verdicts, ``--json`` for scripts
+- ``mlcomp_tpu gangs``          — multi-host gang state (elastic
+  gang-atomic recovery, server/supervisor.py): per gang, the live
+  generation, parent status, rank roster with computers and failure
+  reasons, ``--json`` for scripts
 """
 
 import json
@@ -284,6 +288,68 @@ def recovery(as_json, limit):
         if it['computer']:
             parts.append(f"on {it['computer']}")
         click.echo(' — '.join(parts))
+
+
+@main.command()
+@click.option('--json', 'as_json', is_flag=True,
+              help='machine-readable output')
+@click.option('--limit', type=int, default=50,
+              help='newest gangs to show')
+def gangs(as_json, limit):
+    """Multi-host gang state (elastic gang-atomic recovery): one line
+    per gang — live generation, parent status, rank roster — plus the
+    failure reason each dead rank carried."""
+    from mlcomp_tpu.db.enums import TaskType
+    session = Session.create_session()
+    migrate(session)
+    # parent rows only: detached ranks of earlier generations also
+    # have parent=NULL but keep their Service type
+    parents = session.query(
+        'SELECT * FROM task WHERE gang_id IS NOT NULL '
+        'AND parent IS NULL AND type != ? ORDER BY id DESC LIMIT ?',
+        (int(TaskType.Service), int(limit)))
+    items = []
+    for p in parents:
+        ranks = session.query(
+            'SELECT id, name, status, computer_assigned, '
+            'failure_reason, gang_generation FROM task '
+            'WHERE parent=? AND gang_id=? ORDER BY id',
+            (p['id'], p['gang_id']))
+        items.append({
+            'gang': p['gang_id'],
+            'parent': p['id'],
+            'name': p['name'],
+            'status': TaskStatus(p['status']).name,
+            'generation': p['gang_generation'] or 0,
+            'attempt': p['attempt'] or 0,
+            'failure_reason': p['failure_reason'],
+            'ranks': [{
+                'task': r['id'],
+                'status': TaskStatus(r['status']).name,
+                'computer': r['computer_assigned'],
+                'generation': r['gang_generation'] or 0,
+                'failure_reason': r['failure_reason'],
+            } for r in ranks],
+        })
+    if as_json:
+        click.echo(json.dumps(items))
+        return
+    if not items:
+        click.echo('no gangs')
+        return
+    for it in items:
+        head = (f"{it['gang']} [{it['status']}] {it['name']} "
+                f"(task {it['parent']}) — generation "
+                f"{it['generation']}, retries {it['attempt']}")
+        if it['failure_reason']:
+            head += f", last failure {it['failure_reason']}"
+        click.echo(head)
+        for r in it['ranks']:
+            line = (f"  rank task {r['task']} [{r['status']}]"
+                    + (f" on {r['computer']}" if r['computer'] else ''))
+            if r['failure_reason']:
+                line += f" — {r['failure_reason']}"
+            click.echo(line)
 
 
 if __name__ == '__main__':

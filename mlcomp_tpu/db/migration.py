@@ -237,8 +237,30 @@ def _v7_recovery(session: Session):
             '"redelivered" INTEGER DEFAULT 0')
 
 
+def _v8_gang(session: Session):
+    """Gang-atomic multi-host recovery: gang identity + generation on
+    task (stamped on the distributed parent and every fanned-out
+    service row). A fresh DB's _v1 already created task with the new
+    columns, so the ALTERs are guarded by a live pragma check. The
+    gang_generation DEFAULT matters: legacy rows must read 0 ("never
+    fanned out"), not NULL, for the supervisor's bump arithmetic."""
+    have = {r['name'] for r in session.query('PRAGMA table_info(task)')}
+    if have:        # an empty pragma = table absent (partial legacy DB)
+        if 'gang_id' not in have:
+            session.execute('ALTER TABLE task ADD COLUMN "gang_id" TEXT')
+        if 'gang_generation' not in have:
+            session.execute(
+                'ALTER TABLE task ADD COLUMN '
+                '"gang_generation" INTEGER DEFAULT 0')
+        # the gang-stall watchdog rule and the `mlcomp_tpu gangs` CLI
+        # scan by gang id every evaluation — keep those reads indexed
+        session.execute(
+            'CREATE INDEX IF NOT EXISTS idx_task_gang_id '
+            'ON task("gang_id")')
+
+
 MIGRATIONS = [_v1_init, _v2_data, _v3_auth, _v4_telemetry, _v5_preflight,
-              _v6_tracing_alerts, _v7_recovery]
+              _v6_tracing_alerts, _v7_recovery, _v8_gang]
 
 
 def migrate(session: Session = None):

@@ -48,6 +48,14 @@ class Task(DBModel):
     # when the supervisor may requeue a transiently-Failed task
     next_retry_at = Column('TEXT', dtype='datetime')
     failure_reason = Column('TEXT')       # taxonomy code, e.g. 'db-error'
+    # gang-atomic multi-host recovery (migration v8): the gang a
+    # fanned-out distributed job belongs to (parent AND service rows
+    # share it) and which incarnation of it this row served. 0 = never
+    # fanned out; the first dispatch is generation 1, each gang-atomic
+    # requeue bumps it — the "did the whole gang come back exactly
+    # once" accounting the chaos suite asserts on.
+    gang_id = Column('TEXT', index=True)
+    gang_generation = Column('INTEGER', default=0)
 
 
 class TaskDependence(DBModel):

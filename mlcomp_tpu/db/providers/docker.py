@@ -29,6 +29,12 @@ class DockerProvider(BaseDataProvider):
         (reference worker/__main__.py:147-160 registers the Docker row at
         worker-supervisor start; folding it into the heartbeat makes the
         liveness contract self-contained)."""
+        # chaos seam (mlcomp_tpu/testing/faults.py): host.preempt kills
+        # the heartbeat writer — the stand-in for a whole preempted
+        # host, whose silence the gang-stall watchdog rule diagnoses.
+        # A `when: {computer: ...}` filter preempts one host only.
+        from mlcomp_tpu.testing.faults import fault_point
+        fault_point('host.preempt', computer=computer)
         cur = self.session.execute(
             'UPDATE docker SET last_activity=? WHERE computer=? AND name=?',
             (now(), computer, name))

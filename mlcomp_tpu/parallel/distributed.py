@@ -18,10 +18,87 @@ from typing import Any, Optional
 _state = {'initialized': False}
 
 
+#: substrings of coordination-service errors that mean "my peers never
+#: arrived / the coordinator is gone", not "my own config is broken" —
+#: the gang-peer-lost carve-out of the join failure space
+_PEER_LOST_MARKERS = ('deadline', 'timed out', 'timeout', 'unavailable',
+                      'connection refused', 'connect failed',
+                      'failed to connect', 'barrier')
+
+
+def _probe_coordinator(address: str, timeout_s: float, rank: int,
+                       count: int, gang: dict) -> float:
+    """Bounded TCP probe of the coordinator BEFORE touching
+    ``jax.distributed.initialize``: the xla coordination client
+    ``LOG(FATAL)``s (process abort, nothing catchable in Python) when
+    its registration deadline expires, so the common gang failure —
+    the coordinator HOST died at dispatch — must be diagnosed out
+    here, where it can raise ``GangPeerLost`` and flow through the
+    normal failure-classification path instead of a silent SIGABRT.
+    Returns the seconds SPENT probing — the caller deducts them from
+    the registration deadline so probe + register together honour ONE
+    join budget, not two."""
+    import socket
+    import time as _time
+    from mlcomp_tpu.recovery import GangPeerLost
+    host, _, port = address.rpartition(':')
+    start = _time.monotonic()
+    deadline = start + float(timeout_s)
+    last_err = 'unreachable'
+    while _time.monotonic() < deadline:
+        try:
+            with socket.create_connection((host, int(port)), timeout=2):
+                return _time.monotonic() - start
+        except OSError as e:
+            last_err = str(e) or type(e).__name__
+            _time.sleep(min(1.0, max(
+                0.05, deadline - _time.monotonic())))
+    raise GangPeerLost(
+        f'rank {rank}/{count} of gang {gang.get("id") or "?"} '
+        f'(generation {gang.get("generation") or "?"}) gave up joining '
+        f'coordinator {address} after {timeout_s:.0f}s: {last_err}')
+
+
+def _enable_cpu_collectives(jax):
+    """CPU multi-process: XLA's CPU client has NO cross-process
+    collectives unless an implementation is selected BEFORE the
+    backend initializes ("Multiprocess computations aren't implemented
+    on the CPU backend" otherwise) — gloo ships in jaxlib. Real TPU
+    runs never reach the condition (their platform list doesn't lead
+    with cpu; TPU collectives ride ICI/DCN in the TPU client), and an
+    explicit user choice ('mpi') is left alone."""
+    import os
+    try:
+        platforms = str(
+            jax.config.jax_platforms
+            or os.environ.get('JAX_PLATFORMS') or '')
+        if platforms.split(',')[0].strip().lower() != 'cpu':
+            return
+        from jax._src import xla_bridge
+        if xla_bridge.CPU_COLLECTIVES_IMPLEMENTATION.value in (
+                None, 'none'):
+            jax.config.update(
+                'jax_cpu_collectives_implementation', 'gloo')
+    except Exception:
+        pass        # older/newer jax layouts: join without the assist
+
+
 def initialize_from_distr_info(distr_info: Optional[dict]) -> bool:
     """Idempotently initialize the jax distributed runtime from the
     supervisor's distr_info {coordinator_address, process_index,
-    process_count}. Returns True when running multi-process."""
+    process_count}. Returns True when running multi-process.
+
+    The join is BOUNDED: ``distr_info['join_timeout_s']`` (stamped by
+    the supervisor from ``RecoveryConfig.join_timeout_s``) caps how
+    long this rank waits for the gang to assemble. Without it a gang
+    whose sibling died at dispatch strands every survivor at the
+    coordinator forever — with it the stranded rank fails fast as
+    ``GangPeerLost`` (taxonomy ``gang-peer-lost``) where the failure
+    is catchable (dead-coordinator TCP probe, jax versions that raise)
+    and as a bounded process abort where xla's coordination client
+    ``LOG(FATAL)``s (a missing middle peer) — either way the rank
+    dies within the bound, the gang verdict aggregates, and the whole
+    gang requeues as one unit."""
     if not distr_info:
         return False
     count = int(distr_info.get('process_count') or 1)
@@ -30,10 +107,46 @@ def initialize_from_distr_info(distr_info: Optional[dict]) -> bool:
     if _state['initialized']:
         return True
     import jax
-    jax.distributed.initialize(
-        coordinator_address=distr_info['coordinator_address'],
-        num_processes=count,
-        process_id=int(distr_info.get('process_index') or 0))
+    _enable_cpu_collectives(jax)
+    timeout = distr_info.get('join_timeout_s')
+    rank = int(distr_info.get('process_index') or 0)
+    gang = distr_info.get('gang') or {}
+    address = distr_info['coordinator_address']
+    remaining = float(timeout) if timeout else None
+    if timeout and rank != 0:
+        # rank 0 IS the coordinator — probing itself would deadlock.
+        # The probe spends part of the ONE join budget; registration
+        # gets what is left, so the rank's total wait stays bounded by
+        # join_timeout_s rather than paying it twice in sequence.
+        spent = _probe_coordinator(address, float(timeout), rank,
+                                   count, gang)
+        remaining = max(1.0, float(timeout) - spent)
+    kwargs = {
+        'coordinator_address': address,
+        'num_processes': count,
+        'process_id': rank,
+    }
+    if remaining:
+        kwargs['initialization_timeout'] = max(1, int(remaining))
+    try:
+        try:
+            jax.distributed.initialize(**kwargs)
+        except TypeError:
+            # older jax without initialization_timeout: join unbounded
+            # (the gang-stall watchdog still reaps the strand)
+            kwargs.pop('initialization_timeout', None)
+            jax.distributed.initialize(**kwargs)
+    except Exception as e:
+        from mlcomp_tpu.recovery import GangPeerLost
+        text = f'{type(e).__name__}: {e}'.lower()
+        if any(marker in text for marker in _PEER_LOST_MARKERS):
+            raise GangPeerLost(
+                f'rank {rank}/{count} of gang '
+                f'{gang.get("id") or "?"} (generation '
+                f'{gang.get("generation") or "?"}) gave up joining '
+                f'coordinator {address}: '
+                f'{type(e).__name__}: {e}') from e
+        raise
     _state['initialized'] = True
     return True
 

@@ -101,6 +101,20 @@ def host_grant_granularity(spec: Optional[Dict]) -> int:
     return max(1, intra_host_product(spec))
 
 
+def mesh_reshapeable(spec: Optional[Dict]) -> bool:
+    """Can a gang with this mesh spec come back on FEWER cores after a
+    host preemption? A remainder (-1) axis absorbs the lost cores (the
+    wildcard recomputes against whatever grant placement finds, in
+    fixed-axes-product multiples); so does no mesh at all. A fully
+    pinned spec needs exactly its product — the elastic requeue then
+    waits for capacity instead of dispatching a gang that would die at
+    ``normalize_mesh_spec``."""
+    if not spec:
+        return True
+    _, wild = check_mesh_spec(spec)
+    return wild is not None
+
+
 __all__ = ['AXIS_ORDER', 'ICI_AXES', 'check_mesh_spec',
            'intra_host_product', 'validate_mesh_request',
-           'host_grant_granularity']
+           'host_grant_granularity', 'mesh_reshapeable']

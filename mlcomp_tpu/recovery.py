@@ -20,11 +20,22 @@ db-error        transient  sqlite ``OperationalError`` / remote-db errors
 io-error        transient  ``ConnectionError``/``TimeoutError``/``OSError``
 preempted       transient  SIGTERM/SIGKILL of the task subprocess
 stall-killed    transient  the watchdog's task-stall kill (supervisor)
-worker-lost     transient  dead-pid reaper / worker subprocess vanished
+worker-lost     transient  dead-pid reaper / worker subprocess vanished /
+                           gang-stall host-silence verdict
 lease-expired   transient  queue lease reclaim gave up on a dead host
+gang-peer-lost  transient  coordinator-join timeout: a peer rank of the
+                           gang never showed up (parallel/distributed.py)
+gang-aborted    transient  the supervisor's gang-abort sweep killed this
+                           surviving rank after a sibling failed
 executor-error  permanent  any other executor exception (a bug retries
                            into the same bug — fail fast instead)
 ==============  =========  ==================================================
+
+``gang-peer-lost`` and ``gang-aborted`` are COLLATERAL reasons: they
+say a rank died because its gang did, not why the gang died. The gang
+verdict (``aggregate_child_reasons``) therefore prefers a sibling's
+root-cause reason over them, so a gang whose rank 1 was preempted
+retries as ``preempted`` even though ranks 0/2 carry ``gang-aborted``.
 
 Deterministic OS errors (``FileNotFoundError``, ``PermissionError``,
 ``IsADirectoryError``, ``NotADirectoryError``) are carved out of the
@@ -46,26 +57,83 @@ from mlcomp_tpu.utils.io import yaml_dump, yaml_load
 #: reasons the supervisor will automatically retry
 TRANSIENT_REASONS = frozenset({
     'db-error', 'io-error', 'preempted', 'stall-killed', 'worker-lost',
-    'lease-expired',
+    'lease-expired', 'gang-peer-lost', 'gang-aborted',
 })
+
+#: transient reasons that describe gang COLLATERAL, not a root cause —
+#: the gang verdict prefers any sibling's root-cause reason over these
+GANG_COLLATERAL_REASONS = frozenset({'gang-peer-lost', 'gang-aborted'})
 
 #: deterministic OSError subclasses that must NOT classify as transient
 _DETERMINISTIC_OS_ERRORS = (FileNotFoundError, PermissionError,
                             IsADirectoryError, NotADirectoryError)
 
 
+class GangPeerLost(RuntimeError):
+    """A rank of a multi-host gang gave up waiting for its peers at the
+    jax coordinator (bounded join timeout, parallel/distributed.py).
+    Classified ``gang-peer-lost``: transient collateral — the gang
+    verdict retries on the ROOT cause a sibling carries."""
+
+
 def is_transient(reason) -> bool:
     return reason in TRANSIENT_REASONS
 
 
-def classify_exception(exc) -> str:
+def aggregate_child_reasons(reasons) -> str:
+    """The failure reason a distributed parent (gang) inherits from its
+    Failed service children, or None (= never auto-retried).
+
+    - any permanent (or missing) child reason pins the verdict there —
+      retrying a gang whose rank hit a deterministic bug re-hits it;
+    - all-transient children make the gang retryable, and the verdict
+      prefers a ROOT-cause reason (``preempted``, ``worker-lost``, …)
+      over gang collateral (``gang-aborted``/``gang-peer-lost``),
+      which only says a rank died because its gang did."""
+    reasons = list(reasons)
+    if not reasons:
+        return None
+    for reason in reasons:
+        if not reason or not is_transient(reason):
+            return reason or None   # surface the permanent verdict
+    for reason in reasons:
+        if reason not in GANG_COLLATERAL_REASONS:
+            return reason
+    return reasons[0]               # all collateral: any will do
+
+
+#: error-text markers of the distributed runtime dying under a task —
+#: a surviving gang rank whose collective fails because a PEER vanished
+#: raises an opaque XlaRuntimeError (RuntimeError subclass) that would
+#: otherwise classify executor-error and PIN the whole gang permanent
+_GANG_RUNTIME_MARKERS = (
+    'gloo', 'coordination service', 'coordination_service', 'collective',
+    'all-reduce', 'allreduce', 'all-gather', 'allgather',
+    'deadline', 'connection reset', 'connection closed',
+    'socket closed', 'broken pipe', 'peer', 'distributed runtime',
+    'heartbeat', 'unavailable',
+)
+
+
+def classify_exception(exc, gang: bool = False) -> str:
     """Failure reason for an exception raised by the task pipeline.
     Walks the cause/context chain so a transient root wrapped in a
-    framework exception still classifies transient."""
+    framework exception still classifies transient.
+
+    ``gang=True`` (the task is a rank of a multi-host gang) adds one
+    carve-out to the executor-error fallback: a RuntimeError whose
+    chain reads like the distributed runtime dying (gloo/coordination
+    /collective failures, connection resets) classifies
+    ``gang-peer-lost`` — a rank's collective failing because its peer
+    vanished is collateral the gang retries on the root cause, not a
+    deterministic bug in this rank's code."""
     seen = set()
     cur = exc
+    texts = []
     while cur is not None and id(cur) not in seen:
         seen.add(id(cur))
+        if isinstance(cur, GangPeerLost):
+            return 'gang-peer-lost'
         if isinstance(cur, sqlite3.Error):
             return 'db-error'
         if isinstance(cur, RuntimeError) and \
@@ -75,7 +143,16 @@ def classify_exception(exc) -> str:
             return 'executor-error'
         if isinstance(cur, (ConnectionError, TimeoutError, OSError)):
             return 'io-error'
+        if isinstance(cur, RuntimeError):
+            # only RuntimeErrors feed the gang carve-out below: the
+            # distributed runtime surfaces as XlaRuntimeError (a
+            # RuntimeError subclass) — a ValueError mentioning
+            # 'deadline' is still a deterministic bug
+            texts.append(f'{type(cur).__name__}: {cur}'.lower())
         cur = cur.__cause__ or cur.__context__
+    if gang and any(marker in text for text in texts
+                    for marker in _GANG_RUNTIME_MARKERS):
+        return 'gang-peer-lost'
     return 'executor-error'
 
 
@@ -109,6 +186,11 @@ class RecoveryConfig:
     #: jitter fraction added on top of the backoff — deterministic per
     #: (task, attempt), so retries de-sync without wall-clock flakiness
     jitter_frac = 0.2
+    #: seconds a rank of a multi-host gang waits at the jax coordinator
+    #: before failing fast with ``gang-peer-lost`` instead of hanging
+    #: forever on a peer that will never arrive (stamped into
+    #: distr_info at fan-out, consumed by parallel/distributed.py)
+    join_timeout_s = 300.0
 
     def __init__(self, **overrides):
         for key, value in overrides.items():
@@ -176,13 +258,15 @@ def detach_service_children(session, task_id: int) -> int:
 
 
 def reset_for_requeue(provider, task, resume: dict = None,
-                      exclude_computer: str = None,
+                      exclude_computer=None,
                       reset_attempts: bool = False):
     """Reset a finished task back to NotRan for re-dispatch, with the
     ``resume`` info attached so training continues from the last
     checkpoint. Shared by the restart-with-resume API (human restart,
     ``reset_attempts=True``) and the supervisor's automatic retry
-    (``exclude_computer`` = the host that just failed it)."""
+    (``exclude_computer`` = the host — or, for a gang, the hostS —
+    that just failed it; a gang excluding its dead host re-places on
+    the survivors with a reshaped mesh)."""
     info = yaml_load(task.additional_info) \
         if task.additional_info else {}
     info = dict(info or {})
@@ -194,7 +278,9 @@ def reset_for_requeue(provider, task, resume: dict = None,
         # checkpoint — restart from scratch means exactly that
         info.pop('resume', None)
     if exclude_computer:
-        info['retry_exclude'] = [exclude_computer]
+        if isinstance(exclude_computer, str):
+            exclude_computer = [exclude_computer]
+        info['retry_exclude'] = sorted(set(exclude_computer))
     else:
         info.pop('retry_exclude', None)
     detach_service_children(provider.session, task.id)
@@ -214,7 +300,8 @@ def reset_for_requeue(provider, task, resume: dict = None,
     provider.update(task)
 
 
-__all__ = ['TRANSIENT_REASONS', 'is_transient', 'classify_exception',
-           'classify_returncode', 'RecoveryConfig', 'retry_delay_s',
-           'find_resume_info', 'detach_service_children',
-           'reset_for_requeue']
+__all__ = ['TRANSIENT_REASONS', 'GANG_COLLATERAL_REASONS',
+           'GangPeerLost', 'is_transient', 'aggregate_child_reasons',
+           'classify_exception', 'classify_returncode',
+           'RecoveryConfig', 'retry_delay_s', 'find_resume_info',
+           'detach_service_children', 'reset_for_requeue']

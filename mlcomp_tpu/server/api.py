@@ -332,10 +332,11 @@ def api_task_stop(data, s):
 
 
 def api_task_info(data, s):
-    task = TaskProvider(s).by_id(data['id'])
+    provider = TaskProvider(s)
+    task = provider.by_id(data['id'])
     if task is None:
         raise ApiError('task not found', status=404)
-    return {
+    info = {
         'id': task.id,
         'pid': task.pid,
         'worker_index': task.worker_index,
@@ -350,7 +351,31 @@ def api_task_info(data, s):
         'next_retry_at': str(task.next_retry_at)
         if task.next_retry_at else None,
         'failure_reason': task.failure_reason,
+        # gang bookkeeping (elastic multi-host recovery): identity +
+        # generation, and for a gang parent the live rank roster the
+        # dashboard gang card renders
+        'gang_id': task.gang_id,
+        'gang_generation': task.gang_generation or 0,
     }
+    if task.gang_id and not task.parent:
+        ranks = []
+        for child in sorted(provider.children(task.id),
+                            key=lambda c: c.id):
+            child_info = yaml_load(child.additional_info) \
+                if child.additional_info else {}
+            distr = (child_info or {}).get('distr_info') or {}
+            if not distr:
+                continue
+            ranks.append({
+                'task': child.id,
+                'rank': distr.get('process_index'),
+                'status': TaskStatus(child.status).name,
+                'computer': child.computer_assigned,
+                'generation': child.gang_generation or 0,
+                'failure_reason': child.failure_reason,
+            })
+        info['gang_ranks'] = ranks
+    return info
 
 
 def api_task_steps(data, s):

@@ -22,8 +22,10 @@ inline SVG) covering the same surfaces:
   durations, a cross-process trace waterfall (supervisor/worker/train
   legs on one wall-clock axis), a recovery card (retries used vs
   budget, failure taxonomy verdict, next-retry time, the task.retry
-  event timeline — mlcomp_tpu/recovery.py), and on-demand profiler
-  start/stop buttons
+  event timeline — mlcomp_tpu/recovery.py), a gang card for
+  multi-host jobs (gang id, generation, per-rank roster with status/
+  computer/reason, the gang.generation bump timeline — elastic
+  gang-atomic recovery), and on-demand profiler start/stop buttons
 - supervisor tab: watchdog alerts card (open alerts + resolve button,
   telemetry/watchdog.py) above the decision trace
 - report detail: LAYOUT-DRIVEN rendering (reference
@@ -837,6 +839,45 @@ function recoveryCard(info, series) {
   return html + '</div>';
 }
 
+function gangCard(info, series) {
+  // elastic gang-atomic recovery (server/supervisor.py): the gang a
+  // multi-host job belongs to, which generation is live (each
+  // gang-abort + requeue bumps it — possibly onto fewer hosts with a
+  // reshaped mesh), the per-rank roster, and the generation-bump
+  // event timeline the supervisor records as gang.generation rows
+  if (!info.gang_id) return '';
+  const bumps = series['gang.generation'] || [];
+  let html = '<h3>gang</h3><div class="card">'
+    + '<div style="display:flex;gap:18px;margin-bottom:8px">'
+    + `<div><b>${esc(info.gang_id)}</b>
+       <span class="dim">gang</span></div>`
+    + `<div><b>${info.gang_generation || 1}</b>
+       <span class="dim">generation</span></div>`;
+  if ((info.gang_ranks || []).length)
+    html += `<div><b>${info.gang_ranks.length}</b>
+       <span class="dim">ranks</span></div>`;
+  html += '</div>';
+  if ((info.gang_ranks || []).length)
+    html += '<table><tr><th>rank</th><th>task</th><th>status</th>'
+      + '<th>computer</th><th>reason</th></tr>'
+      + info.gang_ranks.map(r => `<tr>
+        <td>${r.rank == null ? '?' : r.rank}</td>
+        <td>${r.task}</td>
+        <td><span class="status s-${esc(r.status)}">${esc(r.status)}
+          </span></td>
+        <td class="dim">${esc(r.computer || '')}</td>
+        <td class="dim">${esc(r.failure_reason || '')}</td>
+        </tr>`).join('') + '</table>';
+  if (bumps.length)
+    html += '<div class="dim" style="font-size:11px;margin-top:6px">'
+      + bumps.map(p => 'generation '
+        + (p.step == null ? '?' : p.step)
+        + (p.tags && p.tags.reason ? ' (' + esc(p.tags.reason) + ')' : '')
+        + ' at ' + esc(p.time || '')).join(' &middot; ')
+      + '</div>';
+  return html + '</div>';
+}
+
 async function profileToggle(id, action) {
   // on-demand jax.profiler trace on a RUNNING task; the training
   // process polls the request at epoch boundaries
@@ -869,6 +910,10 @@ async function viewTaskDetail(el, id) {
   // task's "why" and "what happens next" read together
   const rec = recoveryCard(info, tel.series || {});
   if (rec) el.appendChild(h('<div>' + rec + '</div>'));
+  // gang card: multi-host identity + generation + rank roster, next
+  // to the recovery card that explains WHY a generation was bumped
+  const gang = gangCard(info, tel.series || {});
+  if (gang) el.appendChild(h('<div>' + gang + '</div>'));
   const tree = (nodes) => '<div class="tree">' + nodes.map(s =>
     `<div>&#9656; ${esc(s.name)} <span class="dim">${esc(s.started||'')}
      ${s.finished?'&rarr; '+esc(s.finished):''}</span>

@@ -91,8 +91,12 @@ class SupervisorBuilder:
 
     # -------------------------------------------------------- parent tasks
     def process_parent_tasks(self):
-        """Aggregate child statuses into distributed parents; stop
-        siblings when one child fails (reference supervisor.py:350-394)."""
+        """Aggregate child statuses into distributed parents; a failed
+        child GANG-ABORTS its siblings (reference supervisor.py:350-394
+        stopped them politely; a multi-host jax job's survivors are
+        stuck at a dead collective burning their slots, so they are
+        killed, revoked and Failed ``gang-aborted`` in the same tick,
+        and the ranks' taxonomy aggregates into one gang verdict)."""
         processed = []
         for parent_task, _started, _finished, statuses in \
                 self.provider.parent_tasks_stats():
@@ -112,52 +116,75 @@ class SupervisorBuilder:
             if new_status is not None and \
                     parent_task.status != int(new_status):
                 if new_status == TaskStatus.Failed:
-                    self.stop_children(parent_task.id)
-                    # propagate the failure taxonomy UP so the retry
-                    # pass can judge the parent (service children are
-                    # never retried directly): all-transient child
-                    # failures make the parent retryable; any
-                    # permanent (or reasonless) child failure pins it
-                    # Failed — and overwrites a stale transient reason
-                    # from an earlier attempt, which would otherwise
-                    # retry a now-deterministic bug
-                    parent_task.failure_reason = \
-                        self._aggregate_failure_reason(parent_task.id)
-                    self.provider.update(parent_task,
-                                         ['failure_reason'])
-                self.provider.change_status(parent_task, new_status)
+                    self._fail_gang_parent(parent_task)
+                else:
+                    self.provider.change_status(parent_task, new_status)
                 processed.append(
                     {'parent': parent_task.id, 'status': new_status.name})
         self.aux['parent_tasks'] = processed
 
-    def _aggregate_failure_reason(self, parent_id: int):
-        """The failure reason a distributed parent inherits from its
-        Failed service children, or None (= never auto-retried) when
-        no child carries a transient verdict."""
-        from mlcomp_tpu.recovery import is_transient
-        reasons = [c.failure_reason for c in self.provider.children(
-            parent_id, statuses=[TaskStatus.Failed])]
-        if reasons and all(r and is_transient(r) for r in reasons):
-            return reasons[0]
-        for reason in reasons:
-            if reason and not is_transient(reason):
-                return reason       # surface the permanent verdict
-        return None
+    def _fail_gang_parent(self, parent_task: Task):
+        """The gang-atomic failure transition, shared by parent
+        aggregation (a rank already Failed) and the watchdog's
+        gang-stall action (a rank's host went silent): abort the
+        surviving ranks, aggregate the ranks' failure taxonomy into
+        the parent's verdict (recovery.aggregate_child_reasons — a
+        root cause beats gang collateral; any permanent or reasonless
+        child pins it, overwriting a stale transient verdict from an
+        earlier attempt that would otherwise retry a now-deterministic
+        bug), and mark the parent Failed. Service children are never
+        retried directly; the PARENT is the unit of retry — for a
+        gang, that is what makes retry gang-atomic."""
+        from mlcomp_tpu.recovery import aggregate_child_reasons
+        self.gang_abort(parent_task.id)
+        parent_task.failure_reason = aggregate_child_reasons(
+            c.failure_reason for c in self.provider.children(
+                parent_task.id, statuses=[TaskStatus.Failed]))
+        self.provider.update(parent_task, ['failure_reason'])
+        if parent_task.status != int(TaskStatus.Failed):
+            self.provider.change_status(parent_task, TaskStatus.Failed)
 
-    def stop_children(self, parent_id: int):
+    def gang_abort(self, parent_id: int):
+        """Kill/revoke every surviving rank of a failing gang in ONE
+        sweep: queue message revoked, process tree killed (locally or
+        routed through the owning host's control queue), the rank
+        Failed with reason ``gang-aborted`` so the verdict aggregation
+        sees collateral, not mystery. Non-gang service children (no
+        distr_info) keep the old polite stop."""
         from mlcomp_tpu.worker.tasks import kill_task
+        aborted = []
         for child in self.provider.children(
                 parent_id,
                 statuses=[TaskStatus.NotRan, TaskStatus.Queued,
                           TaskStatus.InProgress]):
             try:
+                info = yaml_load(child.additional_info) \
+                    if child.additional_info else {}
+                is_rank = bool((info or {}).get('distr_info')) \
+                    or bool(child.gang_id)
+                if is_rank:
+                    # Failed-with-reason FIRST: kill_task never
+                    # downgrades a Failed status, and on the remote
+                    # path the routed kill lands after this tick
+                    self.provider.fail_with_reason(child, 'gang-aborted')
                 kill_task(child.id, session=self.session)
+                if is_rank:
+                    aborted.append(child.id)
             except Exception:
                 if self.logger:
                     self.logger.error(
-                        f'failed stopping child {child.id}:\n'
+                        f'gang abort of child {child.id} failed:\n'
                         f'{traceback.format_exc()}',
                         ComponentType.Supervisor)
+        if aborted:
+            self.telemetry.count('supervisor.gang_aborted_ranks',
+                                 len(aborted))
+            self.aux.setdefault('gang_aborted', {})[parent_id] = aborted
+            if self.logger:
+                self.logger.warning(
+                    f'gang of task {parent_id}: aborted surviving '
+                    f'rank task(s) {aborted}',
+                    ComponentType.Supervisor, None, parent_id)
 
     # -------------------------------------------------------------- loading
     def load_tasks(self):
@@ -270,7 +297,19 @@ class SupervisorBuilder:
 
     def find_port(self, comp) -> int:
         """Coordinator port from the per-computer range
-        (reference supervisor.py:163-169)."""
+        (reference supervisor.py:163-169).
+
+        Release contract: ``comp['ports']`` is DERIVED state, rebuilt
+        by ``load_computers`` every tick from the ``distr_info`` of
+        live (Queued/InProgress) rows only — so a port is released the
+        moment its gang reaches a terminal state (Success, Failed,
+        gang-abort), with no separate bookkeeping to leak. The one
+        historical leak was a gang whose host died before CLAIMING its
+        dispatch: the rank sat Queued forever (a never-claimed pending
+        message is neither lease-reclaimed nor stranded), pinning its
+        port until ~len(MASTER_PORT_RANGE) such gangs exhausted the
+        range. The gang-stall watchdog rule now aborts those gangs at
+        the host-silence horizon, which is what frees the port."""
         lo, hi = MASTER_PORT_RANGE
         for port in range(lo, hi + 1):
             if port not in comp['ports']:
@@ -326,11 +365,15 @@ class SupervisorBuilder:
                             distr_info: dict, index: int) -> Task:
         """One child per host of a multi-host job
         (reference supervisor.py:131-161 creates one per GPU slot; a TPU
-        host's chips belong to one jax process, so fan-out is per host)."""
+        host's chips belong to one jax process, so fan-out is per host).
+        The gang identity + generation ride both the row columns (the
+        watchdog's indexed gang-stall scan) and ``distr_info`` (the
+        rank's own process reads them for logs and fault seams)."""
         info = yaml_load(task.additional_info) \
             if task.additional_info else {}
         info = dict(info or {})
         info['distr_info'] = distr_info
+        gang = distr_info.get('gang') or {}
         service = Task(
             name=f'{task.name}_{index}',
             status=int(TaskStatus.NotRan),
@@ -345,6 +388,8 @@ class SupervisorBuilder:
             additional_info=yaml_dump(info),
             gpu_requirement=task.gpu_requirement,
             single_node=task.single_node,
+            gang_id=gang.get('id'),
+            gang_generation=gang.get('generation') or 0,
         )
         self.provider.add(service)
         return service
@@ -473,6 +518,16 @@ class SupervisorBuilder:
         master_comp = placements[0][0]
         port = self.find_port(master_comp)
         world = len(placements)
+        # gang identity: minted at the FIRST fan-out, stable across
+        # generations (the parent row is requeued, never recreated);
+        # each gang-atomic retry bumped gang_generation before the
+        # re-placement that brought us here, so this dispatch IS that
+        # generation — possibly on fewer hosts with a reshaped mesh
+        gang_id = task.gang_id or f'g{task.id}'
+        generation = max(1, int(task.gang_generation or 0))
+        task.gang_id = gang_id
+        task.gang_generation = generation
+        self.provider.update(task, ['gang_id', 'gang_generation'])
         for rank, (comp, cores) in enumerate(placements):
             distr_info = {
                 'coordinator_address': f'{master_comp["ip"]}:{port}',
@@ -481,13 +536,20 @@ class SupervisorBuilder:
                 'process_count': world,
                 'master_computer': master_comp['name'],
                 'mesh': (info or {}).get('mesh'),
+                'gang': {'id': gang_id, 'generation': generation},
+                # bounded coordinator join: a rank whose peers never
+                # arrive fails fast as gang-peer-lost instead of
+                # hanging (parallel/distributed.py)
+                'join_timeout_s': float(
+                    self.recovery_config.join_timeout_s),
             }
             service = self.create_service_task(
                 task, comp, cores, distr_info, rank)
             queue = self.dispatch(service, comp, cores)
             self.aux.setdefault('dispatched', []).append(
                 {'task': service.id, 'parent': task.id, 'queue': queue,
-                 'cores': cores, 'rank': rank})
+                 'cores': cores, 'rank': rank, 'gang': gang_id,
+                 'generation': generation})
         self.provider.change_status(task, TaskStatus.Queued)
 
     # ------------------------------------------------------------- recovery
@@ -630,13 +692,18 @@ class SupervisorBuilder:
         now_dt = now()
         # filter in SQL: permanent failures and reasonless legacy rows
         # accumulate forever in a long-lived deployment — only the
-        # transient-Failed set (bounded by live incidents) may load
+        # transient-Failed set (bounded by live incidents) may load.
+        # Service rows are NEVER units of retry, even once detached
+        # (parent=NULL) by a requeue: a detached gang rank keeps its
+        # Failed row + taxonomy as history, and retrying it would
+        # resurrect one rank of a gang whose PARENT already retried —
+        # each dead rank spawning its own shadow gang
         reasons = sorted(TRANSIENT_REASONS)
         marks = ','.join('?' * len(reasons))
         rows = self.session.query(
             f'SELECT * FROM task WHERE status=? AND parent IS NULL '
-            f'AND failure_reason IN ({marks})',
-            (int(TaskStatus.Failed), *reasons))
+            f'AND type != ? AND failure_reason IN ({marks})',
+            (int(TaskStatus.Failed), int(TaskType.Service), *reasons))
         for task in [Task.from_row(r) for r in rows]:
             reason = task.failure_reason
             attempt = task.attempt or 0
@@ -687,35 +754,129 @@ class SupervisorBuilder:
         attached (training restores the last checkpoint), the failing
         computer excluded, and the retry made observable — a
         ``task.retry`` metric row (immediate, not buffered: retries
-        are rare and the dashboard/exporter must see them now)."""
-        from mlcomp_tpu.recovery import find_resume_info, reset_for_requeue
+        are rare and the dashboard/exporter must see them now).
+
+        For a GANG parent the requeue is gang-atomic and elastic:
+        the whole gang comes back as generation N+1 in one unit — the
+        DEAD hosts (computers of ranks that failed with a root-cause
+        reason, not ``gang-aborted`` collateral) are excluded from the
+        next placement, so a remainder-axis mesh re-fans-out on the
+        surviving hosts with a recomputed (smaller) mesh; and the
+        sharded checkpoint's rect coverage is asserted BEFORE dispatch
+        (ckpt_shard.resume_reshape_ok) so the reshaped restore is
+        known to succeed — an uncovered checkpoint drops the resume
+        blob (restart from scratch) instead of dispatching a gang
+        doomed to die inside the restore."""
+        from mlcomp_tpu.recovery import (
+            GANG_COLLATERAL_REASONS, find_resume_info, reset_for_requeue,
+        )
         failed_on = task.computer_assigned
+        exclude = failed_on
+        reshapeable = None
+        if task.gang_id:
+            exclude = sorted({
+                c.computer_assigned for c in self.provider.children(
+                    task.id, statuses=[TaskStatus.Failed])
+                if c.computer_assigned and c.failure_reason
+                and c.failure_reason not in GANG_COLLATERAL_REASONS
+            }) or None          # all-collateral: no host to blame
+            # can generation N+1 come back SMALLER? A remainder-axis
+            # mesh reshapes onto the surviving hosts; a fully pinned
+            # one needs exactly its product, so placement holds the
+            # gang until that capacity returns — label the requeue so
+            # the operator reads the difference from aux/logs instead
+            # of watching a not_placed verdict repeat
+            from mlcomp_tpu.parallel.meshspec import mesh_reshapeable
+            info0 = yaml_load(task.additional_info) \
+                if task.additional_info else {}
+            mesh = (info0 or {}).get('mesh')
+            try:
+                reshapeable = mesh_reshapeable(
+                    mesh if isinstance(mesh, dict) else None)
+            except ValueError:
+                reshapeable = None      # malformed legacy spec
         try:
             resume = find_resume_info(self.provider, task)
         except LookupError:
             resume = None       # no rank-0 child — restart from scratch
+        if resume is not None and task.gang_id:
+            resume, detail = self._validate_gang_resume(task, resume)
+            if resume is None:
+                self.aux.setdefault('gang_resume_dropped',
+                                    {})[task.id] = detail
         task.attempt = (task.attempt or 0) + 1
-        # reset_for_requeue's full-row update persists the increment
+        if task.gang_id:
+            task.gang_generation = \
+                max(1, int(task.gang_generation or 0)) + 1
+        # reset_for_requeue's full-row update persists the increments
         reset_for_requeue(self.provider, task, resume=resume,
-                          exclude_computer=failed_on)
+                          exclude_computer=exclude)
         from mlcomp_tpu.db.providers import MetricProvider
+        rows = [(task.id, 'task.retry', 'counter', task.attempt, 1.0,
+                 now(), 'supervisor', json.dumps({'reason': reason}))]
+        if task.gang_id:
+            # the generation-bump event the mlcomp_gang_generations
+            # /metrics family and the dashboard gang card read
+            rows.append((
+                task.id, 'gang.generation', 'counter',
+                task.gang_generation, 1.0, now(), 'supervisor',
+                json.dumps({'gang': task.gang_id, 'reason': reason})))
+            self.telemetry.count('supervisor.gang_requeues')
         try:
-            MetricProvider(self.session).add_many([
-                (task.id, 'task.retry', 'counter', task.attempt, 1.0,
-                 now(), 'supervisor', json.dumps({'reason': reason}))])
+            MetricProvider(self.session).add_many(rows)
         except Exception:
             pass                # observability must not block the retry
         self.telemetry.count('supervisor.task_retries')
         self.aux.setdefault('retried', []).append(
             {'task': task.id, 'attempt': task.attempt,
-             'reason': reason, 'excluded': failed_on})
+             'reason': reason, 'excluded': exclude,
+             'gang': task.gang_id,
+             'generation': task.gang_generation if task.gang_id
+             else None,
+             'mesh_reshapeable': reshapeable})
         if self.logger:
+            gang_note = ''
+            if task.gang_id:
+                gang_note = (f' as gang {task.gang_id} generation '
+                             f'{task.gang_generation}')
+                if reshapeable is True:
+                    gang_note += ' (mesh may reshape onto fewer hosts)'
+                elif reshapeable is False:
+                    gang_note += (' (pinned mesh — waits for its full '
+                                  'capacity)')
             self.logger.warning(
                 f'task {task.id} ({task.name}): retry '
                 f'{task.attempt} after {reason} — requeued with '
-                f'resume' + (f', excluding {failed_on}'
-                             if failed_on else ''),
+                f'resume{gang_note}'
+                + (f', excluding {exclude}' if exclude else ''),
                 ComponentType.Supervisor, None, task.id)
+
+    def _validate_gang_resume(self, task: Task, resume: dict):
+        """(resume_or_None, detail): assert the reshaped restore can
+        succeed before the gang re-dispatches. jax-free rect-coverage
+        arithmetic over the sharded checkpoint's index + fragment
+        tables (no shard bytes read); best-effort — a folder this
+        process cannot see (remote master, FileSync still running)
+        passes, the restore-time guards still hold there."""
+        import os
+        from mlcomp_tpu import TASK_FOLDER
+        ck_dir = os.path.join(TASK_FOLDER, str(task.id), 'checkpoints')
+        if not os.path.isdir(ck_dir):
+            return resume, 'checkpoint folder not visible here'
+        try:
+            from mlcomp_tpu.train.ckpt_shard import resume_reshape_ok
+            ok, detail = resume_reshape_ok(ck_dir)
+        except Exception as e:
+            return resume, f'coverage check crashed ({e}) — not blocking'
+        if ok:
+            return resume, detail
+        if self.logger:
+            self.logger.warning(
+                f'task {task.id} ({task.name}): gang resume dropped — '
+                f'{detail}; generation {int(task.gang_generation or 1) + 1} '
+                f'restarts from scratch',
+                ComponentType.Supervisor, None, task.id)
+        return None, detail
 
     # ------------------------------------------------------------ preflight
     def dag_preflight_errors(self, dag_id: int) -> list:
@@ -859,6 +1020,9 @@ class SupervisorBuilder:
             for f in findings]
         from mlcomp_tpu.worker.tasks import kill_task
         for finding in findings:
+            if finding['rule'] == 'gang-stall':
+                self._act_on_gang_stall(finding)
+                continue
             if finding['rule'] != 'task-stall':
                 continue
             task_id = finding['task']
@@ -883,6 +1047,39 @@ class SupervisorBuilder:
                         f'watchdog failed stopping stalled task '
                         f'{task_id}:\n{traceback.format_exc()}',
                         ComponentType.Supervisor)
+
+    def _act_on_gang_stall(self, finding):
+        """A gang rank's host went silent: fail the silent rank
+        (``worker-lost`` — the root cause the gang verdict retries on)
+        and gang-abort its siblings IN THIS TICK, so the survivors
+        stop burning their slots at a dead collective the moment the
+        silence is diagnosed rather than a tick later through parent
+        aggregation."""
+        task_id = finding['task']
+        try:
+            task = self.provider.by_id(task_id)
+            if task is None or task.status >= int(TaskStatus.Failed):
+                return          # raced: someone else already acted
+            from mlcomp_tpu.worker.tasks import kill_task
+            self.provider.fail_with_reason(task, 'worker-lost')
+            kill_task(task_id, session=self.session)
+            parent = self.provider.by_id(task.parent) \
+                if task.parent else None
+            if parent is not None and \
+                    parent.status < int(TaskStatus.Failed):
+                self._fail_gang_parent(parent)
+            if self.logger:
+                self.logger.error(
+                    f'watchdog: {finding["message"]} — rank failed '
+                    f'worker-lost, gang aborted (alert '
+                    f'{finding.get("alert_id")})',
+                    ComponentType.Supervisor, None, task_id)
+        except Exception:
+            if self.logger:
+                self.logger.error(
+                    f'watchdog failed acting on gang-stall for task '
+                    f'{task_id}:\n{traceback.format_exc()}',
+                    ComponentType.Supervisor)
 
     # ---------------------------------------------------------------- main
     def build(self):

@@ -40,8 +40,8 @@ REQUIRED_FAMILIES = (
     'mlcomp_worker_slots', 'mlcomp_alerts_open',
     'mlcomp_dispatch_latency_seconds', 'mlcomp_step_phase_ms',
     'mlcomp_pipeline_efficiency', 'mlcomp_compile_events',
-    'mlcomp_task_retries', 'mlcomp_serving_latency_ms',
-    'mlcomp_scrape_errors',
+    'mlcomp_task_retries', 'mlcomp_gang_generations',
+    'mlcomp_serving_latency_ms', 'mlcomp_scrape_errors',
 )
 
 
@@ -369,6 +369,30 @@ def _collect_task_retries(session, samples):
         samples.append(('_total', {'task': task, 'reason': reason}, n))
 
 
+def _collect_gang_generations(session, samples):
+    """``mlcomp_gang_generations_total{gang,reason}`` from the
+    per-event ``gang.generation`` metric rows the supervisor writes at
+    each gang-atomic requeue (retry_task). One sample per (gang,
+    reason) counting bump EVENTS — same windowed id scan and counter
+    semantics as the task-retry family above."""
+    counts = {}
+    for r in session.query(
+            "SELECT tags FROM metric "
+            "WHERE id > (SELECT COALESCE(MAX(id), 0) FROM metric) - ? "
+            "AND name='gang.generation'", (_RETRY_SCAN_WINDOW,)):
+        gang, reason = 'unknown', 'unknown'
+        try:
+            tags = json.loads(r['tags'] or '{}')
+            gang = tags.get('gang') or 'unknown'
+            reason = tags.get('reason') or 'unknown'
+        except ValueError:
+            pass
+        key = (gang, reason)
+        counts[key] = counts.get(key, 0) + 1
+    for (gang, reason), n in sorted(counts.items()):
+        samples.append(('_total', {'gang': gang, 'reason': reason}, n))
+
+
 #: rows scanned per scrape for the serving re-export: the latest
 #: heartbeat's bucket/count/mean rows live at the table's tail, so a
 #: bounded id window keeps the scrape O(window) however old the
@@ -443,13 +467,14 @@ def collect_server_families(session):
 
     tasks, queues, slots, alerts = [], [], [], []
     dispatch, phases, eff, compiles, serving = [], [], [], [], []
-    retries = []
+    retries, gangs = [], []
     guarded(_collect_tasks, session, tasks)
     guarded(_collect_queue_depth, session, queues)
     guarded(_collect_worker_slots, session, slots)
     guarded(_collect_alerts, session, alerts)
     guarded(_collect_dispatch_latency, session, dispatch)
     guarded(_collect_task_retries, session, retries)
+    guarded(_collect_gang_generations, session, gangs)
     running = []
     try:
         running = _running_task_ids(session)
@@ -485,6 +510,10 @@ def collect_server_families(session):
         family('mlcomp_task_retries', 'counter',
                'automatic task retries by failure reason '
                '(recovery subsystem; recent event window)', retries),
+        family('mlcomp_gang_generations', 'counter',
+               'gang-atomic requeue events by gang and failure reason '
+               '(elastic multi-host recovery; recent event window)',
+               gangs),
         family('mlcomp_serving_latency_ms', 'histogram',
                'served-model request latency (cumulative buckets, '
                'latest heartbeat snapshot)', serving),

@@ -34,6 +34,15 @@ Rules (all thresholds tunable via WatchdogConfig):
   past ``recompile_warmup_steps`` within ``recompile_window_s``
   (telemetry/compile_events.py records them); time-windowed so the
   alert auto-resolves when the storm stops.
+- **gang-stall** — a Queued/InProgress service rank of a multi-host
+  gang whose assigned HOST went silent (docker heartbeat older than
+  ``gang_host_silence_s``). The per-task stall rule pools life across
+  the family (only rank 0 writes metrics, so healthy siblings are
+  legitimately quiet), which means one preempted host would otherwise
+  hide behind rank 0's heartbeat until the whole-gang stall horizon;
+  the host heartbeat is the per-rank signal that is NOT quiet on a
+  healthy rank. The supervisor acts by failing the silent rank
+  (``worker-lost``) and gang-aborting its siblings in the same tick.
 
 Cost: a handful of indexed SELECTs over the few InProgress tasks per
 evaluation, and evaluations are rate-limited to ``evaluate_every_s``
@@ -84,6 +93,13 @@ class WatchdogConfig:
     recompile_storm_count = 3
     recompile_warmup_steps = 20
     recompile_window_s = 600.0
+    #: gang-stall: seconds of docker-heartbeat silence before a gang
+    #: rank's host counts as preempted. Heartbeats tick every ~5 s, so
+    #: this is dozens of missed beats — far past an agent restart or a
+    #: 15 s liveness blip, far before the conservative per-task stall
+    #: deadline (the gang's peers burn TPU time at a dead barrier for
+    #: every second of it, which is why the horizon is its own knob)
+    gang_host_silence_s = 180.0
     #: min seconds between evaluations (rate limit inside the tick)
     evaluate_every_s = 10.0
 
@@ -140,6 +156,7 @@ class Watchdog:
         for rule in (
                 lambda: self._check_stalls(running, metrics, alerts,
                                            now_dt),
+                lambda: self._check_gang_stalls(alerts, now_dt),
                 lambda: self._check_regressions(running, metrics,
                                                 alerts),
                 lambda: self._check_stragglers(running, metrics,
@@ -165,7 +182,7 @@ class Watchdog:
         alerts stay open — they are the paper trail of a kill — and so
         do retry-exhausted alerts (supervisor recovery pass): both
         describe a task that is precisely NOT running anymore."""
-        keep_open = ('task-stall', 'retry-exhausted')
+        keep_open = ('task-stall', 'retry-exhausted', 'gang-stall')
         running_ids = {t.id for t in running}
         for alert in alerts.get(status='open', limit=1000):
             if alert.rule in keep_open or alert.task is None:
@@ -227,6 +244,57 @@ class Watchdog:
                     f'(deadline {self.config.stall_deadline_s:.0f}s)',
                     task, severity='critical',
                     details={'age_s': round(age, 1)}))
+        return out
+
+    def _check_gang_stalls(self, alerts, now_dt):
+        """One silent HOST aborts the gang: a live gang rank (Queued or
+        InProgress — a never-claimed dispatch on a preempted host is
+        exactly the stuck case) whose assigned computer's docker
+        heartbeat is older than ``gang_host_silence_s``. Scans only
+        rows with a gang id (indexed, v8) — zero cost on deployments
+        without multi-host jobs."""
+        from mlcomp_tpu.db.enums import TaskStatus
+        from mlcomp_tpu.db.models import Task
+        deadline = float(self.config.gang_host_silence_s)
+        rows = self.session.query(
+            'SELECT * FROM task WHERE gang_id IS NOT NULL '
+            'AND computer_assigned IS NOT NULL AND status IN (?, ?)',
+            (int(TaskStatus.Queued), int(TaskStatus.InProgress)))
+        ranks = [Task.from_row(r) for r in rows]
+        if not ranks:
+            return []
+        heartbeats = {
+            r['computer']: parse_datetime(r['hb'])
+            for r in self.session.query(
+                'SELECT computer, MAX(last_activity) AS hb FROM docker '
+                'GROUP BY computer')}
+        out = []
+        for task in ranks:
+            # the silence clock starts at the NEWEST of the host's
+            # heartbeat and the rank's own activity (its dispatch
+            # stamp): a host whose docker row predates this gang — or
+            # is missing entirely — must not instantly abort a
+            # just-placed generation
+            latest = heartbeats.get(task.computer_assigned)
+            own = parse_datetime(task.last_activity)
+            if own and (latest is None or own > latest):
+                latest = own
+            if latest is None:
+                continue
+            age = (now_dt - latest).total_seconds()
+            if age > deadline:
+                out.append(self._raise(
+                    alerts, 'gang-stall',
+                    f'gang {task.gang_id} (generation '
+                    f'{task.gang_generation}): rank task {task.id} '
+                    f'({task.name}) on {task.computer_assigned} — host '
+                    f'heartbeat silent for {age:.0f}s (deadline '
+                    f'{deadline:.0f}s); aborting the gang',
+                    task, severity='critical',
+                    details={'age_s': round(age, 1),
+                             'gang': task.gang_id,
+                             'generation': task.gang_generation,
+                             'parent': task.parent}))
         return out
 
     def _window(self, metrics, task_id, name='step_time_ms'):

@@ -42,7 +42,11 @@ Actions:
 
 ``after`` (default 1) is the 1-based hit index of the first firing;
 ``times`` (default 1) the number of consecutive firing hits, ``None``
-meaning every hit from ``after`` on.
+meaning every hit from ``after`` on. ``when`` (optional dict) filters
+hits by the site's context kwargs — ``{"when": {"rank": 1}}`` counts
+and fires only on hits whose ``ctx['rank'] == 1``, which is how a
+gang chaos test kills exactly one rank of a fanned-out job while the
+same ``MLCOMP_FAULTS`` env var travels into every rank's subprocess.
 
 Injection points shipped in the framework (grep ``fault_point(``):
 
@@ -55,6 +59,13 @@ Injection points shipped in the framework (grep ``fault_point(``):
 - ``train.epoch``               — end of each training epoch
   (kill-worker-mid-epoch)
 - ``task.execute``              — just before the executor runs
+- ``host.preempt``              — the host agent's docker heartbeat
+  (db/providers/docker.py): firing it kills the heartbeat writer, the
+  chaos stand-in for a whole preempted host (ctx: ``computer``)
+- ``gang.rank_exit``            — per-rank seams of a multi-host gang:
+  at distributed bring-up (worker/tasks.py, ctx ``phase='join'``) and
+  at each epoch boundary (train/executor.py, ctx ``phase='epoch'``),
+  both carrying ``rank`` so a ``when`` filter kills one rank only
 """
 
 import json
@@ -128,6 +139,9 @@ def fault_point(name: str, **ctx):
     spec = _ACTIVE.get(name)
     if spec is None:
         return
+    when = spec.get('when')
+    if when and any(ctx.get(k) != v for k, v in when.items()):
+        return          # context filter: non-matching hits don't count
     spec['_hits'] += 1
     hit = spec['_hits']
     after = int(spec.get('after') or 1)

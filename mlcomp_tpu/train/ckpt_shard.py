@@ -498,6 +498,54 @@ def checkpoint_meta_sharded(directory: str,
     return dict(index['meta']) if index else None
 
 
+def resume_reshape_ok(directory: str,
+                      kind: str = 'last') -> Tuple[bool, str]:
+    """jax-free pre-dispatch check: can the ``kind`` checkpoint restore
+    onto an ARBITRARY reshaped mesh from the fragments visible on THIS
+    filesystem? (ok, detail).
+
+    The elastic gang requeue (server/supervisor.py) calls this before
+    re-dispatching generation N+1 on fewer hosts: a reshaped mesh cuts
+    every leaf into different slices, so restore succeeds iff the
+    union of saved shard rectangles covers each full leaf — exactly
+    the ``_rects_cover`` arithmetic the restore itself runs per slice,
+    evaluated here over the whole leaf without loading a byte of shard
+    data. A flat msgpack blob always covers (it is the full state); no
+    checkpoint at all is trivially "resumable" (fresh start). Only an
+    indexed sharded folder with holes — fragments not yet synced from
+    a dead host — fails, and the caller drops the resume blob (restart
+    from scratch) instead of dispatching a gang doomed to die in
+    ``_ShardReader.assemble``."""
+    if os.path.exists(os.path.join(directory, f'{kind}.msgpack')):
+        return True, 'flat msgpack blob (full state)'
+    folder = os.path.join(directory, kind)
+    index = _read_index(folder)
+    if index is None:
+        return True, 'no checkpoint (fresh start)'
+    try:
+        reader = _ShardReader(folder, require_all=False, index=index)
+    except FileNotFoundError as e:
+        return False, str(e)
+    try:
+        for li, desc in enumerate(reader.leaves):
+            if desc.get('none') or desc.get('empty'):
+                continue
+            shape = tuple(desc['shape'])
+            rects = [tuple(zip(start, stop))
+                     for start, stop, _, _ in reader.by_leaf.get(li, ())]
+            covered = bool(rects) if shape == () else \
+                _rects_cover(shape, rects)
+            if not covered:
+                return False, (
+                    f'leaf {"/".join(desc["path"])}: saved fragments '
+                    f'do not cover shape {shape} — checkpoint not yet '
+                    f'fully synced to this host')
+        return True, (f'sharded generation {index["generation"]} '
+                      f'fully covered')
+    finally:
+        reader.close()
+
+
 def restore_checkpoint_sharded(directory: str, target: Any,
                                kind: str = 'last'
                                ) -> Tuple[Optional[Any], Optional[dict]]:
@@ -612,4 +660,4 @@ def _set_path(tree: dict, path: tuple, value):
 __all__ = ['state_needs_sharded_ckpt', 'build_shard_plan',
            'write_shard_plan', 'save_checkpoint_sharded',
            'restore_checkpoint_sharded', 'checkpoint_meta_sharded',
-           'read_checkpoint_tree', 'LAST_STATS']
+           'resume_reshape_ok', 'read_checkpoint_tree', 'LAST_STATS']

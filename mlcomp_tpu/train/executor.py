@@ -878,13 +878,26 @@ class JaxTrain(Executor):
                 if profiling:
                     self._stop_profile(global_epoch)
                 global_epoch += 1
-                # chaos seam (mlcomp_tpu/testing/faults.py): the
+                # chaos seams (mlcomp_tpu/testing/faults.py): the
                 # kill-worker-mid-epoch fault dies HERE, after epoch
-                # N's checkpoint submit — one module-global check when
-                # no faults are armed
+                # N's checkpoint submit — one module-global check per
+                # seam when no faults are armed. gang.rank_exit
+                # additionally carries the rank + gang so a `when`
+                # filter kills exactly one rank of a multi-host gang
+                # (the elastic-recovery acceptance chaos), even though
+                # MLCOMP_FAULTS arms every rank's subprocess alike
                 from mlcomp_tpu.testing.faults import fault_point
                 fault_point('train.epoch', epoch=global_epoch,
                             task=self.task.id if self.task else None)
+                distr = dict(getattr(self, 'additional_info', None)
+                             or {}).get('distr_info') or {}
+                if distr:
+                    fault_point(
+                        'gang.rank_exit', phase='epoch',
+                        epoch=global_epoch,
+                        rank=distr.get('process_index'),
+                        gang=(distr.get('gang') or {}).get('id'),
+                        task=self.task.id if self.task else None)
             if (dispatch_stage is not None or self.stage_per_dispatch) \
                     and stage_name != stage_names[-1]:
                 # return for requeue: next dispatch runs the next stage.

@@ -191,9 +191,22 @@ class ExecuteBuilder:
         """Join the multi-host job this service task belongs to
         (reference set_dist_env, catalyst.py:195-207): consume the
         supervisor-manufactured distr_info BEFORE the first jax backend
-        use so jax.devices() becomes the global device list."""
+        use so jax.devices() becomes the global device list. The join
+        is bounded (``join_timeout_s`` in distr_info): a rank whose
+        peer died at dispatch raises ``GangPeerLost`` here instead of
+        hanging, classified ``gang-peer-lost`` by the failure path
+        below — transient gang collateral, so the supervisor's
+        gang-atomic retry requeues the whole gang on the root cause."""
         distr_info = self.additional_info().get('distr_info')
         if distr_info:
+            gang = distr_info.get('gang') or {}
+            # chaos seam (mlcomp_tpu/testing/faults.py): kill one rank
+            # AT BRING-UP — its peers strand at the coordinator until
+            # the join timeout fails them fast as gang-peer-lost
+            from mlcomp_tpu.testing.faults import fault_point
+            fault_point('gang.rank_exit', phase='join',
+                        rank=distr_info.get('process_index'),
+                        gang=gang.get('id'), task=self.task.id)
             from mlcomp_tpu.parallel.distributed import (
                 initialize_from_distr_info,
             )
@@ -202,7 +215,10 @@ class ExecuteBuilder:
                     f'task {self.task.id}: joined distributed job as '
                     f'process {distr_info.get("process_index")}/'
                     f'{distr_info.get("process_count")} '
-                    f'(coordinator {distr_info.get("coordinator_address")})',
+                    f'(coordinator {distr_info.get("coordinator_address")}'
+                    + (f', gang {gang.get("id")} generation '
+                       f'{gang.get("generation")}' if gang else '')
+                    + ')',
                     ComponentType.Worker, None, self.task.id)
 
     def create_executor(self, folder: str):
@@ -358,10 +374,21 @@ class ExecuteBuilder:
                     # classify for the supervisor's retry pass
                     # (mlcomp_tpu/recovery.py): a DB hiccup or
                     # connection drop retries from the last
-                    # checkpoint, an executor bug fails for good
+                    # checkpoint, an executor bug fails for good. A
+                    # gang rank (distr_info present) gets the
+                    # distributed-runtime carve-out: a collective
+                    # dying because a PEER vanished is gang-peer-lost
+                    # collateral, not a permanent bug in this rank
                     from mlcomp_tpu.recovery import classify_exception
+                    gang = False
+                    try:
+                        gang = bool((yaml_load(task.additional_info)
+                                     or {}).get('distr_info')) \
+                            if task.additional_info else False
+                    except Exception:
+                        pass
                     self.provider.fail_with_reason(
-                        task, classify_exception(e))
+                        task, classify_exception(e, gang=gang))
             raise
         finally:
             try:

@@ -24,6 +24,15 @@ simulated by rewinding the stored timestamps, never by sleeping):
 5. claim race: a rival stealing the candidate between SELECT and
    UPDATE (injected at the ``queue.claim`` seam) costs the claimer one
    loop iteration, never a double delivery
+6. gang preemption (elastic gang-atomic recovery): a 3-rank gang loses
+   rank 1's HOST via the ``host.preempt`` seam (its heartbeat writer
+   dies); the gang-stall watchdog rule diagnoses the silence, the
+   supervisor fails the silent rank ``worker-lost`` and gang-aborts
+   ranks 0/2 in the same tick (``gang-aborted``, messages revoked),
+   the gang requeues EXACTLY ONCE as generation 2 — re-placed on the
+   two surviving hosts (reshaped world size 2, dead host excluded) —
+   and the bump is visible in ``gang.generation`` telemetry and
+   ``mlcomp_gang_generations_total`` on /metrics
 """
 
 import datetime
@@ -252,6 +261,117 @@ def scenario_claim_race(session):
         clear_faults()
 
 
+def scenario_gang_preemption(session):
+    """A preempted host takes down one rank of a 3-rank gang; the
+    supervisor gang-aborts the survivors and requeues the WHOLE gang
+    once, reshaped onto the two surviving hosts."""
+    from mlcomp_tpu.db.providers import DockerProvider
+    # retire the earlier scenarios' hosts: this scenario's re-placement
+    # assertion is about WHICH survivors of the gang's own pool win
+    session.execute('UPDATE computer SET can_process_tasks=0')
+    for host in ('gang_a', 'gang_b', 'gang_c'):
+        add_computer(session, host)
+    tp = TaskProvider(session)
+    qp = QueueProvider(session)
+    task = Task(name='gang_train', executor='noop', cores=8,
+                cores_max=24, single_node=False,
+                additional_info='distr: true\n',
+                status=int(TaskStatus.NotRan), last_activity=now())
+    tp.add(task)
+    cfg = RecoveryConfig(lease_seconds=30, backoff_base_s=0,
+                         max_retries=3)
+    sup = SupervisorBuilder(session=session, recovery_config=cfg)
+    sup.watchdog.config.evaluate_every_s = 0.0   # judge every tick
+    sup.build()
+    children = tp.children(task.id)
+    parent = tp.by_id(task.id)
+    check('gang fanned out across 3 hosts as generation 1',
+          len(children) == 3 and parent.gang_id == f'g{task.id}'
+          and parent.gang_generation == 1
+          and all(c.gang_id == parent.gang_id
+                  and c.gang_generation == 1 for c in children),
+          str(sup.aux.get('not_placed')))
+    victim = next(c for c in children
+                  if c.computer_assigned == 'gang_b')
+    survivors = [c for c in children if c.id != victim.id]
+    # ranks 0/2 claim + run; rank 1's host is preempted BEFORE its
+    # worker ever claims — the stuck-Queued case that used to pin the
+    # coordinator port forever
+    for c in survivors:
+        qp.claim([f'{c.computer_assigned}_default'],
+                 f'{c.computer_assigned}:0')
+        tp.change_status(c, TaskStatus.InProgress)
+
+    # host.preempt: gang_b's heartbeat writer dies from here on; the
+    # stored heartbeat is rewound past the gang-stall horizon (clocks
+    # are never slept on in this suite)
+    configure_faults({'host.preempt': {
+        'action': 'raise', 'when': {'computer': 'gang_b'},
+        'times': None}})
+    try:
+        try:
+            DockerProvider(session).heartbeat('gang_b', 'default')
+            check('host.preempt seam fires', False)
+        except RuntimeError:
+            check('host.preempt seam fires', True)
+        horizon = sup.watchdog.config.gang_host_silence_s + 60
+        session.execute(
+            'UPDATE docker SET last_activity=? WHERE computer=?',
+            (now() - datetime.timedelta(seconds=horizon), 'gang_b'))
+        rewind(session, 'task', 'last_activity', victim.id, horizon)
+        sup.build()
+    finally:
+        clear_faults()
+    victim = tp.by_id(victim.id)
+    check('silent rank failed worker-lost by the gang-stall rule',
+          victim.status == int(TaskStatus.Failed)
+          and victim.failure_reason == 'worker-lost',
+          f'{TaskStatus(victim.status).name}/{victim.failure_reason}')
+    aborted = [tp.by_id(c.id) for c in survivors]
+    check('surviving ranks gang-aborted in the same tick',
+          all(a.status == int(TaskStatus.Failed)
+              and a.failure_reason == 'gang-aborted' for a in aborted),
+          str([(a.id, a.status, a.failure_reason) for a in aborted]))
+    parent = tp.by_id(task.id)
+    check('gang verdict is the root cause, not the collateral',
+          parent.status == int(TaskStatus.Failed)
+          and parent.failure_reason == 'worker-lost',
+          str(parent.failure_reason))
+
+    # backoff 0: the next ticks schedule + requeue generation 2
+    sup.build()
+    session.execute('UPDATE task SET next_retry_at=? WHERE id=?',
+                    (now() - datetime.timedelta(seconds=1), task.id))
+    sup.build()
+    parent = tp.by_id(task.id)
+    info = yaml_load(parent.additional_info) or {}
+    gen2 = tp.children(task.id)
+    check('single generation bump, exactly-once requeue',
+          parent.gang_generation == 2 and parent.attempt == 1,
+          f'gen={parent.gang_generation} attempt={parent.attempt}')
+    check('reshaped 2-host re-placement excluding the dead host',
+          len(gen2) == 2
+          and info.get('retry_exclude') == ['gang_b']
+          and all(c.computer_assigned != 'gang_b'
+                  and c.gang_generation == 2 for c in gen2)
+          and all((yaml_load(c.additional_info) or {})
+                  ['distr_info']['process_count'] == 2 for c in gen2),
+          str([(c.id, c.computer_assigned) for c in gen2]))
+    bumps = session.query(
+        "SELECT * FROM metric WHERE name='gang.generation' AND task=?",
+        (task.id,))
+    check('gang.generation telemetry emitted once', len(bumps) == 1)
+    from mlcomp_tpu.telemetry.export import (
+        parse_openmetrics, render_server_metrics,
+    )
+    doc = parse_openmetrics(render_server_metrics(session))
+    samples = doc.get('mlcomp_gang_generations', {}).get('samples', [])
+    check('mlcomp_gang_generations_total on /metrics', any(
+        labels.get('gang') == parent.gang_id
+        and labels.get('reason') == 'worker-lost' and value == 1
+        for _, labels, value in samples), str(samples))
+
+
 def main():
     session = Session.create_session(key='chaos_smoke')
     migrate(session)
@@ -259,6 +379,7 @@ def main():
     scenario_permanent_and_exhaustion(session, sup)
     scenario_db_outage(session)
     scenario_claim_race(session)
+    scenario_gang_preemption(session)
     if FAILURES:
         print(f'FAIL: {len(FAILURES)} scenario check(s): {FAILURES}')
         return 1

@@ -217,6 +217,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 rank, nprocs, folder, coord = (int(sys.argv[1]), int(sys.argv[2]),
                                sys.argv[3], sys.argv[4])
+# CPU cross-process collectives (the write barriers) need an
+# implementation selected before backend init — same assist
+# parallel/distributed.py applies on the production path
+jax.config.update('jax_cpu_collectives_implementation', 'gloo')
 jax.distributed.initialize(coordinator_address=coord,
                            num_processes=nprocs, process_id=rank)
 sys.path.insert(0, '/root/repo')

@@ -235,6 +235,58 @@ class TestDistributed:
             int(TaskStatus.NotRan)
         assert task.id in sup.aux.get('not_placed', {})
 
+    def test_remainder_mesh_sheds_whole_tail_host(self, session,
+                                                  dag_id):
+        """The tail-shedding branch of remainder-mesh placement: the
+        granted total (5 + 2 = 7) is not a multiple of the fixed-axes
+        product (dp: 4), so the excess sheds from the tail — host2's
+        whole take (2) goes first (the ``placements.pop()`` branch),
+        then one more core from host1 — leaving a single-host 4-core
+        placement that the -1 axis can cover."""
+        from mlcomp_tpu.utils.io import yaml_dump
+        add_computer(session, name='host1', cores=5)
+        add_computer(session, name='host2', cores=2)
+        task = add_task(
+            session, dag_id, name='train', cores=4, cores_max=8,
+            single_node=False,
+            additional_info=yaml_dump(
+                {'distr': True, 'mesh': {'dp': 4, 'fsdp': -1}}))
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        tp = TaskProvider(session)
+        children = tp.children(task.id)
+        assert len(children) == 1, sup.aux
+        child = children[0]
+        assert child.computer_assigned == 'host1'
+        assert len(json.loads(child.cores_assigned)) == 4
+        from mlcomp_tpu.utils.io import yaml_load
+        di = yaml_load(child.additional_info)['distr_info']
+        assert di['process_count'] == 1
+        # host2 holds no grant at all — its take was fully shed
+        busy2 = [t for t in tp.by_status(TaskStatus.Queued)
+                 if t.computer_assigned == 'host2']
+        assert busy2 == []
+
+    def test_remainder_mesh_tail_shed_below_minimum_not_placed(
+            self, session, dag_id):
+        """When tail-shedding trims the grant below the task's core
+        minimum, the task must stay NotRan with a not_placed verdict
+        rather than dispatch an under-sized gang."""
+        from mlcomp_tpu.utils.io import yaml_dump
+        add_computer(session, name='host1', cores=3)
+        add_computer(session, name='host2', cores=2)
+        task = add_task(
+            session, dag_id, name='train', cores=8, cores_max=8,
+            single_node=False,
+            additional_info=yaml_dump(
+                {'distr': True, 'mesh': {'dp': 4, 'fsdp': -1}}))
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        tp = TaskProvider(session)
+        assert tp.children(task.id) == []
+        assert tp.by_id(task.id).status == int(TaskStatus.NotRan)
+        assert task.id in sup.aux.get('not_placed', {})
+
     def test_single_node_prefers_most_free_cores(self, session, dag_id):
         add_computer(session, name='small', cores=2)
         add_computer(session, name='big', cores=8)
