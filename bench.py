@@ -55,14 +55,23 @@ os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
 
 def _step_flops(train_step, state, x, y):
     """FLOPs of one compiled train step from XLA's cost analysis."""
+    flops, _ = _step_cost(train_step, state, x, y)
+    return flops
+
+
+def _step_cost(train_step, state, x, y):
+    """(flops, bytes accessed) of one compiled step from XLA's cost
+    analysis — the XLA-billed numbers (a Pallas custom call is billed
+    at its operand/output bytes; what happens inside is invisible)."""
     try:
         lowered = train_step.lower(state, x, y)
         cost = lowered.compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
-        return float(cost.get('flops', 0.0)) or None
+        return (float(cost.get('flops', 0.0)) or None,
+                float(cost.get('bytes accessed', 0.0)) or None)
     except Exception:
-        return None
+        return None, None
 
 
 #: wall-clock budget for the whole bench: optional legs are skipped
@@ -313,20 +322,22 @@ def bench_lm(peak_tflops: float) -> dict:
     loss_fn = loss_for_task('lm_ce')
 
     def measure(attn_impl, remat=False, t=seq_len, d=d_model,
-                layers=n_layers, v=vocab, n_steps=steps):
+                layers=n_layers, v=vocab, n_steps=steps,
+                model_extra=None, opt=None):
         """One timed config in its own scope: device buffers die with
         the frame whether it returns or raises."""
+        opt = opt if opt is not None else optimizer
         tokens = np.random.RandomState(0).randint(
             0, v, (batch, t)).astype(np.int32)
         model = create_model(
             'transformer_lm', mesh=mesh, vocab_size=v,
             d_model=d, n_layers=layers, n_heads=d // 64,
             d_ff=4 * d, max_seq_len=t, dtype='bfloat16',
-            attn_impl=attn_impl, remat=remat)
+            attn_impl=attn_impl, remat=remat, **(model_extra or {}))
         state = create_train_state(
-            model, optimizer, tokens, jax.random.PRNGKey(0), mesh=mesh)
+            model, opt, tokens, jax.random.PRNGKey(0), mesh=mesh)
         n_params = param_count(state.params)
-        step = make_train_step(model, optimizer, loss_fn, mesh=mesh,
+        step = make_train_step(model, opt, loss_fn, mesh=mesh,
                                self_supervised=True)
         x, _ = place_batch((tokens, None), mesh)
         for _ in range(warmup):
@@ -417,11 +428,13 @@ def bench_lm(peak_tflops: float) -> dict:
     # MFU is its d=1024 GEMM shape class's ceiling
     # (docs/performance.md); this leg demonstrates the framework
     # clears ~0.42 the moment the shapes allow
+    wide_tok_s = None
     if not over_budget():
         try:
             wide_d = int(os.environ.get('BENCH_LM_WIDE_DMODEL', '2048'))
             tok_s, mfu_w, n_p = measure(flash_impl, d=wide_d,
                                         layers=n_layers, n_steps=6)
+            wide_tok_s = tok_s
             result['lm_wide_tokens_per_sec'] = round(tok_s, 1)
             result['lm_wide_mfu'] = round(mfu_w, 4)
             result['lm_wide_config'] = (
@@ -429,6 +442,113 @@ def bench_lm(peak_tflops: float) -> dict:
                 f'the wide-GEMM shape class (docs/performance.md)')
         except Exception as e:
             result['lm_wide_error'] = f'{type(e).__name__}: {e}'[:200]
+
+    # int8 TRAINING leg, at the wide-GEMM shape where the shape-class
+    # table says quantization can pay (round 6): matmul_precision=
+    # 'int8' (dynamic per-channel quant of both operands, f32 accum,
+    # STE vjp, int8 residuals) + bf16 master weights (param_dtype +
+    # optimizer master_dtype) vs the bf16 wide leg just measured.
+    # Loss parity is pinned by tests/test_train.py's
+    # test_int8_training_loss_parity; this leg publishes the speedup.
+    if wide_tok_s and not over_budget():
+        try:
+            int8_opt, _ = make_optimizer(
+                {'name': 'adamw', 'lr': 3e-4,
+                 'master_dtype': 'bfloat16'}, 1000)
+            tok_s_i8, _, _ = measure(
+                flash_impl, d=wide_d, layers=n_layers, n_steps=6,
+                model_extra={'matmul_precision': 'int8',
+                             'param_dtype': 'bfloat16'},
+                opt=int8_opt)
+            result['lm_wide_int8_tokens_per_sec'] = round(tok_s_i8, 1)
+            result['lm_wide_int8_vs_bf16'] = round(
+                tok_s_i8 / wide_tok_s, 3)
+            result['lm_wide_int8_config'] = (
+                f'd={wide_d} T={seq_len} int8 train matmuls '
+                f'(dynamic per-channel both operands, f32 accum, STE '
+                f'vjp) + bf16 master weights vs the bf16 wide leg')
+        except Exception as e:
+            result['lm_wide_int8_error'] = \
+                f'{type(e).__name__}: {e}'[:200]
+
+    # scan-over-layers compile-time leg: the flagship stack dispatched
+    # by the old Python for-loop (scan_layers=False — L identical
+    # layer programs inlined into the step HLO) vs the shipped nn.scan
+    # default, backend compile wall-clock + tokens/sec parity. The
+    # persistent XLA compile cache is disabled around the measurement
+    # (a cache hit would time disk, not the compiler).
+    if not over_budget():
+        cache_flag = None
+        try:
+            try:
+                cache_flag = jax.config.jax_enable_compilation_cache
+                jax.config.update('jax_enable_compilation_cache',
+                                  False)
+            except Exception:
+                cache_flag = None
+
+            def compile_ms(scan_layers):
+                tokens = np.random.RandomState(0).randint(
+                    0, vocab, (batch, seq_len)).astype(np.int32)
+                model = create_model(
+                    'transformer_lm', mesh=mesh, vocab_size=vocab,
+                    d_model=d_model, n_layers=n_layers,
+                    n_heads=d_model // 64, d_ff=4 * d_model,
+                    max_seq_len=seq_len, dtype='bfloat16',
+                    attn_impl=flash_impl, scan_layers=scan_layers)
+                state = create_train_state(
+                    model, optimizer, tokens, jax.random.PRNGKey(0),
+                    mesh=mesh)
+                step = make_train_step(model, optimizer, loss_fn,
+                                       mesh=mesh,
+                                       self_supervised=True)
+                x, _ = place_batch((tokens, None), mesh)
+                t0 = time.perf_counter()
+                lowered = step.lower(state, x, None)
+                trace_s = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                compiled = lowered.compile()
+                backend_s = time.perf_counter() - t0
+                # a few real steps off the SAME compiled executable:
+                # the claim is compile time down at unchanged tok/s
+                state, metrics = compiled(state, x, None)
+                float(metrics['loss'])
+                t0 = time.perf_counter()
+                for _ in range(4):
+                    state, metrics = compiled(state, x, None)
+                float(metrics['loss'])
+                dt = time.perf_counter() - t0
+                return (trace_s * 1e3, backend_s * 1e3,
+                        batch * seq_len * 4 / dt)
+
+            loop_trace, loop_backend, loop_tok = compile_ms(False)
+            scan_trace, scan_backend, scan_tok = compile_ms(True)
+            result.update({
+                'lm_loop_backend_compile_ms': round(loop_backend, 1),
+                'lm_scan_backend_compile_ms': round(scan_backend, 1),
+                'lm_scan_compile_reduction_pct': round(
+                    100.0 * (1 - scan_backend / loop_backend), 1),
+                'lm_loop_trace_ms': round(loop_trace, 1),
+                'lm_scan_trace_ms': round(scan_trace, 1),
+                'lm_scan_tokens_per_sec': round(scan_tok, 1),
+                'lm_scan_vs_loop_tokens': round(
+                    scan_tok / loop_tok, 3),
+                'lm_scan_config': (
+                    f'flagship shape (d={d_model}, {n_layers} layers, '
+                    f'T={seq_len}): one nn.scan-compiled layer vs the '
+                    f'for-loop step HLO, persistent compile cache '
+                    f'disabled for the measurement'),
+            })
+        except Exception as e:
+            result['lm_scan_compile_error'] = \
+                f'{type(e).__name__}: {e}'[:200]
+        finally:
+            if cache_flag is not None:
+                try:
+                    jax.config.update('jax_enable_compilation_cache',
+                                      cache_flag)
+                except Exception:
+                    pass
     return result
 
 
@@ -699,7 +819,7 @@ def main():
     # fetch a VALUE, not block_until_ready: on remote-tunneled devices
     # the ready signal can resolve before execution; a transfer cannot
     float(metrics['loss'])
-    flops = _step_flops(train_step, state, x, y)
+    flops, bn_bytes = _step_cost(train_step, state, x, y)
 
     # ONE dispatch for the whole compute loop (lax.scan over steps):
     # per-step python dispatch pays the tunnel's round trip 30 times
@@ -788,6 +908,80 @@ def main():
     if flops:
         steps_per_sec = n_steps / epoch_dt
         mfu = flops * steps_per_sec / (peak_tflops * 1e12 * n_devices)
+
+    # ---- fused-norm CIFAR leg (round 6): norm='fused' routes every
+    # BatchNorm+relu site through the single-pass Pallas kernel
+    # (ops/fused_norm.py) — the byte-count answer to the round-5
+    # ablation that billed BN at 28% of step bytes. Measured compute-
+    # only against the SAME scan dispatch as the primary, plus the
+    # XLA-billed bytes of both steps (the kernel's operands/outputs at
+    # face value — what the claim is written against).
+    fused_result = {}
+    if not over_budget():
+        try:
+            # explicit 'pallas' (not 'auto') like the flash leg: a
+            # silent fall-back to the dense composition must never be
+            # mislabeled a fused-kernel measurement
+            # (BENCH_FUSED_NORM_IMPL=dense lets CPU smoke-runs pass)
+            fused_model = create_model(
+                'resnet18', num_classes=10, dtype='bfloat16',
+                norm='fused',
+                norm_impl=os.environ.get('BENCH_FUSED_NORM_IMPL',
+                                         'pallas'))
+            fused_state = create_train_state(
+                fused_model, optimizer,
+                x_train[:max(1, len(mesh.devices.flat))],
+                jax.random.PRNGKey(0), mesh=mesh)
+            fused_step = make_train_step(fused_model, optimizer,
+                                         loss_fn, mesh=mesh)
+            for _ in range(warmup):
+                fused_state, fmetrics = fused_step(fused_state, x, y)
+            float(fmetrics['loss'])
+            f_flops, f_bytes = _step_cost(fused_step, fused_state,
+                                          x, y)
+
+            def _fused_scan(s, xb, yb):
+                def body(st, _):
+                    st, m = fused_step(st, xb, yb)
+                    return st, m['loss']
+                return _jax.lax.scan(body, s, None,
+                                     length=compute_steps)
+            fused_fn = _jax.jit(_fused_scan)
+            fused_state, flosses = fused_fn(fused_state, x, y)
+            float(np.asarray(flosses)[-1])
+            fused_dt = float('inf')
+            for _ in range(3):
+                t0 = time.perf_counter()
+                fused_state, flosses = fused_fn(fused_state, x, y)
+                float(np.asarray(flosses)[-1])
+                fused_dt = min(fused_dt, time.perf_counter() - t0)
+            fused_ips = batch_size * compute_steps / fused_dt
+            # BN flops for the MFU accounting: same model math, and
+            # XLA cannot see the FLOPs inside the Pallas custom call
+            fused_mfu = None
+            if flops:
+                fused_mfu = (flops * (compute_steps / fused_dt)
+                             / (peak_tflops * 1e12 * n_devices))
+            fused_result = {
+                'cifar_fused_norm_images_per_sec': round(fused_ips, 1),
+                'cifar_fused_norm_mfu':
+                    round(fused_mfu, 4) if fused_mfu else None,
+                'cifar_fused_norm_bytes_per_step': f_bytes,
+                'cifar_bn_bytes_per_step': bn_bytes,
+                'cifar_fused_norm_byte_reduction_pct': round(
+                    100.0 * (1 - f_bytes / bn_bytes), 1)
+                    if f_bytes and bn_bytes else None,
+                'cifar_fused_norm_config': (
+                    f'resnet18 norm=fused (Pallas single-pass '
+                    f'norm+act, ops/fused_norm.py) bs={batch_size} '
+                    f'bf16 compute-only scan vs the BN baseline; '
+                    f'bytes = XLA cost analysis, MFU billed at the '
+                    f'BN step\'s FLOPs'),
+            }
+            del fused_state, fused_fn, fused_step
+        except Exception as e:
+            fused_result = {'cifar_fused_norm_error':
+                            f'{type(e).__name__}: {e}'[:200]}
 
     # ---- telemetry hot-path overhead (budget: <1% of step time).
     # The recorder cost is measured in isolation — an instrumented
@@ -1069,6 +1263,7 @@ def main():
             f'scheduling; abort/requeue/reshape run only on a dying '
             f'gang; budget ~0 (<1%)',
     }
+    result.update(fused_result)
     result.update(grid_result)
 
     # second workload: the flagship long-context LM (skippable, and

@@ -82,6 +82,12 @@ RULES = {
         SEV_WARNING,
         'jax.debug.print in a step function adds a host callback per '
         'step — fine while debugging, a throughput killer left in'),
+    'jax-layer-loop': (
+        SEV_WARNING,
+        'a Python for-loop over a homogeneous layer stack traces and '
+        'compiles the same layer program L times (L-fold trace + XLA '
+        'compile cost, visible as compile.backend_ms) — roll it with '
+        'nn.scan/lax.scan so the layer compiles once'),
 }
 
 

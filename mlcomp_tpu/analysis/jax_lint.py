@@ -18,6 +18,11 @@ Rules (ids in findings.RULES):
 - ``jax-donate``         train-step jit without ``donate_argnums``
 - ``jax-scalar-closure`` loop variable captured by a jitted closure
 - ``jax-jit-in-loop``    ``jax.jit(...)`` called inside a loop body
+- ``jax-layer-loop``     Python for-loop over a homogeneous layer
+  stack — L-fold trace+compile cost; roll it with ``nn.scan``. This
+  rule alone also covers ``@nn.compact`` bodies (layer stacks live in
+  model code, which jit traces through even though the jit call sits
+  a module away).
 
 Suppression: put ``# preflight: disable=<rule>[,<rule>...]`` (or
 ``disable=all``) on the flagged line or on a comment line directly
@@ -39,6 +44,8 @@ _STATE_PARAMS = {'state', 'params', 'train_state', 'carry'}
 _NUMPY_SYNC_ATTRS = {'asarray', 'array', 'copy', 'frombuffer'}
 _DEBUG_CALLS = {'jax.debug.print', 'debug.print',
                 'jax.debug.breakpoint', 'debug.breakpoint'}
+_COMPACT_NAMES = {'nn.compact', 'compact', 'linen.compact',
+                  'flax.linen.compact'}
 
 
 def _dotted(node):
@@ -177,6 +184,79 @@ def _bound_names(fn) -> set:
     return out
 
 
+def _is_range_iter(it) -> bool:
+    """``range(...)`` or ``enumerate(range(...))`` — the homogeneity
+    signal: iterating a COUNT, not a per-layer parameter collection."""
+    if not isinstance(it, ast.Call):
+        return False
+    name = _dotted(it.func)
+    if name == 'range':
+        return True
+    return name == 'enumerate' and it.args \
+        and _is_range_iter(it.args[0])
+
+
+def _carried_application(loop) -> str:
+    """The name a loop body threads through layer calls — the
+    ``x = layer(x, ...)`` / ``x = Layer(cfg, ...)(x)`` signature of a
+    sequential stack — or None. The carry assignment's value may be an
+    arbitrary expression (``x = l(x) if remat else l(x, t=t)``); it
+    qualifies when any Call inside it takes the carry as an argument."""
+    for node in ast.walk(loop):
+        if not (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        carry = node.targets[0].id
+        for call in ast.walk(node.value):
+            if not isinstance(call, ast.Call):
+                continue
+            args = list(call.args) + [k.value for k in call.keywords]
+            if any(isinstance(n, ast.Name) and n.id == carry
+                   for a in args for n in ast.walk(a)):
+                return carry
+    return None
+
+
+def _constructs_module(loop) -> bool:
+    """Evidence that the loop body actually BUILDS a layer, as opposed
+    to any fixed-iteration numeric loop that threads a carry through a
+    plain function (Newton steps, ``x = jnp.tanh(x)``, power
+    iteration): a Call carrying a flax ``name=`` keyword, or the
+    construct-then-apply shape ``Layer(...)(x)`` (a Call whose callee
+    is itself a Call). Without one of these the loop is not a layer
+    stack and must not be flagged."""
+    for node in ast.walk(loop):
+        if not isinstance(node, ast.Call):
+            continue
+        if any(k.arg == 'name' for k in node.keywords):
+            return True
+        if isinstance(node.func, ast.Call):
+            return True
+    return False
+
+
+def _reads_any(expr, names: set) -> bool:
+    """Does ``expr`` load any of ``names`` — ignoring uses inside a
+    ``name=`` keyword (flax layer naming like ``name=f'layer_{i}'`` is
+    exactly what a scan replaces, not real heterogeneity)."""
+    if not names:
+        return False
+
+    def walk(node):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id in names:
+                return True
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.keyword) and child.arg == 'name':
+                continue
+            if walk(child):
+                return True
+        return False
+
+    return walk(expr)
+
+
 class ModuleLinter:
     def __init__(self, text: str, path: str):
         self.mod = _Module(text, path)
@@ -312,6 +392,42 @@ class ModuleLinter:
             f"train-step jit of '{fn.name}' carries '{first}' without "
             f"donate_argnums", fn.lineno)
 
+    def _check_layer_loop(self, fn):
+        """Python for-loop dispatching a homogeneous layer stack.
+
+        The signature: ``for i in range(L)`` whose body threads a
+        carried activation through a call (``x = layer(x, ...)``)
+        AND shows layer construction (a ``name=`` keyword or
+        ``Layer(...)(x)``), where nothing about the layer's
+        CONSTRUCTION depends on the loop variable except the flax
+        ``name=`` keyword. When the constructor reads the loop
+        variable anywhere else (widths, strides, a per-layer flag) the
+        stack is heterogeneous and a scan cannot roll it — not
+        flagged. Plain numeric carries (``x = jnp.tanh(x)``, Newton
+        steps) show no construction and are not flagged either.
+        """
+        for loop in ast.walk(fn):
+            if not isinstance(loop, ast.For) \
+                    or not _is_range_iter(loop.iter) \
+                    or not _constructs_module(loop):
+                continue
+            targets = {t.id for t in ast.walk(loop.target)
+                       if isinstance(t, ast.Name)}
+            # ANY read of a loop variable outside a name= keyword makes
+            # the stack heterogeneous (per-layer widths/strides/flags,
+            # index-dependent branches) — a scan cannot roll it
+            if any(_reads_any(stmt, targets) for stmt in loop.body):
+                continue
+            carry = _carried_application(loop)
+            if carry is None:
+                continue
+            self._add(
+                'jax-layer-loop',
+                f"for-loop over range(...) re-dispatches '{carry}' "
+                f"through an identically-constructed layer every "
+                f"iteration — roll the stack with nn.scan/lax.scan",
+                loop.lineno)
+
     def _check_jit_in_loop(self):
         for node in ast.walk(self.mod.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -346,6 +462,15 @@ class ModuleLinter:
             self._check_donate(fn, has_donate, anchor)
             self._check_region(fn)
             self._check_scalar_closure(fn)
+            self._check_layer_loop(fn)
+        # layer stacks live in model code: the layer-loop rule (alone)
+        # also covers @nn.compact bodies, which jit traces through even
+        # though the jit call sits a module away
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and any(_dotted(d) in _COMPACT_NAMES
+                            for d in node.decorator_list):
+                self._check_layer_loop(node)
         self._check_jit_in_loop()
         self.findings.sort(key=lambda f: (f.path or '', f.line or 0))
         return self.findings

@@ -188,6 +188,24 @@ def _pipelined(mesh=None, n_microbatches=4, **kwargs):
     fields = {f.name for f in dataclasses.fields(TransformerConfig)}
     cfg = TransformerConfig(
         **{k: v for k, v in kwargs.items() if k in fields})
+    # loud-failure contract (cf. train/optim.py): this model's raw
+    # einsum math implements none of these TransformerConfig knobs —
+    # accepting them silently would train a different model than the
+    # config says
+    if cfg.matmul_precision != 'bf16':
+        raise ValueError(
+            f"pipelined_lm does not implement matmul_precision="
+            f"{cfg.matmul_precision!r} (its layer math is raw einsums"
+            f" — use transformer_lm for int8 training)")
+    if cfg.param_dtype != 'float32':
+        raise ValueError(
+            f"pipelined_lm does not implement param_dtype="
+            f"{cfg.param_dtype!r}; its params are created in f32")
+    if cfg.scan_layers is True:
+        raise ValueError(
+            'pipelined_lm stages already scan their layer slices '
+            '(stage_apply) — scan_layers does not apply; leave it '
+            "'auto'")
     return PipelinedTransformerLM(cfg, mesh=mesh,
                                   n_microbatches=int(n_microbatches))
 

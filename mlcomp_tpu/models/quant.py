@@ -1,0 +1,75 @@
+"""Quantized-training flax layers.
+
+``Int8DenseGeneral`` is a drop-in for the matmul subset of
+``nn.DenseGeneral`` the transformer uses (no bias; ``axis`` a trailing
+dim or dims): the parameter tree is identical (one ``kernel`` leaf,
+same shape, same logical-axis boxing), so a checkpoint trained at one
+``matmul_precision`` restores into the other — the precision is a
+property of the STEP, not of the saved state.
+
+The matmul itself is ``ops/int8_matmul.int8_train_matmul``: dynamic
+per-channel int8 quantization of BOTH operands each step, f32
+accumulation, straight-through gradients, int8 residuals saved for the
+backward. Where it pays and where it doesn't is a shape-class question
+— see the round-6 table in docs/performance.md before flipping it on.
+"""
+
+from typing import Any, Callable, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from mlcomp_tpu.ops.int8_matmul import int8_train_matmul
+
+Dtype = Any
+
+
+def _canonical_axes(axis, ndim: int) -> Tuple[int, ...]:
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    axes = tuple(a % ndim for a in axes)
+    if axes != tuple(range(ndim - len(axes), ndim)):
+        raise ValueError(
+            f'Int8DenseGeneral contracts trailing dims only, got '
+            f'axis={axis} for ndim={ndim}')
+    return axes
+
+
+class Int8DenseGeneral(nn.Module):
+    """DenseGeneral-compatible int8 training matmul (see module
+    docstring). ``features`` an int or tuple, ``axis`` the trailing
+    contracting dim(s); ``use_bias`` is unsupported on purpose — the
+    transformer's projections are bias-free."""
+
+    features: Union[int, Sequence[int]]
+    axis: Union[int, Sequence[int]] = -1
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    use_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        if self.use_bias:
+            raise NotImplementedError(
+                'Int8DenseGeneral is matmul-only (use_bias=False)')
+        features = (self.features,) if isinstance(self.features, int) \
+            else tuple(self.features)
+        axes = _canonical_axes(self.axis, x.ndim)
+        contract = tuple(x.shape[a] for a in axes)
+        kernel = self.param('kernel', self.kernel_init,
+                            contract + features,
+                            jnp.dtype(self.param_dtype))
+        k_in = int(np.prod(contract))
+        n_out = int(np.prod(features))
+        batch_shape = x.shape[:x.ndim - len(axes)]
+        x2 = x.reshape((-1, k_in) if batch_shape else (1, k_in))
+        w2 = jnp.asarray(kernel).reshape(k_in, n_out)
+        # compute dtype = the model's activation dtype: bf16 keeps the
+        # int8->MXU casts exact; f32 only in CPU parity tests
+        y = int8_train_matmul(x2, w2, jnp.dtype(self.dtype))
+        y = y.astype(self.dtype)
+        return y.reshape(batch_shape + features)
+
+
+__all__ = ['Int8DenseGeneral']

@@ -11,6 +11,7 @@ from functools import partial
 from typing import Any, Callable, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from mlcomp_tpu.models.base import register_model
@@ -36,6 +37,150 @@ def norm_partial(dtype, train):
     """The zoo-wide BatchNorm convention."""
     return partial(nn.BatchNorm, use_running_average=not train,
                    momentum=0.9, epsilon=1e-5, dtype=dtype)
+
+
+# ------------------------------------------------------- norm variants
+# The round-5 ablation (docs/performance.md) billed BatchNorm at 28% of
+# all CIFAR step bytes. Two byte-count answers ride on a ``norm=`` knob
+# ('batch' stays the default and its param tree is untouched):
+#
+# - 'fused': the Pallas single-program norm (ops/fused_norm.py) with
+#   the relu folded in — the normalized intermediate and the pre-relu
+#   tensor never reach HBM;
+# - 'none':  no normalization at all — weight-standardized convs
+#   (WSConv, the NF-net recipe) with a zero-init per-channel gain on
+#   each residual branch end (SkipInit) so deep stacks still train.
+
+
+class WSConv(nn.Module):
+    """Conv with weight standardization: the kernel is standardized
+    per output channel over (h, w, in) in f32 at each apply, scaled by
+    ``1/sqrt(fan_in)`` and a learned per-channel gain (the scaled-WS /
+    NF formulation). Field order mirrors ``nn.Conv`` so
+    ``conv_partial``-style positional calls work unchanged."""
+
+    features: int
+    kernel_size: Tuple[int, int]
+    strides: Any = None
+    kernel_dilation: Any = None
+    dtype: Any = jnp.bfloat16
+    use_bias: bool = False
+    eps: float = 1e-4
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        c_in = x.shape[-1]
+        kernel = self.param('kernel', conv_kernel_init(),
+                            (kh, kw, c_in, self.features), jnp.float32)
+        gain = self.param('gain', nn.with_logical_partitioning(
+            nn.initializers.ones, ('conv_out',)), (self.features,),
+            jnp.float32)
+        k32 = jnp.asarray(kernel, jnp.float32)
+        mean = jnp.mean(k32, axis=(0, 1, 2), keepdims=True)
+        var = jnp.var(k32, axis=(0, 1, 2), keepdims=True)
+        fan_in = kh * kw * c_in
+        khat = (k32 - mean) * jax.lax.rsqrt(var * fan_in + self.eps)
+        khat = khat * gain[None, None, None, :]
+        dn = ('NHWC', 'HWIO', 'NHWC')
+        return jax.lax.conv_general_dilated(
+            x.astype(self.dtype), khat.astype(self.dtype),
+            window_strides=tuple(self.strides or (1, 1)),
+            padding='SAME',
+            rhs_dilation=tuple(self.kernel_dilation or (1, 1)),
+            dimension_numbers=dn)
+
+
+class FusedNormAct(nn.Module):
+    """BatchNorm-compatible module over the fused kernel: same
+    ``scale``/``bias`` params and ``batch_stats`` ``mean``/``var``
+    variables as ``nn.BatchNorm`` (checkpoints carry over), with the
+    following activation folded into the same program when ``act``."""
+
+    use_running_average: bool
+    act: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    impl: str = 'auto'
+    scale_init: Callable = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, x):
+        from mlcomp_tpu.ops.fused_norm import (
+            fused_norm_act, reference_norm_act,
+        )
+        c = x.shape[-1]
+        # unboxed like nn.BatchNorm's own scale/bias (the 'norm'
+        # logical axis is replicated anyway): keeps the param tree
+        # EXACTLY the BatchNorm layout so checkpoints interchange
+        scale = self.param('scale', self.scale_init, (c,), jnp.float32)
+        bias = self.param('bias', nn.initializers.zeros, (c,),
+                          jnp.float32)
+        ra_mean = self.variable('batch_stats', 'mean',
+                                lambda: jnp.zeros((c,), jnp.float32))
+        ra_var = self.variable('batch_stats', 'var',
+                               lambda: jnp.ones((c,), jnp.float32))
+        x2 = x.reshape(-1, c)
+        if self.use_running_average:
+            y, _, _ = reference_norm_act(
+                x2, scale, bias, eps=self.epsilon, act=self.act,
+                stats=(ra_mean.value, ra_var.value))
+        else:
+            y, mean, var = fused_norm_act(
+                x2, scale, bias, self.epsilon, self.act, self.impl)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * \
+                    jax.lax.stop_gradient(mean)
+                ra_var.value = m * ra_var.value + (1 - m) * \
+                    jax.lax.stop_gradient(var)
+        return y.reshape(x.shape).astype(self.dtype)
+
+
+class _Identity(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return x
+
+
+class _SkipGain(nn.Module):
+    """SkipInit: a zero-init per-channel gain at the residual-branch
+    end — the norm-free stand-in for BN's zero-init scale."""
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        scale = self.param('scale', nn.with_logical_partitioning(
+            nn.initializers.zeros, ('norm',)), (c,), jnp.float32)
+        return x * scale.astype(x.dtype)[None, None, None, :]
+
+
+class NormFactory:
+    """Norm-slot factory for the non-BN variants. ``fuses_act=True``
+    tells blocks the returned module applies the relu itself."""
+
+    def __init__(self, kind: str, dtype, train: bool,
+                 impl: str = 'auto'):
+        if kind not in ('none', 'fused'):
+            raise ValueError(f'unknown norm variant {kind!r}')
+        self.kind = kind
+        self.dtype = dtype
+        self.train = train
+        self.impl = impl
+        self.fuses_act = kind == 'fused'
+
+    def __call__(self, scale_init=None, name=None, act=False):
+        if self.kind == 'fused':
+            return FusedNormAct(
+                use_running_average=not self.train, act=act,
+                dtype=self.dtype, impl=self.impl, name=name,
+                scale_init=scale_init or nn.initializers.ones)
+        # 'none': the zeros-scale_init slot (residual-branch end)
+        # becomes SkipInit, every other slot is the identity
+        if scale_init is nn.initializers.zeros:
+            return _SkipGain(name=name)
+        return _Identity(name=name)
 
 
 class SqueezeExcite(nn.Module):
@@ -69,12 +214,22 @@ class BasicBlock(nn.Module):
     def __call__(self, x):
         residual = x
         d = (self.dilation, self.dilation)
+        fuses = getattr(self.norm, 'fuses_act', False)
+        # norm names are pinned to what flax auto-naming gave the
+        # original BatchNorm variant, so the 'batch' param tree is
+        # byte-identical to before the knob existed AND the 'fused'
+        # tree shares its structure (checkpoints interchange — the
+        # FusedNormAct param/batch_stats layout mirrors BatchNorm)
         y = self.conv(self.filters, (3, 3), self.strides,
                       kernel_dilation=d)(x)
-        y = self.norm()(y)
-        y = self.act(y)
+        n0 = self.norm(act=True, name='BatchNorm_0') if fuses \
+            else self.norm(name='BatchNorm_0')
+        y = n0(y)
+        if not fuses:
+            y = self.act(y)
         y = self.conv(self.filters, (3, 3), kernel_dilation=d)(y)
-        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        y = self.norm(scale_init=nn.initializers.zeros,
+                      name='BatchNorm_1')(y)
         if self.se:
             y = SqueezeExcite(dtype=y.dtype, name='se')(y)
         if residual.shape != y.shape:
@@ -96,15 +251,24 @@ class Bottleneck(nn.Module):
     @nn.compact
     def __call__(self, x):
         residual = x
+        fuses = getattr(self.norm, 'fuses_act', False)
+        # explicit auto-name-compatible norm names: see BasicBlock
         y = self.conv(self.filters, (1, 1))(x)
-        y = self.norm()(y)
-        y = self.act(y)
+        n0 = self.norm(act=True, name='BatchNorm_0') if fuses \
+            else self.norm(name='BatchNorm_0')
+        y = n0(y)
+        if not fuses:
+            y = self.act(y)
         y = self.conv(self.filters, (3, 3), self.strides,
                       kernel_dilation=(self.dilation, self.dilation))(y)
-        y = self.norm()(y)
-        y = self.act(y)
+        n1 = self.norm(act=True, name='BatchNorm_1') if fuses \
+            else self.norm(name='BatchNorm_1')
+        y = n1(y)
+        if not fuses:
+            y = self.act(y)
         y = self.conv(self.filters * 4, (1, 1))(y)
-        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        y = self.norm(scale_init=nn.initializers.zeros,
+                      name='BatchNorm_2')(y)
         if self.se:
             y = SqueezeExcite(dtype=y.dtype, name='se')(y)
         if residual.shape != y.shape:
@@ -121,11 +285,24 @@ class ResNet(nn.Module):
     num_filters: int = 64
     cifar_stem: bool = True      # 3x3 stride-1 stem, no maxpool
     dtype: jnp.dtype = jnp.bfloat16
+    # 'batch' (default, param tree untouched) | 'fused' (Pallas fused
+    # norm+act kernel, ops/fused_norm.py) | 'none' (weight-standardized
+    # convs + SkipInit, no norm at all) — the byte-count knobs from the
+    # round-5 BN ablation, see the norm-variants section above
+    norm: str = 'batch'
+    norm_impl: str = 'auto'      # fused-kernel path selection
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        conv = conv_partial(self.dtype)
-        norm = norm_partial(self.dtype, train)
+        if self.norm == 'batch':
+            conv = conv_partial(self.dtype)
+            norm = norm_partial(self.dtype, train)
+        else:
+            conv = conv_partial(self.dtype) if self.norm == 'fused' \
+                else partial(WSConv, dtype=self.dtype)
+            norm = NormFactory(self.norm, self.dtype, train,
+                               impl=self.norm_impl)
+        fuses = getattr(norm, 'fuses_act', False)
         act = nn.relu
 
         x = x.astype(self.dtype)
@@ -133,8 +310,10 @@ class ResNet(nn.Module):
             x = conv(self.num_filters, (3, 3), name='conv_stem')(x)
         else:
             x = conv(self.num_filters, (7, 7), (2, 2), name='conv_stem')(x)
-        x = norm(name='norm_stem')(x)
-        x = act(x)
+        x = norm(name='norm_stem', act=True)(x) if fuses \
+            else norm(name='norm_stem')(x)
+        if not fuses:
+            x = act(x)
         if not self.cifar_stem:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
 
@@ -163,13 +342,15 @@ _VARIANTS = {
 
 for _name, (_sizes, _block) in _VARIANTS.items():
     def _factory(num_classes=10, cifar_stem=True, dtype='bfloat16',
-                 num_filters=64, _sizes=_sizes, _block=_block, **_):
+                 num_filters=64, norm='batch', norm_impl='auto',
+                 _sizes=_sizes, _block=_block, **_):
         # num_filters: base width (torchvision uses 64; smaller widths
         # serve toy configs and the converter golden tests)
         return ResNet(stage_sizes=_sizes, block=_block,
                       num_classes=num_classes, cifar_stem=cifar_stem,
                       num_filters=int(num_filters),
-                      dtype=jnp.dtype(dtype))
+                      dtype=jnp.dtype(dtype),
+                      norm=norm, norm_impl=norm_impl)
     register_model(_name)(_factory)
 
 

@@ -16,11 +16,12 @@ Green-field relative to the reference (its zoo is CNNs only — SURVEY.md
 """
 
 import dataclasses
-from typing import Optional
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from flax.core import meta as flax_meta
 from jax.sharding import Mesh
 
 from mlcomp_tpu.models.base import register_model
@@ -56,18 +57,55 @@ class TransformerConfig:
     n_experts: int = 0
     moe_every: int = 2            # every k-th layer is MoE when n_experts>0
     capacity_factor: float = 1.25
+    # 'auto' | True | False: dispatch the decoder stack as ONE
+    # nn.scan over a stacked DecoderLayer instead of a Python for-loop.
+    # The loop pays L-fold trace + XLA-compile cost (every layer is an
+    # identical program compiled L times — compile.backend_ms sees it);
+    # the scan compiles the layer once. 'auto' = scan whenever the
+    # stack is homogeneous (no MoE interleave). Param layout changes:
+    # per-layer 'layer_i' subtrees become one 'layers' subtree with a
+    # leading [L] axis ('layers' logical axis, replicated);
+    # train/layer_stack.py converts checkpoints both ways.
+    scan_layers: Any = 'auto'
+    # 'bf16' | 'int8': int8 routes every qkv/out/mlp projection through
+    # the dynamic int8 training matmul (ops/int8_matmul.py
+    # int8_train_matmul: per-channel quant of both operands, f32 accum,
+    # STE gradients, int8 residuals). The lm_head and MoE router stay
+    # at the activation dtype — the vocab head's logit drift feeds the
+    # loss directly and the router is f32 by design. Param tree is
+    # identical either way (checkpoints interchange). Pay attention to
+    # the shape class before enabling: docs/performance.md round 6
+    matmul_precision: str = 'bf16'
+    # dtype params are STORED in ('float32' default). 'bfloat16' halves
+    # param HBM traffic — the int8-training configuration's "bf16
+    # master weights"; pair it with optimizer master_dtype: bfloat16
+    # (train/optim.py) so the update arithmetic still runs in f32
+    param_dtype: str = 'float32'
 
     @property
     def head_dim(self):
         return self.d_model // self.n_heads
 
 
-def _dense(features, axes, dtype, name=None):
+def _dense(features, axes, dtype, name=None, param_dtype=jnp.float32,
+           int8: bool = False, axis=-1):
+    init = nn.with_logical_partitioning(
+        nn.initializers.lecun_normal(), axes)
+    if int8:
+        from mlcomp_tpu.models.quant import Int8DenseGeneral
+        return Int8DenseGeneral(
+            features, axis=axis, dtype=dtype, param_dtype=param_dtype,
+            kernel_init=init, name=name)
     return nn.DenseGeneral(
-        features, axis=-1, dtype=dtype, use_bias=False,
-        kernel_init=nn.with_logical_partitioning(
-            nn.initializers.lecun_normal(), axes),
-        name=name)
+        features, axis=axis, dtype=dtype, use_bias=False,
+        param_dtype=param_dtype, kernel_init=init, name=name)
+
+
+def _check_precision(cfg):
+    if cfg.matmul_precision not in ('bf16', 'int8'):
+        raise ValueError(
+            f"matmul_precision must be 'bf16' or 'int8', "
+            f"got {cfg.matmul_precision!r}")
 
 
 class Attention(nn.Module):
@@ -78,14 +116,13 @@ class Attention(nn.Module):
     def __call__(self, x, train: bool = False):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
+        pdtype = jnp.dtype(cfg.param_dtype)
+        int8 = cfg.matmul_precision == 'int8'
         h, d = cfg.n_heads, cfg.head_dim
 
-        qkv = nn.DenseGeneral(
-            (3, h, d), axis=-1, dtype=dtype, use_bias=False,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), ('embed', 'qkv', 'heads',
-                                                 'kv')),
-            name='qkv')(x)
+        qkv = _dense(
+            (3, h, d), ('embed', 'qkv', 'heads', 'kv'), dtype,
+            name='qkv', param_dtype=pdtype, int8=int8)(x)
         q, k, v = (jnp.squeeze(a, 2) for a in jnp.split(qkv, 3, axis=2))
         q = nn.with_logical_constraint(q, ('batch', 'seq', 'heads', 'kv'))
         k = nn.with_logical_constraint(k, ('batch', 'seq', 'heads', 'kv'))
@@ -102,11 +139,9 @@ class Attention(nn.Module):
         out = nn.with_logical_constraint(
             out, ('batch', 'seq', 'heads', 'kv'))
 
-        out = nn.DenseGeneral(
-            cfg.d_model, axis=(-2, -1), dtype=dtype, use_bias=False,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), ('heads', 'kv', 'embed')),
-            name='out')(out)
+        out = _dense(
+            cfg.d_model, ('heads', 'kv', 'embed'), dtype, name='out',
+            param_dtype=pdtype, int8=int8, axis=(-2, -1))(out)
         if cfg.dropout:
             out = nn.Dropout(cfg.dropout, deterministic=not train)(out)
         return nn.with_logical_constraint(out, ('batch', 'seq', 'embed'))
@@ -119,11 +154,16 @@ class MlpBlock(nn.Module):
     def __call__(self, x, train: bool = False):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
-        gate = _dense(cfg.d_ff, ('embed', 'mlp'), dtype, 'wi_gate')(x)
-        up = _dense(cfg.d_ff, ('embed', 'mlp'), dtype, 'wi_up')(x)
+        pdtype = jnp.dtype(cfg.param_dtype)
+        int8 = cfg.matmul_precision == 'int8'
+        gate = _dense(cfg.d_ff, ('embed', 'mlp'), dtype, 'wi_gate',
+                      param_dtype=pdtype, int8=int8)(x)
+        up = _dense(cfg.d_ff, ('embed', 'mlp'), dtype, 'wi_up',
+                    param_dtype=pdtype, int8=int8)(x)
         y = nn.silu(gate) * up
         y = nn.with_logical_constraint(y, ('batch', 'seq', 'mlp'))
-        y = _dense(cfg.d_model, ('mlp', 'embed'), dtype, 'wo')(y)
+        y = _dense(cfg.d_model, ('mlp', 'embed'), dtype, 'wo',
+                   param_dtype=pdtype, int8=int8)(y)
         if cfg.dropout:
             y = nn.Dropout(cfg.dropout, deterministic=not train)(y)
         return nn.with_logical_constraint(y, ('batch', 'seq', 'embed'))
@@ -178,16 +218,21 @@ class MoeMlpBlock(nn.Module):
             pos, capacity, dtype=jnp.float32)               # [B,T,X,C]
         combine = dispatch * gate[..., None, None]
 
+        # expert weights follow param_dtype like every dense matmul
+        # weight — for MoE they dominate the parameter count, so bf16
+        # masters would be hollow without them (the ROUTER stays f32
+        # by design: routing decisions are precision-sensitive)
+        pdtype = jnp.dtype(cfg.param_dtype)
         w_in = self.param(
             'w_in', nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(),
                 ('expert', 'embed', 'mlp')),
-            (n_x, m, cfg.d_ff))
+            (n_x, m, cfg.d_ff), pdtype)
         w_out = self.param(
             'w_out', nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(),
                 ('expert', 'mlp', 'embed')),
-            (n_x, cfg.d_ff, m))
+            (n_x, cfg.d_ff, m), pdtype)
 
         expert_in = jnp.einsum(
             'btxc,btm->xbcm', dispatch.astype(dtype), x.astype(dtype))
@@ -209,6 +254,9 @@ class DecoderLayer(nn.Module):
     cfg: TransformerConfig
     mesh: Optional[Mesh] = None
     use_moe: bool = False
+    # set by the nn.scan dispatch: a scan body must return
+    # (carry, output), a loop body just the activations
+    scanned: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -216,6 +264,7 @@ class DecoderLayer(nn.Module):
         dtype = jnp.dtype(cfg.dtype)
         norm = lambda name: nn.RMSNorm(  # noqa: E731
             dtype=dtype, name=name,
+            param_dtype=jnp.dtype(cfg.param_dtype),
             scale_init=nn.with_logical_partitioning(
                 nn.initializers.ones, ('norm',)))
         y = norm('norm_attn')(x)
@@ -225,7 +274,8 @@ class DecoderLayer(nn.Module):
             x = x + MoeMlpBlock(cfg, name='moe')(y, train)
         else:
             x = x + MlpBlock(cfg, name='mlp')(y, train)
-        return nn.with_logical_constraint(x, ('batch', 'seq', 'embed'))
+        x = nn.with_logical_constraint(x, ('batch', 'seq', 'embed'))
+        return (x, None) if self.scanned else x
 
 
 class TransformerLM(nn.Module):
@@ -235,12 +285,14 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, train: bool = False):
         cfg = self.cfg
+        _check_precision(cfg)
         dtype = jnp.dtype(cfg.dtype)
+        pdtype = jnp.dtype(cfg.param_dtype)
 
         table = self.param(
             'embed', nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.02), ('vocab', 'embed')),
-            (cfg.vocab_size, cfg.d_model))
+            (cfg.vocab_size, cfg.d_model), pdtype)
         if self.mesh is not None \
                 and self.mesh.shape.get('fsdp', 1) > 1:
             # one-hot matmul decode (the t5x/maxtext TPU idiom): with the
@@ -264,30 +316,64 @@ class TransformerLM(nn.Module):
             'pos_embed',
             nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.02), ('seq', 'embed')),
-            (cfg.max_seq_len, cfg.d_model))
+            (cfg.max_seq_len, cfg.d_model), pdtype)
         x = x + pos[None, :tokens.shape[1], :].astype(dtype)
         x = nn.with_logical_constraint(x, ('batch', 'seq', 'embed'))
 
-        layer_cls = DecoderLayer
-        if cfg.remat:
-            layer_cls = nn.remat(DecoderLayer, static_argnums=(2,))
-        for i in range(cfg.n_layers):
-            # every moe_every-th layer is MoE (Switch convention:
-            # interleave dense and expert layers)
-            use_moe = bool(cfg.n_experts) and \
-                (i % cfg.moe_every == cfg.moe_every - 1)
-            layer = layer_cls(cfg, mesh=self.mesh, use_moe=use_moe,
-                              name=f'layer_{i}')
-            x = layer(x, train) if cfg.remat else layer(x, train=train)
+        use_scan = (not cfg.n_experts) if cfg.scan_layers == 'auto' \
+            else bool(cfg.scan_layers)
+        if use_scan and cfg.n_experts:
+            raise ValueError(
+                'scan_layers=True needs a homogeneous stack — the MoE '
+                'interleave (n_experts>0) makes every moe_every-th '
+                'layer a different program; use scan_layers=False or '
+                "leave it 'auto'")
+        if use_scan:
+            # ONE traced+compiled layer body instead of L: nn.scan
+            # stacks the per-layer params on a leading [L] axis (the
+            # 'layers' logical axis, replicated by the rule table) and
+            # lax.scan's rolled loop dispatches it L times. remat
+            # composes inside the scan (prevent_cse off: the scan
+            # already isolates iterations, and the barrier would block
+            # the layer-boundary fusions)
+            body = DecoderLayer
+            if cfg.remat:
+                body = nn.remat(DecoderLayer, static_argnums=(2,),
+                                prevent_cse=False)
+            scanned = nn.scan(
+                body,
+                variable_axes={'params': 0, 'intermediates': 0},
+                split_rngs={'params': True, 'dropout': True},
+                in_axes=nn.broadcast,
+                length=cfg.n_layers,
+                metadata_params={flax_meta.PARTITION_NAME: 'layers'})
+            x, _ = scanned(cfg, mesh=self.mesh, scanned=True,
+                           name='layers')(x, train)
+        else:
+            layer_cls = DecoderLayer
+            if cfg.remat:
+                layer_cls = nn.remat(DecoderLayer, static_argnums=(2,))
+            for i in range(cfg.n_layers):
+                # every moe_every-th layer is MoE (Switch convention:
+                # interleave dense and expert layers)
+                # preflight: disable=jax-layer-loop
+                use_moe = bool(cfg.n_experts) and \
+                    (i % cfg.moe_every == cfg.moe_every - 1)
+                layer = layer_cls(cfg, mesh=self.mesh, use_moe=use_moe,
+                                  name=f'layer_{i}')
+                x = layer(x, train) if cfg.remat \
+                    else layer(x, train=train)
 
         x = nn.RMSNorm(
-            dtype=dtype, name='norm_final',
+            dtype=dtype, name='norm_final', param_dtype=pdtype,
             scale_init=nn.with_logical_partitioning(
                 nn.initializers.ones, ('norm',)))(x)
-        # tied-untied head: separate projection, vocab sharded over tp
+        # tied-untied head: separate projection, vocab sharded over tp.
+        # Deliberately NOT int8 even at matmul_precision='int8': head
+        # logit drift feeds the loss directly (cf. head_dtype note)
         head_dtype = jnp.dtype(cfg.head_dtype or cfg.dtype)
         logits = _dense(cfg.vocab_size, ('embed', 'vocab'), head_dtype,
-                        'lm_head')(x)
+                        'lm_head', param_dtype=pdtype)(x)
         return nn.with_logical_constraint(
             logits, ('batch', 'seq', 'vocab'))
 

@@ -73,9 +73,18 @@ class ViT(nn.Module):
         x = x + pos[None].astype(dtype)
         x = nn.with_logical_constraint(x, ('batch', 'seq', 'embed'))
 
+        if cfg.scan_layers is True:
+            # loud failure over a silently-ignored knob: ViT keeps the
+            # per-layer loop until its checkpoints need the converter
+            raise ValueError(
+                "ViT does not implement scan_layers=True yet — leave "
+                "it 'auto' (transformer_lm has the scanned stack)")
         layer_cls = DecoderLayer
         if cfg.remat:
             layer_cls = nn.remat(DecoderLayer, static_argnums=(2,))
+        # scan candidate (transformer.py scan_layers is the pattern);
+        # ViT stays per-layer until its checkpoints need the converter
+        # preflight: disable=jax-layer-loop
         for i in range(cfg.n_layers):
             layer = layer_cls(cfg, mesh=self.mesh, name=f'layer_{i}')
             x = layer(x, train) if cfg.remat else layer(x, train=train)
@@ -103,7 +112,10 @@ def _vit(num_classes: int, image_size: int = 32, patch_size: int = 4,
         vocab_size=1,   # unused — no token table in the encoder
         d_model=d_model, n_layers=n_layers, n_heads=n_heads, d_ff=d_ff,
         max_seq_len=(image_size // patch_size) ** 2, dropout=dropout,
-        dtype=dtype, remat=remat, attn_impl=attn_impl, causal=False)
+        dtype=dtype, remat=remat, attn_impl=attn_impl, causal=False,
+        # threaded so an explicit scan_layers=True fails loudly in
+        # __call__ instead of vanishing into **kwargs
+        scan_layers=kwargs.get('scan_layers', 'auto'))
     return ViT(cfg, num_classes=num_classes, patch_size=patch_size,
                mesh=mesh)
 

@@ -1,0 +1,27 @@
+"""Version compat shims for the Pallas TPU API.
+
+One resolver, used by every kernel module: jax renamed
+``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams`` (and the old
+name off again later), so a single hard reference breaks one side or
+the other — on jax 0.4.37 every ``pltpu.CompilerParams(...)`` call in
+the tree raised ``AttributeError`` and took 24 tier-1 tests with it.
+All kernel call sites go through :func:`tpu_compiler_params` instead.
+"""
+
+from jax.experimental.pallas import tpu as pltpu
+
+# prefer the current name, fall back to the pre-rename one; resolved
+# once at import so the per-call cost is a plain function call
+_COMPILER_PARAMS_CLS = getattr(
+    pltpu, 'CompilerParams', None) or getattr(
+    pltpu, 'TPUCompilerParams')
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams(**kwargs)`` under whichever name this
+    jax ships (``CompilerParams`` post-rename, ``TPUCompilerParams``
+    before)."""
+    return _COMPILER_PARAMS_CLS(**kwargs)
+
+
+__all__ = ['tpu_compiler_params']

@@ -54,6 +54,8 @@ from jax import lax
 try:  # pallas is TPU/GPU-oriented; tolerate CPU-only installs
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    from mlcomp_tpu.ops._compat import tpu_compiler_params
     _PALLAS_OK = True
 except Exception:  # pragma: no cover
     _PALLAS_OK = False
@@ -260,7 +262,7 @@ def flash_attention_forward(q, k, v, causal: bool = True,
             pltpu.VMEM((block_q, 128), jnp.float32),   # normaliser
             pltpu.VMEM((block_q, d), jnp.float32),     # output accum
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
     )(qf, kf, vf)
@@ -411,7 +413,7 @@ def flash_attention_backward(q, k, v, out, lse, do,
         ],
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
     )(qf, kf, vf, dof, lsef, delta)
@@ -438,7 +440,7 @@ def flash_attention_backward(q, k, v, out, lse, do,
         out_specs=[k_spec, k_spec],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
     )(qf, kf, vf, dof, lsef, delta)

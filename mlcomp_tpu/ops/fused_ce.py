@@ -39,6 +39,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from mlcomp_tpu.ops._compat import tpu_compiler_params
+
 NEG_INF = -1e30
 
 
@@ -162,7 +164,7 @@ def _pallas_ce_fwd(logits, labels, block_n, block_v, interpret,
             pltpu.VMEM((block_n, 128), jnp.float32),   # picked logit
             pltpu.VMEM((block_n, 128), jnp.float32),   # running sum(x)
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=('parallel', 'arbitrary')),
         interpret=interpret,
     )(logits, y_rep)
@@ -189,7 +191,7 @@ def _pallas_ce_bwd(logits, labels, lse, g, block_n, block_v, interpret,
             pl.BlockSpec((block_n, 128), lambda i, j: (i, 0)),
         ],
         out_specs=pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=('parallel', 'parallel')),
         interpret=interpret,
     )(logits, y_rep, lse_rep, g_rep)

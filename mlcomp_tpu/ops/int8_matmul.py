@@ -46,6 +46,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from mlcomp_tpu.ops._compat import tpu_compiler_params
+
 def quantize_int8(w):
     """Symmetric per-output-channel quantization of a [K, N] weight.
     Returns (w_qt int8 [N, K] — TRANSPOSED, see module docstring —
@@ -118,7 +120,7 @@ def _pallas_int8_matmul(x, w_qt, scale, block_n, block_k,
         ],
         out_specs=pl.BlockSpec((m, block_n), lambda j, kk: (0, j)),
         scratch_shapes=[pltpu.VMEM((m, block_n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=('parallel', 'arbitrary')),
         interpret=interpret,
     )(x.astype(jnp.bfloat16), w_qt, scale.reshape(1, n))
@@ -161,4 +163,116 @@ def int8_matmul(x, w_qt, scale, impl: str = 'auto',
                                interpret=interpret)
 
 
-__all__ = ['quantize_int8', 'int8_matmul', 'reference_int8_matmul']
+# --------------------------------------------------------------- training
+# Dynamic int8 TRAINING matmul (the serving quantizer extended to the
+# train step). Both operands are quantized per step, per channel —
+# activations per ROW (each token/sample scales over its K features),
+# weights per COLUMN (each output channel scales over its K inputs) —
+# the MXU contracts the raw int8 values (cast to bf16: exact, int8
+# fits bf16's mantissa) with f32 accumulation, and both scales apply
+# ONCE to the f32 [M, N] output (the POST-scaling lesson from the
+# serving path, module docstring).
+#
+# Gradients are straight-through on the quantizer (the standard STE of
+# quantized training): the vjp differentiates ``dequant(q(x)) @
+# dequant(q(w))`` treating q∘dequant as identity, so
+#
+#     dx = (dy * sw) @ qw^T        dw = qx^T @ (dy * sx)
+#
+# — the backward contracts the SAME int8 residuals the forward saved.
+# That is the byte story: the residuals held for the backward are int8
+# (4x smaller than f32 saves, 2x smaller than bf16), and every
+# weight/activation operand read in all three matmuls is int8.
+# ``reference_int8_train_matmul`` is the jnp STE oracle the vjp is
+# pinned against in tests (fwd AND grads).
+
+
+def _quantize_rows(x):
+    """Per-ROW symmetric int8 quantization of [M, K]: scale [M]."""
+    x = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x / scale[:, None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _quantize_cols(w):
+    """Per-COLUMN symmetric int8 quantization of [K, N]: scale [N]."""
+    w = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w), axis=0)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale[None, :]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _accum_dot(a, b, dims, compute_dtype):
+    """dot_general with int8 operands cast to the compute dtype (bf16
+    on the MXU path — exact for int8 values) and f32 accumulation."""
+    return jax.lax.dot_general(
+        a.astype(compute_dtype), b.astype(compute_dtype), (dims, ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def reference_int8_train_matmul(x, w, compute_dtype=jnp.bfloat16):
+    """The STE oracle: ``dequant(q(x)) @ dequant(q(w))`` with the
+    quantizer wrapped straight-through (``v + stop_grad(dq(q(v)) - v)``)
+    so ``jax.grad`` of this function produces exactly the gradients the
+    custom vjp must emit. Same cast/accumulation discipline as the fast
+    path so test parity is tight."""
+    def ste(v, axis):
+        v32 = v.astype(jnp.float32)
+        absmax = jnp.max(jnp.abs(v32), axis=axis, keepdims=True)
+        scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+        dq = jnp.clip(jnp.round(v32 / scale), -127, 127) * scale
+        return v32 + jax.lax.stop_gradient(dq - v32)
+
+    y = jax.lax.dot_general(
+        ste(x, 1).astype(compute_dtype), ste(w, 0).astype(compute_dtype),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    return y
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def int8_train_matmul(x, w, compute_dtype=jnp.bfloat16):
+    """``x [M, K] @ w [K, N] -> f32 [M, N]`` with both operands
+    dynamically quantized to int8 per channel (straight-through
+    gradients; see the training section of the module docstring).
+
+    ``compute_dtype`` is the MXU operand dtype for the scale-folded
+    side of each dot (int8 residuals cast exactly; bf16 default —
+    pass f32 for bit-tight CPU parity tests)."""
+    y, _ = _int8_train_fwd(x, w, compute_dtype)
+    return y
+
+
+def _int8_train_fwd(x, w, compute_dtype):
+    qx, sx = _quantize_rows(x)
+    qw, sw = _quantize_cols(w)
+    y = _accum_dot(qx, qw, ((1,), (0,)), compute_dtype)
+    y = y * sx[:, None] * sw[None, :]
+    # zero-size carriers keep the primal dtypes in the residual tree
+    # (a bare np.dtype is not a valid pytree leaf)
+    return y, (qx, sx, qw, sw,
+               jnp.zeros((0,), x.dtype), jnp.zeros((0,), w.dtype))
+
+
+def _int8_train_bwd(compute_dtype, res, dy):
+    qx, sx, qw, sw, x_proto, w_proto = res
+    x_dtype, w_dtype = x_proto.dtype, w_proto.dtype
+    dy = dy.astype(jnp.float32)
+    # dx = dy @ dequant(w)^T: fold the per-column scale into dy so the
+    # weight operand read stays a pure int8 convert
+    dx = _accum_dot((dy * sw[None, :]).astype(compute_dtype), qw,
+                    ((1,), (1,)), compute_dtype)
+    # dw = dequant(x)^T @ dy: the per-row scale folds into dy the same
+    # way, so the saved activation read stays a pure int8 convert
+    dw = _accum_dot(qx, (dy * sx[:, None]).astype(compute_dtype),
+                    ((0,), (0,)), compute_dtype)
+    return dx.astype(x_dtype), dw.astype(w_dtype)
+
+
+int8_train_matmul.defvjp(_int8_train_fwd, _int8_train_bwd)
+
+
+__all__ = ['quantize_int8', 'int8_matmul', 'reference_int8_matmul',
+           'int8_train_matmul', 'reference_int8_train_matmul']

@@ -36,6 +36,8 @@ import jax.numpy as jnp
 try:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    from mlcomp_tpu.ops._compat import tpu_compiler_params
     _PALLAS_OK = True
 except Exception:  # pragma: no cover
     _PALLAS_OK = False
@@ -147,7 +149,7 @@ def serving_stack(x, w_stack, scales=None, feed: bool = True,
             pltpu.VMEM((m, kdim), jnp.bfloat16),   # resident activation
             pltpu.VMEM((m, n), jnp.float32),       # layer accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=('arbitrary', 'arbitrary', 'arbitrary')),
         interpret=interpret,
     )(x.astype(jnp.bfloat16), w_stack,

@@ -25,6 +25,17 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _static_axis_size(axis_name: str) -> int:
+    """``lax.axis_size`` under whichever API this jax ships: the public
+    helper post-0.4.x, ``jax.core.axis_frame`` (returns the bare int
+    size on 0.4.37) before it. The schedule needs the STATIC size —
+    tick count, permute ring, and drain slicing are Python control
+    flow — so a traced ``psum(1, axis)`` cannot substitute."""
+    if hasattr(lax, 'axis_size'):
+        return lax.axis_size(axis_name)
+    return jax.core.axis_frame(axis_name)
+
+
 def stage_apply(layer_fn, stage_params, h):
     """Apply this stage's stack of layers (leading dim = layers on this
     stage) to activation ``h`` — a scan so the layer loop stays compiled
@@ -44,7 +55,7 @@ def pipeline_apply(layer_fn, stage_params, x_microbatches,
     stacked layer params. Returns [M, mb, ...] outputs, valid on every
     rank (the last stage's results are broadcast via psum masking).
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = _static_axis_size(axis_name)
     my_stage = lax.axis_index(axis_name)
     n_micro = x_microbatches.shape[0]
     n_ticks = n_stages + n_micro - 1

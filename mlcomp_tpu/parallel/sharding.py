@@ -39,6 +39,9 @@ DEFAULT_LOGICAL_RULES = (
     # for kernels whose width doesn't divide by the fsdp axis
     ('conv_out', 'fsdp'),
     ('norm', None),
+    # the stacked-layer axis nn.scan inserts (models/transformer.py
+    # scan_layers): every device runs every layer, so it replicates
+    ('layers', None),
 )
 
 

@@ -263,7 +263,23 @@ def restore_checkpoint(directory: str, target: Any,
     try:
         with open(path, 'rb') as fh:
             blob = fh.read()
-        state = serialization.from_bytes(target, blob)
+        try:
+            state = serialization.from_bytes(target, blob)
+        except Exception:
+            # layout bridge: a checkpoint written with the other layer
+            # layout (scan_layers stacked vs per-layer loop,
+            # train/layer_stack.py) restores through the converter; any
+            # other mismatch re-raises into the torn-last fallback below
+            from mlcomp_tpu.train.layer_stack import convert_layer_layout
+            raw = serialization.msgpack_restore(blob)
+            converted = convert_layer_layout(
+                raw, serialization.to_state_dict(target))
+            if converted is None:
+                raise
+            logger.info(
+                'checkpoint %s uses the other layer layout — '
+                'converting (stacked<->per-layer)', path)
+            state = serialization.from_state_dict(target, converted)
     except Exception as e:
         # torn `last` (truncated blob from a crash/power loss the
         # fsync path couldn't cover, or a pre-fsync checkpoint): fall

@@ -569,6 +569,10 @@ def restore_checkpoint_sharded(directory: str, target: Any,
         saved_paths = [tuple(d['path']) for d in reader.leaves]
         got_paths = [p for p, _ in target_leaves]
         if saved_paths != got_paths:
+            converted = _try_layer_layout_restore(folder, target,
+                                                  saved_paths)
+            if converted is not None:
+                return converted, dict(index.get('meta') or {})
             missing = set(saved_paths) ^ set(got_paths)
             raise ValueError(
                 f'checkpoint structure mismatch '
@@ -621,6 +625,73 @@ def restore_checkpoint_sharded(directory: str, target: Any,
         return state, dict(index.get('meta') or {})
     finally:
         reader.close()
+
+
+def _try_layer_layout_restore(folder: str, target: Any,
+                              saved_paths=None):
+    """Cross-layer-layout sharded restore (scan_layers stacked vs
+    per-layer loop, train/layer_stack.py): assemble the saved tree on
+    host, convert, place each leaf onto the target's shardings.
+
+    This is the one restore path that materializes full leaves on one
+    host — a deliberate migration cost, paid once per layout switch,
+    per leaf (never the whole state at once beyond the tree itself).
+    Returns the restored state, or None when the structure mismatch is
+    not a layer-layout difference. ``saved_paths`` (the index's leaf
+    paths) gates that applicability check BEFORE any leaf data is
+    read: a genuinely wrong-architecture mismatch must cost one index
+    read, not a full-checkpoint host assembly."""
+    import jax
+    from flax import serialization
+
+    from mlcomp_tpu.train.layer_stack import (
+        _has_per_layer, _has_stacked, convert_layer_layout,
+    )
+
+    if saved_paths is not None:
+        skeleton = {}
+        for path in saved_paths:
+            _set_path(skeleton, tuple(path), 0)
+        tgt_sd = _state_dict(target)
+        applies = (
+            (_has_stacked(tgt_sd) and _has_per_layer(skeleton)
+             and not _has_stacked(skeleton))
+            or (_has_per_layer(tgt_sd) and _has_stacked(skeleton)
+                and not _has_per_layer(skeleton)))
+        if not applies:
+            return None
+
+    raw = read_checkpoint_tree(folder)
+    converted = convert_layer_layout(raw, _state_dict(target))
+    if converted is None:
+        return None
+    target_leaves = _flatten(_state_dict(target))
+    conv_by_path = dict(_flatten(converted))
+    # exact structure match required BOTH ways: a stacked checkpoint
+    # with MORE layers than the target unstacks into extra layer_i
+    # paths the placement loop below would never look up — without
+    # this guard that truncation restored "successfully" onto a
+    # wrong-architecture state instead of raising
+    extra = set(conv_by_path) - {p for p, _ in target_leaves}
+    if extra:
+        return None
+    placed = {}
+    for path, leaf in target_leaves:
+        if path not in conv_by_path:
+            return None
+        value = conv_by_path[path]
+        if value is _EMPTY:
+            _set_path(placed, path, {})
+            continue
+        if _is_jax_array(leaf):
+            if tuple(np.shape(value)) != tuple(leaf.shape):
+                raise ValueError(
+                    f'leaf {"/".join(path)}: converted shape '
+                    f'{np.shape(value)} vs target {tuple(leaf.shape)}')
+            value = jax.device_put(
+                np.asarray(value, dtype=leaf.dtype), leaf.sharding)
+        _set_path(placed, path, value)
+    return serialization.from_state_dict(target, placed)
 
 
 def read_checkpoint_tree(folder: str) -> dict:

@@ -1,0 +1,144 @@
+"""Stacked ↔ per-layer parameter-tree conversion.
+
+``scan_layers`` (models/transformer.py) changed the decoder stack's
+param layout: the loop's ``layer_0 … layer_{L-1}`` sibling subtrees
+become ONE ``layers`` subtree whose leaves carry a leading ``[L]``
+axis. Checkpoints written in either layout must keep loading — a
+recompile-cheap model flag must never orphan weeks of training — so
+this module converts raw checkpoint state dicts (nested plain dicts of
+host arrays, the wire format both train/checkpoint.py and
+train/ckpt_shard.py speak) between the two layouts, and the restore
+paths call :func:`convert_layer_layout` automatically whenever the
+stored structure doesn't match the requested target.
+
+The transform is structural, not model-specific: ANY dict node whose
+keys include a dense ``layer_0..layer_{k-1}`` run (identical subtree
+structures) stacks, any dict node holding a ``layers`` dict whose
+leaves share a leading dim unstacks. That makes it equally valid for
+``params`` and for the optimizer state (adam's ``mu``/``nu`` mirror
+the param tree, so the same walk converts them), which is what lets a
+whole TrainState cross layouts, not just the weights.
+"""
+
+import re
+from typing import Any, Optional
+
+import numpy as np
+
+_LAYER_RE = re.compile(r'^layer_(\d+)$')
+STACKED_KEY = 'layers'
+
+
+def _layer_run(node: dict) -> Optional[list]:
+    """['layer_0', ..., 'layer_{k-1}'] when node holds a dense run of
+    per-layer dict subtrees, else None."""
+    found = {}
+    for key, value in node.items():
+        m = _LAYER_RE.match(str(key))
+        if m and isinstance(value, dict):
+            found[int(m.group(1))] = key
+    if not found or sorted(found) != list(range(len(found))):
+        return None
+    return [found[i] for i in range(len(found))]
+
+
+def _tree_paths(tree: Any, prefix=()):
+    if isinstance(tree, dict):
+        for key, value in tree.items():
+            yield from _tree_paths(value, prefix + (str(key),))
+    else:
+        yield prefix, tree
+
+
+def stack_layer_tree(tree: Any) -> Any:
+    """Per-layer → stacked: every dense ``layer_0..layer_{k-1}`` run of
+    identically-structured dict siblings becomes one ``layers`` subtree
+    with each leaf ``np.stack``-ed on a new leading axis."""
+    if not isinstance(tree, dict):
+        return tree
+    out = {key: stack_layer_tree(value) for key, value in tree.items()}
+    run = _layer_run(out)
+    if run is None:
+        return out
+    layers = [out.pop(key) for key in run]
+    shapes = [sorted(path for path, _ in _tree_paths(l)) for l in layers]
+    if any(s != shapes[0] for s in shapes[1:]):
+        raise ValueError(
+            'per-layer subtrees differ in structure — a heterogeneous '
+            '(e.g. MoE-interleaved) stack cannot be scanned/stacked')
+
+    def merge(parts):
+        if isinstance(parts[0], dict):
+            return {k: merge([p[k] for p in parts]) for k in parts[0]}
+        return np.stack([np.asarray(p) for p in parts])
+
+    if STACKED_KEY in out:
+        raise ValueError(
+            f'node already has a {STACKED_KEY!r} subtree next to '
+            f'per-layer keys — refusing an ambiguous merge')
+    out[STACKED_KEY] = merge(layers)
+    return out
+
+
+def unstack_layer_tree(tree: Any) -> Any:
+    """Stacked → per-layer: every ``layers`` dict subtree whose leaves
+    share a leading dim L expands back into ``layer_0..layer_{L-1}``."""
+    if not isinstance(tree, dict):
+        return tree
+    out = {key: unstack_layer_tree(value) for key, value in tree.items()}
+    stacked = out.get(STACKED_KEY)
+    if not isinstance(stacked, dict):
+        return out
+    dims = {np.asarray(leaf).shape[0] if np.asarray(leaf).ndim else None
+            for _, leaf in _tree_paths(stacked)}
+    dims.discard(None)
+    if len(dims) != 1:
+        return out      # not a uniform stack — leave untouched
+    n_layers = dims.pop()
+
+    def split(node, i):
+        if isinstance(node, dict):
+            return {k: split(v, i) for k, v in node.items()}
+        return np.asarray(node)[i]
+
+    out.pop(STACKED_KEY)
+    for i in range(n_layers):
+        out[f'layer_{i}'] = split(stacked, i)
+    return out
+
+
+def _has_stacked(tree: Any) -> bool:
+    if not isinstance(tree, dict):
+        return False
+    if isinstance(tree.get(STACKED_KEY), dict):
+        return True
+    return any(_has_stacked(v) for v in tree.values())
+
+
+def _has_per_layer(tree: Any) -> bool:
+    if not isinstance(tree, dict):
+        return False
+    if _layer_run(tree):
+        return True
+    return any(_has_per_layer(v) for v in tree.values())
+
+
+def convert_layer_layout(raw: Any, target_state_dict: Any
+                         ) -> Optional[Any]:
+    """Convert a raw checkpoint state dict toward the layout of
+    ``target_state_dict``. Returns the converted tree, or None when no
+    layer-layout conversion applies (the mismatch is something else —
+    callers fall through to their normal structure-mismatch error)."""
+    want_stacked = _has_stacked(target_state_dict)
+    want_per = _has_per_layer(target_state_dict)
+    have_stacked = _has_stacked(raw)
+    have_per = _has_per_layer(raw)
+    if want_stacked and have_per and not have_stacked:
+        return stack_layer_tree(raw)
+    if want_per and have_stacked and not have_per:
+        return unstack_layer_tree(raw)
+    return None
+
+
+__all__ = ['stack_layer_tree', 'unstack_layer_tree',
+           'convert_layer_layout', 'STACKED_KEY']

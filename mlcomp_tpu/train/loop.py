@@ -487,7 +487,15 @@ def create_train_state(model, optimizer, sample_x, rng,
 
     abstract = jax.eval_shape(init_fn, init_rng)
     shardings = logical_to_sharding(abstract, mesh)
-    with mesh, nn.logical_axis_rules(logical_rules(mesh)):
+    # partitionable threefry for the sharded init: under the legacy
+    # (non-partitionable) RNG, a jitted random draw's VALUES depend on
+    # its out_sharding — the same model inited on a {'pp':4,'dp':2}
+    # mesh vs a {'dp':8} mesh got different weights wherever the
+    # logical rules sharded the leaf differently, breaking every
+    # cross-mesh parity guarantee (pp-vs-dp, ep-vs-dp). Partitionable
+    # draws are sharding-invariant by construction.
+    with mesh, nn.logical_axis_rules(logical_rules(mesh)), \
+            jax.threefry_partitionable(True):
         state = jax.jit(init_fn, out_shardings=shardings)(init_rng)
     return state
 

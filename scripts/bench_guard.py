@@ -1,0 +1,146 @@
+"""Bench regression guard: hold the freshest BENCH_r*.json to named
+floor thresholds.
+
+The bench trajectory is the repo's perf contract — every round's
+headline legs (docs/performance.md) must hold while new paths land.
+This guard encodes the floors (seeded from round 5's published numbers
+minus noise margin) and exits nonzero when a published leg regresses
+below its floor, so CI catches a perf regression the same way it
+catches a failed test.
+
+A leg ABSENT from the JSON is a warning, not a failure, by default:
+the bench sheds optional legs on slow-tunnel days (bench.py
+BENCH_BUDGET_S) and a shed leg is not a regression. ``--strict``
+promotes missing tracked legs to failures (for release gating).
+
+Usage:
+    python scripts/bench_guard.py              # freshest BENCH_r*.json
+    python scripts/bench_guard.py path.json    # explicit file
+    python scripts/bench_guard.py --list       # print the floor table
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: leg -> (direction, floor, description). Directions: 'min' = value
+#: must be >= floor, 'max' = value must be <= floor.
+FLOORS = {
+    # headline legs, seeded from BENCH_r05 (cifar 0.5045, lm 48833,
+    # serving 1.486, dag 2.42) with room for run-to-run tunnel noise
+    'mfu': ('min', 0.48, 'CIFAR bf16 headline MFU'),
+    'lm_tokens_per_sec': ('min', 46000.0,
+                          'flagship LM tokens/sec (bf16 flash)'),
+    'serving_int8_speedup': ('min', 1.35,
+                             'int8 serving-stack speedup vs bf16'),
+    'dag_grid_sched_overhead_pct': ('max', 6.0,
+                                    'grid-DAG scheduling overhead %'),
+    # round-6 legs (ISSUE 8 acceptance bars)
+    'cifar_fused_norm_mfu': ('min', 0.55,
+                             'CIFAR fused-norm headline MFU'),
+    'cifar_fused_norm_byte_reduction_pct': (
+        'min', 20.0, 'fused-norm XLA-billed byte reduction vs BN %'),
+    'lm_scan_compile_reduction_pct': (
+        'min', 40.0, 'scan-over-layers backend compile-time cut %'),
+    'lm_scan_vs_loop_tokens': (
+        'min', 0.90, 'scan tokens/sec parity vs the layer loop '
+                     '(4-step probe; tunnel noise is ±5-7%)'),
+    'lm_wide_int8_vs_bf16': (
+        'min', 1.15, 'int8 training speedup at the wide-GEMM shape'),
+}
+
+
+def freshest_bench(root: str = REPO):
+    """Highest-numbered BENCH_r*.json (falls back to newest mtime for
+    unnumbered files)."""
+    paths = glob.glob(os.path.join(root, 'BENCH_r*.json'))
+    if not paths:
+        return None
+
+    def key(p):
+        m = re.search(r'BENCH_r(\d+)\.json$', p)
+        return (int(m.group(1)) if m else -1, os.path.getmtime(p))
+    return max(paths, key=key)
+
+
+def load_legs(path: str) -> dict:
+    """The leg dict from either wire format: the driver's wrapper
+    ({"parsed": {...}}) or bench.py's own raw JSON line."""
+    with open(path) as fh:
+        data = json.load(fh)
+    if isinstance(data, dict) and isinstance(data.get('parsed'), dict):
+        return data['parsed']
+    if isinstance(data, dict):
+        return data
+    raise ValueError(f'{path}: not a bench JSON object')
+
+
+def check(legs: dict, strict: bool = False):
+    """Returns (failures, warnings) — lists of human-readable lines."""
+    failures, warnings = [], []
+    for name, (direction, floor, desc) in FLOORS.items():
+        value = legs.get(name)
+        if value is None:
+            line = (f'MISSING {name} ({desc}): leg absent from the '
+                    f'bench JSON')
+            (failures if strict else warnings).append(line)
+            continue
+        try:
+            value = float(value)
+        except (TypeError, ValueError):
+            failures.append(
+                f'BAD     {name} ({desc}): non-numeric {value!r}')
+            continue
+        ok = value >= floor if direction == 'min' else value <= floor
+        cmp = '>=' if direction == 'min' else '<='
+        if ok:
+            warnings.append(
+                f'ok      {name} = {value:g} ({cmp} {floor:g})')
+        else:
+            failures.append(
+                f'FLOOR   {name} ({desc}): {value:g} violates '
+                f'{cmp} {floor:g}')
+    return failures, warnings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+    ap.add_argument('path', nargs='?', default=None,
+                    help='bench JSON (default: freshest BENCH_r*.json)')
+    ap.add_argument('--strict', action='store_true',
+                    help='missing tracked legs fail instead of warn')
+    ap.add_argument('--list', action='store_true',
+                    help='print the floor table and exit')
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name, (direction, floor, desc) in FLOORS.items():
+            cmp = '>=' if direction == 'min' else '<='
+            print(f'{name:40s} {cmp} {floor:<10g} {desc}')
+        return 0
+
+    path = args.path or freshest_bench()
+    if path is None:
+        print('bench_guard: no BENCH_r*.json found — nothing to guard')
+        return 0
+    legs = load_legs(path)
+    failures, warnings = check(legs, strict=args.strict)
+    print(f'bench_guard: {os.path.basename(path)}')
+    for line in warnings:
+        print(f'  {line}')
+    for line in failures:
+        print(f'  {line}', file=sys.stderr)
+    if failures:
+        print(f'bench_guard: {len(failures)} floor violation(s)',
+              file=sys.stderr)
+        return 1
+    print('bench_guard: all published legs hold their floors')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -108,6 +108,23 @@ def main():
     build(ResNet(stage_sizes=[2, 2, 2, 2], block=nn.remat(BasicBlock),
                  num_classes=10, cifar_stem=True,
                  dtype=jnp.bfloat16), 'remat')
+    # round-6 byte-count variants (the answers to the no_bn ablation
+    # row below): the fused Pallas norm+act kernel, and no norm at all
+    # (weight-standardized convs + SkipInit)
+    norm_impl = os.environ.get('PROBE_FUSED_NORM_IMPL', 'pallas')
+    try:
+        build(create_model('resnet18', num_classes=10,
+                           dtype='bfloat16', norm='fused',
+                           norm_impl=norm_impl), 'fused')
+    except Exception as e:
+        print(f'fused      FAILED: {type(e).__name__}: {e}',
+              flush=True)
+    try:
+        build(create_model('resnet18', num_classes=10,
+                           dtype='bfloat16', norm='none'), 'ws_skip')
+    except Exception as e:
+        print(f'ws_skip    FAILED: {type(e).__name__}: {e}',
+              flush=True)
 
     import mlcomp_tpu.models.resnet as R
 

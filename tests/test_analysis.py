@@ -149,6 +149,12 @@ def make_outer():
         def step(state, x):
             return state * lr
     return step
+
+@jax.jit
+def run_stack(x, layers):
+    for i in range(12):
+        x = layers[0](x, name=f'layer_{i}')
+    return x
 '''
 
 
@@ -158,7 +164,7 @@ class TestJaxLint:
         assert rules == {
             'jax-donate', 'jax-host-cast', 'jax-host-item',
             'jax-host-numpy', 'jax-debug-print', 'jax-scalar-closure',
-            'jax-jit-in-loop'}
+            'jax-jit-in-loop', 'jax-layer-loop'}
 
     def test_findings_carry_location_and_why(self):
         f = lint_source(LINT_FIXTURE, 'fix.py')[0]
@@ -223,6 +229,68 @@ class TestJaxLint:
 
     def test_syntax_error_is_silent(self):
         assert lint_source('def broken(:', 'b.py') == []
+
+    def test_layer_loop_fires_in_compact_body(self):
+        """The rule also covers @nn.compact model bodies (where layer
+        stacks actually live) — jit traces through them even though
+        the jit call sits a module away."""
+        src = ('import flax.linen as nn\n'
+               'class LM(nn.Module):\n'
+               '    @nn.compact\n'
+               '    def __call__(self, x):\n'
+               '        for i in range(12):\n'
+               "            x = Layer(self.cfg, name=f'l_{i}')(x)\n"
+               '        return x\n')
+        assert [f.rule for f in lint_source(src)] == ['jax-layer-loop']
+
+    def test_layer_loop_heterogeneous_not_flagged(self):
+        """Reading the loop variable anywhere but a name= keyword means
+        per-layer construction differs — a scan cannot roll it."""
+        src = ('import flax.linen as nn\n'
+               'class Net(nn.Module):\n'
+               '    @nn.compact\n'
+               '    def __call__(self, x):\n'
+               '        for i in range(4):\n'
+               '            x = Layer(width=32 * i,\n'
+               "                      name=f'l_{i}')(x)\n"
+               '        return x\n')
+        assert lint_source(src) == []
+
+    def test_layer_loop_numeric_carry_not_flagged(self):
+        """A fixed-iteration numeric loop (Newton steps, repeated
+        elementwise ops) threads a carry but constructs no layer —
+        no name= keyword, no Layer(...)(x) — and must not be
+        flagged."""
+        src = ('import jax\n'
+               'import jax.numpy as jnp\n'
+               '@jax.jit\n'
+               'def smooth(x):\n'
+               '    for _ in range(5):\n'
+               '        x = jnp.tanh(x)\n'
+               '    return x\n')
+        assert lint_source(src) == []
+
+    def test_layer_loop_param_collection_not_flagged(self):
+        """Iterating a per-layer parameter collection (not range) is
+        not the homogeneity signal."""
+        src = ('import jax\n'
+               '@jax.jit\n'
+               'def apply_fn(x, layers):\n'
+               '    for layer in layers:\n'
+               '        x = layer(x)\n'
+               '    return x\n')
+        assert lint_source(src) == []
+
+    def test_layer_loop_suppression(self):
+        src = ('import flax.linen as nn\n'
+               'class LM(nn.Module):\n'
+               '    @nn.compact\n'
+               '    def __call__(self, x):\n'
+               '        # preflight: disable=jax-layer-loop\n'
+               '        for i in range(12):\n'
+               "            x = Layer(self.cfg, name=f'l_{i}')(x)\n"
+               '        return x\n')
+        assert lint_source(src) == []
 
     def test_self_lint_clean(self):
         """The framework is the linter's first customer: every finding

@@ -1,0 +1,95 @@
+"""Bench regression guard (scripts/bench_guard.py): floor semantics,
+wire-format tolerance, freshest-round selection. No jax."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    'bench_guard', os.path.join(
+        os.path.dirname(__file__), '..', 'scripts', 'bench_guard.py'))
+bench_guard = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(bench_guard)
+
+
+def _passing_legs():
+    legs = {}
+    for name, (direction, floor, _) in bench_guard.FLOORS.items():
+        legs[name] = floor * (1.01 if direction == 'min' else 0.99)
+    return legs
+
+
+class TestCheck:
+    def test_all_floors_hold(self):
+        failures, warnings = bench_guard.check(_passing_legs())
+        assert failures == []
+        assert len(warnings) == len(bench_guard.FLOORS)
+
+    def test_min_floor_violation_fails(self):
+        legs = _passing_legs()
+        legs['mfu'] = 0.40                      # floor is 0.48
+        failures, _ = bench_guard.check(legs)
+        assert len(failures) == 1 and 'mfu' in failures[0]
+
+    def test_max_floor_violation_fails(self):
+        legs = _passing_legs()
+        legs['dag_grid_sched_overhead_pct'] = 50.0
+        failures, _ = bench_guard.check(legs)
+        assert any('dag_grid_sched_overhead_pct' in f
+                   for f in failures)
+
+    def test_missing_leg_warns_unless_strict(self):
+        legs = _passing_legs()
+        del legs['lm_wide_int8_vs_bf16']
+        failures, warnings = bench_guard.check(legs)
+        assert failures == []
+        assert any('MISSING' in w for w in warnings)
+        failures, _ = bench_guard.check(legs, strict=True)
+        assert any('lm_wide_int8_vs_bf16' in f for f in failures)
+
+    def test_non_numeric_value_fails(self):
+        legs = _passing_legs()
+        legs['serving_int8_speedup'] = 'broken'
+        failures, _ = bench_guard.check(legs)
+        assert any('BAD' in f for f in failures)
+
+    def test_round6_legs_are_tracked(self):
+        """The ISSUE-8 acceptance legs have registered floors."""
+        for leg in ('cifar_fused_norm_mfu',
+                    'cifar_fused_norm_byte_reduction_pct',
+                    'lm_scan_compile_reduction_pct',
+                    'lm_wide_int8_vs_bf16'):
+            assert leg in bench_guard.FLOORS, leg
+
+
+class TestWire:
+    def test_driver_wrapper_and_raw_format(self, tmp_path):
+        legs = _passing_legs()
+        wrapped = tmp_path / 'BENCH_r07.json'
+        wrapped.write_text(json.dumps({'n': 7, 'parsed': legs}))
+        raw = tmp_path / 'raw.json'
+        raw.write_text(json.dumps(legs))
+        assert bench_guard.load_legs(str(wrapped)) == legs
+        assert bench_guard.load_legs(str(raw)) == legs
+        bad = tmp_path / 'bad.json'
+        bad.write_text('[1, 2]')
+        with pytest.raises(ValueError, match='not a bench'):
+            bench_guard.load_legs(str(bad))
+
+    def test_freshest_picks_highest_round(self, tmp_path):
+        for n in (2, 10, 9):
+            (tmp_path / f'BENCH_r{n:02d}.json').write_text('{}')
+        got = bench_guard.freshest_bench(str(tmp_path))
+        assert got.endswith('BENCH_r10.json')
+        assert bench_guard.freshest_bench(
+            str(tmp_path / 'nothing-here')) is None
+
+    def test_main_exit_codes(self, tmp_path, capsys):
+        path = tmp_path / 'BENCH_r01.json'
+        path.write_text(json.dumps({'parsed': _passing_legs()}))
+        assert bench_guard.main([str(path)]) == 0
+        bad = dict(_passing_legs(), lm_tokens_per_sec=10.0)
+        path.write_text(json.dumps({'parsed': bad}))
+        assert bench_guard.main([str(path)]) == 1

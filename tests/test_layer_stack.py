@@ -1,0 +1,298 @@
+"""Stacked ↔ per-layer checkpoint conversion (train/layer_stack.py)
+and its wiring into both restore paths: ``scan_layers`` changed the
+TransformerLM param layout, and a checkpoint written in either layout
+must keep loading into the other — params AND mirrored optimizer state
+(adam's mu/nu follow the param tree), dense blob and sharded folder
+alike. Plus the bf16-master-weight optimizer wrapper
+(train/optim.make_optimizer master_dtype) the int8-training
+configuration pairs with.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip('jax')
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from mlcomp_tpu.train.layer_stack import (  # noqa: E402
+    convert_layer_layout, stack_layer_tree, unstack_layer_tree,
+)
+
+
+def _per_layer_tree(n_layers=3, with_opt=True, seed=0):
+    rng = np.random.RandomState(seed)
+    params = {'embed': rng.randn(8, 4).astype(np.float32)}
+    for i in range(n_layers):
+        params[f'layer_{i}'] = {
+            'attn': {'kernel': rng.randn(4, 4).astype(np.float32)},
+            'norm': {'scale': rng.rand(4).astype(np.float32)},
+        }
+    tree = {'params': params, 'step': np.asarray(7)}
+    if with_opt:
+        # adam mirrors the param tree — the SAME walk must convert it
+        tree['opt_state'] = {
+            '0': {'mu': {k: (jax.tree.map(np.zeros_like, v)
+                             if isinstance(v, dict) else v)
+                         for k, v in params.items()}},
+        }
+    return tree
+
+
+class TestConverter:
+    def test_round_trip_params_and_opt_state(self):
+        tree = _per_layer_tree()
+        stacked = stack_layer_tree(tree)
+        assert 'layers' in stacked['params']
+        assert 'layer_0' not in stacked['params']
+        k = stacked['params']['layers']['attn']['kernel']
+        assert k.shape == (3, 4, 4)
+        # the optimizer mirror stacked with the same walk
+        assert stacked['opt_state']['0']['mu']['layers'][
+            'attn']['kernel'].shape == (3, 4, 4)
+
+        back = unstack_layer_tree(stacked)
+        orig_flat = jax.tree.leaves(tree)
+        back_flat = jax.tree.leaves(back)
+        assert jax.tree_util.tree_structure(back) \
+            == jax.tree_util.tree_structure(tree)
+        for a, b in zip(orig_flat, back_flat):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_heterogeneous_run_refuses_to_stack(self):
+        tree = _per_layer_tree(with_opt=False)
+        del tree['params']['layer_2']['norm']   # structure differs
+        with pytest.raises(ValueError, match='heterogeneous|differ'):
+            stack_layer_tree(tree)
+
+    def test_sparse_run_left_alone(self):
+        """layer_0, layer_2 without layer_1 is not a dense run — no
+        conversion, no crash."""
+        tree = _per_layer_tree(with_opt=False)
+        del tree['params']['layer_1']
+        out = stack_layer_tree(tree)
+        assert 'layers' not in out['params']
+        assert 'layer_2' in out['params']
+
+    def test_ambiguous_merge_refused(self):
+        tree = _per_layer_tree(with_opt=False)
+        tree['params']['layers'] = {'x': np.zeros(2)}
+        with pytest.raises(ValueError, match='ambiguous'):
+            stack_layer_tree(tree)
+
+    def test_non_uniform_stack_not_unstacked(self):
+        tree = {'layers': {'a': np.zeros((3, 2)), 'b': np.zeros((4, 2))}}
+        out = unstack_layer_tree(tree)
+        assert 'layers' in out      # left untouched
+
+    def test_convert_direction_detection(self):
+        per = _per_layer_tree(with_opt=False)
+        stacked = stack_layer_tree(per)
+        got = convert_layer_layout(per, stacked)
+        assert got is not None and 'layers' in got['params']
+        got = convert_layer_layout(stacked, per)
+        assert got is not None and 'layer_0' in got['params']
+        # same layout on both sides -> no conversion applies
+        assert convert_layer_layout(per, per) is None
+        assert convert_layer_layout({'a': np.zeros(2)}, per) is None
+
+
+class TestDenseCheckpointBridge:
+    def test_per_layer_blob_restores_into_scan_target(self, tmp_path):
+        from mlcomp_tpu.train.checkpoint import (
+            restore_checkpoint, save_checkpoint,
+        )
+        per = _per_layer_tree(seed=3)
+        save_checkpoint(str(tmp_path), per, {'stage': 's1', 'epoch': 1})
+
+        target = jax.tree.map(np.zeros_like, stack_layer_tree(per))
+        restored, meta = restore_checkpoint(str(tmp_path), target)
+        assert meta['epoch'] == 1
+        np.testing.assert_array_equal(
+            restored['params']['layers']['attn']['kernel'],
+            stack_layer_tree(per)['params']['layers']['attn']['kernel'])
+
+    def test_stacked_blob_restores_into_per_layer_target(self,
+                                                         tmp_path):
+        from mlcomp_tpu.train.checkpoint import (
+            restore_checkpoint, save_checkpoint,
+        )
+        per = _per_layer_tree(seed=4)
+        stacked = stack_layer_tree(per)
+        save_checkpoint(str(tmp_path), stacked, {'stage': 's1',
+                                                 'epoch': 2})
+        target = jax.tree.map(np.zeros_like, per)
+        restored, _ = restore_checkpoint(str(tmp_path), target)
+        np.testing.assert_array_equal(
+            restored['params']['layer_1']['attn']['kernel'],
+            per['params']['layer_1']['attn']['kernel'])
+
+    def test_true_mismatch_still_raises(self, tmp_path):
+        """A genuinely different tree is NOT silently converted — the
+        restore falls through its normal mismatch error (and the
+        torn-last -> best fallback, when a best exists)."""
+        from mlcomp_tpu.train.checkpoint import (
+            restore_checkpoint, save_checkpoint,
+        )
+        save_checkpoint(str(tmp_path), {'a': np.zeros(2)}, {'epoch': 0})
+        with pytest.raises(Exception):
+            restore_checkpoint(
+                str(tmp_path),
+                {'completely': {'different': np.zeros(3)}})
+
+
+class TestShardedCheckpointBridge:
+    def _mesh(self):
+        devs = np.array(jax.devices()[:8]).reshape(8)
+        return Mesh(devs, ('fsdp',))
+
+    def test_cross_layout_sharded_restore(self, tmp_path):
+        from mlcomp_tpu.train import ckpt_shard as cs
+        mesh = self._mesh()
+        rng = np.random.RandomState(5)
+        sharding = NamedSharding(mesh, P('fsdp', None))
+        rep = NamedSharding(mesh, P())
+
+        def place(arr, sh):
+            return jax.device_put(jnp.asarray(arr), sh)
+
+        per = {'params': {}}
+        for i in range(2):
+            per['params'][f'layer_{i}'] = {
+                'w': place(rng.randn(16, 4).astype(np.float32),
+                           sharding)}
+        per['params']['embed'] = place(
+            rng.randn(8, 4).astype(np.float32), rep)
+        per['step'] = place(np.asarray(3, np.int32), rep)
+        cs.save_checkpoint_sharded(str(tmp_path), per, {'step': 3})
+
+        # scan-layout target: ONE stacked [2, 16, 4] leaf
+        target = {
+            'params': {
+                'layers': {'w': place(np.zeros((2, 16, 4), np.float32),
+                                      NamedSharding(
+                                          mesh, P(None, 'fsdp')))},
+                'embed': place(np.zeros((8, 4), np.float32), rep),
+            },
+            'step': place(np.asarray(0, np.int32), rep),
+        }
+        restored, meta = cs.restore_checkpoint_sharded(
+            str(tmp_path), target)
+        assert meta['step'] == 3
+        want = np.stack([np.asarray(per['params'][f'layer_{i}']['w'])
+                         for i in range(2)])
+        np.testing.assert_array_equal(
+            np.asarray(restored['params']['layers']['w']), want)
+        # placed onto the TARGET's shardings, not the saved ones
+        assert restored['params']['layers']['w'].sharding \
+            == target['params']['layers']['w'].sharding
+        assert int(restored['step']) == 3
+
+    def test_layer_count_mismatch_still_raises(self, tmp_path):
+        """A stacked checkpoint with MORE layers than the per-layer
+        target must raise, not restore silently truncated — the
+        converter unstacks extra layer_i paths the placement loop
+        would otherwise never look up."""
+        from mlcomp_tpu.train import ckpt_shard as cs
+        mesh = self._mesh()
+        rep = NamedSharding(mesh, P())
+        state = {'params': {'layers': {'w': jax.device_put(
+            jnp.ones((4, 16, 4)),
+            NamedSharding(mesh, P(None, 'fsdp')))}}}
+        cs.save_checkpoint_sharded(str(tmp_path), state, {'step': 1})
+        target = {'params': {
+            f'layer_{i}': {'w': jax.device_put(jnp.zeros((16, 4)),
+                                               rep)}
+            for i in range(2)}}
+        with pytest.raises(ValueError, match='structure mismatch'):
+            cs.restore_checkpoint_sharded(str(tmp_path), target)
+
+    def test_unrelated_mismatch_still_raises(self, tmp_path):
+        from mlcomp_tpu.train import ckpt_shard as cs
+        mesh = self._mesh()
+        rep = NamedSharding(mesh, P())
+        state = {'params': {'w': jax.device_put(
+            jnp.zeros((16, 4)), NamedSharding(mesh, P('fsdp', None)))}}
+        cs.save_checkpoint_sharded(str(tmp_path), state, {'step': 1})
+        target = {'params': {'other': jax.device_put(
+            jnp.zeros((16, 4)), rep)}}
+        with pytest.raises(ValueError, match='structure mismatch'):
+            cs.restore_checkpoint_sharded(str(tmp_path), target)
+
+
+class TestMasterWeightOptimizer:
+    def _grads_params(self, dtype):
+        rng = np.random.RandomState(6)
+        params = {'w': jnp.asarray(rng.randn(8, 4), dtype)}
+        grads = {'w': jnp.asarray(rng.randn(8, 4) * 0.1, dtype)}
+        return params, grads
+
+    def test_moments_stay_f32_updates_match_param_dtype(self):
+        from mlcomp_tpu.train.optim import make_optimizer
+        opt, _ = make_optimizer(
+            {'name': 'adam', 'lr': 1e-2, 'master_dtype': 'bfloat16'}, total_steps=10)
+        params, grads = self._grads_params(jnp.bfloat16)
+        state = opt.init(params)
+        moments = [l for l in jax.tree.leaves(state)
+                   if hasattr(l, 'dtype') and l.ndim > 0]
+        assert all(m.dtype == jnp.float32 for m in moments)
+        updates, _ = opt.update(grads, state, params)
+        assert updates['w'].dtype == jnp.bfloat16
+
+    def test_bf16_master_tracks_f32_trajectory(self):
+        """A few adam steps at bf16 masters stay close to the all-f32
+        trajectory — the wrapper's whole point (bf16-native moment
+        arithmetic would diverge immediately via grad² underflow)."""
+        import optax
+        from mlcomp_tpu.train.optim import make_optimizer
+        opt16, _ = make_optimizer(
+            {'name': 'adam', 'lr': 1e-2, 'master_dtype': 'bfloat16'}, total_steps=10)
+        opt32, _ = make_optimizer(
+            {'name': 'adam', 'lr': 1e-2}, total_steps=10)
+        p32, _ = self._grads_params(jnp.float32)
+        p16 = jax.tree.map(lambda x: x.astype(jnp.bfloat16), p32)
+        s16, s32 = opt16.init(p16), opt32.init(p32)
+        rng = np.random.RandomState(7)
+        for _ in range(5):
+            g = {'w': jnp.asarray(rng.randn(8, 4) * 0.1, jnp.float32)}
+            u16, s16 = opt16.update(
+                jax.tree.map(lambda x: x.astype(jnp.bfloat16), g),
+                s16, p16)
+            u32, s32 = opt32.update(g, s32, p32)
+            p16 = optax.apply_updates(p16, u16)
+            p32 = optax.apply_updates(p32, u32)
+        np.testing.assert_allclose(
+            np.asarray(p16['w'], np.float32), np.asarray(p32['w']),
+            rtol=0.02, atol=0.02)
+
+    def test_accumulation_runs_in_f32(self):
+        """master_weight_update wraps OUTSIDE MultiSteps: bf16 grads
+        are upcast before accumulation, so the running micro-grad
+        average is f32 (accumulating at bf16's 8-bit mantissa loses
+        small contributions every macro step)."""
+        from mlcomp_tpu.train.optim import make_optimizer
+        opt, _ = make_optimizer(
+            {'name': 'adam', 'lr': 1e-2, 'master_dtype': 'bfloat16',
+             'accum_steps': 4}, total_steps=12)
+        params, grads = self._grads_params(jnp.bfloat16)
+        state = opt.init(params)
+        arrays = [l for l in jax.tree.leaves(state)
+                  if hasattr(l, 'dtype') and getattr(l, 'ndim', 0) > 0]
+        # acc_grads AND the inner adam moments: all f32
+        assert arrays and all(a.dtype == jnp.float32 for a in arrays)
+        updates, _ = opt.update(grads, state, params)
+        assert updates['w'].dtype == jnp.bfloat16
+
+    def test_f32_master_is_passthrough(self):
+        from mlcomp_tpu.train.optim import make_optimizer, \
+            master_weight_update
+        import optax
+        inner = optax.sgd(1e-2)
+        assert master_weight_update(inner, 'float32') is inner
+        # and the spec key is accepted end-to-end
+        opt, _ = make_optimizer(
+            {'name': 'sgd', 'lr': 1e-2, 'master_dtype': 'float32'},
+            total_steps=10)
+        params, grads = self._grads_params(jnp.float32)
+        opt.update(grads, opt.init(params), params)

@@ -187,6 +187,18 @@ def test_vit_rejects_bad_patch_size():
                    jnp.zeros((1, 32, 32, 3), jnp.float32))
 
 
+def test_vit_rejects_scan_layers():
+    """ViT keeps the per-layer loop; an explicit scan_layers=True must
+    fail loudly instead of being silently ignored."""
+    model = create_model('vit', num_classes=4, image_size=32,
+                         patch_size=4, d_model=32, n_layers=1,
+                         n_heads=2, d_ff=64, dtype='float32',
+                         scan_layers=True)
+    with pytest.raises(ValueError, match='scan_layers'):
+        model.init(jax.random.PRNGKey(0),
+                   jnp.zeros((1, 32, 32, 3), jnp.float32))
+
+
 def test_vit_rejects_resolution_mismatch():
     """The declared image_size is authoritative — feeding a different
     resolution fails loud instead of silently building a
@@ -233,3 +245,241 @@ def test_drn_keeps_late_stages_dense():
     hw = [f.shape[1:3] for f in feats]
     assert hw[2] == hw[3] == hw[4], hw   # dilated stages keep c3's HW
     assert hw[1][0] == 2 * hw[2][0]      # the one real stride remains
+
+
+# --------------------------------------------- scan-over-layers LM
+
+
+def _lm_kwargs(**over):
+    kw = dict(vocab_size=128, d_model=64, n_layers=3, n_heads=4,
+              d_ff=128, max_seq_len=32, dtype='float32')
+    kw.update(over)
+    return kw
+
+
+def test_transformer_scan_vs_loop_logit_equivalence():
+    """The scanned stack is the SAME program as the loop: init the
+    per-layer model, stack its params with the checkpoint converter
+    (train/layer_stack.py), and the scan model's f32 logits match."""
+    from flax import serialization
+    from mlcomp_tpu.train.layer_stack import (
+        stack_layer_tree, unstack_layer_tree,
+    )
+    loop = create_model('transformer_lm',
+                        **_lm_kwargs(scan_layers=False))
+    scan = create_model('transformer_lm',
+                        **_lm_kwargs(scan_layers=True))
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, (2, 32)), jnp.int32)
+    loop_vars = loop.init(jax.random.PRNGKey(0), tokens)
+    want = loop.apply(loop_vars, tokens)
+
+    scan_shape = jax.eval_shape(
+        lambda: scan.init(jax.random.PRNGKey(0), tokens))
+    stacked = stack_layer_tree(
+        serialization.to_state_dict(loop_vars))
+    scan_vars = serialization.from_state_dict(scan_shape, stacked)
+    got = scan.apply(scan_vars, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    # and back: unstacking the scan params reproduces the loop logits
+    back = serialization.from_state_dict(
+        jax.eval_shape(lambda: loop_vars),
+        unstack_layer_tree(serialization.to_state_dict(scan_vars)))
+    again = loop.apply(back, tokens)
+    np.testing.assert_allclose(np.asarray(again), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_transformer_scan_auto_and_moe_guard():
+    """'auto' scans homogeneous stacks, falls back to the loop for the
+    MoE interleave; an explicit scan_layers=True + MoE is a config
+    error."""
+    tokens = jnp.zeros((1, 16), jnp.int32)
+    moe = create_model('transformer_lm',
+                       **_lm_kwargs(n_experts=2, max_seq_len=16))
+    variables = moe.init(jax.random.PRNGKey(0), tokens)
+    # auto -> loop: the per-layer names are present
+    assert any(k.startswith('layer_') for k in variables['params'])
+    bad = create_model('transformer_lm',
+                       **_lm_kwargs(n_experts=2, scan_layers=True,
+                                    max_seq_len=16))
+    with pytest.raises(ValueError, match='homogeneous'):
+        bad.init(jax.random.PRNGKey(0), tokens)
+    # scan: ONE stacked subtree, leading axis = n_layers
+    scan = create_model('transformer_lm', **_lm_kwargs())
+    svars = scan.init(jax.random.PRNGKey(0), jnp.zeros((1, 32),
+                                                       jnp.int32))
+    assert 'layers' in svars['params']
+    from flax.core import meta as flax_meta
+    qkv = flax_meta.unbox(
+        svars['params']['layers']['attn']['qkv']['kernel'])
+    assert qkv.shape == (3, 64, 3, 4, 16)   # leading [L] stack axis
+
+
+def test_transformer_scan_remat_matches():
+    """remat composes inside the scan (prevent_cse off) without
+    changing the math."""
+    tokens = jnp.asarray(
+        np.random.RandomState(1).randint(0, 128, (2, 32)), jnp.int32)
+    plain = create_model('transformer_lm', **_lm_kwargs())
+    remat = create_model('transformer_lm', **_lm_kwargs(remat=True))
+    variables = plain.init(jax.random.PRNGKey(0), tokens)
+    want = plain.apply(variables, tokens)
+    got = remat.apply(variables, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------- int8 training matmuls
+
+
+def test_transformer_int8_param_tree_interchangeable():
+    """matmul_precision is a property of the STEP, not the state: the
+    int8 model's param tree is identical to bf16's, and the forward
+    stays close to the full-precision logits."""
+    tokens = jnp.asarray(
+        np.random.RandomState(2).randint(0, 128, (2, 32)), jnp.int32)
+    base = create_model('transformer_lm', **_lm_kwargs())
+    quant = create_model('transformer_lm',
+                         **_lm_kwargs(matmul_precision='int8'))
+    variables = base.init(jax.random.PRNGKey(0), tokens)
+    qshape = jax.eval_shape(
+        lambda: quant.init(jax.random.PRNGKey(0), tokens))
+    assert jax.tree_util.tree_structure(variables) \
+        == jax.tree_util.tree_structure(qshape)
+    assert [(l.shape, l.dtype) for l in jax.tree.leaves(variables)] \
+        == [(l.shape, l.dtype) for l in jax.tree.leaves(qshape)]
+
+    # int8 STE forward tracks the exact logits at few-percent level
+    want = np.asarray(base.apply(variables, tokens))
+    got = np.asarray(quant.apply(variables, tokens))
+    denom = np.abs(want).max()
+    assert np.abs(got - want).max() / denom < 0.1
+
+    bad = create_model('transformer_lm',
+                       **_lm_kwargs(matmul_precision='fp4'))
+    with pytest.raises(ValueError, match='matmul_precision'):
+        bad.init(jax.random.PRNGKey(0), tokens)
+
+
+def test_param_dtype_covers_moe_expert_weights():
+    """param_dtype='bfloat16' must reach the MoE expert weights (they
+    dominate a MoE model's parameter count); only the router stays
+    f32 by design."""
+    tokens = jnp.asarray(
+        np.random.RandomState(3).randint(0, 128, (2, 32)), jnp.int32)
+    model = create_model('transformer_lm',
+                         **_lm_kwargs(n_experts=4, moe_every=2,
+                                      param_dtype='bfloat16'))
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), tokens))
+    flat = {jax.tree_util.keystr(k): v for k, v in
+            jax.tree_util.tree_leaves_with_path(shapes)}
+    moe = {k: v for k, v in flat.items() if "'w_in'" in k
+           or "'w_out'" in k}
+    router = {k: v for k, v in flat.items() if "'router'" in k}
+    assert moe and all(v.dtype == jnp.bfloat16 for v in moe.values())
+    assert router and all(v.dtype == jnp.float32
+                          for v in router.values())
+
+
+def test_transformer_int8_grads_flow():
+    """One grad step through the int8 custom vjp inside the full LM."""
+    import optax
+    quant = create_model(
+        'transformer_lm',
+        **_lm_kwargs(matmul_precision='int8', n_layers=2))
+    tokens = jnp.asarray(
+        np.random.RandomState(3).randint(0, 128, (2, 32)), jnp.int32)
+    variables = quant.init(jax.random.PRNGKey(0), tokens)
+
+    def loss_fn(params):
+        logits = quant.apply({'params': params}, tokens)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], tokens[:, 1:]).mean()
+
+    from flax.core import meta as flax_meta
+    grads = flax_meta.unbox(
+        jax.grad(loss_fn)(variables['params']))
+    flat = jax.tree.leaves(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+    # the quantized projections DO receive gradient signal
+    qkv = grads['layers']['attn']['qkv']['kernel']
+    assert np.abs(np.asarray(qkv)).max() > 0
+
+
+# --------------------------------------------- fused-norm CIFAR block
+
+
+def test_resnet_norm_variants_forward():
+    x = jnp.zeros((2, 32, 32, 3))
+    for norm in ('fused', 'none'):
+        model = create_model('resnet18', num_classes=10,
+                             dtype='float32', norm=norm)
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        out, _ = model.apply(variables, x, train=True,
+                             mutable=['batch_stats'])
+        assert out.shape == (2, 10), norm
+        out_eval = model.apply(variables, x, train=False)
+        assert out_eval.shape == (2, 10), norm
+    # 'none' really has no statistics to carry
+    wsmodel = create_model('resnet18', num_classes=10,
+                           dtype='float32', norm='none')
+    ws_vars = wsmodel.init(jax.random.PRNGKey(0), x, train=False)
+    assert 'batch_stats' not in ws_vars
+    with pytest.raises(ValueError, match='unknown norm'):
+        create_model('resnet18', norm='nope', dtype='float32').init(
+            jax.random.PRNGKey(0), x, train=False)
+
+
+def test_resnet_fused_checkpoint_interchanges_with_batch():
+    """The 'fused' variant's variable tree is EXACTLY the 'batch'
+    layout (explicit BatchNorm_i names, unboxed scale/bias, same
+    batch_stats), so a BN-trained checkpoint drives the fused model —
+    and in eval mode (running stats, dense path) bit-identically."""
+    import jax.tree_util as tu
+    x = jnp.zeros((2, 32, 32, 3))
+    mb = create_model('resnet18', num_classes=10, dtype='float32',
+                      norm='batch')
+    mf = create_model('resnet18', num_classes=10, dtype='float32',
+                      norm='fused')
+    vb = mb.init(jax.random.PRNGKey(0), x, train=False)
+    vf = mf.init(jax.random.PRNGKey(0), x, train=False)
+    assert ({tu.keystr(k) for k, _ in tu.tree_leaves_with_path(vb)}
+            == {tu.keystr(k) for k, _ in tu.tree_leaves_with_path(vf)})
+    np.testing.assert_array_equal(
+        np.asarray(mf.apply(vb, x, train=False)),
+        np.asarray(mb.apply(vb, x, train=False)))
+
+
+def test_fused_norm_module_matches_batchnorm():
+    """FusedNormAct (models/resnet.py) reproduces nn.BatchNorm's train
+    numerics (same scale/bias/batch_stats contract) with the relu
+    folded in."""
+    import flax.linen as nn
+    from mlcomp_tpu.models.resnet import FusedNormAct
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(4, 8, 8, 16) * 2 + 1, jnp.float32)
+
+    fused = FusedNormAct(use_running_average=False, act=True,
+                         dtype=jnp.float32)
+    bn = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                      epsilon=1e-5, dtype=jnp.float32)
+    fvars = fused.init(jax.random.PRNGKey(0), x)
+    bvars = bn.init(jax.random.PRNGKey(0), x)
+    got, fups = fused.apply(fvars, x, mutable=['batch_stats'])
+    want, bups = bn.apply(bvars, x, mutable=['batch_stats'])
+    np.testing.assert_allclose(np.asarray(got),
+                               np.maximum(np.asarray(want), 0.0),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(fups['batch_stats']['mean']),
+        np.asarray(bups['batch_stats']['mean']), rtol=1e-5, atol=1e-5)
+
+    # eval path: running stats drive the normalization
+    eval_mod = FusedNormAct(use_running_average=True, act=False,
+                            dtype=jnp.float32)
+    y = eval_mod.apply(fvars, x)
+    assert y.shape == x.shape

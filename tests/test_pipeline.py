@@ -40,6 +40,18 @@ class TestSchedule:
                 jax.jit(lambda v, t: piped.apply(v, t))(var, tokens))
         np.testing.assert_allclose(out1, out0, atol=1e-4)
 
+    def test_unimplemented_knobs_rejected(self):
+        """pipelined_lm must refuse TransformerConfig knobs its raw
+        einsum math does not implement (loud-failure contract), not
+        silently train a different model than the config says."""
+        with pytest.raises(ValueError, match='matmul_precision'):
+            _model(matmul_precision='int8')
+        with pytest.raises(ValueError, match='param_dtype'):
+            _model(param_dtype='bfloat16')
+        with pytest.raises(ValueError, match='scan_layers'):
+            _model(scan_layers=True)
+        _model(scan_layers='auto')      # the default stays accepted
+
     def test_microbatch_count_invariance(self):
         import flax.linen as nn
         import jax

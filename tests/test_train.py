@@ -405,6 +405,55 @@ class TestJaxTrain:
         }, str(tmp_path / 'ck'))
         assert result['best_score'] is not None
 
+    def test_resnet_fused_norm_training(self, tmp_path):
+        """The norm='fused' CIFAR block trains through the executor
+        (auto impl = dense composition on CPU, identical math to the
+        Pallas path's oracle)."""
+        result = run_executor({
+            'model': {'name': 'resnet18', 'num_classes': 4,
+                      'dtype': 'float32', 'norm': 'fused'},
+            'dataset': {'name': 'synthetic_images', 'n_train': 64,
+                        'n_valid': 32, 'image_size': 16, 'num_classes': 4},
+            'batch_size': 16,
+            'stages': [{'name': 's1', 'epochs': 1,
+                        'optimizer': {'name': 'sgd', 'lr': 0.01}}],
+        }, str(tmp_path / 'ck'))
+        assert result['best_score'] is not None
+
+    def test_int8_training_loss_parity(self, tmp_path):
+        """The int8-training configuration end-to-end through the
+        executor config plumbing — matmul_precision + bf16 master
+        weights (param_dtype/master_dtype) — must land within
+        tolerance of the bf16 run's final loss (the acceptance
+        loss-parity gate, scaled down to CPU size)."""
+        spec = {
+            'model': {'name': 'transformer_lm', 'vocab_size': 64,
+                      'd_model': 32, 'n_layers': 2, 'n_heads': 2,
+                      'd_ff': 64, 'max_seq_len': 32,
+                      'dtype': 'float32'},
+            'dataset': {'name': 'synthetic_lm', 'n_train': 256,
+                        'n_valid': 64, 'seq_len': 32, 'vocab_size': 64},
+            'loss': 'lm_ce',
+            'batch_size': 32,
+            'main_metric': 'loss',
+            'minimize': True,
+            'stages': [{'name': 's1', 'epochs': 2,
+                        'optimizer': {'name': 'adamw', 'lr': 3e-3}}],
+        }
+        base = run_executor(dict(spec), str(tmp_path / 'bf16'))
+
+        quant = dict(spec)
+        quant['model'] = dict(
+            spec['model'], matmul_precision='int8',
+            param_dtype='bfloat16')
+        quant['stages'] = [{'name': 's1', 'epochs': 2,
+                            'optimizer': {'name': 'adamw', 'lr': 3e-3,
+                                          'master_dtype': 'bfloat16'}}]
+        got = run_executor(quant, str(tmp_path / 'int8'))
+        assert got['best_score'] < 4.1          # it learned
+        assert abs(got['best_score'] - base['best_score']) < 0.35, \
+            (got['best_score'], base['best_score'])
+
 
 class TestTrainDag:
     def test_jax_train_via_dag(self, session, tmp_path):
