@@ -611,6 +611,235 @@ def bench_fused_ce() -> dict:
     }
 
 
+def bench_fleet() -> dict:
+    """Serving-fleet load-generator leg (server/gateway.py): the
+    routing tier measured end-to-end on loopback with stub replicas —
+    jax-free, so the number isolates what the FLEET adds on top of a
+    replica's own latency (routing, breakers, hedging, shedding).
+
+    Three phases, one gateway:
+
+    1. **sustained** — 6 keep-alive clients drive a 3-replica pool for
+       a fixed window; publishes ``fleet_sustained_qps`` and
+       ``fleet_p99_ms`` (the gateway's rolling window, the same one
+       admission control sheds on).
+    2. **replica kill** — one stub is shut down mid-load;
+       ``fleet_recovery_s`` is the time until the pool is back to 25
+       consecutive successes with recent latency under the SLO, and
+       ``fleet_failed_requests`` counts non-429 client failures during
+       the outage (budget 0: the breaker + hedged retry must absorb
+       the kill).
+    3. **overload** — replicas are made slow (50 ms) against a 20 ms
+       SLO; ``fleet_shed_rate_pct`` is the 429 share once the rolling
+       p99 trips — load shedding must ENGAGE (floor: >1%), or the SLO
+       machinery is decorative.
+    """
+    import http.client
+    import subprocess
+    import threading
+
+    from mlcomp_tpu import TOKEN
+    from mlcomp_tpu.server.gateway import FleetGateway
+
+    # stub replicas as SUBPROCESSES: in-process stub servers would put
+    # three more HTTP stacks behind this process's GIL and the bench
+    # would measure interpreter thrash, not the gateway. POST /delay
+    # retunes their simulated predict time (the overload phase).
+    stub_src = (
+        'import json, sys, time\n'
+        'from http.server import BaseHTTPRequestHandler, '
+        'ThreadingHTTPServer\n'
+        'DELAY = [float(sys.argv[1])]\n'
+        'class Stub(BaseHTTPRequestHandler):\n'
+        '    protocol_version = "HTTP/1.1"\n'
+        '    def log_message(self, *a):\n'
+        '        pass\n'
+        '    def do_POST(self):\n'
+        '        n = int(self.headers.get("Content-Length", 0))\n'
+        '        body = self.rfile.read(n)\n'
+        '        if self.path == "/delay":\n'
+        '            DELAY[0] = float(json.loads(body)["s"])\n'
+        '            blob = b"{}"\n'
+        '        else:\n'
+        '            if DELAY[0]:\n'
+        '                time.sleep(DELAY[0])\n'
+        '            blob = b\'{"y": [0], "ms": 1.0}\'\n'
+        '        self.send_response(200)\n'
+        '        self.send_header("Content-Length", str(len(blob)))\n'
+        '        self.end_headers()\n'
+        '        self.wfile.write(blob)\n'
+        'srv = ThreadingHTTPServer(("127.0.0.1", 0), Stub)\n'
+        'print(srv.server_address[1], flush=True)\n'
+        'srv.serve_forever()\n')
+    procs, ports = [], []
+    for _ in range(3):
+        proc = subprocess.Popen([sys.executable, '-c', stub_src,
+                                 '0.002'], stdout=subprocess.PIPE,
+                                text=True)
+        ports.append(int(proc.stdout.readline()))
+        procs.append(proc)
+
+    def set_delay(port, seconds):
+        conn = http.client.HTTPConnection('127.0.0.1', port, timeout=5)
+        try:
+            conn.request('POST', '/delay',
+                         body=json.dumps({'s': seconds}).encode())
+            conn.getresponse().read()
+        finally:
+            conn.close()
+
+    gw = FleetGateway(port=0, hedge_ratio=0.5,
+                      breaker_kw={'failure_threshold': 1,
+                                  'cooldown_s': 5.0})
+    gw.set_fleet('bench', 1,
+                 [f'http://127.0.0.1:{p}' for p in ports],
+                 slo_p99_ms=250.0, max_pending=512)
+    gw.start_background()
+    headers = {'Authorization': TOKEN,
+               'Content-Type': 'application/json'}
+    codes_lock = threading.Lock()
+    local = threading.local()
+
+    def fire():
+        """One request over this thread's persistent connection (the
+        production client pattern the gateway's HTTP/1.1 keep-alive
+        exists for); a transport error drops the connection."""
+        t0 = time.perf_counter()
+        try:
+            conn = getattr(local, 'conn', None)
+            if conn is None:
+                conn = http.client.HTTPConnection(
+                    '127.0.0.1', gw.port, timeout=10)
+                local.conn = conn
+            conn.request('POST', '/predict/bench',
+                         body=b'{"x": [[1]]}', headers=headers)
+            resp = conn.getresponse()
+            resp.read()
+            code = resp.status
+            if resp.will_close:
+                conn.close()
+                local.conn = None
+        except Exception:
+            code = -1
+            conn = getattr(local, 'conn', None)
+            if conn is not None:
+                conn.close()
+            local.conn = None
+        return code, (time.perf_counter() - t0) * 1e3
+
+    def drive(duration_s, counters, clients=6):
+        stop = time.monotonic() + duration_s
+
+        def client():
+            while time.monotonic() < stop:
+                code, ms = fire()
+                with codes_lock:
+                    counters.setdefault(code, 0)
+                    counters[code] += 1
+                    counters.setdefault('lat', []).append(ms)
+            conn = getattr(local, 'conn', None)
+            if conn is not None:
+                conn.close()
+                local.conn = None
+        threads = [threading.Thread(target=client)
+                   for _ in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    try:
+        # phase 1: sustained QPS at the p99 SLO
+        drive(1.0, {})              # warm connections + window
+        sustained = {}
+        window_s = float(os.environ.get('BENCH_FLEET_WINDOW_S', '4'))
+        t0 = time.perf_counter()
+        drive(window_s, sustained)
+        elapsed = time.perf_counter() - t0
+        ok = sustained.get(200, 0)
+        lat = sorted(sustained.get('lat', [])) or [0.0]
+        qps = ok / elapsed
+        p99 = lat[min(len(lat) - 1, int(0.99 * (len(lat) - 1)))]
+
+        # phase 2: kill one replica mid-load, measure recovery
+        outage = {}
+        recovery = {'t': None}
+        kill_at = [None]
+
+        def killer():
+            time.sleep(0.5)
+            kill_at[0] = time.monotonic()
+            procs[0].kill()         # SIGKILL: the unclean real thing
+
+        probe_stop = [False]
+
+        def recovery_probe():
+            while kill_at[0] is None and not probe_stop[0]:
+                time.sleep(0.01)
+            streak = 0
+            deadline = time.monotonic() + 30.0
+            while not probe_stop[0] and time.monotonic() < deadline:
+                code, ms = fire()
+                if code == 200 and ms < 250.0:
+                    streak += 1
+                    if streak >= 25:
+                        recovery['t'] = time.monotonic() - kill_at[0]
+                        return
+                else:
+                    streak = 0
+                time.sleep(0.005)
+        kt = threading.Thread(target=killer)
+        rt = threading.Thread(target=recovery_probe, daemon=True)
+        kt.start()
+        rt.start()
+        drive(3.0, outage)
+        kt.join()
+        rt.join(timeout=35)
+        probe_stop[0] = True
+        failed = sum(n for code, n in outage.items()
+                     if code not in (200, 429, 'lat'))
+
+        # phase 3: overload — slow replicas against a tight SLO; the
+        # rolling window must trip and shed
+        for port in ports[1:]:
+            set_delay(port, 0.05)
+        route = gw.route('bench')
+        route.slo.slo_p99_ms = 20.0
+        shed_counters = {}
+        shed_before = route.snapshot()['shed']
+        req_before = route.snapshot()['requests']
+        drive(2.5, shed_counters)
+        snap = route.snapshot()
+        shed_n = snap['shed'] - shed_before
+        shed_total = snap['requests'] - req_before
+        shed_rate = 100.0 * shed_n / max(1, shed_total)
+        return {
+            'fleet_sustained_qps': round(qps, 1),
+            'fleet_p99_ms': round(p99, 2),
+            'fleet_recovery_s': round(recovery['t'], 3)
+            if recovery['t'] is not None else None,
+            'fleet_failed_requests': failed,
+            'fleet_shed_rate_pct': round(shed_rate, 1),
+            'fleet_hedges': snap['hedges'],
+            'fleet_config': (
+                f'3 stub replicas (2 ms) behind the routing gateway '
+                f'on loopback, 6 keep-alive clients x '
+                f'{window_s:.0f}s sustained; '
+                f'recovery = kill 1 replica mid-load -> 25 consecutive '
+                f'sub-SLO successes; shed = 50 ms replicas vs 20 ms '
+                f'p99 SLO. Jax-free: measures the routing tier itself '
+                f'(breakers, hedged retry, SLO shedding), not a '
+                f'model.'),
+        }
+    finally:
+        gw.shutdown()
+        for proc in procs:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+
+
 def bench_serving_int8() -> dict:
     """Weight-only int8 serving: an 8-layer K=N=8192 stack at M=64
     tokens. The int8 path is the FUSED serving megakernel
@@ -770,6 +999,17 @@ def main():
     grid_result = {}
     if os.environ.get('BENCH_GRID', '1') == '1' and not over_budget():
         grid_result = bench_grid_dag()
+
+    # the fleet leg is jax-free (stub replicas + the routing gateway on
+    # loopback) and cheap (~12 s) — it runs before this process
+    # initializes jax so it never contends with the chip workloads
+    fleet_result = {}
+    if os.environ.get('BENCH_FLEET', '1') == '1' and not over_budget():
+        try:
+            fleet_result = bench_fleet()
+        except Exception as e:
+            fleet_result = {'fleet_error':
+                            f'{type(e).__name__}: {e}'[:200]}
 
     import jax
     import numpy as np
@@ -1265,6 +1505,7 @@ def main():
     }
     result.update(fused_result)
     result.update(grid_result)
+    result.update(fleet_result)
 
     # second workload: the flagship long-context LM (skippable, and
     # skipped automatically on CPU where a T=8192 dense step is

@@ -22,6 +22,10 @@
   gang-atomic recovery, server/supervisor.py): per gang, the live
   generation, parent status, rank roster with computers and failure
   reasons, ``--json`` for scripts
+- ``mlcomp_tpu fleets``         — serving-fleet state (server/fleet.py):
+  per fleet, the active generation and model, desired vs healthy
+  replica counts, the replica roster with endpoints/states/respawn
+  lineage, ``--json`` for scripts
 """
 
 import json
@@ -349,6 +353,69 @@ def gangs(as_json, limit):
                     + (f" on {r['computer']}" if r['computer'] else ''))
             if r['failure_reason']:
                 line += f" — {r['failure_reason']}"
+            click.echo(line)
+
+
+@main.command()
+@click.option('--json', 'as_json', is_flag=True,
+              help='machine-readable output')
+@click.option('--all', 'show_all', is_flag=True,
+              help='include stopped fleets')
+def fleets(as_json, show_all):
+    """Serving-fleet state (server/fleet.py): one block per fleet —
+    active generation/model, desired vs healthy, and the replica
+    roster with endpoints, probe state and respawn lineage."""
+    from mlcomp_tpu.db.providers import FleetProvider, ReplicaProvider
+    session = Session.create_session()
+    migrate(session)
+    fp, rp = FleetProvider(session), ReplicaProvider(session)
+    items = []
+    for fleet in fp.all():
+        if fleet.status == 'stopped' and not show_all:
+            continue
+        replicas = rp.of_fleet(fleet.id)
+        items.append({
+            'name': fleet.name, 'model': fleet.model,
+            'status': fleet.status,
+            'generation': fleet.generation or 0,
+            'target_generation': fleet.target_generation,
+            'target_model': fleet.target_model,
+            'desired': fleet.desired or 0,
+            'healthy': sum(1 for r in replicas
+                           if r.state == 'healthy'),
+            'slo_p99_ms': fleet.slo_p99_ms,
+            'replicas': [{
+                'id': r.id, 'task': r.task,
+                'generation': r.generation, 'state': r.state,
+                'computer': r.computer, 'url': r.url,
+                'failure_reason': r.failure_reason,
+                'respawned_from': r.respawned_from,
+            } for r in replicas],
+        })
+    if as_json:
+        click.echo(json.dumps(items))
+        return
+    if not items:
+        click.echo('no fleets')
+        return
+    for it in items:
+        head = (f"{it['name']} [{it['status']}] {it['model']} — "
+                f"generation {it['generation']}, "
+                f"{it['healthy']}/{it['desired']} healthy")
+        if it['target_generation']:
+            head += (f", swapping to generation "
+                     f"{it['target_generation']} "
+                     f"({it['target_model']})")
+        click.echo(head)
+        for r in it['replicas']:
+            line = (f"  replica {r['id']} g{r['generation']} "
+                    f"[{r['state']}]"
+                    + (f" on {r['computer']}" if r['computer'] else '')
+                    + (f" {r['url']}" if r['url'] else ''))
+            if r['failure_reason']:
+                line += f" — {r['failure_reason']}"
+            if r['respawned_from']:
+                line += f" (replaced {r['respawned_from']})"
             click.echo(line)
 
 

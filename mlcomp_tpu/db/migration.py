@@ -259,8 +259,19 @@ def _v8_gang(session: Session):
             'ON task("gang_id")')
 
 
+def _v9_fleet(session: Session):
+    """Serving-fleet tables (server/fleet.py): serve_fleet (desired
+    state + rolling-swap machine) and serve_replica (per-replica
+    endpoint/health/lineage). New tables only — CREATE IF NOT EXISTS
+    is safe on a fresh DB whose _v1 already made them."""
+    from mlcomp_tpu.db.models import ServeFleet, ServeReplica
+    for model in (ServeFleet, ServeReplica):
+        for stmt in model.create_table_ddl():   # IF NOT EXISTS — safe
+            session.execute(stmt)
+
+
 MIGRATIONS = [_v1_init, _v2_data, _v3_auth, _v4_telemetry, _v5_preflight,
-              _v6_tracing_alerts, _v7_recovery, _v8_gang]
+              _v6_tracing_alerts, _v7_recovery, _v8_gang, _v9_fleet]
 
 
 def migrate(session: Session = None):

@@ -16,12 +16,14 @@ from mlcomp_tpu.db.models.auxiliary import Auxiliary
 from mlcomp_tpu.db.models.queue import QueueMessage
 from mlcomp_tpu.db.models.auth import DbAudit, WorkerToken
 from mlcomp_tpu.db.models.telemetry import Alert, Metric, TelemetrySpan
+from mlcomp_tpu.db.models.fleet import ServeFleet, ServeReplica
 
 ALL_MODELS = [
     Project, Report, ReportLayout, Dag, Task, TaskDependence, TaskSynced,
     Computer, ComputerUsage, Docker, File, DagStorage, DagLibrary, Log, Step,
     ReportImg, ReportSeries, ReportTasks, Model, Auxiliary, QueueMessage,
     WorkerToken, DbAudit, Metric, TelemetrySpan, DagPreflight, Alert,
+    ServeFleet, ServeReplica,
 ]
 
 __all__ = [m.__name__ for m in ALL_MODELS] + ['ALL_MODELS']

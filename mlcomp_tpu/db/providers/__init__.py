@@ -25,8 +25,10 @@ from mlcomp_tpu.db.providers.auth import (
 from mlcomp_tpu.db.providers.telemetry import (
     AlertProvider, MetricProvider, TelemetrySpanProvider
 )
+from mlcomp_tpu.db.providers.fleet import FleetProvider, ReplicaProvider
 
 __all__ = [
+    'FleetProvider', 'ReplicaProvider',
     'WorkerTokenProvider', 'DbAuditProvider', 'AlertProvider',
     'MetricProvider', 'TelemetrySpanProvider', 'DagPreflightProvider',
     'BaseDataProvider', 'ProjectProvider', 'DagProvider', 'TaskProvider',

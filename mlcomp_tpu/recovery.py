@@ -27,6 +27,9 @@ gang-peer-lost  transient  coordinator-join timeout: a peer rank of the
                            gang never showed up (parallel/distributed.py)
 gang-aborted    transient  the supervisor's gang-abort sweep killed this
                            surviving rank after a sibling failed
+replica-unhealthy transient  the fleet reconciler's health probes gave
+                           up on a serving replica (server/fleet.py) —
+                           it is killed and respawned elsewhere
 executor-error  permanent  any other executor exception (a bug retries
                            into the same bug — fail fast instead)
 ==============  =========  ==================================================
@@ -58,6 +61,7 @@ from mlcomp_tpu.utils.io import yaml_dump, yaml_load
 TRANSIENT_REASONS = frozenset({
     'db-error', 'io-error', 'preempted', 'stall-killed', 'worker-lost',
     'lease-expired', 'gang-peer-lost', 'gang-aborted',
+    'replica-unhealthy',
 })
 
 #: transient reasons that describe gang COLLATERAL, not a root cause —

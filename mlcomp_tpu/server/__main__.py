@@ -8,6 +8,12 @@
   process group (supervisord parity, reference server/__main__.py:44-92;
   no redis child — the queue lives in the DB)
 - ``python -m mlcomp_tpu.server stop`` — terminate the group
+- ``python -m mlcomp_tpu.server gateway`` — the fleet routing gateway
+  (server/gateway.py): health-gated proxy with circuit breaking,
+  hedged retry and SLO-keyed load shedding
+- ``python -m mlcomp_tpu.server fleet-create|fleet-swap|fleet-scale|
+  fleet-stop`` — declare/mutate serving fleets the supervisor's
+  reconciler drives (server/fleet.py)
 """
 
 import os
@@ -138,6 +144,125 @@ def serve(model, project, host, port, batch_size, activation, quantize,
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
     server.serve_forever()
+
+
+@main.command()
+@click.option('--host', default='127.0.0.1')
+@click.option('--port', type=int, default=4300)
+@click.option('--refresh', type=float, default=2.0,
+              help='seconds between DB refreshes of the routing table')
+@click.option('--hedge-ratio', type=float, default=0.1,
+              help='fraction of traffic that may spend a hedged retry')
+@click.option('--flush-every', type=float, default=15.0,
+              help='seconds between telemetry flushes (shed counters, '
+                   'latency buckets) into the DB')
+def gateway(host, port, refresh, hedge_ratio, flush_every):
+    """Run the fleet routing gateway (server/gateway.py): proxies
+    POST /predict/<fleet> to healthy replicas with circuit breaking,
+    hedged retry and SLO-keyed load shedding; GET /health and
+    GET /metrics for introspection. Routing tables refresh from the
+    fleet tables the supervisor's reconciler maintains."""
+    import threading
+    import time as _time
+    from mlcomp_tpu.db.migration import migrate
+    from mlcomp_tpu.server.gateway import FleetGateway
+    session = Session.create_session(key='gateway')
+    migrate(session)
+    gw = FleetGateway(host=host, port=port, session=session,
+                      refresh_s=refresh, hedge_ratio=hedge_ratio)
+    gw.bind()
+
+    def flusher():
+        while True:
+            _time.sleep(flush_every)
+            try:
+                gw.flush_telemetry(session)
+            except Exception:
+                pass
+    threading.Thread(target=flusher, daemon=True).start()
+    print(f'gateway on http://{host}:{gw.port} '
+          f'(refresh {refresh}s, hedge ratio {hedge_ratio})')
+    gw.serve_forever()
+
+
+@main.command(name='fleet-create')
+@click.argument('name')
+@click.argument('model')
+@click.option('--project', default=None)
+@click.option('--replicas', type=int, default=2)
+@click.option('--slo-p99-ms', type=float, default=250.0)
+@click.option('--cores', type=int, default=1)
+@click.option('--batch-size', type=int, default=64)
+@click.option('--quantize', default=None)
+@click.option('--max-pending', type=int, default=256)
+def fleet_create(name, model, project, replicas, slo_p99_ms, cores,
+                 batch_size, quantize, max_pending):
+    """Register a serving fleet: NAME replicas of export MODEL. The
+    supervisor's reconciler brings them up on its next tick."""
+    from mlcomp_tpu.db.migration import migrate
+    from mlcomp_tpu.server.fleet import create_fleet
+    session = Session.create_session(key='fleet_cli')
+    migrate(session)
+    fleet = create_fleet(session, name, model, project=project,
+                         desired=replicas, slo_p99_ms=slo_p99_ms,
+                         cores=cores, batch_size=batch_size,
+                         quantize=quantize, max_pending=max_pending)
+    print(f'fleet {name} (id {fleet.id}): {replicas} replica(s) of '
+          f'{model}, p99 SLO {slo_p99_ms}ms')
+
+
+@main.command(name='fleet-swap')
+@click.argument('name')
+@click.argument('model')
+def fleet_swap(name, model):
+    """Rolling swap of fleet NAME to export MODEL: generation N+1
+    warms up, the router flips, generation N drains — failed warmup
+    auto-rolls-back."""
+    from mlcomp_tpu.db.migration import migrate
+    from mlcomp_tpu.db.providers import FleetProvider
+    from mlcomp_tpu.server.fleet import start_swap
+    session = Session.create_session(key='fleet_cli')
+    migrate(session)
+    fleet = FleetProvider(session).by_name(name)
+    if fleet is None:
+        raise click.ClickException(f'no fleet {name!r}')
+    start_swap(session, fleet, model)
+    print(f'fleet {name}: swapping to {model} as generation '
+          f'{fleet.target_generation}')
+
+
+@main.command(name='fleet-scale')
+@click.argument('name')
+@click.argument('replicas', type=int)
+def fleet_scale(name, replicas):
+    """Change fleet NAME's desired replica count."""
+    from mlcomp_tpu.db.migration import migrate
+    from mlcomp_tpu.db.providers import FleetProvider
+    session = Session.create_session(key='fleet_cli')
+    migrate(session)
+    provider = FleetProvider(session)
+    fleet = provider.by_name(name)
+    if fleet is None:
+        raise click.ClickException(f'no fleet {name!r}')
+    fleet.desired = int(replicas)
+    provider.touch(fleet, ['desired'])
+    print(f'fleet {name}: desired replicas = {replicas}')
+
+
+@main.command(name='fleet-stop')
+@click.argument('name')
+def fleet_stop(name):
+    """Retire fleet NAME: replicas drain and their tasks stop."""
+    from mlcomp_tpu.db.migration import migrate
+    from mlcomp_tpu.db.providers import FleetProvider
+    from mlcomp_tpu.server.fleet import stop_fleet
+    session = Session.create_session(key='fleet_cli')
+    migrate(session)
+    fleet = FleetProvider(session).by_name(name)
+    if fleet is None:
+        raise click.ClickException(f'no fleet {name!r}')
+    stop_fleet(session, fleet)
+    print(f'fleet {name}: stopped')
 
 
 @main.command(name='issue-token')

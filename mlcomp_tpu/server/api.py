@@ -24,6 +24,10 @@ the behavior is subtle):
   ``resume{master_computer, master_task_id, load_last}`` attached,
   including distributed-master discovery (app.py:488-552)
 - ``/api/auxiliary`` supervisor introspection, no auth (app.py:555-558)
+- ``/api/fleets`` (GET or POST, no auth) — serving-fleet roster
+  (replica states, generations, respawn lineage);
+  ``/api/fleet/create|scale|swap|stop`` (auth) — mutate the desired
+  state the supervisor's fleet reconciler drives (server/fleet.py)
 - ``/api/telemetry/series|spans|trace`` (also GET ``/telemetry/series``,
   ``/telemetry/spans``, ``/telemetry/trace/<id>``, no auth) and
   ``/api/telemetry/profile`` — telemetry subsystem reads, the
@@ -531,6 +535,105 @@ def api_auxiliary(data, s):
     return out
 
 
+def api_fleets(data, s):
+    """Serving-fleet roster (server/fleet.py): every fleet with its
+    replica table — states, endpoints, generations, respawn lineage.
+    Same no-auth introspection tier as /api/auxiliary; the dashboard's
+    fleet card and the `mlcomp_tpu fleets` CLI read this."""
+    from mlcomp_tpu.db.providers import FleetProvider, ReplicaProvider
+    fp, rp = FleetProvider(s), ReplicaProvider(s)
+    include_stopped = bool(data.get('all'))
+    out = []
+    for fleet in fp.all():
+        if fleet.status == 'stopped' and not include_stopped:
+            continue
+        replicas = [{
+            'id': r.id, 'task': r.task, 'generation': r.generation,
+            'state': r.state, 'computer': r.computer, 'url': r.url,
+            'probe_failures': r.probe_failures or 0,
+            'failure_reason': r.failure_reason,
+            'respawned_from': r.respawned_from,
+        } for r in rp.of_fleet(fleet.id)]
+        out.append({
+            'id': fleet.id, 'name': fleet.name, 'model': fleet.model,
+            'project': fleet.project, 'status': fleet.status,
+            'desired': fleet.desired or 0,
+            'generation': fleet.generation or 0,
+            'target_generation': fleet.target_generation,
+            'target_model': fleet.target_model,
+            'slo_p99_ms': fleet.slo_p99_ms,
+            'max_pending': fleet.max_pending,
+            'healthy': sum(1 for r in replicas
+                           if r['state'] == 'healthy'),
+            'replicas': replicas,
+        })
+    return {'data': out}
+
+
+def _fleet_or_404(data, s):
+    from mlcomp_tpu.db.providers import FleetProvider
+    fleet = None
+    if data.get('id') is not None:
+        fleet = FleetProvider(s).by_id(_int_arg(data, 'id'))
+    elif data.get('name'):
+        fleet = FleetProvider(s).by_name(data['name'])
+    else:
+        raise ApiError('id or name required')
+    if fleet is None:
+        raise ApiError('fleet not found', status=404)
+    return fleet
+
+
+def api_fleet_create(data, s):
+    from mlcomp_tpu.server.fleet import create_fleet
+    if not data.get('name') or not data.get('model'):
+        raise ApiError('name and model required')
+    kwargs = {}
+    for key in ('project', 'desired', 'slo_p99_ms', 'cores',
+                'batch_size', 'quantize', 'max_pending'):
+        if data.get(key) is not None:
+            kwargs[key] = data[key]
+    try:
+        fleet = create_fleet(s, data['name'], data['model'], **kwargs)
+    except ValueError as e:
+        raise ApiError(str(e), status=409)
+    return {'success': True, 'fleet': fleet.id}
+
+
+def api_fleet_scale(data, s):
+    from mlcomp_tpu.db.providers import FleetProvider
+    fleet = _fleet_or_404(data, s)
+    desired = _int_arg(data, 'desired', required=True)
+    if desired < 0:
+        raise ApiError('desired must be >= 0')
+    fleet.desired = desired
+    FleetProvider(s).touch(fleet, ['desired'])
+    return {'success': True, 'fleet': fleet.id, 'desired': desired}
+
+
+def api_fleet_swap(data, s):
+    """Stage a zero-downtime rolling swap to a new export version —
+    the reconciler warms generation N+1, flips the router, drains N;
+    a failed warmup auto-rolls-back (server/fleet.py)."""
+    from mlcomp_tpu.server.fleet import start_swap
+    fleet = _fleet_or_404(data, s)
+    if not data.get('model'):
+        raise ApiError('model required')
+    try:
+        start_swap(s, fleet, data['model'])
+    except ValueError as e:
+        raise ApiError(str(e), status=409)
+    return {'success': True, 'fleet': fleet.id,
+            'target_generation': fleet.target_generation}
+
+
+def api_fleet_stop(data, s):
+    from mlcomp_tpu.server.fleet import stop_fleet
+    fleet = _fleet_or_404(data, s)
+    stop_fleet(s, fleet)
+    return {'success': True, 'fleet': fleet.id}
+
+
 def _int_arg(data, key, required=False):
     """Parse an integer request arg; bad input is the caller's fault
     (400), not a server error — GET args arrive as strings."""
@@ -899,6 +1002,13 @@ _ROUTES = {
     '/api/dag/toogle_report': (api_dag_toggle_report, True),
     '/api/task/toogle_report': (api_task_toggle_report, True),
     '/api/auxiliary': (api_auxiliary, False),
+    # serving-fleet tier (server/fleet.py): the roster read is the
+    # same introspection tier as auxiliary; mutations need the token
+    '/api/fleets': (api_fleets, False),
+    '/api/fleet/create': (api_fleet_create, True),
+    '/api/fleet/scale': (api_fleet_scale, True),
+    '/api/fleet/swap': (api_fleet_swap, True),
+    '/api/fleet/stop': (api_fleet_stop, True),
     # telemetry reads are an introspection surface like /api/auxiliary
     # (no secrets: metric names + floats); the profile toggle mutates
     # state and needs the token
@@ -930,7 +1040,7 @@ _READ_ONLY_ROUTES = frozenset({
     '/api/img_classify', '/api/img_segment', '/api/config', '/api/graph',
     '/api/dags', '/api/code', '/api/tasks', '/api/task/info',
     '/api/task/steps', '/api/dag/preflight', '/api/auxiliary',
-    '/api/logs', '/api/reports',
+    '/api/fleets', '/api/logs', '/api/reports',
     '/api/report', '/api/report/update_layout_start',
     '/api/telemetry/series', '/api/telemetry/spans',
     '/api/telemetry/trace', '/api/alerts',
@@ -1128,7 +1238,7 @@ class ApiHandler(BaseHTTPRequestHandler):
                     {'success': False, 'reason': 'internal error'}, 500)
             return
         if parsed.path in ('/telemetry/series', '/telemetry/spans',
-                           '/api/alerts') \
+                           '/api/alerts', '/api/fleets') \
                 or parsed.path.startswith('/telemetry/trace/'):
             # GET mirrors of the POST routes (curl-friendly:
             # /telemetry/series?task=7&name=loss,
@@ -1142,6 +1252,8 @@ class ApiHandler(BaseHTTPRequestHandler):
                 handler = api_telemetry_spans
             elif parsed.path == '/api/alerts':
                 handler = api_alerts
+            elif parsed.path == '/api/fleets':
+                handler = api_fleets
             else:
                 data['id'] = parsed.path[len('/telemetry/trace/'):]
                 handler = api_telemetry_trace

@@ -1,0 +1,534 @@
+"""Fleet reconciler — the supervisor's serving half.
+
+A fleet (db/models/fleet.py) declares DESIRED serving state: N
+replicas of one model export behind the routing gateway
+(server/gateway.py). This module is the control loop that drives
+ACTUAL toward it, one supervisor tick at a time, reusing the recovery
+machinery PRs 5–6 built for training tasks:
+
+- **desired-count reconciliation** — each live replica is a
+  supervisor-scheduled Service task (``serve_replica`` executor); a
+  shortfall mints replica rows + task rows that the NORMAL placement
+  path (``process_tasks``) dispatches, including ``retry_exclude`` of
+  the computer that just failed a replica — the same soft exclusion
+  retried trainers get.
+- **health classification** — replicas are probed (``GET /health``)
+  and their tasks watched: a probe-failing replica is classified
+  ``replica-unhealthy`` (transient, recovery taxonomy), its task
+  killed through ``kill_task`` (revoke + SIGTERM, local or routed),
+  and a replacement spawned on another computer EXACTLY ONCE —
+  ``respawned_from`` records the lineage, ``already_respawned`` guards
+  the once. Heartbeat-silent replicas (task ``last_activity`` past the
+  silence horizon) go the same way as ``worker-lost``; a replica whose
+  task died through the lease/watchdog machinery inherits that task's
+  taxonomy verdict.
+- **rolling model swap** — ``start_swap`` stages generation N+1 with a
+  new export; the reconciler brings its replicas up and WARM (healthy
+  probes — the replica executor pays the XLA compile before binding),
+  then flips the fleet's active generation (the gateway's refresh
+  re-routes), marks generation N draining, and retires it after a
+  grace period through ``serve.py``'s graceful drain. A warmup that
+  misses its deadline rolls back: generation N+1 is retired, the
+  active generation never flips, and a critical ``swap-rollback``
+  alert says so.
+
+Every transition is observable: ``fleet.respawn`` / ``fleet.swap``
+metric events feed ``mlcomp_fleet_respawns_total`` /
+``mlcomp_fleet_swaps_total`` on the API server's /metrics, replica
+states and generations are exported as gauges, and the dashboard's
+fleet card renders the roster.
+"""
+
+import json
+import traceback
+
+from mlcomp_tpu.db.enums import ComponentType, TaskStatus, TaskType
+from mlcomp_tpu.db.models import Dag, ServeReplica, Task
+from mlcomp_tpu.db.providers import (
+    DagProvider, FleetProvider, ReplicaProvider, TaskProvider,
+)
+from mlcomp_tpu.utils.io import yaml_dump, yaml_load
+from mlcomp_tpu.utils.misc import now
+
+
+class FleetConfig:
+    """Reconciler knobs; keyword overrides like RecoveryConfig."""
+
+    #: seconds between health probes of one replica
+    probe_interval_s = 5.0
+    #: HTTP timeout of one probe
+    probe_timeout_s = 2.0
+    #: consecutive probe failures before a healthy replica is declared
+    #: unhealthy and replaced
+    unhealthy_after = 3
+    #: task last_activity silence (s) past which a replica with no
+    #: reachable endpoint is declared worker-lost. The replica
+    #: executor's beat touches last_activity every few seconds, so this
+    #: horizon only needs to cover a slow export load + XLA compile.
+    replica_silence_s = 180.0
+    #: seconds a swap's generation N+1 may take to come up healthy
+    #: before the swap rolls back
+    warmup_timeout_s = 300.0
+    #: seconds a draining (post-flip) replica keeps serving before its
+    #: task is retired — covers the gateway's refresh interval plus
+    #: in-flight requests
+    drain_grace_s = 10.0
+
+    def __init__(self, **overrides):
+        for key, value in overrides.items():
+            if not hasattr(type(self), key):
+                raise TypeError(f'unknown fleet option {key!r}')
+            setattr(self, key, type(getattr(type(self), key))(value))
+
+
+def http_probe(url: str, timeout_s: float = 2.0) -> bool:
+    """Default health probe: ``GET <url>/health`` must answer 200 with
+    ``status: ok`` — a draining replica is alive but must leave the
+    routable set. Marked with the probe header so admission control
+    never sheds it."""
+    import urllib.request
+    from mlcomp_tpu.server.gateway import PROBE_HEADER
+    req = urllib.request.Request(url.rstrip('/') + '/health',
+                                 headers={PROBE_HEADER: '1'})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            if resp.status != 200:
+                return False
+            body = json.loads(resp.read())
+            return body.get('status') == 'ok'
+    except Exception:
+        return False
+
+
+def create_fleet(session, name: str, model: str, project: str = None,
+                 desired: int = 2, slo_p99_ms: float = 250.0,
+                 cores: int = 1, batch_size: int = 64,
+                 quantize: str = None, max_pending: int = 256):
+    """Register a fleet (idempotent on name). The reconciler brings the
+    replicas up on the next supervisor tick."""
+    from mlcomp_tpu.db.models import ServeFleet
+    provider = FleetProvider(session)
+    fleet = provider.by_name(name)
+    if fleet is not None:
+        raise ValueError(f'fleet {name!r} already exists (id {fleet.id})')
+    fleet = ServeFleet(
+        name=name, project=project, model=model, desired=int(desired),
+        generation=1, status='active', slo_p99_ms=float(slo_p99_ms),
+        cores=int(cores), batch_size=int(batch_size), quantize=quantize,
+        max_pending=int(max_pending), created=now(), updated=now())
+    provider.add(fleet)
+    return fleet
+
+
+def start_swap(session, fleet, new_model: str):
+    """Stage a rolling swap to ``new_model`` as generation N+1. The
+    reconciler warms the new generation and flips the router; a failed
+    warmup auto-rolls-back."""
+    provider = FleetProvider(session)
+    if fleet.status == 'swapping':
+        raise ValueError(
+            f'fleet {fleet.name!r} already swapping to generation '
+            f'{fleet.target_generation}')
+    fleet.target_generation = int(fleet.generation or 1) + 1
+    fleet.target_model = new_model
+    fleet.swap_started = now()
+    fleet.status = 'swapping'
+    provider.touch(fleet, ['target_generation', 'target_model',
+                           'swap_started', 'status'])
+    return fleet
+
+
+def stop_fleet(session, fleet):
+    """Retire a fleet: mark it stopped and kill every live replica
+    task (graceful — the replica process drains in-flight requests on
+    SIGTERM)."""
+    from mlcomp_tpu.worker.tasks import kill_task
+    provider = FleetProvider(session)
+    rp = ReplicaProvider(session)
+    for replica in rp.live(fleet.id) + rp.of_fleet(
+            fleet.id, states=('draining',)):
+        if replica.task:
+            kill_task(replica.task, session=session)
+        rp.set_state(replica, 'dead', reason='fleet-stopped')
+    fleet.status = 'stopped'
+    provider.touch(fleet, ['status'])
+    return fleet
+
+
+class FleetReconciler:
+    """Drives every active fleet one tick at a time. Constructed by the
+    supervisor (one per SupervisorBuilder); ``probe`` is injectable so
+    tests and the chaos suite control health verdicts without HTTP."""
+
+    def __init__(self, session, logger=None, config: FleetConfig = None,
+                 probe=None, telemetry=None):
+        self.session = session
+        self.logger = logger
+        self.config = config or FleetConfig()
+        self.probe = probe or (
+            lambda url: http_probe(url, self.config.probe_timeout_s))
+        self.telemetry = telemetry
+        self.fleets = FleetProvider(session)
+        self.replicas = ReplicaProvider(session)
+        self.tasks = TaskProvider(session)
+        self.dags = DagProvider(session)
+        self.aux = {}
+
+    # ------------------------------------------------------------- tick
+    def tick(self) -> dict:
+        """One reconciliation pass over every active fleet. Crashes are
+        contained per fleet — the serving control loop must never take
+        the scheduling tick down."""
+        self.aux = {}
+        for fleet in self.fleets.active():
+            try:
+                self._reconcile(fleet)
+            except Exception:
+                if self.logger:
+                    self.logger.error(
+                        f'fleet {fleet.name} reconcile failed:\n'
+                        f'{traceback.format_exc()}',
+                        ComponentType.Supervisor)
+        return self.aux
+
+    def _reconcile(self, fleet):
+        self._absorb_task_verdicts(fleet)
+        self._probe_replicas(fleet)
+        self._retire_draining(fleet)
+        if fleet.status == 'swapping':
+            self._advance_swap(fleet)
+        generations = [(fleet.generation, fleet.model)]
+        if fleet.status == 'swapping' and fleet.target_generation:
+            generations.append((fleet.target_generation,
+                                fleet.target_model or fleet.model))
+        for generation, model in generations:
+            self._ensure_desired(fleet, generation, model)
+
+    # ----------------------------------------------------- health gates
+    def _absorb_task_verdicts(self, fleet):
+        """A replica whose TASK reached a terminal state is dead — the
+        lease/watchdog/taxonomy machinery already judged it; the
+        replica row inherits the verdict and the shortfall respawns it
+        elsewhere (``retry_exclude`` carries the blame)."""
+        for replica in self.replicas.live(fleet.id):
+            task = self.tasks.by_id(replica.task) if replica.task else None
+            if task is None:
+                self.replicas.set_state(replica, 'dead',
+                                        reason='task-missing')
+                continue
+            if task.status == int(TaskStatus.Failed):
+                self.replicas.set_state(
+                    replica, 'dead',
+                    reason=task.failure_reason or 'worker-lost')
+                self._note(fleet, 'replica_dead', replica.id,
+                           task.failure_reason or 'worker-lost')
+            elif task.status in (int(TaskStatus.Stopped),
+                                 int(TaskStatus.Skipped),
+                                 int(TaskStatus.Success)):
+                # a serving task never finishes on its own: Stopped =
+                # operator/swap retirement, Success = clean drain exit
+                self.replicas.set_state(replica, 'dead',
+                                        reason='stopped')
+            elif task.status == int(TaskStatus.InProgress):
+                self._check_silence(fleet, replica, task)
+
+    def _check_silence(self, fleet, replica, task):
+        from mlcomp_tpu.db.core import parse_datetime
+        last = parse_datetime(task.last_activity)
+        if last is None:
+            return
+        silence = (now() - last).total_seconds()
+        if silence <= float(self.config.replica_silence_s):
+            return
+        # heartbeat-silent replica: same verdict the gang-stall rule
+        # gives a silent rank — worker-lost, kill, respawn elsewhere
+        self._fail_replica(fleet, replica, task, 'worker-lost',
+                           f'heartbeat silent {silence:.0f}s')
+
+    def _probe_replicas(self, fleet):
+        from mlcomp_tpu.db.core import parse_datetime
+        due = []
+        for replica in self.replicas.live(fleet.id):
+            if not replica.url:
+                continue        # endpoint not bound yet: silence guard
+            last = parse_datetime(replica.last_probe)
+            if last is not None and (now() - last).total_seconds() < \
+                    float(self.config.probe_interval_s):
+                continue
+            due.append(replica)
+        if not due:
+            return
+        # probes run CONCURRENTLY: this loop lives inside the 1 Hz
+        # supervisor tick, and a dead host's probes each block the
+        # full probe_timeout_s — serially, M unreachable replicas
+        # would freeze lease reclaim/watchdog/placement for 2*M s
+        # exactly when a failure is in progress. One timeout bounds
+        # the whole batch instead.
+        def run_probe(replica):
+            try:
+                return bool(self.probe(replica.url))
+            except Exception:
+                return False
+        if len(due) == 1:
+            verdicts = [run_probe(due[0])]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=min(8, len(due))) \
+                    as pool:
+                verdicts = list(pool.map(run_probe, due))
+        for replica, ok in zip(due, verdicts):
+            flipped = self.replicas.record_probe(
+                replica, ok,
+                unhealthy_after=int(self.config.unhealthy_after))
+            if flipped or (not ok and replica.state == 'unhealthy'
+                           and replica.probe_failures >=
+                           2 * int(self.config.unhealthy_after)):
+                task = self.tasks.by_id(replica.task) \
+                    if replica.task else None
+                self._fail_replica(
+                    fleet, replica, task, 'replica-unhealthy',
+                    f'{replica.probe_failures} consecutive probe '
+                    f'failures')
+
+    def _fail_replica(self, fleet, replica, task, reason: str,
+                      detail: str):
+        """Classify → kill → mark dead. The respawn happens in the
+        SAME tick's desired-count pass, excluding this computer."""
+        from mlcomp_tpu.worker.tasks import kill_task
+        if task is not None and task.status < int(TaskStatus.Failed):
+            self.tasks.fail_with_reason(task, reason)
+        if replica.task:
+            try:
+                kill_task(replica.task, session=self.session)
+            except Exception:
+                pass            # routed kill is best-effort; the row
+        self.replicas.set_state(replica, 'dead', reason=reason)
+        self._note(fleet, 'replica_dead', replica.id,
+                   f'{reason} ({detail})')
+        if self.logger:
+            self.logger.warning(
+                f'fleet {fleet.name}: replica {replica.id} on '
+                f'{replica.computer or "?"} failed {reason} ({detail}) '
+                f'— killing and respawning elsewhere',
+                ComponentType.Supervisor, None, replica.task)
+
+    # ------------------------------------------------------ desired count
+    def _ensure_desired(self, fleet, generation: int, model: str):
+        live = self.replicas.live(fleet.id, generation)
+        need = int(fleet.desired or 0) - len(live)
+        if need <= 0:
+            return
+        # respawn lineage first: each dead-but-never-respawned replica
+        # of this generation seeds ONE replacement, excluding its
+        # computer — the exactly-once contract the chaos suite asserts
+        dead = [r for r in self.replicas.of_fleet(
+                    fleet.id, generation, states=('dead',))
+                if not self.replicas.already_respawned(r.id)]
+        spawned = []
+        for corpse in dead[:need]:
+            exclude = [corpse.computer] if corpse.computer else None
+            replica = self._spawn(fleet, generation, model,
+                                  exclude=exclude,
+                                  respawned_from=corpse.id,
+                                  reason=corpse.failure_reason)
+            spawned.append(replica.id)
+        for _ in range(need - len(spawned)):
+            replica = self._spawn(fleet, generation, model)
+            spawned.append(replica.id)
+        if spawned:
+            self.aux.setdefault('spawned', {}).setdefault(
+                fleet.name, []).extend(spawned)
+
+    def _spawn(self, fleet, generation: int, model: str, exclude=None,
+               respawned_from=None, reason=None) -> ServeReplica:
+        replica = ServeReplica(
+            fleet=fleet.id, generation=int(generation),
+            state='starting', respawned_from=respawned_from,
+            created=now(), updated=now())
+        self.replicas.add(replica)
+        info = {'serve': {
+            'fleet': fleet.id, 'fleet_name': fleet.name,
+            'replica': replica.id, 'generation': int(generation),
+            'model': model, 'project': fleet.project,
+            'batch_size': int(fleet.batch_size or 64),
+            'quantize': fleet.quantize,
+            'max_pending': int(fleet.max_pending or 256),
+        }}
+        if exclude:
+            info['retry_exclude'] = sorted(
+                c for c in exclude if c)
+        task = Task(
+            name=f'serve_{fleet.name}_g{generation}_r{replica.id}',
+            status=int(TaskStatus.NotRan),
+            executor='serve_replica',
+            cores=int(fleet.cores or 1), cores_max=int(fleet.cores or 1),
+            cpu=1, memory=0.1,
+            dag=self._ensure_dag(fleet),
+            type=int(TaskType.Service), single_node=1,
+            additional_info=yaml_dump(info),
+            last_activity=now())
+        self.tasks.add(task)
+        replica.task = task.id
+        self.replicas.update(replica, ['task'])
+        if respawned_from is not None:
+            self._event(fleet, 'fleet.respawn',
+                        {'fleet': fleet.name,
+                         'reason': reason or 'unknown'},
+                        value=replica.id, task=task.id)
+            if self.telemetry is not None:
+                self.telemetry.count('supervisor.fleet_respawns')
+        return replica
+
+    def _ensure_dag(self, fleet) -> int:
+        """The fleet's internal dag row: gives replica tasks a config
+        the worker pipeline can build the ``serve_replica`` executor
+        from (no code snapshot — the executor is a framework builtin,
+        which the preflight gate resolves by AST without importing
+        jax)."""
+        name = f'fleet_{fleet.name}'
+        row = self.session.query_one(
+            'SELECT id FROM dag WHERE name=?', (name,))
+        if row is not None:
+            return row['id']
+        dag = Dag(name=name, created=now(), config=yaml_dump({
+            'info': {'name': name,
+                     'project': fleet.project or 'default'},
+            'executors': {'serve_replica': {'type': 'serve_replica'}},
+        }))
+        self.dags.add(dag)
+        return dag.id
+
+    # ------------------------------------------------------------- swap
+    def _advance_swap(self, fleet):
+        from mlcomp_tpu.db.core import parse_datetime
+        target = fleet.target_generation
+        if not target:          # inconsistent row: heal to active
+            fleet.status = 'active'
+            self.fleets.touch(fleet, ['status'])
+            return
+        live = self.replicas.live(fleet.id, target)
+        healthy = [r for r in live if r.state == 'healthy']
+        if len(healthy) >= int(fleet.desired or 0) and fleet.desired:
+            self._flip(fleet)
+            return
+        started = parse_datetime(fleet.swap_started)
+        if started is not None and \
+                (now() - started).total_seconds() > \
+                float(self.config.warmup_timeout_s):
+            self._rollback(fleet)
+
+    def _flip(self, fleet):
+        """Generation N+1 is warm: route to it, drain N. The flip is
+        one row update — the gateway's next refresh re-reads the
+        active generation and swaps its backend set wholesale."""
+        old_generation = fleet.generation
+        fleet.generation = fleet.target_generation
+        fleet.model = fleet.target_model or fleet.model
+        fleet.target_generation = None
+        fleet.target_model = None
+        fleet.swap_started = None
+        fleet.status = 'active'
+        self.fleets.touch(fleet, ['generation', 'model',
+                                  'target_generation', 'target_model',
+                                  'swap_started', 'status'])
+        for replica in self.replicas.live(fleet.id, old_generation):
+            self.replicas.set_state(replica, 'draining')
+        self._event(fleet, 'fleet.swap',
+                    {'fleet': fleet.name, 'outcome': 'completed'},
+                    value=fleet.generation)
+        self._note(fleet, 'swap', 'completed',
+                   f'generation {fleet.generation}')
+        if self.logger:
+            self.logger.info(
+                f'fleet {fleet.name}: rolling swap complete — '
+                f'generation {fleet.generation} ({fleet.model}) is '
+                f'live, generation {old_generation} draining',
+                ComponentType.Supervisor)
+
+    def _rollback(self, fleet):
+        """Warmup missed its deadline: retire generation N+1, keep
+        serving N, and say so loudly."""
+        from mlcomp_tpu.worker.tasks import kill_task
+        target = fleet.target_generation
+        for replica in self.replicas.live(fleet.id, target):
+            if replica.task:
+                try:
+                    kill_task(replica.task, session=self.session)
+                except Exception:
+                    pass
+            self.replicas.set_state(replica, 'dead',
+                                    reason='swap-rollback')
+        fleet.target_generation = None
+        fleet.target_model = None
+        fleet.swap_started = None
+        fleet.status = 'active'
+        self.fleets.touch(fleet, ['target_generation', 'target_model',
+                                  'swap_started', 'status'])
+        self._event(fleet, 'fleet.swap',
+                    {'fleet': fleet.name, 'outcome': 'rollback'},
+                    value=target)
+        self._note(fleet, 'swap', 'rollback',
+                   f'generation {target} warmup timed out')
+        try:
+            from mlcomp_tpu.db.providers import AlertProvider
+            AlertProvider(self.session).raise_alert(
+                'swap-rollback',
+                f'fleet {fleet.name}: generation {target} warmup '
+                f'exceeded {self.config.warmup_timeout_s:.0f}s — '
+                f'rolled back to generation {fleet.generation}',
+                severity='critical',
+                details={'fleet': fleet.name, 'generation': target})
+        except Exception:
+            pass                # alerting must not block the rollback
+        if self.logger:
+            self.logger.error(
+                f'fleet {fleet.name}: swap to generation {target} '
+                f'rolled back (warmup timeout)',
+                ComponentType.Supervisor)
+
+    def _retire_draining(self, fleet):
+        """Draining replicas keep serving through the drain grace (the
+        gateway has already stopped routing to them), then their tasks
+        are stopped — serve.py's SIGTERM path finishes what's in
+        flight. A drained task reaching a terminal state marks the
+        replica dead in ``_absorb_task_verdicts``' next pass."""
+        from mlcomp_tpu.db.core import parse_datetime
+        from mlcomp_tpu.worker.tasks import kill_task
+        for replica in self.replicas.of_fleet(fleet.id,
+                                              states=('draining',)):
+            task = self.tasks.by_id(replica.task) if replica.task else None
+            if task is None or task.status > int(TaskStatus.InProgress):
+                self.replicas.set_state(replica, 'dead',
+                                        reason='drained')
+                continue
+            since = parse_datetime(replica.updated)
+            if since is not None and \
+                    (now() - since).total_seconds() < \
+                    float(self.config.drain_grace_s):
+                continue
+            try:
+                kill_task(replica.task, session=self.session)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------ observability
+    def _event(self, fleet, name: str, tags: dict, value=1.0,
+               task=None):
+        """Immediate metric event row (like the supervisor's
+        task.retry/gang.generation events) — the windowed /metrics
+        scans and the dashboard timeline read these."""
+        from mlcomp_tpu.db.providers import MetricProvider
+        try:
+            MetricProvider(self.session).add_many([
+                (task, name, 'counter', None, float(value), now(),
+                 'supervisor', json.dumps(tags))])
+        except Exception:
+            pass                # observability must not block the loop
+
+    def _note(self, fleet, kind: str, *detail):
+        self.aux.setdefault(kind, {}).setdefault(
+            fleet.name, []).append(' '.join(str(d) for d in detail))
+
+
+__all__ = ['FleetReconciler', 'FleetConfig', 'create_fleet',
+           'start_swap', 'stop_fleet', 'http_probe']

@@ -27,7 +27,9 @@ inline SVG) covering the same surfaces:
   computer/reason, the gang.generation bump timeline — elastic
   gang-atomic recovery), and on-demand profiler start/stop buttons
 - supervisor tab: watchdog alerts card (open alerts + resolve button,
-  telemetry/watchdog.py) above the decision trace
+  telemetry/watchdog.py) above the decision trace, and a serving-
+  fleets card (server/fleet.py: per-fleet generation/model, desired vs
+  healthy, replica roster with endpoints/states/respawn lineage)
 - report detail: LAYOUT-DRIVEN rendering (reference
   db/report_info/info.py:28-129 consumed by the SPA's report renderer):
   panels of metric series, img_classify gallery with confusion-matrix
@@ -558,6 +560,50 @@ function alertsCard(alerts) {
         >resolve</button></td></tr>`).join('') + '</table>';
 }
 
+async function fleetScale(name) {
+  // serving-fleet desired-count change (server/fleet.py reconciler
+  // drives actual toward it on the next supervisor tick)
+  const n = prompt('desired replicas for fleet '+name+':');
+  if (n == null || n === '') return;
+  await api('fleet/scale', {name, desired: +n});
+  render();
+}
+
+async function fleetSwap(name) {
+  // zero-downtime rolling swap: generation N+1 warms, router flips,
+  // N drains; failed warmup auto-rolls-back
+  const model = prompt('new export model for rolling swap of '
+                       +name+':');
+  if (!model) return;
+  await api('fleet/swap', {name, model});
+  render();
+}
+
+async function fleetStop(name) {
+  if (!confirm('stop fleet '+name+' (replicas drain, tasks stop)?'))
+    return;
+  await api('fleet/stop', {name});
+  render();
+}
+
+function fleetCreateDialog() {
+  dialog('create serving fleet', `
+    <div class="formrow"><label>name</label>
+      <input id="fname" style="width:100%"></div>
+    <div class="formrow"><label>model export</label>
+      <input id="fmodel" style="width:100%"></div>
+    <div class="formrow"><label>replicas</label>
+      <input id="freps" value="2" style="width:100%"></div>
+    <div class="formrow"><label>p99 SLO (ms)</label>
+      <input id="fslo" value="250" style="width:100%"></div>`,
+    async d => {
+      await api('fleet/create', {
+        name: fval(d, 'fname'), model: fval(d, 'fmodel'),
+        desired: +fval(d, 'freps') || 2,
+        slo_p99_ms: +fval(d, 'fslo') || 250});
+    });
+}
+
 async function viewSupervisor(el) {
   const res = await api('auxiliary');
   // db_audit needs auth while auxiliary does not — don't let a 401
@@ -617,6 +663,46 @@ async function viewSupervisor(el) {
           ? ' (STALE '+esc(s.age_s)+'s)' : ''}</td></tr>`;
         }).join('')
       + '</table>'));
+  // serving fleets (server/fleet.py): the self-healing replica-pool
+  // tier — desired vs healthy, swap generations, respawn lineage.
+  // Dead rows render dim: they are the audit trail of the healing
+  let fleets = {data: []};
+  try { fleets = await api('fleets'); } catch (e) {}
+  if (fleets && fleets.success === false) fleets = {data: []};
+  el.appendChild(h('<h3>serving fleets <button class="btn" '
+    + 'onclick="fleetCreateDialog()">create fleet</button></h3>'));
+  if ((fleets.data||[]).length)
+    el.appendChild(h('<div class="cards">'
+      + fleets.data.map(f => {
+          const state = f.status === 'swapping'
+            ? `swapping to g${f.target_generation}
+               (${esc(f.target_model||'')})`
+            : esc(f.status);
+          return `<div class="card">
+        <h3>${esc(f.name)} — g${f.generation} ${esc(f.model)}</h3>
+        <div>${f.healthy}/${f.desired} healthy · ${state}
+          · p99 SLO ${f.slo_p99_ms} ms</div>
+        <div>
+          <button class="btn"
+            onclick="fleetScale('${esc(f.name)}')">scale</button>
+          <button class="btn"
+            onclick="fleetSwap('${esc(f.name)}')">swap</button>
+          <button class="btn"
+            onclick="fleetStop('${esc(f.name)}')">stop</button>
+        </div>
+        <table><tr><th>replica</th><th>gen</th><th>state</th>
+          <th>computer</th><th>endpoint</th><th>reason</th></tr>
+        ${(f.replicas||[]).map(r => `<tr${r.state==='dead'
+            ? ' class="dim"' : ''}>
+          <td>${r.id}${r.respawned_from
+            ? ' <span class="dim">replaces '+r.respawned_from+'</span>'
+            : ''}</td>
+          <td>${r.generation}</td><td>${esc(r.state)}</td>
+          <td>${esc(r.computer||'')}</td>
+          <td style="font-family:monospace">${esc(r.url||'')}</td>
+          <td>${esc(r.failure_reason||'')}</td></tr>`).join('')}
+        </table></div>`;
+        }).join('') + '</div>'));
   const np = sup.not_placed || {};
   if (Object.keys(np).length)
     el.appendChild(h('<h3>not placed (reasons)</h3><table>'

@@ -253,6 +253,16 @@ class _ServedModel:
         return False
 
     def handle_predict(self, body: dict):
+        # chaos seams (mlcomp_tpu/testing/faults.py): serve.request is
+        # the generic raise/sleep hook; replica.slow models a degraded
+        # replica (latency SLO breach without death); replica.crash an
+        # unclean serving-box death mid-load (action 'exit' — no drain,
+        # exactly like the real thing). Disabled cost: one module-
+        # global check each.
+        from mlcomp_tpu.testing.faults import fault_point
+        fault_point('serve.request', model=self.name)
+        fault_point('replica.slow', model=self.name)
+        fault_point('replica.crash', model=self.name, phase='request')
         x = body.get('x')
         if x is None:
             raise ValueError("body must carry 'x': [[...], ...]")
@@ -400,9 +410,12 @@ class ModelServer:
         self._serving = False
         self._closed = False
         self._draining = False
-        # HTTP-level in-flight count, incremented BEFORE the draining
-        # check — drain() waits on this, not the models' pending, so a
-        # request between accept and admission can't slip the drain
+        # HTTP-level in-flight count. The admission decision (serve vs
+        # 503) is taken under _inflight_lock at the same instant the
+        # request counts itself in, and drain() flips _draining under
+        # that same lock — so every request is either admitted (drain
+        # waits for it on this counter) or rejected, with no window
+        # where an accepted request is 503'd by the drain waiting on it
         self._http_inflight = 0
         self._inflight_lock = threading.Lock()
 
@@ -456,6 +469,11 @@ class ModelServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # keep-alive (every response carries Content-Length): the
+            # fleet gateway pools persistent connections per replica —
+            # HTTP/1.0 close-per-request would void the pool
+            protocol_version = 'HTTP/1.1'
+
             def log_message(self, *a):
                 pass
 
@@ -499,27 +517,53 @@ class ModelServer:
                 self._send(200, payload)
 
             def do_POST(self):
+                # admission is decided HERE, under the same lock
+                # drain() flips _draining under: a request accepted
+                # (inflight counted) before the flip is served to
+                # completion — drain waits on the counter — and one
+                # arriving after gets a clean 503. Deciding later (in
+                # _do_post, as this code once did) left a window where
+                # an accepted-but-not-yet-admitted request was 503'd by
+                # the very drain that was waiting for it, which is how
+                # a rolling swap fails the requests it promised not to.
                 with server._inflight_lock:
                     server._http_inflight += 1
+                    admitted = not server._draining
                 try:
-                    self._do_post()
+                    self._do_post(admitted)
                 finally:
                     with server._inflight_lock:
                         server._http_inflight -= 1
 
-            def _do_post(self):
+            def _do_post(self, admitted: bool):
+                # consume the request body FIRST, whatever the answer:
+                # under HTTP/1.1 keep-alive an unread body would be
+                # parsed as the NEXT request line on the same
+                # connection — the gateway's pooled connections would
+                # desync on every early return (404/401/drain-503)
+                n = int(self.headers.get('Content-Length', 0))
+                raw = self.rfile.read(n) if n else b''
                 model, err = server._route(self.path)
                 if err is not None:
                     return self._send(*err)
                 supplied = self.headers.get('Authorization', '').strip()
                 if supplied != server.token:
                     return self._send(401, {'error': 'unauthorized'})
-                if server._draining:
-                    return self._send(503, {
-                        'error': 'server draining — shutting down'})
+                if not admitted:
+                    # Retry-After: the router's cue to fail over to a
+                    # live replica instead of surfacing the drain
+                    self.send_response(503)
+                    blob = json.dumps({
+                        'error': 'server draining — shutting down',
+                        'retry_after_s': 1}).encode()
+                    self.send_header('Content-Type', 'application/json')
+                    self.send_header('Content-Length', str(len(blob)))
+                    self.send_header('Retry-After', '1')
+                    self.end_headers()
+                    self.wfile.write(blob)
+                    return
                 try:
-                    n = int(self.headers.get('Content-Length', 0))
-                    body = json.loads(self.rfile.read(n) or '{}')
+                    body = json.loads(raw or '{}')
                     self._send(200, model.handle_predict(body))
                 except Backpressure as e:
                     self._send(429, {'error': str(e)})
@@ -640,8 +684,13 @@ class ModelServer:
         """Stop admitting predicts (503) and wait for in-flight ones to
         finish. Returns True when everything drained in time. Traffic
         steering learns FIRST: the registry heartbeat deregisters and
-        /health flips to 'draining' before any predict is rejected."""
-        self._draining = True
+        /health flips to 'draining' before any predict is rejected.
+        The flag flips under _inflight_lock — the same lock do_POST
+        counts itself in under — so every request is EITHER admitted
+        (and waited for below) or cleanly 503'd, never both-neither
+        (the drain/admission race)."""
+        with self._inflight_lock:
+            self._draining = True
         self._stop_heartbeat()
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:

@@ -39,12 +39,15 @@ from mlcomp_tpu.utils.misc import now
 class SupervisorBuilder:
     def __init__(self, session: Session = None, logger=None,
                  queue_liveness_window: float = 15.0,
-                 recovery_config=None):
+                 recovery_config=None, fleet_config=None,
+                 fleet_probe=None):
         from mlcomp_tpu.recovery import RecoveryConfig
         self.session = session or Session.create_session(key='supervisor')
         self.logger = logger
         self.queue_liveness_window = queue_liveness_window
         self.recovery_config = recovery_config or RecoveryConfig()
+        self.fleet_config = fleet_config
+        self.fleet_probe = fleet_probe
         self.provider = TaskProvider(self.session)
         self.computer_provider = ComputerProvider(self.session)
         self.docker_provider = DockerProvider(self.session)
@@ -69,6 +72,14 @@ class SupervisorBuilder:
         # span durations and metric series; rate-limits itself inside
         # the tick, so the scheduling hot path pays a clock read
         self.watchdog = Watchdog(self.session, logger=logger)
+        # serving-fleet reconciler (server/fleet.py): replica pools,
+        # health-gated respawn, rolling swaps — runs inside the tick,
+        # before load_tasks, so a spawned replica task dispatches on
+        # the same tick through the normal placement path
+        from mlcomp_tpu.server.fleet import FleetReconciler
+        self.fleet_reconciler = FleetReconciler(
+            self.session, logger=logger, config=fleet_config,
+            probe=fleet_probe, telemetry=self.telemetry)
         self._last_claim_ts = now()
         # dag id -> [error findings] ([] = passed); filled lazily the
         # first time a NotRan task of that dag reaches placement
@@ -878,6 +889,23 @@ class SupervisorBuilder:
                 ComponentType.Supervisor, None, task.id)
         return None, detail
 
+    def process_fleets(self):
+        """Serving-fleet reconciliation (server/fleet.py): desired
+        replica counts, health-gated respawn, rolling swaps. Same
+        containment contract as recovery — a reconciler crash never
+        takes the scheduling tick down (and per-fleet crashes are
+        contained inside tick())."""
+        try:
+            fleet_aux = self.fleet_reconciler.tick()
+            if fleet_aux:
+                self.aux['fleets'] = fleet_aux
+        except Exception:
+            if self.logger:
+                self.logger.error(
+                    f'fleet reconciliation failed:\n'
+                    f'{traceback.format_exc()}',
+                    ComponentType.Supervisor)
+
     # ------------------------------------------------------------ preflight
     def dag_preflight_errors(self, dag_id: int) -> list:
         """Error findings for a dag, computed once per supervisor
@@ -1090,6 +1118,7 @@ class SupervisorBuilder:
             # recovery BEFORE load_tasks: a task requeued this tick
             # re-loads as NotRan below and can re-dispatch immediately
             self.process_recovery()
+            self.process_fleets()
             self.load_tasks()
             self.load_computers()
             self.process_tasks()
@@ -1114,7 +1143,9 @@ class SupervisorBuilder:
                 self.logger = create_logger(self.session)
             self.__init__(session=self.session, logger=self.logger,
                           queue_liveness_window=self.queue_liveness_window,
-                          recovery_config=self.recovery_config)
+                          recovery_config=self.recovery_config,
+                          fleet_config=self.fleet_config,
+                          fleet_probe=self.fleet_probe)
 
 
 def register_supervisor(session: Session = None, logger=None,

@@ -41,7 +41,10 @@ REQUIRED_FAMILIES = (
     'mlcomp_dispatch_latency_seconds', 'mlcomp_step_phase_ms',
     'mlcomp_pipeline_efficiency', 'mlcomp_compile_events',
     'mlcomp_task_retries', 'mlcomp_gang_generations',
-    'mlcomp_serving_latency_ms', 'mlcomp_scrape_errors',
+    'mlcomp_serving_latency_ms',
+    'mlcomp_fleet_replicas', 'mlcomp_fleet_generation',
+    'mlcomp_fleet_shed', 'mlcomp_fleet_respawns',
+    'mlcomp_fleet_swaps', 'mlcomp_scrape_errors',
 )
 
 
@@ -453,6 +456,76 @@ def _collect_serving_latency(session, samples):
                                 mean[1] * count[1]))
 
 
+def _collect_fleet_replicas(session, samples):
+    """``mlcomp_fleet_replicas{fleet,state}`` — the replica-pool
+    roster the reconciler maintains (db/models/fleet.py). Dead rows
+    stay counted: a fleet whose dead count climbs while healthy holds
+    at desired is healing correctly; one whose healthy count drops is
+    not — both readable from the same gauge."""
+    from mlcomp_tpu.db.providers.fleet import ReplicaProvider
+    for fleet, states in sorted(
+            ReplicaProvider(session).states_by_fleet().items()):
+        for state, n in sorted(states.items()):
+            samples.append(('', {'fleet': fleet, 'state': state}, n))
+
+
+def _collect_fleet_generations(session, samples):
+    for r in session.query(
+            "SELECT name, generation FROM serve_fleet "
+            "WHERE status != 'stopped'"):
+        samples.append(('', {'fleet': r['name']}, r['generation'] or 0))
+
+
+def _collect_fleet_shed(session, samples):
+    """``mlcomp_fleet_shed_total{fleet}`` from the gateway's flushed
+    cumulative gauge rows (``fleet.<name>.shed_cum``) — latest row per
+    fleet; cumulative at the source, so counter semantics hold."""
+    pattern = re.compile(r'^fleet\.(.+)\.shed_cum$')
+    latest = {}
+    for r in session.query(
+            "SELECT id, name, value FROM metric "
+            "WHERE id > (SELECT COALESCE(MAX(id), 0) FROM metric) - ? "
+            "AND name LIKE 'fleet.%.shed_cum'", (_SERVING_SCAN_WINDOW,)):
+        m = pattern.match(r['name'])
+        if m is None:
+            continue
+        key = m.group(1)
+        if key not in latest or r['id'] > latest[key][0]:
+            latest[key] = (r['id'], r['value'])
+    for fleet, (_, value) in sorted(latest.items()):
+        samples.append(('_total', {'fleet': fleet}, value))
+
+
+def _collect_fleet_events(session, respawns, swaps):
+    """``mlcomp_fleet_respawns_total{fleet,reason}`` +
+    ``mlcomp_fleet_swaps_total{fleet,outcome}`` from the reconciler's
+    per-event metric rows — same windowed id scan and counter
+    semantics as the task-retry family."""
+    r_counts, s_counts = {}, {}
+    for r in session.query(
+            "SELECT name, tags FROM metric "
+            "WHERE id > (SELECT COALESCE(MAX(id), 0) FROM metric) - ? "
+            "AND name IN ('fleet.respawn', 'fleet.swap')",
+            (_RETRY_SCAN_WINDOW,)):
+        try:
+            tags = json.loads(r['tags'] or '{}')
+        except ValueError:
+            continue
+        fleet = tags.get('fleet') or 'unknown'
+        if r['name'] == 'fleet.respawn':
+            key = (fleet, tags.get('reason') or 'unknown')
+            r_counts[key] = r_counts.get(key, 0) + 1
+        else:
+            key = (fleet, tags.get('outcome') or 'unknown')
+            s_counts[key] = s_counts.get(key, 0) + 1
+    for (fleet, reason), n in sorted(r_counts.items()):
+        respawns.append(('_total', {'fleet': fleet, 'reason': reason},
+                         n))
+    for (fleet, outcome), n in sorted(s_counts.items()):
+        swaps.append(('_total', {'fleet': fleet, 'outcome': outcome},
+                      n))
+
+
 def collect_server_families(session):
     """The API server's /metrics families, each collected defensively
     from the DB (+ the scrape-error count so a sick collector is
@@ -468,6 +541,7 @@ def collect_server_families(session):
     tasks, queues, slots, alerts = [], [], [], []
     dispatch, phases, eff, compiles, serving = [], [], [], [], []
     retries, gangs = [], []
+    freplicas, fgens, fshed, frespawns, fswaps = [], [], [], [], []
     guarded(_collect_tasks, session, tasks)
     guarded(_collect_queue_depth, session, queues)
     guarded(_collect_worker_slots, session, slots)
@@ -475,6 +549,10 @@ def collect_server_families(session):
     guarded(_collect_dispatch_latency, session, dispatch)
     guarded(_collect_task_retries, session, retries)
     guarded(_collect_gang_generations, session, gangs)
+    guarded(_collect_fleet_replicas, session, freplicas)
+    guarded(_collect_fleet_generations, session, fgens)
+    guarded(_collect_fleet_shed, session, fshed)
+    guarded(_collect_fleet_events, session, frespawns, fswaps)
     running = []
     try:
         running = _running_task_ids(session)
@@ -517,6 +595,20 @@ def collect_server_families(session):
         family('mlcomp_serving_latency_ms', 'histogram',
                'served-model request latency (cumulative buckets, '
                'latest heartbeat snapshot)', serving),
+        family('mlcomp_fleet_replicas', 'gauge',
+               'serving-fleet replicas by state (reconciler view)',
+               freplicas),
+        family('mlcomp_fleet_generation', 'gauge',
+               'active (routed) swap generation per fleet', fgens),
+        family('mlcomp_fleet_shed', 'counter',
+               'requests shed by SLO-keyed admission control (latest '
+               'gateway flush, cumulative at source)', fshed),
+        family('mlcomp_fleet_respawns', 'counter',
+               'replica respawn events by failure reason (recent '
+               'event window)', frespawns),
+        family('mlcomp_fleet_swaps', 'counter',
+               'rolling-swap events by outcome (recent event window)',
+               fswaps),
         family('mlcomp_scrape_errors', 'gauge',
                'collectors that failed during this scrape',
                [('', None, errors[0])]),

@@ -66,6 +66,17 @@ Injection points shipped in the framework (grep ``fault_point(``):
   at distributed bring-up (worker/tasks.py, ctx ``phase='join'``) and
   at each epoch boundary (train/executor.py, ctx ``phase='epoch'``),
   both carrying ``rank`` so a ``when`` filter kills one rank only
+- ``serve.request``             — serving request path
+  (server/serve.py handle_predict, ctx ``model``): the generic
+  raise/sleep hook for request-level chaos
+- ``replica.slow``              — same site, reserved for latency
+  injection (action ``sleep``) — a degraded replica breaching its SLO
+  without dying, the load-shedding chaos case
+- ``replica.crash``             — the unclean death of a serving
+  replica: fires in the request path (ctx ``phase='request'``) and in
+  the replica executor's heartbeat (worker/executors/serve_replica.py,
+  ctx ``phase='beat'``, plus ``fleet``/``replica``), so a ``when``
+  filter kills exactly one replica of a fleet mid-load
 """
 
 import json

@@ -15,6 +15,7 @@ Executor._builtin_modules = (
     'mlcomp_tpu.worker.executors.prepare_submit',
     'mlcomp_tpu.worker.executors.model',
     'mlcomp_tpu.worker.executors.kaggle',
+    'mlcomp_tpu.worker.executors.serve_replica',
     'mlcomp_tpu.train.executor',
 )
 

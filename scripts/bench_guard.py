@@ -51,6 +51,22 @@ FLOORS = {
                      '(4-step probe; tunnel noise is ±5-7%)'),
     'lm_wide_int8_vs_bf16': (
         'min', 1.15, 'int8 training speedup at the wide-GEMM shape'),
+    # round-7 legs (ISSUE 9: serving-fleet tier). The fleet leg is
+    # jax-free (stub replicas + routing gateway on loopback), so its
+    # floors gate the ROUTING tier: sustained throughput with pooled
+    # connections, recovery from a replica kill absorbed by breaker +
+    # hedged retry (acceptance bar: p99 back under SLO within 30 s),
+    # and SLO shedding actually engaging under overload.
+    'fleet_sustained_qps': ('min', 100.0,
+                            'gateway sustained QPS, 3 stub replicas'),
+    'fleet_recovery_s': ('max', 30.0,
+                         'replica-kill to sub-SLO recovery time (s)'),
+    'fleet_failed_requests': ('max', 0.0,
+                              'non-429 client failures during the '
+                              'replica kill'),
+    'fleet_shed_rate_pct': ('min', 1.0,
+                            'shed share under deliberate overload '
+                            '(SLO admission control must engage)'),
 }
 
 

@@ -33,6 +33,20 @@ simulated by rewinding the stored timestamps, never by sleeping):
    two surviving hosts (reshaped world size 2, dead host excluded) —
    and the bump is visible in ``gang.generation`` telemetry and
    ``mlcomp_gang_generations_total`` on /metrics
+7. fleet self-healing (serving tier, server/fleet.py + gateway.py): a
+   3-replica fleet serves sustained load through the routing gateway;
+   one replica subprocess is killed mid-load via the ``replica.crash``
+   seam (``when``-filtered — one env var arms all three, kills exactly
+   one). The gateway's circuit breaker + hedged retry keep every
+   client request a 200 (no failures other than explicit 429 sheds),
+   the reconciler's probes classify the corpse ``replica-unhealthy``,
+   kill its task and respawn EXACTLY ONCE on a different computer
+   (``retry_exclude``), and the respawn is visible in
+   ``mlcomp_fleet_respawns_total`` on /metrics; then a ROLLING SWAP to
+   a new export version completes under continued load — generation 2
+   warms, the router flips, generation 1 drains — with zero failed
+   requests and the flip visible in ``mlcomp_fleet_swaps_total`` and
+   ``mlcomp_fleet_generation``
 """
 
 import datetime
@@ -372,6 +386,242 @@ def scenario_gang_preemption(session):
         for _, labels, value in samples), str(samples))
 
 
+#: stub replica process: /health answers ok, /predict hits the
+#: replica.crash seam (armed via MLCOMP_FAULTS in the environment)
+#: then answers — the jax-free stand-in for a ModelServer replica
+_STUB_REPLICA = r'''
+import json, sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+sys.path.insert(0, sys.argv[2])
+from mlcomp_tpu.testing.faults import fault_point
+REPLICA = int(sys.argv[1])
+
+class H(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _send(self, payload):
+        blob = json.dumps(payload).encode()
+        self.send_response(200)
+        self.send_header('Content-Length', str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
+    def do_GET(self):
+        self._send({'status': 'ok', 'replica': REPLICA})
+
+    def do_POST(self):
+        n = int(self.headers.get('Content-Length', 0))
+        self.rfile.read(n)
+        fault_point('replica.crash', replica=REPLICA, phase='request')
+        self._send({'y': [REPLICA], 'ms': 1.0})
+
+srv = ThreadingHTTPServer(('127.0.0.1', 0), H)
+print(srv.server_address[1], flush=True)
+srv.serve_forever()
+'''
+
+
+def scenario_fleet_self_healing(session):
+    """A 3-replica serving fleet under load loses one replica to
+    replica.crash mid-run: the gateway fails over (zero non-429
+    failures), the reconciler respawns exactly once on another
+    computer, and /metrics shows the respawn."""
+    import subprocess
+    import time
+    import urllib.request
+    from mlcomp_tpu import TOKEN
+    from mlcomp_tpu.db.enums import TaskType
+    from mlcomp_tpu.db.providers import FleetProvider, ReplicaProvider
+    from mlcomp_tpu.server.fleet import FleetConfig, create_fleet
+    from mlcomp_tpu.server.gateway import FleetGateway
+
+    session.execute('UPDATE computer SET can_process_tasks=0')
+    for host in ('fleet_a', 'fleet_b', 'fleet_c', 'fleet_d'):
+        add_computer(session, host)
+    tp = TaskProvider(session)
+    qp = QueueProvider(session)
+    rp = ReplicaProvider(session)
+    fleet = create_fleet(session, 'chaos', 'stub_model', desired=3,
+                         slo_p99_ms=10000.0)
+    sup = SupervisorBuilder(
+        session=session,
+        recovery_config=RecoveryConfig(lease_seconds=3600),
+        fleet_config=FleetConfig(probe_interval_s=0.0,
+                                 unhealthy_after=2))
+    sup.build()
+    replicas = rp.of_fleet(fleet.id)
+    tasks = [tp.by_id(r.task) for r in replicas]
+    check('fleet fanned out 3 replica tasks across hosts',
+          len(replicas) == 3
+          and len({t.computer_assigned for t in tasks}) == 3,
+          str([(t.id, t.computer_assigned) for t in tasks]))
+
+    # "workers" claim the dispatches and bring up stub replica
+    # processes; ONE MLCOMP_FAULTS env arms all three, the `when`
+    # filter kills exactly replica[0] on its 10th request
+    import json as _json
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    victim = replicas[0]
+    env = dict(os.environ)
+    env['MLCOMP_FAULTS'] = _json.dumps({'replica.crash': {
+        'action': 'exit', 'after': 10,
+        'when': {'replica': victim.id}}})
+    procs = []
+    try:
+        for replica, task in zip(replicas, tasks):
+            qp.claim([f'{task.computer_assigned}_default'],
+                     f'{task.computer_assigned}:0')
+            tp.change_status(task, TaskStatus.InProgress)
+            proc = subprocess.Popen(
+                [sys.executable, '-c', _STUB_REPLICA,
+                 str(replica.id), repo],
+                env=env, stdout=subprocess.PIPE, text=True)
+            port = int(proc.stdout.readline())
+            procs.append(proc)
+            rp.mark_endpoint(replica.id, task.computer_assigned, port,
+                             f'http://127.0.0.1:{port}')
+        sup.build()
+        check('probes brought all replicas healthy',
+              [r.state for r in rp.of_fleet(fleet.id)] == ['healthy'] * 3,
+              str([(r.id, r.state) for r in rp.of_fleet(fleet.id)]))
+
+        gateway = FleetGateway(port=0, session=session, refresh_s=0.1,
+                               breaker_kw={'failure_threshold': 1,
+                                           'cooldown_s': 30.0})
+        gateway.start_background()
+
+        def drive(n, codes, tick_every=5):
+            for i in range(n):
+                req = urllib.request.Request(
+                    f'http://127.0.0.1:{gateway.port}/predict/chaos',
+                    data=b'{"x": [[1]]}',
+                    headers={'Authorization': TOKEN})
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        code = r.status
+                        r.read()
+                except urllib.error.HTTPError as e:
+                    code = e.code
+                    e.read()
+                codes[code] = codes.get(code, 0) + 1
+                if i % tick_every == tick_every - 1:
+                    sup.build()     # the 1 Hz tick, compressed
+                time.sleep(0.01)
+
+        codes = {}
+        try:
+            drive(60, codes)
+            check('no request failed other than explicit 429 sheds',
+                  set(codes) <= {200, 429}, str(codes))
+            check('load actually flowed', codes.get(200, 0) >= 40,
+                  str(codes))
+        finally:
+            gateway.flush_telemetry(session)
+        for _ in range(3):
+            sup.build()             # settle classification + respawn
+        rows = rp.of_fleet(fleet.id)
+        dead = [r for r in rows if r.id == victim.id]
+        check('crashed replica classified dead through the taxonomy',
+              dead and dead[0].state == 'dead'
+              and dead[0].failure_reason == 'replica-unhealthy',
+              str([(r.id, r.state, r.failure_reason) for r in rows]))
+        vt = tp.by_id(victim.task)
+        check('victim task failed replica-unhealthy',
+              vt.status == int(TaskStatus.Failed)
+              and vt.failure_reason == 'replica-unhealthy',
+              f'{TaskStatus(vt.status).name}/{vt.failure_reason}')
+        spawned = [r for r in rows if r.respawned_from == victim.id]
+        check('exactly-once respawn', len(spawned) == 1
+              and len(rows) == 4, str([(r.id, r.respawned_from)
+                                       for r in rows]))
+        if spawned:
+            nt = tp.by_id(spawned[0].task)
+            info = yaml_load(nt.additional_info) or {}
+            check('respawn excluded the dead computer',
+                  nt.computer_assigned != vt.computer_assigned
+                  and info.get('retry_exclude') ==
+                  [vt.computer_assigned],
+                  f'{nt.computer_assigned} vs {vt.computer_assigned}')
+        from mlcomp_tpu.telemetry.export import (
+            parse_openmetrics, render_server_metrics,
+        )
+        doc = parse_openmetrics(render_server_metrics(session))
+        respawns = doc.get('mlcomp_fleet_respawns', {}) \
+            .get('samples', [])
+        check('mlcomp_fleet_respawns_total on /metrics', any(
+            labels.get('fleet') == 'chaos'
+            and labels.get('reason') == 'replica-unhealthy'
+            and value == 1 for _, labels, value in respawns),
+            str(respawns))
+        states = doc.get('mlcomp_fleet_replicas', {}).get('samples', [])
+        check('replica states exported on /metrics', any(
+            labels.get('fleet') == 'chaos'
+            and labels.get('state') == 'healthy'
+            for _, labels, _ in states), str(states))
+
+        # ---- rolling swap under load: generation 2 with a new export
+        # version warms, the router flips, generation 1 drains — and
+        # every client request through the whole window stays a 200
+        from mlcomp_tpu.server.fleet import start_swap
+        fp = FleetProvider(session)
+        start_swap(session, fp.by_name('chaos'), 'stub_model_v2')
+        sup.build()                 # stage generation 2 replica tasks
+        gen2 = rp.of_fleet(fleet.id, generation=2)
+        check('swap staged desired replicas as generation 2',
+              len(gen2) == 3 and fp.by_name('chaos').generation == 1,
+              str([(r.id, r.generation) for r in gen2]))
+        for replica in gen2:        # "workers" bring generation 2 up
+            task = tp.by_id(replica.task)
+            qp.claim([f'{task.computer_assigned}_default'],
+                     f'{task.computer_assigned}:0')
+            tp.change_status(task, TaskStatus.InProgress)
+            proc = subprocess.Popen(
+                [sys.executable, '-c', _STUB_REPLICA,
+                 str(replica.id), repo],
+                env=env, stdout=subprocess.PIPE, text=True)
+            port = int(proc.stdout.readline())
+            procs.append(proc)
+            rp.mark_endpoint(replica.id, task.computer_assigned, port,
+                             f'http://127.0.0.1:{port}')
+        swap_codes = {}
+        drive(40, swap_codes, tick_every=4)   # load ACROSS the flip
+        time.sleep(0.3)             # let the router refresh past it
+        swap_tail = {}
+        drive(10, swap_tail, tick_every=5)
+        gateway.shutdown()
+        fleet_row = fp.by_name('chaos')
+        check('rolling swap flipped to generation 2 under load',
+              fleet_row.generation == 2
+              and fleet_row.model == 'stub_model_v2'
+              and fleet_row.status == 'active',
+              f'gen={fleet_row.generation} model={fleet_row.model}')
+        check('zero failed requests across the swap',
+              set(swap_codes) | set(swap_tail) <= {200, 429}
+              and swap_tail.get(200, 0) >= 8,
+              f'{swap_codes} then {swap_tail}')
+        g1 = rp.of_fleet(fleet.id, generation=1)
+        check('generation 1 retired through drain',
+              all(r.state in ('draining', 'dead') for r in g1
+                  if r.url), str([(r.id, r.state) for r in g1]))
+        doc = parse_openmetrics(render_server_metrics(session))
+        swaps = doc.get('mlcomp_fleet_swaps', {}).get('samples', [])
+        gens = doc.get('mlcomp_fleet_generation', {}).get('samples', [])
+        check('swap completion + generation visible on /metrics', any(
+            labels.get('fleet') == 'chaos'
+            and labels.get('outcome') == 'completed'
+            for _, labels, _ in swaps) and any(
+            labels.get('fleet') == 'chaos' and value == 2
+            for _, labels, value in gens),
+            f'{swaps} / {gens}')
+    finally:
+        for proc in procs:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+
+
 def main():
     session = Session.create_session(key='chaos_smoke')
     migrate(session)
@@ -380,6 +630,7 @@ def main():
     scenario_db_outage(session)
     scenario_claim_race(session)
     scenario_gang_preemption(session)
+    scenario_fleet_self_healing(session)
     if FAILURES:
         print(f'FAIL: {len(FAILURES)} scenario check(s): {FAILURES}')
         return 1

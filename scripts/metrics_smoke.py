@@ -76,7 +76,28 @@ def seed(session):
         + [(None, 'serving.m.latency_ms.count', 'histogram', None,
             5.0, ts, 'serving', None),
            (None, 'serving.m.latency_ms.mean', 'histogram', None,
-            12.0, ts, 'serving', None)])
+            12.0, ts, 'serving', None)]
+        # fleet signals: gateway shed flush + reconciler events
+        + [(None, 'fleet.smokefleet.shed_cum', 'gauge', None, 3.0, ts,
+            'gateway', None),
+           (None, 'fleet.respawn', 'counter', None, 1.0, ts,
+            'supervisor', json.dumps({'fleet': 'smokefleet',
+                                      'reason': 'replica-unhealthy'})),
+           (None, 'fleet.swap', 'counter', None, 2.0, ts,
+            'supervisor', json.dumps({'fleet': 'smokefleet',
+                                      'outcome': 'completed'}))])
+    # serving-fleet roster (serve_fleet/serve_replica, migration v9)
+    from mlcomp_tpu.db.models import ServeFleet, ServeReplica
+    from mlcomp_tpu.db.providers import FleetProvider, ReplicaProvider
+    fleet = ServeFleet(name='smokefleet', model='m', desired=2,
+                       generation=2, status='active', created=now())
+    FleetProvider(session).add(fleet)
+    rp = ReplicaProvider(session)
+    rp.add(ServeReplica(fleet=fleet.id, generation=2, state='healthy',
+                        computer='smoke', created=now()))
+    rp.add(ServeReplica(fleet=fleet.id, generation=1, state='dead',
+                        failure_reason='replica-unhealthy',
+                        created=now()))
     return task.id
 
 
@@ -136,6 +157,22 @@ def main():
         ('mlcomp_serving_latency_ms buckets', any(
             l.get('le') == '+Inf'
             for l in sample_labels('mlcomp_serving_latency_ms'))),
+        ('mlcomp_fleet_replicas states', any(
+            l.get('fleet') == 'smokefleet'
+            and l.get('state') == 'healthy' and v == 1
+            for _, l, v in doc['mlcomp_fleet_replicas']['samples'])),
+        ('mlcomp_fleet_generation', any(
+            l.get('fleet') == 'smokefleet' and v == 2
+            for _, l, v in doc['mlcomp_fleet_generation']['samples'])),
+        ('mlcomp_fleet_shed_total', any(
+            l.get('fleet') == 'smokefleet' and v == 3
+            for _, l, v in doc['mlcomp_fleet_shed']['samples'])),
+        ('mlcomp_fleet_respawns_total', any(
+            l.get('reason') == 'replica-unhealthy' and v == 1
+            for _, l, v in doc['mlcomp_fleet_respawns']['samples'])),
+        ('mlcomp_fleet_swaps_total', any(
+            l.get('outcome') == 'completed'
+            for _, l, v in doc['mlcomp_fleet_swaps']['samples'])),
         ('mlcomp_scrape_errors == 0',
          doc['mlcomp_scrape_errors']['samples'][0][2] == 0),
     ]

@@ -1,0 +1,822 @@
+"""Self-healing serving fleet (server/fleet.py + server/gateway.py).
+
+Three layers of coverage, cheapest first:
+
+- pure router logic (circuit breaker, hedge budget, rolling-SLO
+  window) with injected clocks — no sockets;
+- the gateway's proxy path against stub HTTP backends — failover,
+  hedge-budget exhaustion, shed-rate accounting, probe exemption;
+- the reconciler inside a REAL SupervisorBuilder tick against a
+  sandboxed DB — desired-count spawn through the normal placement
+  path, probe-failure classification → kill → exactly-once respawn
+  with computer exclusion, heartbeat-silence verdicts, the rolling
+  swap state machine (warm → flip → drain, and warmup-timeout
+  rollback), and the ``serve_replica`` executor running a real
+  ModelServer end to end.
+
+The full chaos acceptance (kill 1 of 3 replica SUBPROCESSES mid-load,
+zero non-429 failures) runs jax-free in scripts/chaos_smoke.py.
+"""
+
+import datetime
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from mlcomp_tpu import TOKEN
+from mlcomp_tpu.db.enums import TaskStatus
+from mlcomp_tpu.db.models import Computer
+from mlcomp_tpu.db.providers import (
+    ComputerProvider, DockerProvider, FleetProvider, QueueProvider,
+    ReplicaProvider, TaskProvider,
+)
+from mlcomp_tpu.server.fleet import (
+    FleetConfig, create_fleet, start_swap, stop_fleet,
+)
+from mlcomp_tpu.server.gateway import (
+    CircuitBreaker, FleetGateway, HedgeBudget, PROBE_HEADER, RollingSlo,
+)
+from mlcomp_tpu.utils.io import yaml_load
+from mlcomp_tpu.utils.misc import now
+
+
+# ---------------------------------------------------------------- helpers
+def add_computer(session, name, heartbeat=True):
+    ComputerProvider(session).create_or_update(
+        Computer(name=name, cores=8, cpu=16, memory=64,
+                 ip='127.0.0.1', can_process_tasks=True), 'name')
+    if heartbeat:
+        DockerProvider(session).heartbeat(name, 'default')
+
+
+def make_supervisor(session, health=None, **fleet_kw):
+    """SupervisorBuilder with an injectable probe: ``health`` maps
+    url -> bool (default healthy)."""
+    from mlcomp_tpu.server.supervisor import SupervisorBuilder
+    health = health if health is not None else {}
+    cfg = FleetConfig(probe_interval_s=0.0, unhealthy_after=2,
+                      **fleet_kw)
+    return SupervisorBuilder(
+        session=session, fleet_config=cfg,
+        fleet_probe=lambda url: health.get(url, True)), health
+
+
+def bring_up(session, fleet_id):
+    """Play the worker's part for every starting replica: claim the
+    dispatch, mark InProgress, bind a (fake) endpoint."""
+    rp, tp, qp = (ReplicaProvider(session), TaskProvider(session),
+                  QueueProvider(session))
+    for replica in rp.of_fleet(fleet_id, states=('starting',)):
+        task = tp.by_id(replica.task)
+        if task is None or task.status != int(TaskStatus.Queued):
+            continue
+        qp.claim([f'{task.computer_assigned}_default'],
+                 f'{task.computer_assigned}:0')
+        tp.change_status(task, TaskStatus.InProgress)
+        rp.mark_endpoint(replica.id, task.computer_assigned,
+                         9000 + replica.id,
+                         f'http://127.0.0.1:{9000 + replica.id}')
+
+
+def expire_probes(session):
+    session.execute(
+        'UPDATE serve_replica SET last_probe=?',
+        (now() - datetime.timedelta(seconds=3600),))
+
+
+# --------------------------------------------------------- router logic
+class TestCircuitBreaker:
+    def test_open_half_open_close_cycle(self):
+        clock = [0.0]
+        cb = CircuitBreaker(failure_threshold=3, cooldown_s=10.0,
+                            clock=lambda: clock[0])
+        assert cb.state == 'closed' and cb.allow()
+        for _ in range(3):
+            cb.record_failure()
+        assert cb.state == 'open'
+        assert not cb.allow()               # cooling down
+        clock[0] = 10.1
+        assert cb.allow()                   # the half-open trial
+        assert cb.state == 'half_open'
+        assert not cb.allow()               # one trial at a time
+        cb.record_success()
+        assert cb.state == 'closed' and cb.allow()
+
+    def test_half_open_failure_reopens(self):
+        clock = [0.0]
+        cb = CircuitBreaker(failure_threshold=1, cooldown_s=5.0,
+                            clock=lambda: clock[0])
+        cb.record_failure()
+        assert cb.state == 'open'
+        clock[0] = 5.1
+        assert cb.allow()
+        cb.record_failure()                 # trial failed
+        assert cb.state == 'open'
+        assert not cb.allow()               # cooldown restarted
+        clock[0] = 10.2
+        assert cb.allow()
+
+    def test_success_resets_failure_streak(self):
+        cb = CircuitBreaker(failure_threshold=3)
+        cb.record_failure()
+        cb.record_failure()
+        cb.record_success()
+        cb.record_failure()
+        cb.record_failure()
+        assert cb.state == 'closed'         # never 3 consecutive
+
+
+class TestHedgeBudget:
+    def test_exhaustion_and_earn_back(self):
+        budget = HedgeBudget(ratio=0.5, burst=2.0)
+        assert budget.try_spend() and budget.try_spend()
+        assert not budget.try_spend()       # drained
+        budget.note_request()               # +0.5
+        assert not budget.try_spend()
+        budget.note_request()               # 1.0 — one hedge earned
+        assert budget.try_spend()
+        assert not budget.try_spend()
+
+    def test_burst_cap(self):
+        budget = HedgeBudget(ratio=1.0, burst=3.0)
+        for _ in range(100):
+            budget.note_request()
+        spent = sum(1 for _ in range(10) if budget.try_spend())
+        assert spent == 3
+
+
+class TestRollingSlo:
+    def test_min_samples_gate(self):
+        slo = RollingSlo(10.0, min_samples=5)
+        for _ in range(4):
+            slo.observe(100.0)
+        assert slo.p99() is None and not slo.over_slo()
+        slo.observe(100.0)
+        assert slo.over_slo()
+
+    def test_age_expiry_releases_shedding(self):
+        """The 100%-shed deadlock guard: a fully-shed (quiet) window
+        must drain by AGE so admission resumes as a recovery probe."""
+        clock = [0.0]
+        slo = RollingSlo(10.0, min_samples=5, max_age_s=10.0,
+                         clock=lambda: clock[0])
+        for _ in range(10):
+            slo.observe(100.0)
+        assert slo.over_slo()
+        clock[0] = 10.1                     # everything expires
+        assert slo.p99() is None and not slo.over_slo()
+
+    def test_p99_tracks_tail(self):
+        slo = RollingSlo(50.0, min_samples=10)
+        for ms in [1.0] * 99 + [500.0]:
+            slo.observe(ms)
+        assert slo.p99() == 500.0
+
+
+# ------------------------------------------------------- gateway proxy
+def make_stub(behavior):
+    """Stub backend; ``behavior`` is a mutable dict:
+    status (int), delay_s, count (incremented per predict)."""
+    class Stub(BaseHTTPRequestHandler):
+        protocol_version = 'HTTP/1.1'
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get('Content-Length', 0))
+            self.rfile.read(n)
+            behavior['count'] = behavior.get('count', 0) + 1
+            if behavior.get('delay_s'):
+                time.sleep(behavior['delay_s'])
+            status = behavior.get('status', 200)
+            blob = json.dumps(
+                {'y': [behavior['port']], 'status': status}).encode()
+            self.send_response(status)
+            self.send_header('Content-Length', str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+    srv = ThreadingHTTPServer(('127.0.0.1', 0), Stub)
+    behavior['port'] = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+@pytest.fixture()
+def stub_pair():
+    b1, b2 = {}, {}
+    s1, s2 = make_stub(b1), make_stub(b2)
+    yield (b1, b2)
+    s1.shutdown()
+    s2.shutdown()
+
+
+def gw_post(gw, path='/predict/m', body=b'{"x": [[1]]}', headers=None):
+    req = urllib.request.Request(
+        f'http://127.0.0.1:{gw.port}{path}', data=body,
+        headers={'Authorization': TOKEN, **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read()), resp.headers
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, (json.loads(payload) if payload else {}), \
+            e.headers
+
+
+class TestGatewayRouting:
+    def _gateway(self, behaviors, **kw):
+        gw = FleetGateway(port=0, **kw)
+        gw.set_fleet('m', 1,
+                     [f'http://127.0.0.1:{b["port"]}'
+                      for b in behaviors], slo_p99_ms=None)
+        gw.start_background()
+        return gw
+
+    def test_round_robin(self, stub_pair):
+        b1, b2 = stub_pair
+        gw = self._gateway([b1, b2])
+        try:
+            seen = {gw_post(gw)[1]['y'][0] for _ in range(4)}
+            assert seen == {b1['port'], b2['port']}
+        finally:
+            gw.shutdown()
+
+    def test_unauthorized(self, stub_pair):
+        b1, b2 = stub_pair
+        gw = self._gateway([b1, b2])
+        try:
+            req = urllib.request.Request(
+                f'http://127.0.0.1:{gw.port}/predict/m', data=b'{}',
+                headers={'Authorization': 'wrong'})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 401
+        finally:
+            gw.shutdown()
+
+    def test_failover_on_5xx_and_breaker_opens(self, stub_pair):
+        b1, b2 = stub_pair
+        gw = self._gateway([b1, b2], hedge_ratio=1.0,
+                           breaker_kw={'failure_threshold': 2,
+                                       'cooldown_s': 60.0})
+        try:
+            b1['status'] = 500
+            codes = [gw_post(gw)[0] for _ in range(6)]
+            assert codes == [200] * 6       # hedges absorbed the 500s
+            snap = gw.route('m').snapshot()
+            sick = [b for b in snap['backends']
+                    if b['url'].endswith(str(b1['port']))][0]
+            assert sick['circuit'] == 'open'
+            assert snap['failovers'] >= 1
+            # with the circuit open, routing goes healthy-only: the
+            # sick backend sees no more traffic
+            before = b1.get('count', 0)
+            for _ in range(4):
+                assert gw_post(gw)[0] == 200
+            assert b1.get('count', 0) == before
+        finally:
+            gw.shutdown()
+
+    def test_hedge_budget_exhaustion_surfaces_errors(self, stub_pair):
+        b1, b2 = stub_pair
+        # both backends sick and a tiny budget: once spent, the
+        # replica's own verdict surfaces instead of a retry storm
+        b1['status'] = 500
+        b2['status'] = 500
+        gw = self._gateway([b1, b2], hedge_ratio=0.0,
+                           breaker_kw={'failure_threshold': 100})
+        try:
+            gw.route('m').hedge.tokens = 1.0
+            codes = [gw_post(gw)[0] for _ in range(4)]
+            assert codes == [500] * 4
+            snap = gw.route('m').snapshot()
+            assert snap['hedges'] == 1      # the one budgeted hedge
+            assert snap['errors'] == 4
+        finally:
+            gw.shutdown()
+
+    def test_replica_429_fails_over_without_breaker_penalty(
+            self, stub_pair):
+        b1, b2 = stub_pair
+        b1['status'] = 429
+        gw = self._gateway([b1, b2], hedge_ratio=1.0,
+                           breaker_kw={'failure_threshold': 1})
+        try:
+            codes = [gw_post(gw)[0] for _ in range(4)]
+            assert 200 in codes
+            snap = gw.route('m').snapshot()
+            sick = [b for b in snap['backends']
+                    if b['url'].endswith(str(b1['port']))][0]
+            assert sick['circuit'] == 'closed'   # busy, not broken
+        finally:
+            gw.shutdown()
+
+    def test_client_4xx_passthrough_no_hedge(self, stub_pair):
+        b1, b2 = stub_pair
+        b1['status'] = 400
+        b2['status'] = 400
+        gw = self._gateway([b1, b2], hedge_ratio=1.0)
+        try:
+            code, _, _ = gw_post(gw)
+            assert code == 400
+            assert gw.route('m').snapshot()['hedges'] == 0
+        finally:
+            gw.shutdown()
+
+    def test_no_backends_is_503_with_retry_after(self):
+        gw = FleetGateway(port=0)
+        gw.set_fleet('m', 1, [])
+        gw.start_background()
+        try:
+            code, _, headers = gw_post(gw)
+            assert code == 503
+            assert headers.get('Retry-After') == '1'
+        finally:
+            gw.shutdown()
+
+    def test_unknown_fleet_404_and_single_fleet_default(self,
+                                                       stub_pair):
+        b1, b2 = stub_pair
+        gw = self._gateway([b1, b2])
+        try:
+            assert gw_post(gw, path='/predict/nope')[0] == 404
+            assert gw_post(gw, path='/predict')[0] == 200
+        finally:
+            gw.shutdown()
+
+
+class TestShedAccounting:
+    def test_shed_rate_under_synthetic_overload(self, stub_pair):
+        """Once the rolling p99 is over the SLO, new requests shed
+        with 429 + Retry-After and the shed counter accounts for every
+        one of them — while probe-marked requests pass."""
+        b1, b2 = stub_pair
+        gw = FleetGateway(port=0)
+        route = gw.set_fleet(
+            'm', 1, [f'http://127.0.0.1:{b1["port"]}',
+                     f'http://127.0.0.1:{b2["port"]}'],
+            slo_p99_ms=10.0)
+        route.slo.min_samples = 5
+        gw.start_background()
+        try:
+            # poison the window over the SLO (synthetic: no real load)
+            for _ in range(10):
+                route.slo.observe(100.0)
+            codes = [gw_post(gw)[0] for _ in range(10)]
+            assert codes == [429] * 10
+            _, _, headers = gw_post(gw)
+            assert headers.get('Retry-After') == '1'
+            snap = route.snapshot()
+            assert snap['shed'] == 11
+            assert snap['requests'] == 11
+            # health probes are never shed
+            code, _, _ = gw_post(gw, headers={PROBE_HEADER: '1'})
+            assert code == 200
+            assert route.snapshot()['shed'] == 11
+            # /metrics carries the shed counter
+            from mlcomp_tpu.telemetry.export import parse_openmetrics
+            doc = parse_openmetrics(gw.render_metrics())
+            shed = doc['mlcomp_fleet_shed']['samples']
+            assert shed[0][1] == {'fleet': 'm'} and shed[0][2] == 11
+        finally:
+            gw.shutdown()
+
+    def test_queue_limit_backstop(self, stub_pair):
+        b1, b2 = stub_pair
+        b1['delay_s'] = 0.5
+        b2['delay_s'] = 0.5
+        gw = FleetGateway(port=0)
+        route = gw.set_fleet(
+            'm', 1, [f'http://127.0.0.1:{b1["port"]}',
+                     f'http://127.0.0.1:{b2["port"]}'],
+            slo_p99_ms=None, max_pending=1)
+        gw.start_background()
+        try:
+            codes = []
+            lock = threading.Lock()
+
+            def client():
+                code = gw_post(gw)[0]
+                with lock:
+                    codes.append(code)
+            threads = [threading.Thread(target=client)
+                       for _ in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert 200 in codes and 429 in codes, codes
+        finally:
+            gw.shutdown()
+
+
+# ----------------------------------------------------------- reconciler
+class TestReconciler:
+    def test_spawn_to_desired_through_placement(self, session):
+        for host in ('h1', 'h2', 'h3'):
+            add_computer(session, host)
+        fleet = create_fleet(session, 'f', 'm', desired=3)
+        sup, _ = make_supervisor(session)
+        sup.build()
+        rp, tp = ReplicaProvider(session), TaskProvider(session)
+        replicas = rp.of_fleet(fleet.id)
+        assert len(replicas) == 3
+        tasks = [tp.by_id(r.task) for r in replicas]
+        assert all(t.status == int(TaskStatus.Queued) for t in tasks)
+        assert len({t.computer_assigned for t in tasks}) == 3
+        info = yaml_load(tasks[0].additional_info)
+        assert info['serve']['fleet_name'] == 'f'
+        assert info['serve']['model'] == 'm'
+        # steady state: no spawn storm
+        sup.build()
+        assert len(rp.of_fleet(fleet.id)) == 3
+
+    def test_probe_failure_respawns_exactly_once_excluding_host(
+            self, session):
+        for host in ('h1', 'h2', 'h3'):
+            add_computer(session, host)
+        fleet = create_fleet(session, 'f', 'm', desired=2)
+        health = {}
+        sup, health = make_supervisor(session, health)
+        sup.build()
+        bring_up(session, fleet.id)
+        sup.build()
+        rp, tp = ReplicaProvider(session), TaskProvider(session)
+        assert all(r.state == 'healthy'
+                   for r in rp.of_fleet(fleet.id))
+        victim = rp.of_fleet(fleet.id)[0]
+        health[victim.url] = False
+        for _ in range(3):
+            expire_probes(session)
+            sup.build()
+        rows = rp.of_fleet(fleet.id)
+        dead = next(r for r in rows if r.id == victim.id)
+        assert dead.state == 'dead'
+        assert dead.failure_reason == 'replica-unhealthy'
+        vt = tp.by_id(victim.task)
+        assert vt.status == int(TaskStatus.Failed)
+        assert vt.failure_reason == 'replica-unhealthy'
+        spawned = [r for r in rows if r.respawned_from == victim.id]
+        assert len(spawned) == 1
+        nt = tp.by_id(spawned[0].task)
+        info = yaml_load(nt.additional_info)
+        assert info['retry_exclude'] == [vt.computer_assigned]
+        assert nt.computer_assigned != vt.computer_assigned
+        # exactly once: more ticks mint nothing new
+        for _ in range(3):
+            expire_probes(session)
+            sup.build()
+        assert len(rp.of_fleet(fleet.id)) == 3
+        # the respawn event is on /metrics
+        from mlcomp_tpu.telemetry.export import (
+            parse_openmetrics, render_server_metrics,
+        )
+        doc = parse_openmetrics(render_server_metrics(session))
+        assert any(l.get('fleet') == 'f'
+                   and l.get('reason') == 'replica-unhealthy'
+                   for _, l, _ in
+                   doc['mlcomp_fleet_respawns']['samples'])
+
+    def test_bound_but_never_healthy_replica_is_replaced(self, session):
+        """A replica that binds its endpoint but NEVER answers a
+        healthy probe (sick export) must still be classified and
+        replaced — not parked in 'starting' below desired capacity."""
+        add_computer(session, 'h1')
+        add_computer(session, 'h2')
+        fleet = create_fleet(session, 'f', 'm', desired=1)
+        health = {}
+        sup, health = make_supervisor(session, health)
+        sup.build()
+        bring_up(session, fleet.id)
+        rp = ReplicaProvider(session)
+        replica = rp.of_fleet(fleet.id)[0]
+        health[replica.url] = False         # never healthy
+        for _ in range(4):
+            expire_probes(session)
+            sup.build()
+        rows = rp.of_fleet(fleet.id)
+        dead = next(r for r in rows if r.id == replica.id)
+        assert dead.state == 'dead'
+        assert dead.failure_reason == 'replica-unhealthy'
+        assert any(r.respawned_from == replica.id for r in rows)
+
+    def test_heartbeat_silence_is_worker_lost(self, session):
+        add_computer(session, 'h1')
+        add_computer(session, 'h2')
+        fleet = create_fleet(session, 'f', 'm', desired=1)
+        sup, _ = make_supervisor(session, replica_silence_s=60)
+        sup.build()
+        bring_up(session, fleet.id)
+        sup.build()
+        rp, tp = ReplicaProvider(session), TaskProvider(session)
+        replica = rp.of_fleet(fleet.id)[0]
+        session.execute(
+            'UPDATE task SET last_activity=? WHERE id=?',
+            (now() - datetime.timedelta(seconds=300), replica.task))
+        sup.build()
+        replica = rp.by_id(replica.id)
+        assert replica.state == 'dead'
+        assert replica.failure_reason == 'worker-lost'
+        assert tp.by_id(replica.task).failure_reason == 'worker-lost'
+
+    def test_task_verdict_absorbed(self, session):
+        """A replica whose task the LEASE/watchdog machinery failed
+        inherits that verdict — no probe needed."""
+        add_computer(session, 'h1')
+        add_computer(session, 'h2')
+        fleet = create_fleet(session, 'f', 'm', desired=1)
+        sup, _ = make_supervisor(session)
+        sup.build()
+        rp, tp = ReplicaProvider(session), TaskProvider(session)
+        replica = rp.of_fleet(fleet.id)[0]
+        tp.fail_with_reason(tp.by_id(replica.task), 'lease-expired')
+        sup.build()
+        rows = rp.of_fleet(fleet.id)
+        dead = next(r for r in rows if r.id == replica.id)
+        assert dead.state == 'dead'
+        assert dead.failure_reason == 'lease-expired'
+        assert len(rows) == 2               # replacement minted
+
+    def test_scale_down_is_not_a_respawn_storm(self, session):
+        add_computer(session, 'h1')
+        fleet = create_fleet(session, 'f', 'm', desired=2, cores=1)
+        sup, _ = make_supervisor(session)
+        sup.build()
+        fp = FleetProvider(session)
+        fleet = fp.by_name('f')
+        fleet.desired = 0
+        fp.touch(fleet, ['desired'])
+        sup.build()
+        # desired 0: nothing new minted (live replicas are retired by
+        # stop/swap flows, not the count reconciler)
+        assert len(ReplicaProvider(session).of_fleet(fleet.id)) == 2
+
+    def test_stop_fleet_kills_replicas(self, session):
+        add_computer(session, 'h1')
+        fleet = create_fleet(session, 'f', 'm', desired=2)
+        sup, _ = make_supervisor(session)
+        sup.build()
+        stop_fleet(session, FleetProvider(session).by_name('f'))
+        assert FleetProvider(session).by_name('f').status == 'stopped'
+        rp = ReplicaProvider(session)
+        assert all(r.state == 'dead' for r in rp.of_fleet(fleet.id))
+        sup.build()                         # stopped: not reconciled
+        assert all(r.state == 'dead' for r in rp.of_fleet(fleet.id))
+
+
+class TestRollingSwap:
+    def _warm_fleet(self, session, desired=2):
+        for host in ('h1', 'h2'):
+            add_computer(session, host)
+        fleet = create_fleet(session, 'f', 'm_v1', desired=desired)
+        sup, health = make_supervisor(session, drain_grace_s=0.0)
+        sup.build()
+        bring_up(session, fleet.id)
+        sup.build()
+        return fleet, sup, health
+
+    def test_flip_after_warmup_then_drain(self, session):
+        fleet, sup, _ = self._warm_fleet(session)
+        fp, rp, tp = (FleetProvider(session), ReplicaProvider(session),
+                      TaskProvider(session))
+        start_swap(session, fp.by_name('f'), 'm_v2')
+        sup.build()                         # stage generation 2
+        gen2 = rp.of_fleet(fleet.id, generation=2)
+        assert len(gen2) == 2
+        info = yaml_load(tp.by_id(gen2[0].task).additional_info)
+        assert info['serve']['model'] == 'm_v2'
+        # generation 1 still routed while 2 warms
+        assert fp.by_name('f').generation == 1
+        expire_probes(session)
+        bring_up(session, fleet.id)
+        sup.build()                         # gen2 healthy -> flip
+        fleet_row = fp.by_name('f')
+        assert fleet_row.generation == 2
+        assert fleet_row.model == 'm_v2'
+        assert fleet_row.status == 'active'
+        assert fleet_row.target_generation is None
+        g1 = rp.of_fleet(fleet.id, generation=1)
+        assert all(r.state == 'draining' for r in g1)
+        sup.build()                         # drain grace 0: retire
+        g1 = rp.of_fleet(fleet.id, generation=1)
+        statuses = [tp.by_id(r.task).status for r in g1]
+        assert all(s >= int(TaskStatus.Failed) for s in statuses)
+        sup.build()
+        assert all(r.state == 'dead'
+                   for r in rp.of_fleet(fleet.id, generation=1))
+        # swap event exported
+        from mlcomp_tpu.telemetry.export import (
+            parse_openmetrics, render_server_metrics,
+        )
+        doc = parse_openmetrics(render_server_metrics(session))
+        assert any(l.get('outcome') == 'completed'
+                   for _, l, _ in doc['mlcomp_fleet_swaps']['samples'])
+
+    def test_failed_warmup_rolls_back(self, session):
+        fleet, sup, health = self._warm_fleet(session)
+        fp, rp = FleetProvider(session), ReplicaProvider(session)
+        start_swap(session, fp.by_name('f'), 'm_v2')
+        sup.build()
+        for replica in rp.of_fleet(fleet.id, generation=2):
+            health[f'http://127.0.0.1:{9000 + replica.id}'] = False
+        session.execute(
+            'UPDATE serve_fleet SET swap_started=? WHERE id=?',
+            (now() - datetime.timedelta(seconds=3600), fleet.id))
+        sup.build()
+        fleet_row = fp.by_name('f')
+        assert fleet_row.generation == 1    # never flipped
+        assert fleet_row.model == 'm_v1'
+        assert fleet_row.status == 'active'
+        assert fleet_row.target_generation is None
+        assert all(r.state == 'dead'
+                   and r.failure_reason == 'swap-rollback'
+                   for r in rp.of_fleet(fleet.id, generation=2))
+        from mlcomp_tpu.db.providers import AlertProvider
+        alerts = AlertProvider(session).get(status='open',
+                                            rule='swap-rollback')
+        assert alerts and alerts[0].severity == 'critical'
+        # generation 1 keeps serving and is still reconciled
+        assert len(rp.live(fleet.id, 1)) == 2
+
+    def test_double_swap_rejected(self, session):
+        fleet, sup, _ = self._warm_fleet(session)
+        fp = FleetProvider(session)
+        start_swap(session, fp.by_name('f'), 'm_v2')
+        with pytest.raises(ValueError, match='already swapping'):
+            start_swap(session, fp.by_name('f'), 'm_v3')
+
+
+class TestGatewayDbRefresh:
+    def test_routes_follow_active_generation(self, session, stub_pair):
+        b1, b2 = stub_pair
+        add_computer(session, 'h1')
+        add_computer(session, 'h2')
+        fleet = create_fleet(session, 'f', 'm', desired=1)
+        sup, _ = make_supervisor(session)
+        sup.build()
+        rp = ReplicaProvider(session)
+        replica = rp.of_fleet(fleet.id)[0]
+        bring_up(session, fleet.id)
+        rp.mark_endpoint(replica.id, 'h1', b1['port'],
+                         f'http://127.0.0.1:{b1["port"]}')
+        sup.build()
+        gw = FleetGateway(port=0, session=session, refresh_s=3600)
+        gw.start_background()
+        gw.refresh_from_db()
+        try:
+            code, body, _ = gw_post(gw, path='/predict/f')
+            assert code == 200 and body['y'] == [b1['port']]
+            # flip the healthy endpoint to the second stub (a new
+            # generation in miniature) and refresh
+            session.execute(
+                'UPDATE serve_replica SET url=? WHERE id=?',
+                (f'http://127.0.0.1:{b2["port"]}', replica.id))
+            gw.refresh_from_db()
+            code, body, _ = gw_post(gw, path='/predict/f')
+            assert code == 200 and body['y'] == [b2['port']]
+            # stopped fleet drops out of the routing table
+            stop_fleet(session, FleetProvider(session).by_name('f'))
+            gw.refresh_from_db()
+            assert gw_post(gw, path='/predict/f')[0] == 404
+        finally:
+            gw.shutdown()
+
+
+# ------------------------------------------------- serve_replica executor
+@pytest.mark.slow
+class TestServeReplicaExecutor:
+    def test_executor_serves_and_reports_endpoint(self, session,
+                                                  tmp_path):
+        import numpy as np
+        import jax
+        from mlcomp_tpu.db.models import ServeFleet, ServeReplica, Task
+        from mlcomp_tpu.models import create_model
+        from mlcomp_tpu.train.export import export_model
+        from mlcomp_tpu.worker.executors import Executor
+
+        spec = {'name': 'mlp', 'num_classes': 3, 'hidden': [8],
+                'dtype': 'float32'}
+        model = create_model(**spec)
+        variables = model.init(jax.random.PRNGKey(0),
+                               np.zeros((1, 4, 4, 1), np.float32),
+                               train=False)
+        path = export_model(
+            str(tmp_path / 'exp'), variables['params'], spec,
+            meta={'input_shape': [4, 4, 1]})
+        fp, rp, tp = (FleetProvider(session), ReplicaProvider(session),
+                      TaskProvider(session))
+        fleet = ServeFleet(name='exec', model=path, desired=1,
+                           created=now())
+        fp.add(fleet)
+        replica = ServeReplica(fleet=fleet.id, generation=1,
+                               state='starting', created=now())
+        rp.add(replica)
+        task = Task(name='serve_exec', executor='serve_replica',
+                    status=int(TaskStatus.InProgress),
+                    last_activity=now())
+        tp.add(task)
+        cls = Executor.get('serve_replica')
+        ex = cls()
+        ex.additional_info = {'serve': {
+            'fleet': fleet.id, 'fleet_name': 'exec',
+            'replica': replica.id, 'generation': 1,
+            'model': path, 'batch_size': 8}}
+        ex.session = session
+        ex.task = task
+        ex.beat_interval_s = 0.1
+        result = {}
+
+        def run():
+            result['out'] = ex.work()
+        thread = threading.Thread(target=run, daemon=True)
+        thread.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            row = rp.by_id(replica.id)
+            if row.url:
+                break
+            time.sleep(0.05)
+        row = rp.by_id(replica.id)
+        assert row.url and row.port
+        # the replica answers the fleet probe contract AND predicts
+        from mlcomp_tpu.server.fleet import http_probe
+        assert http_probe(row.url) is True
+        req = urllib.request.Request(
+            row.url + '/predict',
+            data=json.dumps(
+                {'x': np.zeros((2, 4, 4, 1)).tolist()}).encode(),
+            headers={'Authorization': TOKEN})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        assert np.asarray(out['y']).shape == (2, 3)
+        # the beat touches last_activity (the silence horizon input)
+        before = tp.by_id(task.id).last_activity
+        time.sleep(0.3)
+        assert tp.by_id(task.id).last_activity >= before
+        ex.server.shutdown()
+        thread.join(timeout=10)
+        assert result['out']['replica'] == replica.id
+        assert result['out']['requests'] >= 1
+
+
+# ------------------------------------------------------- migration/API
+class TestFleetDbAndApi:
+    def test_v8_db_upgrades_in_place(self, tmp_path):
+        """A pre-fleet DB (migrations rolled to v8) gains the v9
+        tables on migrate() without touching existing rows."""
+        import sqlite3
+        from mlcomp_tpu.db.core import Session
+        from mlcomp_tpu.db.migration import MIGRATIONS, migrate
+        db = tmp_path / 'old.db'
+        session = Session(f'sqlite:///{db}', key='fleet_v8_upgrade')
+        session.execute(
+            'CREATE TABLE IF NOT EXISTS migration_version '
+            '(version INTEGER)')
+        for i, fn in enumerate(MIGRATIONS[:8], start=1):
+            fn(session)
+            session.execute(
+                'INSERT INTO migration_version (version) VALUES (?)',
+                (i,))
+        session.execute(
+            "INSERT INTO task (name, executor, status) "
+            "VALUES ('old', 'e', 0)")
+        migrate(session)
+        names = {r['name'] for r in session.query(
+            "SELECT name FROM sqlite_master WHERE type='table'")}
+        assert {'serve_fleet', 'serve_replica'} <= names
+        assert session.query_one(
+            'SELECT COUNT(*) AS c FROM task')['c'] == 1
+
+    def test_api_fleet_lifecycle(self, session):
+        from mlcomp_tpu.server.api import (
+            api_fleet_create, api_fleet_scale, api_fleet_stop,
+            api_fleet_swap, api_fleets,
+        )
+        res = api_fleet_create(
+            {'name': 'apif', 'model': 'm', 'desired': 2,
+             'slo_p99_ms': 100}, session)
+        assert res['success']
+        listing = api_fleets({}, session)['data']
+        assert listing[0]['name'] == 'apif'
+        assert listing[0]['slo_p99_ms'] == 100.0
+        api_fleet_scale({'name': 'apif', 'desired': 4}, session)
+        api_fleet_swap({'name': 'apif', 'model': 'm2'}, session)
+        listing = api_fleets({}, session)['data'][0]
+        assert listing['desired'] == 4
+        assert listing['status'] == 'swapping'
+        assert listing['target_model'] == 'm2'
+        from mlcomp_tpu.server.api import ApiError
+        with pytest.raises(ApiError):       # duplicate name
+            api_fleet_create({'name': 'apif', 'model': 'm'}, session)
+        with pytest.raises(ApiError):       # double swap
+            api_fleet_swap({'name': 'apif', 'model': 'm3'}, session)
+        api_fleet_stop({'name': 'apif'}, session)
+        assert api_fleets({}, session)['data'] == []
+        assert api_fleets({'all': True}, session)['data'][0][
+            'status'] == 'stopped'

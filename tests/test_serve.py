@@ -626,6 +626,82 @@ class TestRobustness:
         assert done['drained'] is True
         assert 'y' in result      # the in-flight request completed
 
+    def test_drain_admission_race_serves_accepted_request(self, export):
+        """The drain/admission race: a request that was ACCEPTED (in
+        the in-flight count) but had not yet reached the admission
+        check when drain() flipped the flag must be SERVED, not 503'd —
+        admission is decided under the same lock drain flips under.
+        Orchestrated deterministically: the request is held between
+        acceptance and routing while the drain starts."""
+        srv = ModelServer(export, batch_size=8, activation='softmax',
+                          port=0)
+        srv.warmup()
+        srv.bind()
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        gate = threading.Event()
+        orig_route = srv._route
+
+        def held_route(path):
+            gate.wait(10)        # between acceptance and admission
+            return orig_route(path)
+        srv._route = held_route
+        result = {}
+
+        def client():
+            try:
+                result['out'] = _post(
+                    srv, {'x': np.zeros((1, 4, 4, 1)).tolist()})
+            except urllib.error.HTTPError as e:
+                result['code'] = e.code
+        t = threading.Thread(target=client)
+        t.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:   # accepted (counted in)
+            with srv._inflight_lock:
+                if srv._http_inflight:
+                    break
+            time.sleep(0.005)
+        done = {}
+
+        def drainer():
+            done['drained'] = srv.drain(timeout_s=10)
+        dt = threading.Thread(target=drainer)
+        dt.start()
+        deadline = time.monotonic() + 10
+        while not srv._draining and time.monotonic() < deadline:
+            time.sleep(0.005)
+        gate.set()               # request proceeds INTO a live drain
+        t.join(timeout=30)
+        dt.join(timeout=30)
+        try:
+            assert 'out' in result, f'503d by the drain: {result}'
+            assert done['drained'] is True
+        finally:
+            srv.shutdown()
+
+    def test_post_drain_request_rejected_with_retry_after(self, export):
+        """The other side of the race fix: a request arriving AFTER
+        the drain flip gets a clean 503 + Retry-After (the router's
+        failover cue), and drain still completes."""
+        srv = ModelServer(export, batch_size=8, activation='softmax',
+                          port=0)
+        srv.warmup()
+        srv.bind()
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            assert srv.drain(timeout_s=5) is True
+            req = urllib.request.Request(
+                f'http://127.0.0.1:{srv.port}/predict',
+                data=json.dumps(
+                    {'x': np.zeros((1, 4, 4, 1)).tolist()}).encode(),
+                headers={'Authorization': TOKEN})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=30)
+            assert e.value.code == 503
+            assert e.value.headers.get('Retry-After') == '1'
+        finally:
+            srv.shutdown()
+
     def test_drain_timeout_reports_false(self, export):
         srv = ModelServer(export, batch_size=8, activation='softmax',
                           port=0)
@@ -641,6 +717,39 @@ class TestRobustness:
         time.sleep(0.2)
         assert srv.graceful_shutdown(drain_timeout_s=0.2) is False
         t.join(timeout=30)
+
+class TestServingFaultSeams:
+    """Satellite: the serving request path carries the chaos seams
+    (serve.request / replica.slow / replica.crash) the fleet chaos
+    scenario arms — disabled cost is one module-global check each."""
+
+    def test_serve_request_raise_and_replica_slow(self, server):
+        from mlcomp_tpu.testing.faults import (
+            clear_faults, configure_faults,
+        )
+        try:
+            configure_faults({'serve.request': {
+                'action': 'raise', 'after': 1, 'times': 1}})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(server, {'x': np.zeros((1, 4, 4, 1)).tolist()})
+            assert e.value.code == 500
+            # the streak is spent: the server survives and serves
+            out = _post(server, {'x': np.zeros((1, 4, 4, 1)).tolist()})
+            assert np.asarray(out['y']).shape == (1, 3)
+            clear_faults()
+            configure_faults({'replica.slow': {
+                'action': 'sleep', 'ms': 120, 'times': 1}})
+            t0 = time.monotonic()
+            _post(server, {'x': np.zeros((1, 4, 4, 1)).tolist()})
+            slow_wall = time.monotonic() - t0
+            clear_faults()
+            t0 = time.monotonic()
+            _post(server, {'x': np.zeros((1, 4, 4, 1)).tolist()})
+            fast_wall = time.monotonic() - t0
+            assert slow_wall >= fast_wall + 0.1   # the injected 120 ms
+        finally:
+            clear_faults()
+
 
 class TestServingMetrics:
     """Satellite: per-request latencies feed REAL histogram buckets,
