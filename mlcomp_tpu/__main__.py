@@ -122,25 +122,93 @@ def dag(config, params):
     click.echo(f'dag {dag_row.id} created with {total} tasks')
 
 
+#: ``mlcomp_tpu check`` exit-code contract — CI and the submit gate
+#: consume the same interface, so these are API:
+#:   0 — clean (config mode: no error findings; --code mode: no
+#:       findings at all, suppressed ones excluded)
+#:   1 — findings (config mode: >=1 error; --code mode: >=1 finding)
+#:   2 — analyzer error (missing path, unreadable input, engine crash)
+EXIT_CLEAN, EXIT_FINDINGS, EXIT_ANALYZER_ERROR = 0, 1, 2
+
+
+def _findings_json(findings, files: int = None) -> str:
+    from mlcomp_tpu.analysis import split_findings
+    errors, warnings = split_findings(findings)
+    payload = {'findings': [f.to_dict() for f in findings],
+               'counts': {'total': len(findings),
+                          'error': len(errors),
+                          'warning': len(warnings)}}
+    if files is not None:
+        payload['files'] = files
+    return json.dumps(payload)
+
+
 @main.command()
-@click.argument('config')
+@click.argument('config', required=False)
+@click.option('--code', 'code_paths', multiple=True,
+              type=click.Path(),
+              help='lint code tree(s) instead of a config: lockset '
+                   'races, DB state transitions, JAX hot paths '
+                   '(rules cc-*, db-*, jax-*); ANY unsuppressed '
+                   'finding exits 1')
+@click.option('--json', 'as_json', is_flag=True,
+              help='machine-readable output (findings + counts)')
 @click.option('--params', multiple=True,
               help='overrides to dry-run, e.g. --params lr:0.01')
 @click.option('--no-why', is_flag=True,
               help='omit the per-rule rationale lines')
-def check(config, params, no_why):
-    """Preflight a DAG config without submitting it.
+def check(config, code_paths, as_json, params, no_why):
+    """Static analysis without side effects.
 
-    Runs both static-analysis engines (DAG validation + JAX hot-path
-    lint over the experiment folder) and prints rule-tagged findings.
-    Exit status: 0 when no errors (warnings allowed), 1 otherwise.
+    Config mode (``check CONFIG``): DAG validation + JAX hot-path lint
+    over the experiment folder; exit 0 when no errors (warnings ride
+    along), 1 on errors.
+
+    Code mode (``check --code PATH``): the concurrency lockset lint,
+    the DB state-transition checker and the JAX lint over a code tree
+    — the gate CI runs over mlcomp_tpu/ itself; exit 0 only when ZERO
+    unsuppressed findings remain. Both modes: exit 2 on analyzer error
+    (missing path, engine crash); ``--json`` for scripts.
     """
     from mlcomp_tpu.analysis import format_report, split_findings
-    findings, _, _ = _preflight(config, params)
-    click.echo(format_report(findings, with_why=not no_why))
+    if code_paths and config:
+        raise click.UsageError('give a CONFIG or --code, not both')
+    if code_paths:
+        from mlcomp_tpu.analysis import expand_code_paths, lint_code_paths
+        try:
+            files = expand_code_paths(code_paths)
+            findings = lint_code_paths(code_paths, files=files)
+        except FileNotFoundError as e:
+            click.echo(f'analyzer error: {e}', err=True)
+            raise SystemExit(EXIT_ANALYZER_ERROR)
+        except Exception as e:  # engine crash must not read as "clean"
+            click.echo(f'analyzer error: {e}', err=True)
+            raise SystemExit(EXIT_ANALYZER_ERROR)
+        if as_json:
+            click.echo(_findings_json(findings, files=len(files)))
+        else:
+            click.echo(format_report(findings, with_why=not no_why))
+            click.echo(f'linted {len(files)} files')
+        raise SystemExit(EXIT_FINDINGS if findings else EXIT_CLEAN)
+    if not config:
+        raise click.UsageError('give a CONFIG to preflight or --code '
+                               'PATH to lint')
+    if not os.path.exists(config):
+        click.echo(f'analyzer error: config not found: {config}',
+                   err=True)
+        raise SystemExit(EXIT_ANALYZER_ERROR)
+    try:
+        findings, _, _ = _preflight(config, params)
+    except Exception as e:
+        click.echo(f'analyzer error: {e}', err=True)
+        raise SystemExit(EXIT_ANALYZER_ERROR)
+    if as_json:
+        click.echo(_findings_json(findings))
+    else:
+        click.echo(format_report(findings, with_why=not no_why))
     errors, _ = split_findings(findings)
     if errors:
-        raise SystemExit(1)
+        raise SystemExit(EXIT_FINDINGS)
 
 
 @main.command()

@@ -1,23 +1,35 @@
-"""Preflight static analysis: fail bad DAGs at submit, not on a TPU slot.
+"""Preflight static analysis: fail bad DAGs at submit, not on a TPU
+slot — and bad control-plane code in CI, not in a 3 a.m. page.
 
-Two engines, no jax import, no user-code import:
+Four engines, no jax import, no user-code import:
 
 - ``dag_check``: config + code-snapshot validation (executor resolution
   by AST against the registry semantics, dependency cycles/dangling
   edges, mesh-vs-cores arithmetic, ambiguous grid/--params overrides)
 - ``jax_lint``: AST lint of jit'd hot paths (host syncs, missing
-  donation, recompile hazards, leftover debug prints) with inline
-  ``# preflight: disable=<rule>`` suppressions
+  donation, recompile hazards, leftover debug prints)
+- ``concurrency``: lockset lint of the threaded servers (unguarded
+  shared state, check-then-act, blocking calls under a held lock,
+  inconsistent lock order)
+- ``db_check``: DB state-transition checker (naked state-machine
+  writes, read-modify-write across a commit boundary)
 
+All four honor inline ``# preflight: disable=<rule>`` suppressions.
 Wired through: ``mlcomp_tpu check <config>`` (CLI), the ``dag`` upload
-gate (errors reject before DB insert; warnings stored with the dag row),
-``POST /api/dag/preflight`` (server + dashboard), and the supervisor
-(refuses to dispatch tasks of a DAG that fails preflight).
+gate (errors reject before DB insert; warnings stored with the dag
+row), ``POST /api/dag/preflight`` (server + dashboard), the supervisor
+(refuses to dispatch tasks of a DAG that fails preflight), and
+``mlcomp_tpu check --code <path>`` — the code gate CI runs over
+``mlcomp_tpu/`` itself (exit 0 clean / 1 findings / 2 analyzer error).
 ``python -m mlcomp_tpu.analysis --self-lint`` lints mlcomp_tpu itself.
 """
 
+import ast
+import os
+
 from mlcomp_tpu.analysis.findings import (
-    RULES, Finding, PreflightError, format_report, split_findings,
+    RULES, Finding, PreflightError, format_report, sort_findings,
+    split_findings,
 )
 from mlcomp_tpu.analysis.dag_check import (
     builtin_executor_names, folder_sources, gate_config,
@@ -26,11 +38,70 @@ from mlcomp_tpu.analysis.dag_check import (
 from mlcomp_tpu.analysis.jax_lint import (
     lint_paths, lint_source, lint_sources, self_lint,
 )
+from mlcomp_tpu.analysis.concurrency import lint_concurrency_source
+from mlcomp_tpu.analysis.db_check import check_db_source
+
+
+def lint_code_source(text: str, path: str = '<string>') -> list:
+    """Every code-rule engine (jax-*, cc-*, db-*) over one module."""
+    findings = lint_source(text, path)
+    findings += lint_concurrency_source(text, path)
+    findings += check_db_source(text, path)
+    return sort_findings(findings)
+
+
+def expand_code_paths(paths):
+    """Files under ``paths`` the code gate lints (.py, skipping
+    __pycache__/hidden dirs); missing paths raise FileNotFoundError —
+    the CLI maps that to exit code 2 (analyzer error, not 'clean')."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if d != '__pycache__'
+                           and not d.startswith('.')]
+                out.extend(os.path.join(dirpath, f) for f in files
+                           if f.endswith('.py'))
+        elif os.path.isfile(p):
+            out.append(p)
+        else:
+            raise FileNotFoundError(f'no such file or directory: {p}')
+    return sorted(out)
+
+
+def lint_code_paths(paths, files=None) -> list:
+    """The code gate: all code rules over files/trees, deterministic
+    (file, line, rule) order. Pass ``files`` (from a prior
+    ``expand_code_paths``) to skip a second tree walk AND to guarantee
+    the reported file count covers exactly what was linted.
+
+    Unlike the submit-gate engines (which skip unparsable USER
+    snapshots — executor resolution covers that fallout), a file this
+    gate cannot parse raises: the gate's exit 0 asserts "the whole
+    tree was analyzed and is clean", and a module full of merge
+    conflict markers was neither — the CLI maps the raise to exit 2
+    (analyzer error), never to 'clean'."""
+    findings = []
+    for path in (expand_code_paths(paths) if files is None else files):
+        with open(path, encoding='utf-8', errors='ignore') as fh:
+            text = fh.read()
+        try:
+            ast.parse(text)
+        except SyntaxError as e:
+            raise SyntaxError(
+                f'{path} cannot be parsed ({e.msg}, line {e.lineno}) '
+                f'— the code gate refuses to report an unanalyzed '
+                f'file as clean') from e
+        findings.extend(lint_code_source(text, path))
+    return sort_findings(findings)
+
 
 __all__ = [
     'Finding', 'PreflightError', 'RULES', 'format_report',
-    'split_findings', 'preflight_config', 'gate_config',
+    'split_findings', 'sort_findings', 'preflight_config', 'gate_config',
     'resolvable_executor_names', 'builtin_executor_names',
     'folder_sources', 'snapshot_sources',
     'lint_source', 'lint_sources', 'lint_paths', 'self_lint',
+    'lint_concurrency_source', 'check_db_source',
+    'lint_code_source', 'lint_code_paths', 'expand_code_paths',
 ]

@@ -6,7 +6,9 @@
 Exits non-zero when any unsuppressed finding remains — the CI contract:
 every finding in the framework's own code is either fixed or carries an
 inline ``# preflight: disable=<rule>`` with a justification. For config
-preflight use ``mlcomp_tpu check <config>``.
+preflight use ``mlcomp_tpu check <config>``; for the full code gate
+(concurrency lockset + DB state-transition rules on top of these) use
+``mlcomp_tpu check --code <path>``.
 """
 
 import argparse

@@ -1,0 +1,378 @@
+"""Lockset lint for the threaded control plane — the race shapes every
+review round used to hand-find in ``server/``, ``worker/`` and
+``telemetry/``.
+
+Eraser-style lockset approximation in the spirit of Infer's RacerD:
+per-class (plus a per-receiver pass for objects mutated from outside
+their class, the gateway's ``route.*`` pattern), purely syntactic, no
+interprocedural heroics. A lock is an attribute (or module global)
+assigned ``threading.Lock()``/``RLock()``/``Condition()``; a lockset is
+the set of such locks held via enclosing ``with`` statements on the
+SAME receiver. Three rules (ids in findings.RULES):
+
+- ``cc-lockset`` — an attribute written under a lock at one site is
+  written — or read inside an ``if``/``while`` condition, the
+  check-then-act shape — with an empty intersecting lockset at another.
+  The signal is deliberately asymmetric: attributes never written under
+  any lock are skipped (plain single-threaded state), and ``__init__``
+  writes don't count (construction happens before the object is
+  published to other threads).
+- ``cc-lock-held-blocking`` — ``time.sleep``, an HTTP round-trip
+  (``urlopen``/``getresponse``), a subprocess wait, or a DB round-trip
+  (``*.session.query/execute/...``) inside a held lock.
+- ``cc-lock-order`` — two named locks acquired in opposite nesting
+  orders at different sites in one module (AB at one, BA at another).
+
+Known approximations, on purpose: helper functions called from a
+locked region are not followed (single-function locksets);
+``lock.acquire()``/``release()`` pairs are invisible (the codebase is
+``with``-statement discipline throughout); two same-named receivers in
+one module are assumed to alias the same object class. Suppress real
+exceptions inline with ``# preflight: disable=<rule>`` plus a
+justification — the CI gate requires one.
+"""
+
+import ast
+
+from mlcomp_tpu.analysis.findings import Finding
+from mlcomp_tpu.analysis.jax_lint import _dotted, parse_suppressions
+
+#: constructors whose result makes an attribute/global a "lock"
+_LOCK_CTORS = {
+    'threading.Lock', 'threading.RLock', 'threading.Condition',
+    'Lock', 'RLock', 'Condition',
+    'multiprocessing.Lock', 'multiprocessing.RLock',
+}
+
+#: dotted call names that block while held (full-name matches)
+_BLOCKING_DOTTED = {
+    'time.sleep',
+    'urllib.request.urlopen', 'request.urlopen', 'urlopen',
+    'subprocess.run', 'subprocess.check_output',
+    'subprocess.check_call', 'subprocess.call',
+}
+
+#: attribute method names that block whatever the receiver (HTTP
+#: response reads, subprocess waits). ``.wait`` is deliberately absent:
+#: ``Condition.wait`` while holding its own lock is the CORRECT pattern.
+_BLOCKING_ATTRS = {'getresponse', 'urlopen', 'communicate'}
+
+#: method names that are a DB round-trip when called on a session
+_DB_METHODS = {'query', 'query_one', 'execute', 'executemany',
+               'commit', 'add', 'add_all', 'update_obj'}
+
+
+def _is_lock_ctor(node) -> bool:
+    return isinstance(node, ast.Call) and _dotted(node.func) in _LOCK_CTORS
+
+
+def _self_attr(node, name='self'):
+    """'x' for ``self.x`` (Load/Store either), else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == name:
+        return node.attr
+    return None
+
+
+class _ModuleIndex:
+    """Parse once; parent links, suppressions, and the module's lock
+    vocabulary (attribute names + module globals assigned a Lock)."""
+
+    def __init__(self, text: str, path: str):
+        self.path = path
+        self.tree = ast.parse(text)
+        self.suppress = parse_suppressions(text)
+        self.parent = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+        # every attr name assigned a Lock() anywhere in the module
+        # (``self.lock = threading.Lock()``) plus module-level names
+        # (``_LOCK = threading.Lock()``) — the vocabulary the held-lock
+        # walk recognizes in ``with`` items
+        self.lock_attrs = set()
+        self.lock_globals = set()
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Assign) and
+                    _is_lock_ctor(node.value)):
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Attribute):
+                    self.lock_attrs.add(target.attr)
+                elif isinstance(target, ast.Name):
+                    self.lock_globals.add(target.id)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppress.get(line)
+        return bool(rules) and ('all' in rules or rule in rules)
+
+    # ------------------------------------------------------------ lock walk
+    def _lock_token(self, expr):
+        """A hashable identity for a ``with`` item that acquires a
+        known lock: ('recv', attr) for ``recv.attr``, ('', name) for a
+        module-global — None when the expression is not a lock."""
+        if isinstance(expr, ast.Attribute) and \
+                expr.attr in self.lock_attrs and \
+                isinstance(expr.value, ast.Name):
+            return (expr.value.id, expr.attr)
+        if isinstance(expr, ast.Name) and expr.id in self.lock_globals:
+            return ('', expr.id)
+        return None
+
+    def held_locks(self, node):
+        """Lock tokens of every enclosing ``with`` around ``node``,
+        stopping at the enclosing function boundary (locksets are
+        per-function: a caller's lock is invisible, documented)."""
+        held = set()
+        cur = self.parent.get(node)
+        child = node
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                      ast.Lambda, ast.ClassDef, ast.Module)):
+            if isinstance(cur, ast.With) and child in cur.body:
+                for item in cur.items:
+                    token = self._lock_token(item.context_expr)
+                    if token is not None:
+                        held.add(token)
+            child = cur
+            cur = self.parent.get(cur)
+        # the function's own body may sit under a with in an outer
+        # function — stop there anyway: a nested def runs later, on
+        # a thread that does NOT hold the outer with
+        return held
+
+    def enclosing_function(self, node):
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parent.get(cur)
+        return None
+
+    def in_branch_test(self, node) -> bool:
+        """Is ``node`` inside the condition of an if/while/ternary —
+        the check half of check-then-act?"""
+        cur = self.parent.get(node)
+        child = node
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                      ast.Lambda, ast.Module)):
+            if isinstance(cur, (ast.If, ast.While, ast.IfExp)) and \
+                    child is cur.test:
+                return True
+            child = cur
+            cur = self.parent.get(cur)
+        return False
+
+
+class _Access:
+    __slots__ = ('attr', 'line', 'is_write', 'lockset', 'in_test',
+                 'in_init')
+
+    def __init__(self, attr, line, is_write, lockset, in_test, in_init):
+        self.attr = attr
+        self.line = line
+        self.is_write = is_write
+        self.lockset = lockset
+        self.in_test = in_test
+        self.in_init = in_init
+
+
+class ConcurrencyLinter:
+    def __init__(self, text: str, path: str):
+        self.mod = _ModuleIndex(text, path)
+        self.findings = []
+        self._emitted = set()
+
+    def _add(self, rule: str, message: str, line: int):
+        if self.mod.is_suppressed(rule, line):
+            return
+        key = (rule, line, message)
+        if key in self._emitted:
+            return
+        self._emitted.add(key)
+        self.findings.append(Finding(
+            rule, message, path=self.mod.path, line=line))
+
+    # ---------------------------------------------------------- accesses
+    def _collect_accesses(self, scope, receiver: str):
+        """Every ``receiver.attr`` access inside ``scope`` (a class for
+        'self', the module for local receivers) that is not a lock,
+        not a method call's callee, tagged with its lockset."""
+        out = []
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if _self_attr(node, receiver) is None:
+                continue
+            attr = node.attr
+            if attr in self.mod.lock_attrs:
+                continue
+            parent = self.mod.parent.get(node)
+            # ``recv.method(...)`` — the callee, not shared state
+            if isinstance(parent, ast.Call) and parent.func is node:
+                continue
+            fn = self.mod.enclosing_function(node)
+            if fn is None:
+                continue            # class/module body: import time
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del)) or \
+                (isinstance(parent, ast.AugAssign)
+                 and parent.target is node)
+            # restrict locksets to locks on the SAME receiver: holding
+            # an unrelated lock does not guard this object
+            lockset = {t for t in self.mod.held_locks(node)
+                       if t[0] == receiver}
+            out.append(_Access(
+                attr, node.lineno, is_write, lockset,
+                self.mod.in_branch_test(node),
+                fn.name == '__init__'))
+        return out
+
+    def _check_lockset_group(self, accesses, where: str):
+        by_attr = {}
+        for acc in accesses:
+            by_attr.setdefault(acc.attr, []).append(acc)
+        for attr, accs in sorted(by_attr.items()):
+            writes = [a for a in accs if a.is_write and not a.in_init]
+            guards = set()
+            for w in writes:
+                guards |= w.lockset
+            if not guards:
+                continue            # never lock-guarded: no signal
+            names = ', '.join(sorted(
+                t[1] if t[0] in ('', 'self') else f'{t[0]}.{t[1]}'
+                for t in guards))
+            for w in writes:
+                if w.lockset & guards:
+                    continue
+                self._add(
+                    'cc-lockset',
+                    f"'{attr}' written without holding '{names}' that "
+                    f"guards its other writes ({where})", w.line)
+            for r in accs:
+                if r.is_write or r.in_init or not r.in_test:
+                    continue
+                if r.lockset & guards:
+                    continue
+                self._add(
+                    'cc-lockset',
+                    f"check-then-act: '{attr}' read in a condition "
+                    f"without '{names}' that guards its writes "
+                    f"({where}) — the value can change before the "
+                    f"branch acts on it", r.line)
+
+    def _check_locksets(self):
+        # per-class pass: self.* state in classes that own a lock
+        for cls in ast.walk(self.mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            own_locks = set()
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Assign) and \
+                        _is_lock_ctor(node.value):
+                    for t in node.targets:
+                        if _self_attr(t) is not None:
+                            own_locks.add(t.attr)
+            if not own_locks:
+                continue
+            self._check_lockset_group(
+                self._collect_accesses(cls, 'self'),
+                f'class {cls.name}')
+        # per-receiver pass: objects guarded through ``with recv.lock:``
+        # from OUTSIDE their class (the gateway mutates _FleetRoute
+        # counters this way). Group by (receiver name, attr) across the
+        # module; a receiver is interesting once any of its attribute
+        # writes happens under one of its own locks.
+        by_recv = {}
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    token = self.mod._lock_token(item.context_expr)
+                    if token and token[0] not in ('', 'self'):
+                        by_recv.setdefault(token[0], None)
+        for recv in sorted(by_recv):
+            self._check_lockset_group(
+                self._collect_accesses(self.mod.tree, recv),
+                f"receiver '{recv}'")
+
+    # ---------------------------------------------------------- blocking
+    def _is_blocking_call(self, call) -> bool:
+        dotted = _dotted(call.func)
+        if dotted in _BLOCKING_DOTTED:
+            return True
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr in _BLOCKING_ATTRS:
+                return True
+            if attr in _DB_METHODS:
+                recv = call.func.value
+                recv_name = None
+                if isinstance(recv, ast.Name):
+                    recv_name = recv.id
+                elif isinstance(recv, ast.Attribute):
+                    recv_name = recv.attr
+                if recv_name in ('session', '_session', 'db'):
+                    return True
+        return False
+
+    def _check_blocking(self):
+        for node in ast.walk(self.mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not self._is_blocking_call(node):
+                continue
+            held = self.mod.held_locks(node)
+            if not held:
+                continue
+            names = ', '.join(sorted(
+                t[1] if t[0] == '' else f'{t[0]}.{t[1]}' for t in held))
+            what = _dotted(node.func) or (
+                isinstance(node.func, ast.Attribute) and node.func.attr)
+            self._add(
+                'cc-lock-held-blocking',
+                f"'{what}' (sleep/HTTP/DB round-trip) called while "
+                f"holding '{names}' — every thread needing the lock "
+                f"stalls behind it", node.lineno)
+
+    # --------------------------------------------------------- lock order
+    def _check_lock_order(self):
+        pairs = {}                  # (tokA, tokB) -> first line
+        for node in ast.walk(self.mod.tree):
+            if not isinstance(node, ast.With):
+                continue
+            inner = [t for item in node.items
+                     if (t := self.mod._lock_token(item.context_expr))]
+            if not inner:
+                continue
+            outer = self.mod.held_locks(node)
+            for a in outer:
+                for b in inner:
+                    if a != b:
+                        pairs.setdefault((a, b), node.lineno)
+        def fmt(t):
+            return t[1] if t[0] == '' else f'{t[0]}.{t[1]}'
+        for (a, b), line in sorted(pairs.items(), key=lambda kv: kv[1]):
+            if (b, a) in pairs and pairs[(b, a)] < line:
+                self._add(
+                    'cc-lock-order',
+                    f"'{fmt(a)}' then '{fmt(b)}' acquired here, but "
+                    f"the opposite order at line {pairs[(b, a)]} — "
+                    f"concurrent callers deadlock", line)
+
+    # --------------------------------------------------------------- main
+    def run(self):
+        self._check_locksets()
+        self._check_blocking()
+        self._check_lock_order()
+        self.findings.sort(key=lambda f: (f.line or 0, f.rule))
+        return self.findings
+
+
+def lint_concurrency_source(text: str, path: str = '<string>') -> list:
+    try:
+        return ConcurrencyLinter(text, path).run()
+    except SyntaxError:
+        return []
+
+
+__all__ = ['ConcurrencyLinter', 'lint_concurrency_source']

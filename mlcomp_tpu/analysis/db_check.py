@@ -1,0 +1,311 @@
+"""DB state-transition checker — the lost-update shapes behind every
+exactly-once review fix (PR 5's lease reclaim, PR 8's respawn guard).
+
+The control plane's tables are state machines: ``task.status``,
+``queue_message.status``, ``serve_replica.state``, ``serve_fleet``'s
+swap columns. sqlite gives one writer at a time, but NOT one logical
+transition at a time — two processes that each read state S and write
+S' both succeed, and one transition is lost. The defense the codebase
+settled on is the conditional UPDATE (``... WHERE id=? AND
+status='pending'``, rowcount says who won). This pass finds writes
+that skip it. Two rules (ids in findings.RULES):
+
+- ``db-naked-transition`` — a state-machine column written without
+  conditioning on its prior value. Two shapes:
+  (a) raw SQL: an ``UPDATE t SET status=... WHERE ...`` whose WHERE
+      clause never mentions the column being transitioned;
+  (b) ORM: ``obj.status = X`` / ``obj.state = X`` in a function that
+      then ships it through ``update()``/``touch()``/``update_obj()``
+      — the generated statement is ``WHERE id=?``, unconditional by
+      construction.
+- ``db-rmw-commit`` — a row read into a variable, a commit boundary
+  (``commit()`` or another statement on the session — every statement
+  auto-commits in db/core.py), then a mutation of the stale object.
+
+Purely syntactic and per-function: a row passed IN as a parameter is
+not tracked (the caller's read is out of scope), and reads inside
+loops are anchored at the read line. Single-writer paths that are safe
+by architecture (only the supervisor tick writes replica states)
+suppress inline with ``# preflight: disable=<rule>`` + justification.
+"""
+
+import ast
+import re
+
+from mlcomp_tpu.analysis.findings import Finding
+from mlcomp_tpu.analysis.jax_lint import parse_suppressions
+
+#: state-machine columns -> the columns whose presence in a WHERE
+#: clause counts as "conditioned on the prior value". For ``status``/
+#: ``state`` the machine IS the column; for the queue's lease fields
+#: (``claimed_at``, ``redelivered``) and a fleet/gang ``generation``
+#: the machine is driven by ``status`` — a write guarded on the status
+#: transition is the correct conditional shape (``claim`` stamps
+#: claimed_at under ``WHERE ... status='pending'``)
+_STATE_COLUMNS = {
+    'status': {'status'},
+    'state': {'state'},
+    'claimed_at': {'status', 'claimed_at'},
+    'redelivered': {'status', 'redelivered'},
+    'generation': {'status', 'generation'},
+}
+
+#: call names that ship an ORM object to an UPDATE ... WHERE id=?
+_ORM_UPDATE_METHODS = {'update', 'touch', 'update_obj', 'set_state',
+                       'change_status'}
+
+#: call names that read a row into a variable
+_ROW_READ_METHODS = {'query_one', 'by_id', 'by_name', 'by_task',
+                     'fetchone', 'from_row'}
+
+#: call names that end the read's transaction (every statement in
+#: db/core.py is its own transaction, so any further statement is a
+#: commit boundary for an earlier read)
+_COMMIT_METHODS = {'commit', 'execute', 'executemany', 'add',
+                   'add_all', 'update', 'update_obj', 'touch'}
+
+_UPDATE_RE = re.compile(
+    r'^\s*UPDATE\s+(?P<table>[\w"]+)\s+SET\s+(?P<set>.*?)'
+    r'(?:\s+WHERE\s+(?P<where>.*))?$',
+    re.IGNORECASE | re.DOTALL)
+
+
+def _literal_text(node):
+    """Best-effort text of a string expression: Constant str directly,
+    JoinedStr (f-string) with formatted values as '?' placeholders,
+    BinOp('+') concatenation of such — None for anything else."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append('?')
+        return ''.join(parts)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _literal_text(node.left)
+        right = _literal_text(node.right)
+        if left is not None and right is not None:
+            return left + right
+    return None
+
+
+def _naked_sql_columns(sql: str):
+    """State columns SET by this UPDATE whose WHERE clause never
+    mentions them (or that has no WHERE at all)."""
+    m = _UPDATE_RE.match(sql.strip())
+    if m is None:
+        return []
+    set_clause = m.group('set') or ''
+    where = m.group('where') or ''
+    set_cols = {c.strip().strip('"').lower()
+                for c in re.findall(r'([\w"]+)\s*=', set_clause)}
+    where_cols = {w.lower() for w in re.findall(r'\w+', where)}
+    return sorted(c for c in (set_cols & set(_STATE_COLUMNS))
+                  if not (_STATE_COLUMNS[c] & where_cols))
+
+
+class DbTransitionChecker:
+    def __init__(self, text: str, path: str):
+        self.path = path
+        self.tree = ast.parse(text)
+        self.suppress = parse_suppressions(text)
+        self.findings = []
+        self._emitted = set()
+
+    def _add(self, rule: str, message: str, line: int):
+        rules = self.suppress.get(line)
+        if rules and ('all' in rules or rule in rules):
+            return
+        key = (rule, line, message)
+        if key in self._emitted:
+            return
+        self._emitted.add(key)
+        self.findings.append(Finding(
+            rule, message, path=self.path, line=line))
+
+    # ------------------------------------------------------------ raw SQL
+    def _check_sql_strings(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.Constant, ast.JoinedStr,
+                                     ast.BinOp)):
+                continue
+            # only the OUTERMOST expression of a concatenation/f-string
+            # (children of a BinOp/JoinedStr would re-report fragments)
+            parent_types = (ast.BinOp, ast.JoinedStr, ast.FormattedValue)
+            if isinstance(self._parent(node), parent_types):
+                continue
+            text = _literal_text(node)
+            if not text or 'update' not in text.lower():
+                continue
+            for col in _naked_sql_columns(text):
+                self._add(
+                    'db-naked-transition',
+                    f"UPDATE sets state column '{col}' but its WHERE "
+                    f"clause never checks the prior value — a "
+                    f"concurrent transition is silently overwritten "
+                    f"(make it conditional and check rowcount)",
+                    node.lineno)
+
+    def _parent(self, node):
+        if not hasattr(self, '_parents'):
+            self._parents = {}
+            for n in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(n):
+                    self._parents[child] = n
+        return self._parents.get(node)
+
+    # ---------------------------------------------------------- ORM shape
+    @staticmethod
+    def _call_method(node):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute):
+            return node.func.attr
+        return None
+
+    @staticmethod
+    def _first_arg_name(call):
+        if call.args and isinstance(call.args[0], ast.Name):
+            return call.args[0].id
+        return None
+
+    #: names like 'update'/'touch'/'add' exist on dicts and sets too —
+    #: only count them when the receiver is DB-shaped, or every
+    #: ``usage.update(fields)`` becomes a phantom commit boundary
+    _AMBIGUOUS_METHODS = {'update', 'touch', 'add'}
+
+    @classmethod
+    def _is_db_call(cls, call) -> bool:
+        method = cls._call_method(call)
+        if method is None:
+            return False
+        if method not in cls._AMBIGUOUS_METHODS:
+            return True
+        recv = call.func.value
+        if isinstance(recv, ast.Attribute):
+            return True             # self.tasks.update, self.session.add
+        return isinstance(recv, ast.Name) and (
+            recv.id in ('self', 'session', 'provider')
+            or recv.id.endswith('provider'))
+
+    def _functions(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    def _check_orm_writes(self):
+        for fn in self._functions():
+            # receivers this function ships through an ORM update —
+            # 'self' means a provider method updating itself (skipped:
+            # that's the update helper, not a transition site)
+            shipped = set()
+            for node in ast.walk(fn):
+                if self._call_method(node) in _ORM_UPDATE_METHODS \
+                        and self._is_db_call(node):
+                    name = self._first_arg_name(node)
+                    if name:
+                        shipped.add(name)
+            if not shipped:
+                continue
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.ctx, ast.Store)
+                        and node.attr in _STATE_COLUMNS
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in shipped):
+                    continue
+                self._add(
+                    'db-naked-transition',
+                    f"'{node.value.id}.{node.attr}' assigned and "
+                    f"shipped through an ORM update (WHERE id=?, "
+                    f"unconditional) — a concurrent transition on "
+                    f"this row is silently overwritten; use a "
+                    f"conditional UPDATE on the prior "
+                    f"{node.attr!r} and check rowcount",
+                    node.lineno)
+
+    # ------------------------------------------------------- RMW boundary
+    def _rmw_events(self, fn):
+        """(line, kind, var) events in source order. ``ast.walk`` is
+        breadth-first, so events are collected then sorted by line —
+        the pass below is a linear scan over the function's timeline."""
+        events = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                method = None
+                for call in ast.walk(node.value):
+                    if isinstance(call, ast.Call):
+                        method = self._call_method(call)
+                        break
+                if method in _ROW_READ_METHODS:
+                    events.append(
+                        (node.lineno, 'read', node.targets[0].id))
+            elif isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Store) and \
+                    isinstance(node.value, ast.Name):
+                events.append(
+                    (node.lineno, 'mutate', node.value.id))
+            elif isinstance(node, ast.Call):
+                method = self._call_method(node)
+                if not self._is_db_call(node):
+                    continue
+                if method in _ORM_UPDATE_METHODS:
+                    arg = self._first_arg_name(node)
+                    if arg:
+                        events.append((node.lineno, 'ship', arg))
+                if method in _COMMIT_METHODS:
+                    events.append(
+                        (node.lineno, 'boundary',
+                         self._first_arg_name(node)))
+        events.sort(key=lambda e: (e[0], e[1]))
+        return events
+
+    def _check_rmw(self):
+        for fn in self._functions():
+            reads = {}              # var -> read line
+            stale_since = {}        # var -> boundary line
+            for line, kind, var in self._rmw_events(fn):
+                if kind == 'read':
+                    reads[var] = line
+                    stale_since.pop(var, None)
+                elif kind == 'boundary':
+                    # the statement that ships ``var`` itself is its
+                    # write-back, not a boundary for it
+                    for v in reads:
+                        if v != var and v not in stale_since:
+                            stale_since[v] = line
+                elif kind in ('mutate', 'ship') and var in stale_since:
+                    self._add(
+                        'db-rmw-commit',
+                        f"'{var}' (row read at line {reads[var]}) "
+                        f"mutated at line {line} after an intervening "
+                        f"commit/query at line {stale_since[var]} — "
+                        f"the row may have changed underneath; "
+                        f"re-read it or use a conditional UPDATE",
+                        line)
+                    # one finding per stale window: the fix (re-read or
+                    # conditional UPDATE) covers the writes that follow
+                    reads.pop(var, None)
+                    stale_since.pop(var, None)
+
+    def run(self):
+        self._check_sql_strings()
+        self._check_orm_writes()
+        self._check_rmw()
+        self.findings.sort(key=lambda f: (f.line or 0, f.rule))
+        return self.findings
+
+
+def check_db_source(text: str, path: str = '<string>') -> list:
+    try:
+        return DbTransitionChecker(text, path).run()
+    except SyntaxError:
+        return []
+
+
+__all__ = ['DbTransitionChecker', 'check_db_source',
+           '_naked_sql_columns']

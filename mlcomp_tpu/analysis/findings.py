@@ -1,11 +1,14 @@
-"""Finding/rule primitives shared by the two preflight engines.
+"""Finding/rule primitives shared by the preflight engines.
 
 A ``Finding`` is one diagnostic: a stable rule id (``dag-*`` for the
-config engine, ``jax-*`` for the hot-path linter), a severity, the
-location it anchors to, a one-line message, and a short "why" that
-explains the cost of ignoring it. Errors reject a DAG at submit time;
-warnings ride along (stored with the dag row, surfaced by the CLI, API
-and dashboard) but never block.
+config engine, ``jax-*`` for the hot-path linter, ``cc-*`` for the
+concurrency lint, ``db-*`` for the DB state-transition checker), a
+severity, the location it anchors to, a one-line message, and a short
+"why" that explains the cost of ignoring it. Errors reject a DAG at
+submit time; warnings ride along (stored with the dag row, surfaced by
+the CLI, API and dashboard) but never block a submission. The code gate
+(``mlcomp_tpu check --code``) is stricter: ANY unsuppressed finding
+fails it, whatever the severity.
 """
 
 SEV_ERROR = 'error'
@@ -88,6 +91,36 @@ RULES = {
         'compiles the same layer program L times (L-fold trace + XLA '
         'compile cost, visible as compile.backend_ms) — roll it with '
         'nn.scan/lax.scan so the layer compiles once'),
+
+    # --------------------------------------- control-plane concurrency lint
+    'cc-lockset': (
+        SEV_WARNING,
+        'an attribute that other sites guard with a lock is accessed '
+        'without it — under thread interleaving the unguarded access '
+        'races (lost update, torn check-then-act): the PR-8 '
+        'drain/admission-race shape'),
+    'cc-lock-held-blocking': (
+        SEV_WARNING,
+        'sleeping or doing an HTTP/DB round-trip while holding a lock '
+        'serializes every thread that needs it behind the slowest '
+        'response — one dead endpoint freezes the whole server'),
+    'cc-lock-order': (
+        SEV_WARNING,
+        'two locks acquired in opposite orders at different sites '
+        'deadlock the moment both paths run concurrently — each holds '
+        'what the other wants'),
+
+    # ----------------------------------------- DB state-transition checker
+    'db-naked-transition': (
+        SEV_WARNING,
+        'a state-machine column written without conditioning on its '
+        'prior value is a lost update waiting for a concurrent writer '
+        '— the shape behind the PR-5 lease exactly-once fixes'),
+    'db-rmw-commit': (
+        SEV_WARNING,
+        'a row read, then mutated after an intervening commit/query '
+        'may overwrite a concurrent writer with stale values — re-read '
+        'the row or guard the UPDATE with the expected prior state'),
 }
 
 
@@ -143,6 +176,16 @@ def split_findings(findings):
     return errors, warnings
 
 
+def sort_findings(findings):
+    """Deterministic report order: errors first, then (file, line,
+    rule, message) within a severity. Engines walk dicts and thread
+    pools, so raw finding order can vary run to run — CI gates diff
+    their reports, and a reordered report must not read as a change."""
+    return sorted(findings, key=lambda f: (
+        0 if f.is_error else 1, f.path or '', f.line or 0,
+        f.rule, f.message))
+
+
 class PreflightError(ValueError):
     """A DAG rejected by static analysis before any DB insert.
     ``findings`` carries the error-severity Findings."""
@@ -164,4 +207,5 @@ def format_report(findings, with_why: bool = True) -> str:
 
 
 __all__ = ['Finding', 'PreflightError', 'RULES', 'SEV_ERROR',
-           'SEV_WARNING', 'split_findings', 'format_report']
+           'SEV_WARNING', 'split_findings', 'sort_findings',
+           'format_report']

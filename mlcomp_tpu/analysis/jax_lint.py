@@ -33,6 +33,7 @@ naturally sits next to the code it excuses.
 import ast
 import io
 import os
+import re
 import tokenize
 
 from mlcomp_tpu.analysis.findings import Finding
@@ -62,7 +63,13 @@ def _dotted(node):
 
 def parse_suppressions(text: str) -> dict:
     """{line: set(rule ids)} from ``# preflight: disable=...`` comments.
-    A comment standing alone on its line also covers the next line."""
+    A comment standing alone on its line also covers the next line.
+    Anything after the rule list is the justification the suppression
+    policy requires (``disable=cc-lockset — single-writer tick``). The
+    rule list is the longest leading run of comma-separated id tokens;
+    parsing stops at the first word that is not one, so a comma INSIDE
+    the justification ("benign, all writers hold it") cannot mint
+    phantom rule ids — 'all' there must not disable everything."""
     out = {}
     try:
         tokens = tokenize.generate_tokens(io.StringIO(text).readline)
@@ -75,8 +82,12 @@ def parse_suppressions(text: str) -> dict:
             directive = comment[len('preflight:'):].strip()
             if not directive.startswith('disable='):
                 continue
-            rules = {r.strip() for r in
-                     directive[len('disable='):].split(',') if r.strip()}
+            listed = re.match(
+                r'\s*([\w-]+(?:\s*,\s*[\w-]+)*)',
+                directive[len('disable='):])
+            if listed is None:
+                continue
+            rules = {r.strip() for r in listed.group(1).split(',')}
             line = tok.start[0]
             out.setdefault(line, set()).update(rules)
             # standalone comment: nothing but whitespace before it

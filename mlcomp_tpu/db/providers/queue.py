@@ -129,15 +129,33 @@ class QueueProvider(BaseDataProvider):
             (queue, json.dumps(payload)))
         return row['id'] if row else None
 
-    def complete(self, msg_id: int, result: str = None):
-        self.session.execute(
-            "UPDATE queue_message SET status='done', result=? WHERE id=?",
-            (result, msg_id))
+    def complete(self, msg_id: int, result: str = None,
+                 worker: str = None) -> bool:
+        """Finish a CLAIMED message — conditionally. An unconditional
+        ``WHERE id=?`` here was the lost-update race the db-check rule
+        exists for: a worker that stalls past its lease keeps a live
+        reference to the message id; after the supervisor reclaims the
+        lease and a second worker claims it, the first worker's late
+        ``complete()`` must not mark the second worker's in-flight
+        execution done (or, via ``fail()``, seed a duplicate retry).
+        Passing ``worker`` pins the transition to the claim holder;
+        the rowcount says whether this caller's verdict won."""
+        return self._finish(msg_id, 'done', result, worker)
 
-    def fail(self, msg_id: int, result: str = None):
-        self.session.execute(
-            "UPDATE queue_message SET status='failed', result=? WHERE id=?",
-            (result, msg_id))
+    def fail(self, msg_id: int, result: str = None,
+             worker: str = None) -> bool:
+        return self._finish(msg_id, 'failed', result, worker)
+
+    def _finish(self, msg_id: int, status: str, result,
+                worker: str = None) -> bool:
+        sql = (f"UPDATE queue_message SET status='{status}', result=? "
+               f"WHERE id=? AND status='claimed'")
+        params = [result, msg_id]
+        if worker is not None:
+            sql += ' AND claimed_by=?'
+            params.append(worker)
+        cur = self.session.execute(sql, tuple(params))
+        return cur.rowcount > 0
 
     def revoke(self, msg_id: int) -> bool:
         """Revoke a pending message (celery revoke parity,

@@ -76,6 +76,15 @@ class TaskProvider(BaseDataProvider):
 
     # -------------------------------------------------------------- status
     def change_status(self, task, status: TaskStatus):
+        # the transition is guarded at every call site instead of here:
+        # the worker refuses to execute a task that is not Queued, the
+        # supervisor's tick is the only writer for scheduling states,
+        # and kill paths go through the queue's conditional claim.
+        # Folding a prior-status condition in here needs expected-state
+        # plumbing at ~30 call sites — revisit with the Postgres
+        # backend (ROADMAP item 1), where cross-host writers make the
+        # call-site guards insufficient.
+        # preflight: disable=db-naked-transition — see above
         task.status = int(status)
         fields = ['status', 'started', 'finished', 'last_activity']
         if status == TaskStatus.InProgress:

@@ -68,6 +68,10 @@ def build(force: bool = False) -> str:
             tmp = out + f'.tmp{os.getpid()}.{threading.get_ident()}'
             cmd = [gxx, '-O2', '-std=c++17', '-shared', '-fPIC',
                    '-pthread', _SRC, '-o', tmp]
+            # serializing the compiler behind _build_lock is this
+            # lock's entire purpose (one build, many waiters bind the
+            # finished artifact); nothing else ever takes this lock
+            # preflight: disable=cc-lock-held-blocking — see above
             proc = subprocess.run(cmd, capture_output=True, text=True,
                                   timeout=180)
             if proc.returncode != 0:

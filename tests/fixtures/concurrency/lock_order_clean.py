@@ -1,0 +1,20 @@
+"""cc-lock-order clean twin: both paths acquire source-then-sink."""
+
+import threading
+
+
+class Router:
+    def __init__(self):
+        self.source_lock = threading.Lock()
+        self.sink_lock = threading.Lock()
+        self.moved = 0
+
+    def transfer(self):
+        with self.source_lock:
+            with self.sink_lock:
+                self.moved += 1
+
+    def rebalance(self):
+        with self.source_lock:
+            with self.sink_lock:
+                self.moved += 1

@@ -1,0 +1,22 @@
+"""cc-lockset clean twin: every access of ``pending`` — the check, the
+increment, the decrement — holds ``self.lock``."""
+
+import threading
+
+
+class Admission:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.pending = 0
+        self.limit = 4
+
+    def admit(self) -> bool:
+        with self.lock:
+            if self.pending >= self.limit:
+                return False
+            self.pending += 1
+            return True
+
+    def release(self):
+        with self.lock:
+            self.pending -= 1

@@ -1,0 +1,22 @@
+"""cc-lockset positive: ``pending`` is written under ``self.lock`` on
+the admit path but decremented with no lock on the release path, and
+the admission check reads it outside the lock (check-then-act)."""
+
+import threading
+
+
+class Admission:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.pending = 0
+        self.limit = 4
+
+    def admit(self) -> bool:
+        if self.pending >= self.limit:       # unguarded check
+            return False
+        with self.lock:
+            self.pending += 1
+        return True
+
+    def release(self):
+        self.pending -= 1                    # unguarded write

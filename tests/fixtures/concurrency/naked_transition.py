@@ -1,0 +1,20 @@
+"""db-naked-transition positive: both shapes — a raw UPDATE that sets
+a state column without checking its prior value, and an ORM-style
+write shipped through an unconditional ``update(obj)``."""
+
+
+class LeaseProvider:
+    def __init__(self, session):
+        self.session = session
+
+    def finish(self, lease_id: int):
+        # lost-update: a reclaimed-and-reclaimed lease is overwritten
+        self.session.execute(
+            "UPDATE lease SET status='done' WHERE id=?", (lease_id,))
+
+    def mark_unhealthy(self, replica):
+        replica.state = 'unhealthy'
+        self.update(replica, ['state'])
+
+    def update(self, obj, fields):
+        self.session.update_obj(obj, fields)

@@ -1,0 +1,19 @@
+"""db-naked-transition clean twin: the transition is conditioned on
+the prior value and the rowcount decides who won."""
+
+
+class LeaseProvider:
+    def __init__(self, session):
+        self.session = session
+
+    def finish(self, lease_id: int) -> bool:
+        cur = self.session.execute(
+            "UPDATE lease SET status='done' "
+            "WHERE id=? AND status='claimed'", (lease_id,))
+        return cur.rowcount > 0
+
+    def mark_unhealthy(self, replica_id: int) -> bool:
+        cur = self.session.execute(
+            "UPDATE replica SET state='unhealthy' "
+            "WHERE id=? AND state='healthy'", (replica_id,))
+        return cur.rowcount > 0

@@ -1,0 +1,20 @@
+"""db-rmw-commit positive: a row is read, another statement commits
+(every statement is its own transaction), then the stale object is
+mutated and written back — whatever a concurrent writer did to the
+row in between is silently overwritten."""
+
+
+class RetryPass:
+    def __init__(self, session):
+        self.session = session
+
+    def bump_attempt(self, task_id: int):
+        task = self.session.query_one(
+            'SELECT * FROM task WHERE id=?', (task_id,))
+        self.session.execute(
+            'INSERT INTO audit (task) VALUES (?)', (task_id,))
+        task.attempt = (task.attempt or 0) + 1
+        self.update(task, ['attempt'])
+
+    def update(self, obj, fields):
+        self.session.update_obj(obj, fields)

@@ -1,0 +1,18 @@
+"""db-rmw-commit clean twin: the write happens before any other
+statement intervenes — read, mutate, write back, then audit."""
+
+
+class RetryPass:
+    def __init__(self, session):
+        self.session = session
+
+    def bump_attempt(self, task_id: int):
+        task = self.session.query_one(
+            'SELECT * FROM task WHERE id=?', (task_id,))
+        task.attempt = (task.attempt or 0) + 1
+        self.update(task, ['attempt'])
+        self.session.execute(
+            'INSERT INTO audit (task) VALUES (?)', (task_id,))
+
+    def update(self, obj, fields):
+        self.session.update_obj(obj, fields)

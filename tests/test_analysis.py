@@ -1,20 +1,25 @@
 """Static-analysis subsystem (mlcomp_tpu/analysis/): the DAG preflight
-engine, the JAX hot-path linter, and the four wiring layers (CLI gate,
-dag builder, API endpoint, supervisor refusal).
+engine, the JAX hot-path linter, the control-plane concurrency lint +
+DB state-transition checker, and the wiring layers (CLI gate, code
+gate, dag builder, API endpoint, supervisor refusal).
 
 Acceptance contract: ``mlcomp_tpu check`` exits non-zero with
 rule-tagged findings on every config in tests/configs/broken/, zero on
-every shipped examples/ config, and the self-lint of mlcomp_tpu/ itself
-is clean.
+every shipped examples/ config; every cc-*/db-* rule fires on its
+fixture in tests/fixtures/concurrency/ and stays silent on the clean
+twin; and both the self-lint and ``check --code`` over mlcomp_tpu/
+itself are clean.
 """
 
 import glob
+import json
 import os
 
 import pytest
 
 from mlcomp_tpu.analysis import (
-    folder_sources, format_report, preflight_config, split_findings,
+    folder_sources, format_report, lint_code_paths, lint_code_source,
+    preflight_config, sort_findings, split_findings,
 )
 from mlcomp_tpu.analysis.jax_lint import lint_source, self_lint
 from mlcomp_tpu.utils.io import yaml_load
@@ -22,6 +27,8 @@ from mlcomp_tpu.utils.io import yaml_load
 TESTS_DIR = os.path.dirname(__file__)
 BROKEN_DIR = os.path.join(TESTS_DIR, 'configs', 'broken')
 EXAMPLES_DIR = os.path.join(TESTS_DIR, '..', 'examples')
+CONCURRENCY_DIR = os.path.join(TESTS_DIR, 'fixtures', 'concurrency')
+PACKAGE_DIR = os.path.join(TESTS_DIR, '..', 'mlcomp_tpu')
 
 #: corpus file -> rule id its preflight report must contain
 BROKEN_EXPECTED = {
@@ -297,6 +304,220 @@ class TestJaxLint:
         in mlcomp_tpu/ is fixed or carries an inline suppression."""
         findings = self_lint()
         assert not findings, format_report(findings)
+
+
+#: concurrency corpus: positive fixture -> the ONE rule it must fire
+#: (and nothing else); each has a ``*_clean.py`` twin that must be
+#: silent — mirroring the broken-configs corpus pattern above
+CONCURRENCY_EXPECTED = {
+    'lockset_race.py': 'cc-lockset',
+    'blocking_in_lock.py': 'cc-lock-held-blocking',
+    'lock_order.py': 'cc-lock-order',
+    'naked_transition.py': 'db-naked-transition',
+    'rmw_commit.py': 'db-rmw-commit',
+}
+CONCURRENCY_CLEAN = {
+    'lockset_race.py': 'lockset_clean.py',
+    'blocking_in_lock.py': 'blocking_clean.py',
+    'lock_order.py': 'lock_order_clean.py',
+    'naked_transition.py': 'naked_transition_clean.py',
+    'rmw_commit.py': 'rmw_commit_clean.py',
+}
+
+
+def _lint_fixture(name):
+    with open(os.path.join(CONCURRENCY_DIR, name)) as fh:
+        return lint_code_source(fh.read(), name)
+
+
+class TestConcurrencyCorpus:
+    def test_corpus_is_complete(self):
+        files = {os.path.basename(p) for p in
+                 glob.glob(os.path.join(CONCURRENCY_DIR, '*.py'))}
+        assert files == (set(CONCURRENCY_EXPECTED)
+                         | set(CONCURRENCY_CLEAN.values()))
+
+    @pytest.mark.parametrize(
+        'name,rule', sorted(CONCURRENCY_EXPECTED.items()))
+    def test_positive_fires_exactly_its_rule(self, name, rule):
+        findings = _lint_fixture(name)
+        assert findings, f'{name}: nothing fired'
+        assert {f.rule for f in findings} == {rule}, \
+            format_report(findings)
+        assert all(f.path == name and f.line for f in findings)
+
+    @pytest.mark.parametrize(
+        'name', sorted(CONCURRENCY_CLEAN.values()))
+    def test_clean_twin_is_silent(self, name):
+        findings = _lint_fixture(name)
+        assert findings == [], format_report(findings)
+
+    def test_justification_comma_cannot_mint_phantom_rules(self):
+        """A comma INSIDE the justification prose must not contribute
+        rule ids — '— benign, all writers hold it' once parsed 'all'
+        out of the prose and silently disabled EVERY rule on the
+        line. The rule list stops at the first non-id word."""
+        from mlcomp_tpu.analysis.jax_lint import parse_suppressions
+        parsed = parse_suppressions(
+            '# preflight: disable=cc-lockset — benign, all writers '
+            'hold it elsewhere\n')
+        assert parsed[1] == {'cc-lockset'}
+        # a real multi-rule list still works, justification and all
+        parsed = parse_suppressions(
+            '# preflight: disable=cc-lockset, cc-lock-order — '
+            'single-writer, see tick docs\n')
+        assert parsed[1] == {'cc-lockset', 'cc-lock-order'}
+        # and the prose-comma form must NOT suppress an unrelated rule
+        src = ('import threading\n'
+               'import time\n'
+               'class C:\n'
+               '    def __init__(self):\n'
+               '        self.lock = threading.Lock()\n'
+               '        self.n = 0\n'
+               '    def a(self):\n'
+               '        with self.lock:\n'
+               '            self.n += 1\n'
+               '    def b(self):\n'
+               '        with self.lock:\n'
+               '            # preflight: disable=cc-lockset — odd, '
+               'all is well\n'
+               '            time.sleep(1)\n')
+        assert [f.rule for f in lint_code_source(src)] \
+            == ['cc-lock-held-blocking']
+
+    def test_syntax_error_file_is_analyzer_error_not_clean(
+            self, tmp_path):
+        """The gate's exit 0 asserts the whole tree WAS analyzed: a
+        file ast.parse rejects must surface as exit 2, never as
+        'clean' (the submit-gate engines skip unparsable user
+        snapshots; the code gate must not)."""
+        bad = tmp_path / 'conflict.py'
+        bad.write_text('def broken(:\n')
+        with pytest.raises(SyntaxError, match='cannot be parsed'):
+            lint_code_paths([str(tmp_path)])
+        from click.testing import CliRunner
+        from mlcomp_tpu.__main__ import main
+        result = CliRunner().invoke(
+            main, ['check', '--code', str(tmp_path)])
+        assert result.exit_code == 2
+
+    def test_suppression_with_justification(self):
+        """The suppression POLICY format — rule id followed by the
+        written justification — must actually suppress (the rule list
+        is the first token of each comma chunk; the rest is prose)."""
+        src = ('import threading\n'
+               'class C:\n'
+               '    def __init__(self):\n'
+               '        self.lock = threading.Lock()\n'
+               '        self.n = 0\n'
+               '    def a(self):\n'
+               '        with self.lock:\n'
+               '            self.n += 1\n'
+               '    def b(self):\n'
+               '        # preflight: disable=cc-lockset — single-'
+               'writer: only the tick thread calls b()\n'
+               '        self.n -= 1\n')
+        assert lint_code_source(src) == []
+        # the wrong rule id does NOT excuse the finding
+        wrong = src.replace('cc-lockset', 'cc-lock-order')
+        assert [f.rule for f in lint_code_source(wrong)] \
+            == ['cc-lockset']
+
+    def test_code_gate_on_package_tree_is_clean(self):
+        """The acceptance gate CI enforces: zero unsuppressed cc-*/
+        db-*/jax-* findings over mlcomp_tpu/ itself."""
+        findings = lint_code_paths([PACKAGE_DIR])
+        assert findings == [], format_report(findings)
+
+
+class TestCheckCodeCli:
+    """``mlcomp_tpu check --code``: the documented exit-code contract
+    (0 clean / 1 findings / 2 analyzer error) and ``--json``."""
+
+    def _run(self, *args):
+        from click.testing import CliRunner
+        from mlcomp_tpu.__main__ import main
+        return CliRunner().invoke(main, list(args))
+
+    def test_findings_exit_1_with_rule_in_output(self):
+        result = self._run(
+            'check', '--code',
+            os.path.join(CONCURRENCY_DIR, 'lockset_race.py'))
+        assert result.exit_code == 1
+        assert 'cc-lockset' in result.output
+
+    def test_clean_exit_0(self):
+        result = self._run(
+            'check', '--code',
+            os.path.join(CONCURRENCY_DIR, 'lockset_clean.py'))
+        assert result.exit_code == 0
+        assert 'no findings' in result.output
+
+    def test_missing_path_exit_2(self):
+        result = self._run('check', '--code', '/no/such/tree')
+        assert result.exit_code == 2
+
+    def test_missing_config_exit_2(self):
+        result = self._run('check', '/no/such/config.yml')
+        assert result.exit_code == 2
+
+    def test_json_output_shape(self):
+        result = self._run(
+            'check', '--code',
+            os.path.join(CONCURRENCY_DIR, 'naked_transition.py'),
+            '--json')
+        assert result.exit_code == 1
+        payload = json.loads(result.output)
+        assert payload['files'] == 1
+        assert payload['counts']['total'] == len(payload['findings'])
+        rules = {f['rule'] for f in payload['findings']}
+        assert rules == {'db-naked-transition'}
+        first = payload['findings'][0]
+        assert {'rule', 'severity', 'message', 'path', 'line',
+                'why'} <= set(first)
+
+    def test_config_mode_json(self):
+        result = self._run(
+            'check', os.path.join(EXAMPLES_DIR, 'cifar10',
+                                  'config.yml'), '--json')
+        assert result.exit_code == 0
+        payload = json.loads(result.output)
+        assert payload['counts']['error'] == 0
+
+    def test_config_and_code_are_exclusive(self):
+        result = self._run('check', 'x.yml', '--code', 'y')
+        assert result.exit_code != 0
+
+
+class TestDeterministicOrdering:
+    def test_sort_findings_is_stable_and_severity_first(self):
+        from mlcomp_tpu.analysis.findings import Finding
+        shuffled = [
+            Finding('cc-lockset', 'm', path='b.py', line=9),
+            Finding('db-rmw-commit', 'm', path='a.py', line=30),
+            Finding('dag-cycle', 'm', path='z.py', line=1),
+            Finding('db-naked-transition', 'm', path='a.py', line=2),
+            Finding('cc-lock-order', 'm', path='a.py', line=2),
+        ]
+        ordered = sort_findings(shuffled)
+        # the error outranks every warning, then (file, line, rule)
+        assert [(f.rule, f.path, f.line) for f in ordered] == [
+            ('dag-cycle', 'z.py', 1),
+            ('cc-lock-order', 'a.py', 2),
+            ('db-naked-transition', 'a.py', 2),
+            ('db-rmw-commit', 'a.py', 30),
+            ('cc-lockset', 'b.py', 9),
+        ]
+        # deterministic under any input permutation
+        assert sort_findings(list(reversed(shuffled))) == ordered
+
+    def test_code_gate_report_is_reproducible(self):
+        a = lint_code_paths([CONCURRENCY_DIR])
+        b = lint_code_paths([CONCURRENCY_DIR])
+        assert [(f.path, f.line, f.rule) for f in a] \
+            == [(f.path, f.line, f.rule) for f in b]
+        assert [(f.path, f.line, f.rule) for f in a] \
+            == sorted((f.path, f.line, f.rule) for f in a)
 
 
 class TestBuilderGate:

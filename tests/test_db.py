@@ -127,6 +127,63 @@ class TestQueue:
         assert q.revoke(m) is False  # already revoked
 
 
+class TestQueueLateFinisher:
+    """The lost-update interleaving behind the conditional
+    complete()/fail() (db-naked-transition finding on the old
+    unconditional ``WHERE id=?``): a worker that stalls past its lease
+    still holds the message id; after the supervisor reclaims the
+    lease and a second worker claims the message, the first worker's
+    late verdict must LOSE, not clobber the live execution. Played
+    deterministically — each step is one call, no threads needed."""
+
+    def _reclaimed_and_reclaimed(self, session):
+        q = QueueProvider(session)
+        m = q.enqueue('lq', {'action': 'execute', 'task_id': 9})
+        assert q.claim(['lq'], 'w1')[0] == m     # w1 claims, stalls
+        assert q.reclaim(m) is True              # lease expires
+        assert q.claim(['lq'], 'w2')[0] == m     # w2 re-claims
+        return q, m
+
+    def test_late_complete_loses_to_live_claim(self, session):
+        q, m = self._reclaimed_and_reclaimed(session)
+        # w1 wakes up and reports success for a claim it no longer owns
+        assert q.complete(m, worker='w1') is False
+        assert q.status(m) == 'claimed'          # w2 still executing
+        # the live claimant's verdict wins
+        assert q.complete(m, worker='w2') is True
+        assert q.status(m) == 'done'
+
+    def test_late_fail_cannot_seed_duplicate_retry(self, session):
+        q, m = self._reclaimed_and_reclaimed(session)
+        assert q.fail(m, 'w1 stalled then crashed',
+                      worker='w1') is False
+        assert q.status(m) == 'claimed'
+        assert q.fail(m, 'real failure', worker='w2') is True
+        assert q.status(m) == 'failed'
+
+    def test_late_complete_after_reclaim_before_reclaim_loses(
+            self, session):
+        """The narrower window: reclaimed (pending again) but not yet
+        re-claimed. The late complete must not mark a PENDING message
+        done — the redelivery would silently vanish."""
+        q = QueueProvider(session)
+        m = q.enqueue('lq2', {'action': 'execute', 'task_id': 10})
+        assert q.claim(['lq2'], 'w1')[0] == m
+        assert q.reclaim(m) is True
+        assert q.complete(m, worker='w1') is False
+        assert q.status(m) == 'pending'          # redelivery survives
+
+    def test_unpinned_complete_still_requires_claimed(self, session):
+        """Callers without an identity (tests, tools) still get the
+        status guard — only a claimed message can finish."""
+        q = QueueProvider(session)
+        m = q.enqueue('lq3', {'action': 'execute', 'task_id': 11})
+        assert q.complete(m) is False            # pending: refused
+        q.claim(['lq3'], 'w1')
+        assert q.complete(m) is True
+        assert q.complete(m) is False            # already done
+
+
 class TestQueueReturningFallback:
     """The atomic claim on sqlite < 3.35 (no UPDATE ... RETURNING —
     this class exercises BOTH code paths explicitly so the suite

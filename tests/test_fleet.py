@@ -416,6 +416,115 @@ class TestShedAccounting:
             gw.shutdown()
 
 
+class TestAtomicAdmission:
+    """Regression for the admission check-then-act race the
+    concurrency lint flags as cc-lockset: ``inflight >= max_pending``
+    was read OUTSIDE route.lock, then incremented under it — a
+    concurrent burst could all pass the check together and overshoot
+    the bound. ``route.admit()`` now does both under one lock hold, so
+    a racing burst admits EXACTLY max_pending whatever the
+    interleaving (admitted requests hold their slot until release —
+    no timing in the assertion)."""
+
+    def _route(self, max_pending):
+        from mlcomp_tpu.server.gateway import _FleetRoute
+        return _FleetRoute('m', slo_p99_ms=None,
+                           max_pending=max_pending)
+
+    def test_burst_never_overshoots_max_pending(self):
+        route = self._route(4)
+        n = 16
+        barrier = threading.Barrier(n)
+        verdicts = []
+        lock = threading.Lock()
+
+        def client():
+            barrier.wait()
+            ok = route.admit()
+            with lock:
+                verdicts.append(ok)
+
+        threads = [threading.Thread(target=client) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert sum(verdicts) == 4            # exactly the bound
+        assert route.inflight == 4
+        snap = route.snapshot()
+        assert snap['shed'] == n - 4
+        assert snap['requests'] == n
+        for _ in range(4):
+            route.release()
+        assert route.inflight == 0
+        # slots freed: admission resumes
+        assert route.admit() is True
+
+    def test_probe_bypasses_a_full_queue(self):
+        route = self._route(1)
+        assert route.admit() is True
+        assert route.admit() is False        # full: shed
+        assert route.admit(probe=True) is True   # probes never shed
+        assert route.inflight == 2
+        assert route.snapshot()['shed'] == 1
+
+
+class TestStartSwapRace:
+    """Regression for the reconciler-transition finding
+    (db-naked-transition on start_swap): the old read-check-write let
+    two operators holding the SAME stale fleet row both pass the
+    'already swapping' check and stage clashing target generations.
+    The conditional UPDATE (WHERE status='active') picks exactly one
+    winner; the loser gets the ValueError the stale check used to
+    give only by luck. Deterministic: both rows are read before
+    either writes — the exact lost-update interleaving."""
+
+    def test_second_stale_swapper_loses(self, session):
+        create_fleet(session, 'swapf', 'model_v1', desired=1)
+        fp = FleetProvider(session)
+        stale_a = fp.by_name('swapf')
+        stale_b = fp.by_name('swapf')        # both read status=active
+        start_swap(session, stale_a, 'model_v2')
+        with pytest.raises(ValueError, match='swapping'):
+            start_swap(session, stale_b, 'model_v3')
+        row = fp.by_name('swapf')
+        assert row.status == 'swapping'
+        assert row.target_model == 'model_v2'     # winner's staging
+        assert row.target_generation == 2         # not double-bumped
+
+    def test_stale_swap_after_completed_swap_refused(self, session):
+        """status='active' alone is not enough of a guard: after an
+        intervening COMPLETED swap the fleet is active again at
+        generation+1, and a stale caller's target (stale_gen + 1)
+        would collide with the LIVE generation. The WHERE pins the
+        generation the caller read, so the stale request loses."""
+        create_fleet(session, 'genf', 'model_v1', desired=1)
+        fp = FleetProvider(session)
+        stale = fp.by_name('genf')           # generation 1, active
+        # a full swap completes meanwhile: generation 2, active again
+        session.execute(
+            "UPDATE serve_fleet SET generation=2, model='model_v2' "
+            "WHERE name='genf'")
+        with pytest.raises(ValueError, match='moved to generation 2'):
+            start_swap(session, stale, 'model_v3')
+        row = fp.by_name('genf')
+        assert row.status == 'active'
+        assert row.target_generation is None     # nothing staged
+        # a fresh read swaps cleanly to generation 3
+        start_swap(session, fp.by_name('genf'), 'model_v3')
+        row = fp.by_name('genf')
+        assert row.target_generation == 3
+
+    def test_swap_on_stopped_fleet_refused(self, session):
+        fleet = create_fleet(session, 'stopf', 'model_v1', desired=0)
+        stop_fleet(session, fleet)
+        stale = FleetProvider(session).by_name('stopf')
+        with pytest.raises(ValueError, match='stopped'):
+            start_swap(session, stale, 'model_v2')
+        row = FleetProvider(session).by_name('stopf')
+        assert row.status == 'stopped' and row.target_model is None
+
+
 # ----------------------------------------------------------- reconciler
 class TestReconciler:
     def test_spawn_to_desired_through_placement(self, session):
