@@ -549,6 +549,85 @@ def bench_lm(peak_tflops: float) -> dict:
                                       cache_flag)
                 except Exception:
                     pass
+
+    # ---- sharded-step communication attribution (fsdp leg): walk the
+    # compiled HLO of an fsdp-sharded train step for collectives
+    # (telemetry/collectives.py — the same analysis JaxTrain runs per
+    # stage), MEASURE the wire with the probe, and publish the comm
+    # fraction of the observed step plus the per-device HBM timeline
+    # point — the "is my sharded step network-bound" leg. Modest shape
+    # (param gather + grad reduce-scatter dominate regardless);
+    # skipped on one device (no wire to measure).
+    if len(mesh.devices.flat) > 1 and not over_budget():
+        try:
+            from mlcomp_tpu.telemetry import (
+                collective_stats, device_memory_stats,
+                measure_collective_ms,
+            )
+            comm_t = int(os.environ.get('BENCH_COMM_SEQ', '2048'))
+            comm_d = int(os.environ.get('BENCH_COMM_DMODEL', '1024'))
+            comm_layers = int(os.environ.get('BENCH_COMM_LAYERS', '4'))
+            comm_v = 8192
+            fsdp_mesh = mesh_from_spec({'fsdp': -1})
+            tokens = np.random.RandomState(0).randint(
+                0, comm_v, (batch, comm_t)).astype(np.int32)
+            model = create_model(
+                'transformer_lm', mesh=fsdp_mesh, vocab_size=comm_v,
+                d_model=comm_d, n_layers=comm_layers,
+                n_heads=comm_d // 64, d_ff=4 * comm_d,
+                max_seq_len=comm_t, dtype='bfloat16',
+                attn_impl=flash_impl)
+            state = create_train_state(
+                model, optimizer, tokens, jax.random.PRNGKey(0),
+                mesh=fsdp_mesh)
+            step = make_train_step(model, optimizer, loss_fn,
+                                   mesh=fsdp_mesh,
+                                   self_supervised=True)
+            x, _ = place_batch((tokens, None), fsdp_mesh)
+            compiled = step.lower(state, x, None).compile()
+            stats = collective_stats(compiled)
+            state, metrics = compiled(state, x, None)
+            float(metrics['loss'])                 # warm + barrier
+            n_comm_steps = 6
+            t0 = time.perf_counter()
+            for _ in range(n_comm_steps):
+                state, metrics = compiled(state, x, None)
+            float(metrics['loss'])
+            step_ms = (time.perf_counter() - t0) * 1e3 / n_comm_steps
+            probe_ms = measure_collective_ms(
+                fsdp_mesh, stats['total_bytes'])
+            result.update({
+                'comm_bytes_per_step': stats['total_bytes'],
+                'comm_op_counts': {
+                    op: entry['count']
+                    for op, entry in sorted(stats['ops'].items())},
+                'comm_probe_ms':
+                    round(probe_ms, 3) if probe_ms else None,
+                'comm_fraction':
+                    round(min(1.0, probe_ms / step_ms), 4)
+                    if probe_ms and step_ms > 0 else None,
+                'comm_config': (
+                    f'fsdp={len(fsdp_mesh.devices.flat)} LM '
+                    f'(d={comm_d}, {comm_layers} layers, T={comm_t}): '
+                    f'collectives from the compiled HLO, fraction = '
+                    f'measured all-reduce probe of the same per-device '
+                    f'bytes / measured step time'),
+            })
+            # the HBM timeline point of the sharded run, as the train
+            # loop's MemorySampler would record it (telemetry/memory.py)
+            hbm = [d for d in device_memory_stats()
+                   if d['reports_memory']]
+            if hbm:
+                result['lm_fsdp_hbm_used_gb'] = round(
+                    max(d['bytes_in_use'] for d in hbm) / 1e9, 3)
+                result['lm_fsdp_hbm_limit_gb'] = round(
+                    max(d['bytes_limit'] for d in hbm) / 1e9, 3)
+                peak = max(d['peak_bytes_in_use'] for d in hbm)
+                if peak:
+                    result['lm_fsdp_hbm_peak_gb'] = round(peak / 1e9, 3)
+            del state, compiled, step, x
+        except Exception as e:
+            result['comm_error'] = f'{type(e).__name__}: {e}'[:200]
     return result
 
 
@@ -1320,6 +1399,21 @@ def main():
         print(f'# attribution efficiency leg failed: {e!r}',
               file=sys.stderr)
 
+    # ---- memory-sampler overhead (same isolated accounting, same
+    # <1% budget, bench_guard floor): the per-step HBM timeline is one
+    # allocator-stats call per reporting device (telemetry/memory.py)
+    # — timed per sample() against the measured compute step. On a
+    # platform without memory stats (CPU) the sampler certifies its
+    # inert path (one attribute check); the driver's TPU run certifies
+    # the real allocator reads.
+    from mlcomp_tpu.telemetry import MemorySampler
+    mem_sampler = MemorySampler(rec)
+    n_mem = 20000
+    t0 = time.perf_counter()
+    for i in range(n_mem):
+        mem_sampler.sample(step=i)
+    mem_sample_cost = (time.perf_counter() - t0) / n_mem
+
     # ---- trace propagation + watchdog overhead (same <1% budget,
     # measured the same isolated way). Propagation adds one dict read
     # per span exit (the process trace context); the watchdog runs
@@ -1468,6 +1562,14 @@ def main():
             f'({watchdog_eval_cost * 1e3:.2f} ms/eval amortized over '
             f'{steps_per_eval:.0f} steps) vs the measured compute '
             f'step; combined budget <1%',
+        'memory_sampler_overhead_pct':
+            round(100.0 * mem_sample_cost / step_time, 4),
+        'memory_sampler_overhead_note':
+            f'per-step HBM timeline sampler in isolation '
+            f'({mem_sample_cost * 1e6:.2f} us/sample, '
+            f'{len(mem_sampler._devices)} reporting device(s) on '
+            f'{mem_sampler.platform or "cpu"}) vs the measured '
+            f'compute step; budget <1% (bench_guard floor)',
         'attribution_overhead_pct':
             round(100.0 * attr_cost / step_time, 4),
         'attribution_overhead_note':

@@ -22,6 +22,11 @@
   gang-atomic recovery, server/supervisor.py): per gang, the live
   generation, parent status, rank roster with computers and failure
   reasons, ``--json`` for scripts
+- ``mlcomp_tpu postmortem``     — the OOM flight recorder's bundle for
+  one task (telemetry/memory.py): last steps of the loss/phase/HBM/
+  compile series, run snapshot, compiled-step memory attribution,
+  collective tally and alerts, frozen at the failure; ``--json`` for
+  scripts, ``--live`` to assemble from current telemetry
 - ``mlcomp_tpu fleets``         — serving-fleet state (server/fleet.py):
   per fleet, the active generation and model, desired vs healthy
   replica counts, the replica roster with endpoints/states/respawn
@@ -422,6 +427,90 @@ def gangs(as_json, limit):
             if r['failure_reason']:
                 line += f" — {r['failure_reason']}"
             click.echo(line)
+
+
+@main.command()
+@click.argument('task', type=int)
+@click.option('--json', 'as_json', is_flag=True,
+              help='dump the full bundle as JSON')
+@click.option('--live', is_flag=True,
+              help='assemble from current telemetry instead of the '
+                   'frozen at-failure bundle')
+def postmortem(task, as_json, live):
+    """The OOM flight recorder's bundle for one task
+    (telemetry/memory.py): the last steps of the loss / step-time /
+    phase / HBM / compile series, the run snapshot (mesh, batch
+    shape, model), the compiled-step memory attribution, the
+    collective tally, and the alerts — frozen at the failure, so the
+    explanation survives whatever aged out of the metric table."""
+    from mlcomp_tpu.telemetry import build_postmortem, load_postmortem
+    session = Session.create_session()
+    migrate(session)
+    if live:
+        bundle = build_postmortem(session, task)
+    else:
+        bundle = load_postmortem(session, task)
+    if bundle is None or (live and not bundle.get('task_card')):
+        click.echo(f'task {task}: no postmortem recorded (the task '
+                   f'never failed with a taxonomy reason; --live '
+                   f'assembles one from current telemetry)')
+        raise SystemExit(1)
+    if as_json:
+        click.echo(json.dumps(bundle))
+        return
+    card = bundle.get('task_card') or {}
+    head = f'task {task}'
+    if card.get('name'):
+        head += f' ({card["name"]})'
+    if bundle.get('reason'):
+        head += f' — failed: {bundle["reason"]}'
+    if bundle.get('created'):
+        head += f' at {bundle["created"]}'
+    click.echo(head)
+    if card.get('computer'):
+        click.echo(f'  on {card["computer"]}'
+                   + (f', rank {card["rank"]}' if 'rank' in card
+                      else ''))
+    context = bundle.get('context') or {}
+    snapshot = (context.get('run.snapshot') or {}).get('tags') or {}
+    if snapshot:
+        mesh = snapshot.get('mesh')
+        n_params = snapshot.get('n_params')
+        click.echo(
+            f'  run: model={snapshot.get("model")}'
+            + (f' params={n_params:,}' if n_params is not None else '')
+            + (f' mesh={mesh}' if mesh else '')
+            + f' batch={snapshot.get("batch_shape")}')
+    def human_bytes(v):
+        for unit, div in (('GB', 1e9), ('MB', 1e6), ('KB', 1e3)):
+            if abs(v) >= div:
+                return f'{v / div:.2f} {unit}'
+        return f'{v:.0f} B'
+
+    attribution = (context.get('memory.attribution') or {}).get(
+        'tags') or {}
+    if attribution:
+        parts = [f'{k.replace("_bytes", "")}={human_bytes(v)}'
+                 for k, v in sorted(attribution.items())
+                 if isinstance(v, (int, float))]
+        click.echo('  compiled peak: ' + ', '.join(parts))
+    comm = context.get('comm.bytes_per_step') or {}
+    if comm.get('value'):
+        click.echo(f'  collectives: {human_bytes(comm["value"])} per '
+                   f'device per step')
+    series = bundle.get('series') or {}
+    for name in sorted(series):
+        pts = series[name]
+        if not pts:
+            continue
+        last = pts[-1]
+        click.echo(f'  {name}: {len(pts)} samples, last '
+                   f'{last["value"]:.6g}'
+                   + (f' @ step {last["step"]}'
+                      if last.get('step') is not None else ''))
+    for a in bundle.get('alerts') or []:
+        flag = '!' if a.get('severity') == 'critical' else '~'
+        click.echo(f'  {flag} [{a.get("rule")}] {a.get("message")}')
 
 
 @main.command()

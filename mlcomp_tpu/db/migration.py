@@ -270,8 +270,19 @@ def _v9_fleet(session: Session):
             session.execute(stmt)
 
 
+def _v10_postmortem(session: Session):
+    """OOM flight recorder: the ``postmortem`` table freezing a failed
+    task's explanation bundle at death (telemetry/memory.py). New
+    table only — CREATE IF NOT EXISTS is safe on a fresh DB whose _v1
+    already made it."""
+    from mlcomp_tpu.db.models import Postmortem
+    for stmt in Postmortem.create_table_ddl():
+        session.execute(stmt)
+
+
 MIGRATIONS = [_v1_init, _v2_data, _v3_auth, _v4_telemetry, _v5_preflight,
-              _v6_tracing_alerts, _v7_recovery, _v8_gang, _v9_fleet]
+              _v6_tracing_alerts, _v7_recovery, _v8_gang, _v9_fleet,
+              _v10_postmortem]
 
 
 def migrate(session: Session = None):

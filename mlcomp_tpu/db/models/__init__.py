@@ -15,7 +15,9 @@ from mlcomp_tpu.db.models.model import Model
 from mlcomp_tpu.db.models.auxiliary import Auxiliary
 from mlcomp_tpu.db.models.queue import QueueMessage
 from mlcomp_tpu.db.models.auth import DbAudit, WorkerToken
-from mlcomp_tpu.db.models.telemetry import Alert, Metric, TelemetrySpan
+from mlcomp_tpu.db.models.telemetry import (
+    Alert, Metric, Postmortem, TelemetrySpan,
+)
 from mlcomp_tpu.db.models.fleet import ServeFleet, ServeReplica
 
 ALL_MODELS = [
@@ -23,6 +25,7 @@ ALL_MODELS = [
     Computer, ComputerUsage, Docker, File, DagStorage, DagLibrary, Log, Step,
     ReportImg, ReportSeries, ReportTasks, Model, Auxiliary, QueueMessage,
     WorkerToken, DbAudit, Metric, TelemetrySpan, DagPreflight, Alert,
+    Postmortem,
     ServeFleet, ServeReplica,
 ]
 

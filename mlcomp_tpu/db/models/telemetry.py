@@ -55,6 +55,25 @@ class TelemetrySpan(DBModel):
     process_role = Column('TEXT')           # supervisor|worker|train|...
 
 
+class Postmortem(DBModel):
+    """One frozen failure bundle per reasoned task failure (migration
+    v10) — the OOM flight recorder's output (telemetry/memory.py).
+    ``data`` is the assembled JSON bundle: the last N steps of the
+    loss/phase/memory/compile series, the run snapshot (mesh, batch
+    shape, model), the static memory attribution, the collective
+    tally, and the task's alerts — captured at death so the
+    explanation survives however much of the metric table later ages
+    out. Retries append new rows; consumers read the newest."""
+
+    __tablename__ = 'postmortem'
+
+    id = Column('INTEGER', primary_key=True)
+    task = Column('INTEGER', index=True, nullable=False)
+    created = Column('TEXT', dtype='datetime')
+    reason = Column('TEXT')                 # taxonomy verdict at death
+    data = Column('TEXT')                   # json bundle
+
+
 class Alert(DBModel):
     __tablename__ = 'alert'
 
@@ -72,4 +91,4 @@ class Alert(DBModel):
     resolved_time = Column('TEXT', dtype='datetime')
 
 
-__all__ = ['Metric', 'TelemetrySpan', 'Alert']
+__all__ = ['Metric', 'TelemetrySpan', 'Alert', 'Postmortem']

@@ -23,14 +23,16 @@ from mlcomp_tpu.db.providers.auth import (
     DbAuditProvider, WorkerTokenProvider
 )
 from mlcomp_tpu.db.providers.telemetry import (
-    AlertProvider, MetricProvider, TelemetrySpanProvider
+    AlertProvider, MetricProvider, PostmortemProvider,
+    TelemetrySpanProvider,
 )
 from mlcomp_tpu.db.providers.fleet import FleetProvider, ReplicaProvider
 
 __all__ = [
     'FleetProvider', 'ReplicaProvider',
     'WorkerTokenProvider', 'DbAuditProvider', 'AlertProvider',
-    'MetricProvider', 'TelemetrySpanProvider', 'DagPreflightProvider',
+    'MetricProvider', 'TelemetrySpanProvider', 'PostmortemProvider',
+    'DagPreflightProvider',
     'BaseDataProvider', 'ProjectProvider', 'DagProvider', 'TaskProvider',
     'ComputerProvider', 'DockerProvider', 'FileProvider',
     'DagStorageProvider', 'DagLibraryProvider', 'LogProvider',

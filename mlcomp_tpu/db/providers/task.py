@@ -107,10 +107,26 @@ class TaskProvider(BaseDataProvider):
         (mlcomp_tpu/recovery.py) — the supervisor's retry pass reads
         ``failure_reason`` to decide transient-vs-permanent. Every
         failure site should come through here; a bare Failed (no
-        reason) is never retried."""
+        reason) is never retried.
+
+        This is also the flight recorder's choke point: every reasoned
+        failure freezes a postmortem bundle (telemetry/memory.py) —
+        the last steps of the loss/phase/memory/compile series plus
+        the run snapshot — into the ``postmortem`` table, so the
+        explanation survives whatever ages out of the metric table.
+        Worker-side failures flushed their telemetry before reaching
+        here (executor teardown + crash flush); supervisor-side
+        verdicts (worker-lost, lease-expired) bundle whatever the dead
+        process managed to flush. Best-effort by construction: the
+        recorder must never break the failure path it rides."""
         task.failure_reason = reason
         self.update(task, ['failure_reason'])
         self.change_status(task, TaskStatus.Failed)
+        try:
+            from mlcomp_tpu.telemetry.memory import persist_postmortem
+            persist_postmortem(self.session, task.id, reason=reason)
+        except Exception:
+            pass
 
     def by_status(self, *statuses, computer: str = None):
         marks = ','.join('?' * len(statuses))

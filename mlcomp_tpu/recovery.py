@@ -30,6 +30,12 @@ gang-aborted    transient  the supervisor's gang-abort sweep killed this
 replica-unhealthy transient  the fleet reconciler's health probes gave
                            up on a serving replica (server/fleet.py) —
                            it is killed and respawned elsewhere
+oom             permanent  RESOURCE_EXHAUSTED / device out-of-memory
+                           (also host MemoryError): the same shapes
+                           OOM again on retry — blind-retrying burns a
+                           TPU slot re-deriving the same crash. The
+                           flight recorder persists a postmortem
+                           bundle at the failure (telemetry/memory.py)
 executor-error  permanent  any other executor exception (a bug retries
                            into the same bug — fail fast instead)
 ==============  =========  ==================================================
@@ -71,6 +77,14 @@ GANG_COLLATERAL_REASONS = frozenset({'gang-peer-lost', 'gang-aborted'})
 #: deterministic OSError subclasses that must NOT classify as transient
 _DETERMINISTIC_OS_ERRORS = (FileNotFoundError, PermissionError,
                             IsADirectoryError, NotADirectoryError)
+
+#: error-text markers of device memory exhaustion. XLA surfaces OOM as
+#: an XlaRuntimeError whose message leads with the grpc status name
+#: (``RESOURCE_EXHAUSTED: Out of memory allocating ...``); the
+#: allocator wording varies by backend, the status name does not
+_OOM_MARKERS = ('resource_exhausted', 'resource exhausted',
+                'out of memory', 'out-of-memory',
+                'memory allocation failure')
 
 
 class GangPeerLost(RuntimeError):
@@ -130,7 +144,13 @@ def classify_exception(exc, gang: bool = False) -> str:
     /collective failures, connection resets) classifies
     ``gang-peer-lost`` — a rank's collective failing because its peer
     vanished is collateral the gang retries on the root cause, not a
-    deterministic bug in this rank's code."""
+    deterministic bug in this rank's code.
+
+    ``oom`` outranks the gang carve-out: an OOM inside a collective's
+    buffer allocation mentions the collective, but retrying the gang
+    at the same shapes OOMs again — the verdict must pin permanent,
+    which is why the per-link OOM check runs before the text markers
+    accumulate."""
     seen = set()
     cur = exc
     texts = []
@@ -138,6 +158,14 @@ def classify_exception(exc, gang: bool = False) -> str:
         seen.add(id(cur))
         if isinstance(cur, GangPeerLost):
             return 'gang-peer-lost'
+        if isinstance(cur, MemoryError):
+            return 'oom'        # host-side exhaustion: same verdict
+        if isinstance(cur, RuntimeError):
+            text = f'{type(cur).__name__}: {cur}'.lower()
+            if any(marker in text for marker in _OOM_MARKERS):
+                # XlaRuntimeError('RESOURCE_EXHAUSTED: ...') — the
+                # device OOM the flight recorder exists for
+                return 'oom'
         if isinstance(cur, sqlite3.Error):
             return 'db-error'
         if isinstance(cur, RuntimeError) and \

@@ -386,6 +386,35 @@ def api_task_steps(data, s):
     return {'data': StepProvider(s).get(int(data['id']))}
 
 
+def api_task_postmortem(data, s):
+    """The OOM flight recorder's read surface (telemetry/memory.py):
+    the postmortem bundle frozen at the task's failure — last steps of
+    the loss/phase/memory/compile series, run snapshot (mesh/batch/
+    model), static memory attribution, collective tally, alerts.
+    ``{'task': id}`` returns the newest FROZEN bundle (404 when the
+    task never failed with a reason); ``{'task': id, 'live': true}``
+    assembles one on demand from the current DB rows instead — the
+    dashboard's view of a still-running task."""
+    from mlcomp_tpu.telemetry import build_postmortem, load_postmortem
+    task = _int_arg(data, 'task')
+    if task is None:
+        task = _int_arg(data, 'id', required=True)
+    if TaskProvider(s).by_id(task) is None:
+        raise ApiError('task not found', status=404)
+    live = data.get('live') in (True, 'true', '1', 1)
+    if live:
+        bundle = build_postmortem(s, task)
+        bundle['live'] = True
+        return bundle
+    bundle = load_postmortem(s, task)
+    if bundle is None:
+        raise ApiError(
+            'no postmortem recorded for this task (it never failed '
+            'with a taxonomy reason); pass live:true to assemble one '
+            'from the current telemetry', status=404)
+    return bundle
+
+
 def api_dag_stop(data, s):
     provider = DagProvider(s)
     dag_id = int(data['id'])
@@ -995,6 +1024,9 @@ _ROUTES = {
     '/api/task/stop': (api_task_stop, True),
     '/api/task/info': (api_task_info, True),
     '/api/task/steps': (api_task_steps, True),
+    # the flight-recorder read is the same no-auth introspection tier
+    # as the telemetry series it is assembled from
+    '/api/task/postmortem': (api_task_postmortem, False),
     '/api/dag/stop': (api_dag_stop, True),
     '/api/dag/start': (api_dag_start, True),
     '/api/dag/remove': (api_dag_remove, True),
@@ -1043,7 +1075,7 @@ _READ_ONLY_ROUTES = frozenset({
     '/api/fleets', '/api/logs', '/api/reports',
     '/api/report', '/api/report/update_layout_start',
     '/api/telemetry/series', '/api/telemetry/spans',
-    '/api/telemetry/trace', '/api/alerts',
+    '/api/telemetry/trace', '/api/alerts', '/api/task/postmortem',
 })
 
 
@@ -1238,12 +1270,14 @@ class ApiHandler(BaseHTTPRequestHandler):
                     {'success': False, 'reason': 'internal error'}, 500)
             return
         if parsed.path in ('/telemetry/series', '/telemetry/spans',
-                           '/api/alerts', '/api/fleets') \
+                           '/api/alerts', '/api/fleets',
+                           '/api/task/postmortem') \
                 or parsed.path.startswith('/telemetry/trace/'):
             # GET mirrors of the POST routes (curl-friendly:
             # /telemetry/series?task=7&name=loss,
-            # /telemetry/trace/<id>, /api/alerts?status=all); same
-            # no-auth introspection tier as /api/auxiliary
+            # /telemetry/trace/<id>, /api/alerts?status=all,
+            # /api/task/postmortem?task=7); same no-auth introspection
+            # tier as /api/auxiliary
             qs = parse_qs(parsed.query)
             data = {k: v[0] for k, v in qs.items()}
             if parsed.path == '/telemetry/series':
@@ -1254,6 +1288,8 @@ class ApiHandler(BaseHTTPRequestHandler):
                 handler = api_alerts
             elif parsed.path == '/api/fleets':
                 handler = api_fleets
+            elif parsed.path == '/api/task/postmortem':
+                handler = api_task_postmortem
             else:
                 data['id'] = parsed.path[len('/telemetry/trace/'):]
                 handler = api_telemetry_trace

@@ -896,6 +896,131 @@ function performanceCard(series) {
   return html + '</div>';
 }
 
+function memoryCard(series) {
+  // HBM timeline + compiled-peak attribution (telemetry/memory.py):
+  // latest used/limit/peak per device as occupancy bars, plus the
+  // static peak split (arguments/outputs/temps/code) from the
+  // compiled executable's memory_analysis — how close am I, and what
+  // would I have to shrink
+  const last = n => { const pts = series[n]||[];
+    return pts.length ? pts[pts.length-1] : null; };
+  const devs = [];
+  Object.keys(series).forEach(n => {
+    if (n.slice(0,6) === 'device' && n.slice(-9) === '.hbm_used')
+      devs.push(n.slice(6, n.length-9));
+  });
+  const rows = [];
+  let worst = null;
+  devs.forEach(d => {
+    const used = last('device'+d+'.hbm_used');
+    const lim = last('device'+d+'.hbm_limit');
+    const peak = last('device'+d+'.hbm_peak');
+    if (!used || !lim || !lim.value) return;
+    const occ = used.value / lim.value;
+    if (worst == null || occ > worst) worst = occ;
+    rows.push({d:d, used:used.value, lim:lim.value,
+               peak: peak ? peak.value : null, occ:occ});
+  });
+  const attr = last('memory.attribution');
+  if (!rows.length && !attr) return '';
+  const gb = v => (v/1e9).toFixed(2);
+  let html = '<h3>memory</h3><div class="card">';
+  if (worst != null)
+    html += `<div style="margin-bottom:8px"><b>${
+      (worst*100).toFixed(1)}%</b>
+      <span class="dim">worst HBM occupancy (latest sample)</span></div>`;
+  rows.forEach(r => {
+    const pct = r.occ > 1 ? 100 : r.occ*100;
+    html += `<div class="dim" style="font-size:11px">device ${r.d}:
+      ${gb(r.used)} / ${gb(r.lim)} GB`
+      + (r.peak ? ` (peak ${gb(r.peak)})` : '') + '</div>'
+      + '<div style="height:8px;background:#2a2f3a;border-radius:4px;'
+      + 'margin:2px 0 6px">'
+      + `<div style="height:8px;width:${pct.toFixed(1)}%;`
+      + `border-radius:4px;background:${
+        r.occ > 0.92 ? '#e05d5d' : '#41c07c'}"></div></div>`;
+  });
+  if (attr && attr.tags) {
+    const parts = ['argument_bytes','output_bytes','temp_bytes',
+                   'generated_code_bytes']
+      .filter(k => attr.tags[k])
+      .map(k => k.replace('_bytes','') + ' ' + gb(attr.tags[k]) + ' GB');
+    if (parts.length)
+      html += '<div class="dim" style="font-size:11px">compiled peak: '
+        + parts.join(' &middot; ') + '</div>';
+  }
+  return html + '</div>';
+}
+
+function commCard(series) {
+  // collective-communication attribution (telemetry/collectives.py):
+  // the measured comm share of the step, the per-device collective
+  // bytes the compiled HLO moves, and the per-op tally — is this
+  // step math-bound or network-bound, next to the phase breakdown
+  const last = n => { const pts = series[n]||[];
+    return pts.length ? pts[pts.length-1] : null; };
+  const frac = last('comm.fraction');
+  const total = last('comm.bytes_per_step');
+  const probe = last('comm.probe_ms');
+  const ops = [];
+  Object.keys(series).forEach(n => {
+    if (n.slice(0,5) === 'comm.' && n.slice(-6) === '_bytes'
+        && n !== 'comm.bytes_per_step') {
+      const op = n.slice(5, n.length-6);
+      const count = last('comm.'+op+'_count');
+      ops.push({op:op, bytes:last(n).value,
+                count: count ? count.value : null});
+    }
+  });
+  if (!frac && !total && !ops.length) return '';
+  let html = '<h3>communication</h3><div class="card">'
+    + '<div style="display:flex;gap:18px;margin-bottom:8px">';
+  if (frac)
+    html += `<div><b>${(frac.value*100).toFixed(1)}%</b>
+      <span class="dim">measured comm share of step</span></div>`;
+  if (total)
+    html += `<div><b>${(total.value/1e6).toFixed(1)} MB</b>
+      <span class="dim">collective bytes / device / step</span></div>`;
+  if (probe)
+    html += `<div><b>${probe.value.toFixed(2)} ms</b>
+      <span class="dim">wire probe</span></div>`;
+  html += '</div>';
+  if (ops.length)
+    html += '<div class="dim" style="font-size:11px">'
+      + ops.map(o => o.op + ': ' + (o.bytes/1e6).toFixed(1) + ' MB'
+        + (o.count != null ? ' &times; ' + o.count : ''))
+        .join(' &middot; ')
+      + '</div>';
+  return html + '</div>';
+}
+
+function postmortemCard(pm) {
+  // the flight recorder's frozen bundle (telemetry/memory.py,
+  // POST /api/task/postmortem): the at-death explanation of a failed
+  // task — reason, when, and which series the bundle carries
+  if (!pm || pm.success === false || !pm.task) return '';
+  let html = '<h3>postmortem</h3><div class="card">'
+    + '<div style="display:flex;gap:18px;margin-bottom:8px">'
+    + `<div><b>${esc(pm.reason || '?')}</b>
+       <span class="dim">reason</span></div>`
+    + `<div><b>${esc(pm.created || '')}</b>
+       <span class="dim">frozen at</span></div>`;
+  const card = pm.task_card || {};
+  if (card.computer)
+    html += `<div><b>${esc(card.computer)}</b>
+      <span class="dim">computer</span></div>`;
+  html += '</div>';
+  const names = Object.keys(pm.series || {});
+  if (names.length)
+    html += '<div class="dim" style="font-size:11px">'
+      + names.map(n => {
+          const pts = pm.series[n];
+          return esc(n) + ': ' + pts.length + ' pts, last '
+            + (+pts[pts.length-1].value).toPrecision(4);
+        }).join(' &middot; ') + '</div>';
+  return html + '</div>';
+}
+
 function recoveryCard(info, series) {
   // automatic-recovery history (mlcomp_tpu/recovery.py): retries
   // consumed vs budget, the taxonomy verdict of the last failure, the
@@ -1035,6 +1160,23 @@ async function viewTaskDetail(el, id) {
   // tail fetch so 'latest step' is true however long the run
   const perf = performanceCard(perfTel.series || {});
   if (perf) el.appendChild(h('<div>' + perf + '</div>'));
+  // memory + communication cards beside the phase breakdown: the HBM
+  // timeline / compiled-peak attribution and the measured collective
+  // share (telemetry/memory.py, telemetry/collectives.py)
+  const mem = memoryCard(perfTel.series || {});
+  if (mem) el.appendChild(h('<div>' + mem + '</div>'));
+  const comm = commCard(perfTel.series || {});
+  if (comm) el.appendChild(h('<div>' + comm + '</div>'));
+  // postmortem card for failed tasks: the flight recorder's frozen
+  // at-death bundle (404s quietly when the task never failed with a
+  // taxonomy reason)
+  if (info.failure_reason) {
+    let pm = null;
+    try { pm = await api('task/postmortem', {task: id}); }
+    catch (e) {}
+    const pmc = postmortemCard(pm);
+    if (pmc) el.appendChild(h('<div>' + pmc + '</div>'));
+  }
   // span forest: where the task's wall-clock went (worker pipeline
   // phases + executor internals), durations in ms
   const spanTree = nodes => '<div class="tree">' + nodes.map(s =>

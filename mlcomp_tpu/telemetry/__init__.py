@@ -30,6 +30,18 @@ package wired through every layer of this framework:
   land as ``compile.backend_ms`` with the triggering step) and the
   runtime host-sync tripwire, the dynamic counterpart of the
   preflight linter's host-sync rules.
+- ``memory`` — the deep-memory engine: per-step HBM timeline
+  (``MemorySampler`` — used/limit/peak per local device), static peak
+  attribution from the compiled executable's ``memory_analysis()``,
+  and the OOM flight recorder (a postmortem bundle frozen at every
+  reasoned task failure, retrievable via ``mlcomp_tpu postmortem``
+  and ``POST /api/task/postmortem`` — migration v10).
+- ``collectives`` — collective-communication attribution: the
+  compiled HLO walked for all-reduce/all-gather/reduce-scatter/
+  collective-permute (per-op counts + bytes per device per step) and
+  a MEASURED wire probe that turns the tally into the
+  ``comm.fraction`` series — is this step math-bound or
+  network-bound.
 - ``export`` — OpenMetrics renderer + minimal validating parser
   behind ``GET /metrics`` (server/api.py, server/serve.py): queue
   depth, dispatch latency, slots, alerts, step phases, serving
@@ -45,11 +57,20 @@ cost, ``observability_overhead_pct``) every round.
 """
 
 from mlcomp_tpu.telemetry.attribution import PHASES, StepAttribution
+from mlcomp_tpu.telemetry.collectives import (
+    COLLECTIVE_OPS, collective_stats, measure_collective_ms,
+    persist_collective_stats,
+)
 from mlcomp_tpu.telemetry.compile_events import (
     COMPILE_EVENTS, CompileEventRecorder, HostSyncTripwire,
 )
 from mlcomp_tpu.telemetry.device import (
     compiled_cost, device_memory_stats, mfu, record_device_stats,
+)
+from mlcomp_tpu.telemetry.memory import (
+    MemorySampler, build_postmortem, load_postmortem,
+    memory_attribution, persist_memory_attribution,
+    persist_postmortem, persist_run_snapshot,
 )
 from mlcomp_tpu.telemetry.export import (
     OPENMETRICS_CONTENT_TYPE, parse_openmetrics, render_openmetrics,
@@ -80,6 +101,11 @@ __all__ = [
     'Watchdog', 'WatchdogConfig',
     'StepAttribution', 'PHASES',
     'CompileEventRecorder', 'HostSyncTripwire', 'COMPILE_EVENTS',
+    'MemorySampler', 'memory_attribution',
+    'persist_memory_attribution', 'persist_run_snapshot',
+    'build_postmortem', 'persist_postmortem', 'load_postmortem',
+    'COLLECTIVE_OPS', 'collective_stats', 'measure_collective_ms',
+    'persist_collective_stats',
     'render_openmetrics', 'parse_openmetrics', 'render_server_metrics',
     'OPENMETRICS_CONTENT_TYPE',
 ]

@@ -18,8 +18,15 @@ import sys
 
 def device_memory_stats() -> list:
     """Per-local-device HBM stats via ``device.memory_stats()``:
-    ``[{'id', 'platform', 'kind', 'bytes_in_use', 'bytes_limit'}]``.
-    Empty on CPU (no memory_stats) or when jax is not live."""
+    ``[{'id', 'platform', 'kind', 'bytes_in_use', 'bytes_limit',
+    'peak_bytes_in_use', 'reports_memory'}]``. Empty when jax is not
+    live. ``peak_bytes_in_use`` is the allocator's high-water mark
+    when the backend reports one (TPU does; 0 otherwise) — the number
+    an OOM postmortem wants, since the crash-time ``bytes_in_use``
+    reads AFTER the failed allocation was rolled back.
+    ``reports_memory`` is False on platforms without memory stats
+    (CPU), so consumers can skip the device instead of rendering an
+    empty 0/0 HBM row."""
     if 'jax' not in sys.modules:
         return []
     try:
@@ -36,6 +43,9 @@ def device_memory_stats() -> list:
                 'kind': getattr(d, 'device_kind', str(d)),
                 'bytes_in_use': int(stats.get('bytes_in_use', 0)),
                 'bytes_limit': int(stats.get('bytes_limit', 0)),
+                'peak_bytes_in_use':
+                    int(stats.get('peak_bytes_in_use', 0)),
+                'reports_memory': bool(stats.get('bytes_limit')),
             })
         return out
     except Exception:
@@ -70,14 +80,20 @@ def mfu(flops_per_step: float, steps_per_sec: float, n_devices: int,
 
 def record_device_stats(recorder, step: int = None):
     """Gauge rows per local device: ``device<i>.hbm_used`` /
-    ``device<i>.hbm_limit`` (bytes). Cheap no-op off-TPU."""
+    ``device<i>.hbm_limit`` (+ ``hbm_peak`` when the backend reports
+    a high-water mark). Cheap no-op off-TPU: devices that report no
+    memory stats (``reports_memory`` False — CPU) emit nothing, so a
+    CPU run never renders empty 0/0 HBM rows in the dashboard."""
     for d in device_memory_stats():
-        if not d['bytes_limit']:
+        if not d['reports_memory']:
             continue
         recorder.gauge(f'device{d["id"]}.hbm_used',
                        d['bytes_in_use'], step=step)
         recorder.gauge(f'device{d["id"]}.hbm_limit',
                        d['bytes_limit'], step=step)
+        if d['peak_bytes_in_use']:
+            recorder.gauge(f'device{d["id"]}.hbm_peak',
+                           d['peak_bytes_in_use'], step=step)
 
 
 __all__ = ['device_memory_stats', 'compiled_cost', 'mfu',

@@ -28,6 +28,7 @@ Three parts:
 
 import json
 import re
+import time
 
 #: the content type Prometheus negotiates for OpenMetrics 1.0
 OPENMETRICS_CONTENT_TYPE = \
@@ -44,7 +45,9 @@ REQUIRED_FAMILIES = (
     'mlcomp_serving_latency_ms',
     'mlcomp_fleet_replicas', 'mlcomp_fleet_generation',
     'mlcomp_fleet_shed', 'mlcomp_fleet_respawns',
-    'mlcomp_fleet_swaps', 'mlcomp_scrape_errors',
+    'mlcomp_fleet_swaps',
+    'mlcomp_hbm_bytes', 'mlcomp_comm_bytes', 'mlcomp_comm_fraction',
+    'mlcomp_scrape_errors', 'mlcomp_scrape_duration_seconds',
 )
 
 
@@ -329,6 +332,54 @@ def _collect_step_phases(session, running, phase_samples, eff_samples):
                 ('', {'task': r['task'], 'phase': phase}, r['value']))
 
 
+#: device timeline names: device<N>.hbm_<kind>
+_HBM_NAME = re.compile(r'^device(\d+)\.hbm_(used|limit|peak)$')
+#: per-op collective tallies: comm.<op>_bytes (telemetry/collectives.py)
+_COMM_NAME = re.compile(r'^comm\.([a-z_]+)_bytes$')
+
+
+def _collect_hbm(session, running, samples):
+    """``mlcomp_hbm_bytes{task,device,kind=used|limit|peak}`` — the
+    latest point of each running task's HBM timeline
+    (telemetry/memory.py MemorySampler). A scraper alerting on
+    used/limit sees the same occupancy the watchdog's OOM predictor
+    regresses over."""
+    if not running:
+        return
+    marks = ','.join('?' * len(running))
+    for r in session.query(
+            f'SELECT task, name, value, MAX(id) AS latest FROM metric '
+            f"WHERE task IN ({marks}) AND name LIKE 'device%.hbm\\_%' "
+            f"ESCAPE '\\' GROUP BY task, name", tuple(running)):
+        m = _HBM_NAME.match(r['name'])
+        if m is None:
+            continue
+        samples.append(('', {'task': r['task'], 'device': m.group(1),
+                             'kind': m.group(2)}, r['value']))
+
+
+def _collect_comm(session, running, bytes_samples, frac_samples):
+    """``mlcomp_comm_bytes{task,op}`` (per-device bytes per step from
+    the compiled HLO walk) + ``mlcomp_comm_fraction{task}`` (measured
+    wire share of the step) — telemetry/collectives.py. Latest row per
+    (task, name) like the step-phase family."""
+    if not running:
+        return
+    marks = ','.join('?' * len(running))
+    for r in session.query(
+            f'SELECT task, name, value, MAX(id) AS latest FROM metric '
+            f"WHERE task IN ({marks}) AND name LIKE 'comm.%' "
+            f'GROUP BY task, name', tuple(running)):
+        if r['name'] == 'comm.fraction':
+            frac_samples.append(('', {'task': r['task']}, r['value']))
+            continue
+        m = _COMM_NAME.match(r['name'])
+        if m is None:
+            continue        # counts/probe/totals ride the JSON surfaces
+        bytes_samples.append(
+            ('', {'task': r['task'], 'op': m.group(1)}, r['value']))
+
+
 def _collect_compile_events(session, running, samples):
     if not running:
         return
@@ -528,39 +579,61 @@ def _collect_fleet_events(session, respawns, swaps):
 
 def collect_server_families(session):
     """The API server's /metrics families, each collected defensively
-    from the DB (+ the scrape-error count so a sick collector is
-    visible to the scraper instead of silently absent)."""
-    errors = [0]
+    from the DB. Scrape self-observability: ``mlcomp_scrape_errors``
+    carries one labeled sample PER collector (a single aggregate
+    counter says "something is sick" without saying what — the label
+    names the sick collector), and ``mlcomp_scrape_duration_seconds``
+    times the whole collect so a scrape slowly drowning in table
+    growth is visible before Prometheus starts timing out."""
+    t_scrape = time.perf_counter()
+    errors = {}
 
-    def guarded(fn, *args):
+    def guarded(name, fn, *args):
+        errors.setdefault(name, 0)
         try:
             fn(*args)
         except Exception:
-            errors[0] += 1
+            errors[name] += 1
 
     tasks, queues, slots, alerts = [], [], [], []
     dispatch, phases, eff, compiles, serving = [], [], [], [], []
     retries, gangs = [], []
     freplicas, fgens, fshed, frespawns, fswaps = [], [], [], [], []
-    guarded(_collect_tasks, session, tasks)
-    guarded(_collect_queue_depth, session, queues)
-    guarded(_collect_worker_slots, session, slots)
-    guarded(_collect_alerts, session, alerts)
-    guarded(_collect_dispatch_latency, session, dispatch)
-    guarded(_collect_task_retries, session, retries)
-    guarded(_collect_gang_generations, session, gangs)
-    guarded(_collect_fleet_replicas, session, freplicas)
-    guarded(_collect_fleet_generations, session, fgens)
-    guarded(_collect_fleet_shed, session, fshed)
-    guarded(_collect_fleet_events, session, frespawns, fswaps)
+    hbm, comm_bytes, comm_frac = [], [], []
+    guarded('tasks', _collect_tasks, session, tasks)
+    guarded('queue_depth', _collect_queue_depth, session, queues)
+    guarded('worker_slots', _collect_worker_slots, session, slots)
+    guarded('alerts', _collect_alerts, session, alerts)
+    guarded('dispatch_latency', _collect_dispatch_latency, session,
+            dispatch)
+    guarded('task_retries', _collect_task_retries, session, retries)
+    guarded('gang_generations', _collect_gang_generations, session,
+            gangs)
+    guarded('fleet_replicas', _collect_fleet_replicas, session,
+            freplicas)
+    guarded('fleet_generations', _collect_fleet_generations, session,
+            fgens)
+    guarded('fleet_shed', _collect_fleet_shed, session, fshed)
+    guarded('fleet_events', _collect_fleet_events, session, frespawns,
+            fswaps)
     running = []
+    errors.setdefault('running_tasks', 0)
     try:
         running = _running_task_ids(session)
     except Exception:
-        errors[0] += 1
-    guarded(_collect_step_phases, session, running, phases, eff)
-    guarded(_collect_compile_events, session, running, compiles)
-    guarded(_collect_serving_latency, session, serving)
+        errors['running_tasks'] += 1
+    guarded('step_phases', _collect_step_phases, session, running,
+            phases, eff)
+    guarded('compile_events', _collect_compile_events, session,
+            running, compiles)
+    guarded('hbm', _collect_hbm, session, running, hbm)
+    guarded('comm', _collect_comm, session, running, comm_bytes,
+            comm_frac)
+    guarded('serving_latency', _collect_serving_latency, session,
+            serving)
+    error_samples = [('', {'collector': name}, n)
+                     for name, n in sorted(errors.items())]
+    duration = time.perf_counter() - t_scrape
     return [
         family('mlcomp_up', 'gauge',
                'API server is serving this scrape', [('', None, 1)]),
@@ -609,9 +682,25 @@ def collect_server_families(session):
         family('mlcomp_fleet_swaps', 'counter',
                'rolling-swap events by outcome (recent event window)',
                fswaps),
+        family('mlcomp_hbm_bytes', 'gauge',
+               'latest HBM timeline point per running task and device '
+               '(kind=used|limit|peak; telemetry memory sampler, '
+               f'newest {_RUNNING_TASKS_CAP} running tasks)', hbm),
+        family('mlcomp_comm_bytes', 'gauge',
+               'per-device collective bytes per compiled step by op '
+               '(HLO walk; newest '
+               f'{_RUNNING_TASKS_CAP} running tasks)', comm_bytes),
+        family('mlcomp_comm_fraction', 'gauge',
+               'measured collective share of the step (wire probe / '
+               f'step time; newest {_RUNNING_TASKS_CAP} running '
+               'tasks)', comm_frac),
         family('mlcomp_scrape_errors', 'gauge',
-               'collectors that failed during this scrape',
-               [('', None, errors[0])]),
+               'failures during this scrape, labeled by collector '
+               '(the endpoint never 500s on a sick DB — the label '
+               'says WHICH read is sick)', error_samples),
+        family('mlcomp_scrape_duration_seconds', 'gauge',
+               'wall-clock of this scrape\'s DB collection',
+               [('', None, round(duration, 6))]),
     ]
 
 

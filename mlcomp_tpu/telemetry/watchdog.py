@@ -27,9 +27,12 @@ Rules (all thresholds tunable via WatchdogConfig):
   data (a median of two is meaningless).
 - **hbm-pressure** — a running task whose latest
   ``device<i>.hbm_used/hbm_limit`` occupancy crosses
-  ``hbm_threshold``, or climbed monotonically through the recent
-  window above ``hbm_trend_floor`` (heading for an OOM even though it
-  has not crossed the line yet).
+  ``hbm_threshold``, OR whose least-squares occupancy slope over the
+  recent per-step timeline (telemetry/memory.py MemorySampler)
+  projects OOM within ``hbm_oom_horizon_steps`` — the alert fires
+  BEFORE the crash the flight recorder would otherwise only explain
+  after the fact. A monotonic rise above ``hbm_trend_floor`` that
+  projects past the horizon still warns.
 - **recompile-storm** — ``recompile_storm_count`` XLA compile events
   past ``recompile_warmup_steps`` within ``recompile_window_s``
   (telemetry/compile_events.py records them); time-windowed so the
@@ -85,6 +88,14 @@ class WatchdogConfig:
     hbm_threshold = 0.92
     #: rising-trend alerts only above this floor
     hbm_trend_floor = 0.75
+    #: samples of the occupancy window the OOM predictor regresses
+    #: over (telemetry/memory.py's per-step timeline feeds it)
+    hbm_predict_window = 8
+    #: predicted steps-to-OOM at or under this horizon → critical
+    #: BEFORE the crash. At the default sampler cadence (every step)
+    #: this is minutes of warning on real step times — enough for an
+    #: operator (or ROADMAP item 5's scheduler) to act
+    hbm_oom_horizon_steps = 500.0
     #: recompile storm: this many compile events past warmup inside
     #: the window → alert. Warmup compiles are FREE (every stage's
     #: first steps legitimately compile train/eval programs); the
@@ -410,41 +421,100 @@ class Watchdog:
                 alerts.resolve_for_task(task.id, rule='recompile-storm')
         return out
 
+    @staticmethod
+    def _oom_prediction(points):
+        """(slope_per_step, predicted_steps_to_oom) from a
+        newest-first ``[(step, occupancy)]`` window via least squares
+        — the trend half of the hbm-pressure rule. ``(None, None)``
+        when the window is too shallow or the trend is flat/falling;
+        prediction assumes the occupancy keeps climbing at the fitted
+        slope until 1.0 (allocator slack above the limit is already
+        gone by then)."""
+        pts = [(s, o) for s, o in points if s is not None]
+        if len(pts) < 4:
+            # step-less legacy gauges: fall back to sample index so
+            # per-epoch record_device_stats rows still get a verdict
+            pts = [(i, o) for i, (_, o) in enumerate(reversed(points))]
+            pts.reverse()
+        if len(pts) < 4:
+            return None, None
+        n = len(pts)
+        mean_s = sum(s for s, _ in pts) / n
+        mean_o = sum(o for _, o in pts) / n
+        var = sum((s - mean_s) ** 2 for s, _ in pts)
+        if var <= 0:
+            return None, None
+        slope = sum((s - mean_s) * (o - mean_o) for s, o in pts) / var
+        if slope <= 0:
+            return slope, None
+        headroom = 1.0 - pts[0][1]           # newest occupancy
+        if headroom <= 0:
+            return slope, 0.0
+        return slope, headroom / slope
+
     def _check_hbm(self, running, metrics, alerts):
+        """HBM pressure, two ways: the fixed occupancy threshold, and
+        trend-based OOM prediction — a least-squares slope over the
+        recent per-step timeline (telemetry/memory.py MemorySampler)
+        projecting when occupancy hits 1.0. A projection inside
+        ``hbm_oom_horizon_steps`` is CRITICAL while the run is still
+        alive — the point of a flight recorder is the alert BEFORE the
+        crash, not the bundle after it."""
+        window = int(self.config.hbm_predict_window)
         out = []
         for task in running:
             names = metrics.names(task.id, like='device%.hbm_used')
-            worst = None         # (occupancy history newest-first, dev)
+            worst = None    # ((step, occ) history newest-first, dev)
             for used_name in names:
                 limit_name = used_name.replace('.hbm_used', '.hbm_limit')
                 used = metrics.recent_step_values(task.id, used_name,
-                                                  limit=6)
+                                                  limit=window)
                 limits = dict(metrics.recent_step_values(
-                    task.id, limit_name, limit=6))
+                    task.id, limit_name, limit=window))
                 # join on STEP: the two windows are fetched
                 # independently and one side may have dropped a sample
-                occ = [value / limits[step] for step, value in used
-                       if limits.get(step)]
-                if occ and (worst is None or occ[0] > worst[0][0]):
+                occ = [(step, value / limits[step])
+                       for step, value in used if limits.get(step)]
+                if occ and (worst is None or occ[0][1] > worst[0][0][1]):
                     worst = (occ, used_name)
             if worst is None:
                 continue
             occ, dev = worst
-            rising = len(occ) >= 4 and all(
-                a > b for a, b in zip(occ, occ[1:]))  # newest first
-            if occ[0] > self.config.hbm_threshold or \
-                    (rising and occ[0] > self.config.hbm_trend_floor):
+            now_occ = occ[0][1]
+            values = [o for _, o in occ]
+            rising = len(values) >= 4 and all(
+                a > b for a, b in zip(values, values[1:]))  # newest 1st
+            slope, predicted = self._oom_prediction(occ)
+            imminent = (
+                predicted is not None
+                and predicted <= float(self.config.hbm_oom_horizon_steps)
+                and now_occ > self.config.hbm_trend_floor)
+            if now_occ > self.config.hbm_threshold or imminent or \
+                    (rising and now_occ > self.config.hbm_trend_floor):
+                message = (f'task {task.id} ({task.name}): HBM '
+                           f'occupancy {now_occ:.0%} on '
+                           f'{dev.split(".")[0]}')
+                if imminent:
+                    message += (f' — projected OOM in '
+                                f'~{predicted:.0f} steps at the '
+                                f'current growth rate')
+                elif rising:
+                    message += ' and rising'
+                message += \
+                    f' (threshold {self.config.hbm_threshold:.0%})'
+                critical = now_occ > self.config.hbm_threshold \
+                    or imminent
+                details = {'occupancy': round(now_occ, 4),
+                           'rising': rising}
+                if slope is not None:
+                    details['slope_per_step'] = round(slope, 6)
+                if predicted is not None:
+                    details['predicted_steps_to_oom'] = \
+                        round(predicted, 1)
                 out.append(self._raise(
-                    alerts, 'hbm-pressure',
-                    f'task {task.id} ({task.name}): HBM occupancy '
-                    f'{occ[0]:.0%} on {dev.split(".")[0]}'
-                    + (' and rising' if rising else '')
-                    + f' (threshold {self.config.hbm_threshold:.0%})',
-                    task,
-                    severity='critical'
-                    if occ[0] > self.config.hbm_threshold else 'warning',
-                    details={'occupancy': round(occ[0], 4),
-                             'rising': rising}))
+                    alerts, 'hbm-pressure', message, task,
+                    severity='critical' if critical else 'warning',
+                    details=details))
             else:
                 alerts.resolve_for_task(task.id, rule='hbm-pressure')
         return out

@@ -34,7 +34,9 @@ Actions:
   blocks run, exactly like the real thing.
 - ``raise`` — raise an exception: ``exc`` is ``operational`` (sqlite
   ``database is locked`` — the DB-outage window), ``oserror``
-  (connection trouble) or ``runtime``.
+  (connection trouble), ``runtime``, or ``resource``
+  (``RESOURCE_EXHAUSTED`` — the injected device OOM the flight
+  recorder's chaos test kills a run with).
 - ``sleep`` — ``time.sleep(ms/1000)`` (slow dispatch / slow disk).
 - ``call``  — invoke a handler registered in-process via
   ``register_handler(point, fn)`` with the site's context kwargs (the
@@ -97,6 +99,13 @@ _EXCEPTIONS = {
         msg or 'database is locked (injected)'),
     'oserror': lambda msg: OSError(msg or 'connection reset (injected)'),
     'runtime': lambda msg: RuntimeError(msg or 'injected fault'),
+    # device HBM exhaustion, shaped like XlaRuntimeError's surface (a
+    # RuntimeError whose text leads with the grpc status name) so the
+    # taxonomy classifies it `oom` and the flight recorder persists a
+    # postmortem — the deterministic stand-in for a real OOM
+    'resource': lambda msg: RuntimeError(
+        msg or 'RESOURCE_EXHAUSTED: Out of memory allocating '
+               '17179869184 bytes (injected)'),
 }
 
 

@@ -126,11 +126,15 @@ class JaxTrain(Executor):
         # this records the real device timeline incl. fusion + HBM
         self.profile = dict(profile) if profile else None
         # telemetry: True (default) | False | {flush_every: N,
-        # cost_analysis: bool, peak_tflops: float}. Per-step loss/
-        # throughput series + per-epoch device stats land in the
-        # metric table (telemetry/); cost_analysis re-lowers the step
-        # for XLA's FLOPs count so MFU is recorded from inside the
-        # loop — it defaults on off-CPU only (the AOT lowering is an
+        # cost_analysis: bool, memory_analysis: bool,
+        # collectives: bool, memory_every: N, peak_tflops: float}.
+        # Per-step loss/throughput series + the per-step HBM timeline
+        # (MemorySampler, memory_every cadence) + per-epoch device
+        # stats land in the metric table (telemetry/); cost_analysis/
+        # memory_analysis/collectives share ONE AOT lowering of the
+        # step for XLA's FLOPs count, the static peak-memory
+        # attribution, and the collective-communication tally + wire
+        # probe — each defaults on off-CPU only (the lowering is an
         # extra compile the CPU test harness shouldn't pay)
         self.telemetry_spec = dict(telemetry) \
             if isinstance(telemetry, dict) else ({} if telemetry else None)
@@ -399,6 +403,9 @@ class JaxTrain(Executor):
         # device values pull at flush (every flush_every steps and at
         # each epoch boundary).
         self._step_flops = None
+        self._memory = None
+        self._comm_probe_ms = None
+        self._introspected = False
         if self.telemetry_spec is not None and self.session is not None \
                 and self.task is not None and self._is_main:
             from mlcomp_tpu.telemetry import MetricRecorder, TaskProfiler
@@ -418,7 +425,8 @@ class JaxTrain(Executor):
             # compile listener fires only when XLA actually compiles
             # (no-op install on builds without jax.monitoring)
             from mlcomp_tpu.telemetry import (
-                CompileEventRecorder, HostSyncTripwire, StepAttribution,
+                CompileEventRecorder, HostSyncTripwire, MemorySampler,
+                StepAttribution,
             )
             self._attribution = StepAttribution(
                 recorder=self._telemetry)
@@ -426,25 +434,107 @@ class JaxTrain(Executor):
             self._compile_events = CompileEventRecorder(
                 recorder=self._telemetry)
             self._compile_events.install()
+            # per-step HBM timeline (telemetry/memory.py): resolves
+            # "does this platform report memory at all" ONCE — inert
+            # on CPU, one allocator-stats read per device on TPU. The
+            # watchdog's OOM predictor and the postmortem bundle both
+            # read the series it emits.
+            self._memory = MemorySampler(
+                self._telemetry,
+                every=int(self.telemetry_spec.get('memory_every', 1)))
 
-        def _telemetry_step_flops(step_fn, *abstract_args):
-            """XLA cost analysis of the compiled step, once per run —
-            the inside-the-loop half of bench.py's MFU accounting.
-            Off by default on CPU (the AOT lowering is an extra
-            compile the test harness shouldn't pay)."""
-            if self._telemetry is None or self._step_flops is not None:
-                return
-            want = self.telemetry_spec.get('cost_analysis')
+        def _want(key):
+            """Per-feature introspection gate: 'cost_analysis' /
+            'memory_analysis' / 'collectives' each default ON off-CPU
+            only (the shared AOT lowering is an extra compile the CPU
+            test harness shouldn't pay) and can be forced either way
+            in the telemetry spec."""
+            want = self.telemetry_spec.get(key)
             if want is None:
                 want = jax.default_backend() != 'cpu'
-            if not want:
+            return bool(want)
+
+        def _telemetry_step_introspection(step_fn, *abstract_args):
+            """Compiled-step introspection, once per run off ONE AOT
+            lower+compile: XLA cost analysis (the in-loop half of
+            bench's MFU), static peak memory attribution
+            (telemetry/memory.py), and the collective-communication
+            tally + measured wire probe (telemetry/collectives.py).
+            The ``_introspected`` latch stops later stages from paying
+            the lowering again even when a backend offers none of the
+            analyses."""
+            if self._telemetry is None or self._introspected:
                 return
-            from mlcomp_tpu.telemetry import compiled_cost
-            cost = compiled_cost(step_fn, *abstract_args)
-            # 0 = probed-and-unavailable: the is-not-None guard above
-            # must stop later stages from paying the AOT lower+compile
-            # again when cost_analysis has nothing for this backend
-            self._step_flops = cost.get('flops') or 0
+            wants = {key: _want(key) for key in
+                     ('cost_analysis', 'memory_analysis',
+                      'collectives')}
+            if not any(wants.values()):
+                return
+            self._introspected = True
+            try:
+                compiled = step_fn.lower(*abstract_args).compile()
+            except Exception as e:
+                self.info(f'telemetry: step introspection skipped '
+                          f'({e})')
+                return
+            if wants['cost_analysis']:
+                try:
+                    cost = compiled.cost_analysis()
+                    if isinstance(cost, (list, tuple)):
+                        cost = cost[0]
+                    self._step_flops = \
+                        float(cost.get('flops', 0.0)) or 0
+                except Exception:
+                    self._step_flops = 0
+            # everything below is best-effort context, like the
+            # run.snapshot write: a transient DB hiccup (the locked-
+            # sqlite window the db.execute fault point exists for)
+            # during the persist must never fail a HEALTHY training
+            # run through the introspection path
+            if wants['memory_analysis']:
+                from mlcomp_tpu.telemetry import (
+                    memory_attribution, persist_memory_attribution,
+                )
+                attribution = memory_attribution(compiled)
+                if attribution:
+                    try:
+                        persist_memory_attribution(
+                            self.session, self.task.id, attribution)
+                    except Exception:
+                        pass
+                    self.info(
+                        'memory attribution (compiled peak): '
+                        + ', '.join(
+                            f'{k.replace("_bytes", "")}='
+                            f'{v / 1e9:.2f} GB'
+                            for k, v in sorted(attribution.items())))
+            if wants['collectives']:
+                from mlcomp_tpu.telemetry import (
+                    collective_stats, measure_collective_ms,
+                    persist_collective_stats,
+                )
+                try:
+                    stats = collective_stats(compiled)
+                except Exception:
+                    stats = None
+                if stats is not None:
+                    self._comm_probe_ms = measure_collective_ms(
+                        mesh, stats['total_bytes'])
+                    try:
+                        persist_collective_stats(
+                            self.session, self.task.id, stats,
+                            comm_ms=self._comm_probe_ms)
+                    except Exception:
+                        pass
+                    if stats['total_count']:
+                        probe = (f', probe '
+                                 f'{self._comm_probe_ms:.2f} ms'
+                                 if self._comm_probe_ms else '')
+                        self.info(
+                            f'collectives per step: '
+                            f'{stats["total_count"]} ops, '
+                            f'{stats["total_bytes"] / 1e6:.1f} MB '
+                            f'per device{probe}')
 
         def stage_opt_spec(stage):
             return stage.get('optimizer') or \
@@ -495,6 +585,30 @@ class JaxTrain(Executor):
         self.info(
             f'model={self.model_spec.get("name")} params={n_params:,} '
             f'mesh={dict(mesh.shape)} devices={len(mesh.devices.flat)}')
+        if self._telemetry is not None:
+            # the run.snapshot row: the mesh / batch-shape / model
+            # context the postmortem bundle freezes next to the series
+            # (which say WHAT happened — this says on what)
+            from mlcomp_tpu.telemetry import persist_run_snapshot
+            try:
+                persist_run_snapshot(self.session, self.task.id, {
+                    'model': self.model_spec.get('name'),
+                    'model_spec': {k: v for k, v in
+                                   self.model_spec.items()
+                                   if isinstance(v, (str, int, float,
+                                                     bool))},
+                    'n_params': int(n_params),
+                    'mesh': {k: int(v) for k, v in
+                             dict(mesh.shape).items()},
+                    'devices': len(mesh.devices.flat),
+                    'batch_size': int(self.batch_size),
+                    'batch_shape': [int(self.batch_size)]
+                    + [int(d) for d in x_train.shape[1:]],
+                    'input_dtype': str(x_train.dtype),
+                    'loss': self.loss_name,
+                })
+            except Exception:
+                pass            # context is best-effort, never fatal
 
         epochs_done_global = 0
         restored = None
@@ -605,28 +719,42 @@ class JaxTrain(Executor):
             if self._telemetry is not None \
                     and not (use_device_data and self.epoch_scan):
                 import jax.numpy as jnp
+                # abstract batch args carry the REAL input shardings:
+                # an unsharded (replicated) abstract batch compiles a
+                # collective-free program — every device would own the
+                # whole batch, no gradient psum — and the collective
+                # tally/probe would silently certify zero comm for a
+                # step whose production twin all-reduces every grad
                 if use_device_data:
-                    _telemetry_step_flops(
+                    _telemetry_step_introspection(
                         train_step, state, x_all, y_all,
-                        jax.ShapeDtypeStruct((self.batch_size,),
-                                             jnp.int32))
+                        jax.ShapeDtypeStruct(
+                            (self.batch_size,), jnp.int32,
+                            sharding=batch_sharding(mesh, 1)))
                 else:
-                    _telemetry_step_flops(
+                    _telemetry_step_introspection(
                         train_step, state,
                         jax.ShapeDtypeStruct(
                             (self.batch_size,) + x_train.shape[1:],
-                            x_train.dtype),
+                            x_train.dtype,
+                            sharding=batch_sharding(
+                                mesh, 1 + len(x_train.shape[1:]),
+                                seq_dim=seq_dim)),
                         None if y_train is None else
                         jax.ShapeDtypeStruct(
                             (self.batch_size,) + y_train.shape[1:],
-                            y_train.dtype))
+                            y_train.dtype,
+                            sharding=batch_sharding(
+                                mesh,
+                                1 + len(y_train.shape[1:]))))
                 from mlcomp_tpu.train.loop import instrumented_step
                 train_step = instrumented_step(
                     train_step, self._telemetry,
                     batch_size=self.batch_size,
                     attribution=self._attribution,
                     tripwire=self._tripwire,
-                    compile_events=self._compile_events)
+                    compile_events=self._compile_events,
+                    memory=self._memory)
             eval_step = make_eval_step(
                 model, loss_fn, mesh=mesh,
                 self_supervised=self_supervised)
@@ -782,6 +910,19 @@ class JaxTrain(Executor):
                             len(mesh.devices.flat), peak))
                     from mlcomp_tpu.telemetry import record_device_stats
                     record_device_stats(tel)
+                    if self._comm_probe_ms:
+                        # measured comm share of the observed step:
+                        # the wire time of this step's collectives
+                        # (telemetry/collectives.py probe, once per
+                        # stage) over the epoch's mean step time — the
+                        # "is my step communication-bound" series
+                        step_ms = train_dt * 1e3 / steps_per_epoch
+                        if step_ms > 0:
+                            tel.series(
+                                'comm.fraction',
+                                min(1.0,
+                                    self._comm_probe_ms / step_ms),
+                                step=global_epoch)
                     if self._attribution is not None \
                             and self._attribution.steps:
                         # bench's pipeline_efficiency, from inside the

@@ -377,7 +377,8 @@ def make_eval_step(model, loss_fn: Callable,
 
 def instrumented_step(step_fn, recorder, batch_size: int = None,
                       metric_keys=('loss',), attribution=None,
-                      tripwire=None, compile_events=None):
+                      tripwire=None, compile_events=None,
+                      memory=None):
     """Wrap a jit'd train step with per-step telemetry recording
     (telemetry/metrics.py). Hot-path cost per step: a perf_counter
     read and 2-3 list appends — the device arrays in ``metrics`` are
@@ -401,7 +402,12 @@ def instrumented_step(step_fn, recorder, batch_size: int = None,
       inside this step lands with its triggering step number;
     - ``tripwire`` sees the same inter-dispatch interval and flags
       host-sync suspects — except on steps whose interval contains a
-      recorded compile (slow for a known reason).
+      recorded compile (slow for a known reason);
+    - ``memory`` (telemetry/memory.py MemorySampler) records the
+      per-step HBM timeline after the dispatch — one allocator-stats
+      read per reporting device, no device sync, inert on platforms
+      without memory stats (bench publishes
+      ``memory_sampler_overhead_pct``; budget <1%).
     """
     import time as _time
     last = [None]
@@ -433,6 +439,8 @@ def instrumented_step(step_fn, recorder, batch_size: int = None,
                                 step=step)
             if tripwire is not None and not compiled:
                 tripwire.observe(dt * 1e3, step=step)
+        if memory is not None:
+            memory.sample(step=step)
         if attribution is not None:
             attribution.step_end(step=step)
         return out

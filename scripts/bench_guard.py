@@ -67,6 +67,14 @@ FLOORS = {
     'fleet_shed_rate_pct': ('min', 1.0,
                             'shed share under deliberate overload '
                             '(SLO admission control must engage)'),
+    # round-8 leg (ISSUE 12: deep-step observability). The per-step
+    # HBM timeline must stay effectively free — the sampler is one
+    # allocator-stats read per reporting device (telemetry/memory.py),
+    # measured in isolation against the compute step like every other
+    # telemetry overhead number.
+    'memory_sampler_overhead_pct': ('max', 1.0,
+                                    'per-step HBM memory sampler '
+                                    'overhead vs step time %'),
 }
 
 

@@ -47,6 +47,13 @@ simulated by rewinding the stored timestamps, never by sleeping):
    warms, the router flips, generation 1 drains — with zero failed
    requests and the flip visible in ``mlcomp_fleet_swaps_total`` and
    ``mlcomp_fleet_generation``
+8. OOM flight recorder (deep-step observability, telemetry/memory.py):
+   an injected ``RESOURCE_EXHAUSTED`` at the train seam classifies
+   ``oom`` (permanent — never blind-retried at the same shapes), a
+   postmortem bundle (loss/HBM series tail + run snapshot + memory
+   attribution) is frozen at the failure, and the scrape
+   self-observability families (per-collector
+   ``mlcomp_scrape_errors``) stay clean
 """
 
 import datetime
@@ -622,6 +629,77 @@ def scenario_fleet_self_healing(session):
                 pass
 
 
+def scenario_oom_flight_recorder(session, sup):
+    """OOM flight recorder (ISSUE 12 acceptance, jax-free half): a
+    task with live telemetry dies on an injected RESOURCE_EXHAUSTED at
+    the train seam → the taxonomy verdict is ``oom`` (permanent — the
+    supervisor never blind-retries the same shapes), and a postmortem
+    bundle (loss/HBM tail + run snapshot + memory attribution) is
+    frozen in the ``postmortem`` table and visible on the OpenMetrics
+    export's HBM family. The jax end-to-end twin (real train loop,
+    CLI + API retrieval) lives in tests/test_postmortem.py."""
+    from mlcomp_tpu.db.providers import MetricProvider
+    from mlcomp_tpu.recovery import classify_exception
+    from mlcomp_tpu.telemetry import load_postmortem
+    from mlcomp_tpu.telemetry.export import (
+        parse_openmetrics, render_server_metrics,
+    )
+    from mlcomp_tpu.testing.faults import fault_point
+    tp = TaskProvider(session)
+    task = Task(name='oom_victim', executor='jax_train', cores=1,
+                cores_max=1, status=int(TaskStatus.InProgress),
+                computer_assigned='host_a', last_activity=now())
+    tp.add(task)
+    ts = now()
+    MetricProvider(session).add_many(
+        [(task.id, 'loss', 'series', i, 2.0 - i * 0.01, ts, 'train',
+          None) for i in range(30)]
+        + [(task.id, 'device0.hbm_used', 'series', i,
+            1.0e10 + i * 2e8, ts, 'train', None) for i in range(30)]
+        + [(task.id, 'device0.hbm_limit', 'series', i, 1.6e10, ts,
+            'train', None) for i in range(30)]
+        + [(task.id, 'memory.attribution', 'gauge', None, 1.5e10, ts,
+            'train', json.dumps({'argument_bytes': 6e9,
+                                 'temp_bytes': 9e9}))]
+        + [(task.id, 'run.snapshot', 'gauge', None, 0.0, ts, 'train',
+            json.dumps({'model': 'transformer_lm',
+                        'mesh': {'dp': 8}, 'batch_size': 8}))])
+    configure_faults({'train.epoch': {'action': 'raise',
+                                      'exc': 'resource', 'after': 1}})
+    try:
+        try:
+            fault_point('train.epoch', epoch=1, task=task.id)
+            check('injected RESOURCE_EXHAUSTED fires', False)
+        except RuntimeError as e:
+            reason = classify_exception(e)
+            check('RESOURCE_EXHAUSTED classifies as oom',
+                  reason == 'oom', reason)
+            tp.fail_with_reason(task, reason)
+    finally:
+        clear_faults()
+    sup.build()
+    task = tp.by_id(task.id)
+    check('oom is permanent: never auto-retried',
+          task.status == int(TaskStatus.Failed)
+          and task.failure_reason == 'oom'
+          and task.next_retry_at is None and (task.attempt or 0) == 0)
+    bundle = load_postmortem(session, task.id)
+    check('postmortem bundle frozen at death',
+          bundle is not None and bundle['reason'] == 'oom'
+          and len(bundle['series'].get('loss', [])) == 30
+          and 'device0.hbm_used' in bundle['series']
+          and bundle['context'].get('memory.attribution') is not None
+          and (bundle['context'].get('run.snapshot') or {}).get(
+              'tags', {}).get('model') == 'transformer_lm',
+          str(bundle and sorted(bundle['series'])))
+    doc = parse_openmetrics(render_server_metrics(session))
+    errors = doc.get('mlcomp_scrape_errors', {}).get('samples', [])
+    check('scrape errors labeled per collector and all zero',
+          len(errors) >= 15 and all(v == 0 for _, _, v in errors)
+          and all(labels.get('collector') for _, labels, _ in errors),
+          str(errors[:3]))
+
+
 def main():
     session = Session.create_session(key='chaos_smoke')
     migrate(session)
@@ -631,6 +709,7 @@ def main():
     scenario_claim_race(session)
     scenario_gang_preemption(session)
     scenario_fleet_self_healing(session)
+    scenario_oom_flight_recorder(session, sup)
     if FAILURES:
         print(f'FAIL: {len(FAILURES)} scenario check(s): {FAILURES}')
         return 1

@@ -61,6 +61,22 @@ def seed(session):
             'train', None),
            (task.id, 'task.retry', 'counter', 1, 1.0, ts,
             'supervisor', json.dumps({'reason': 'worker-lost'})),
+           # HBM timeline (telemetry/memory.py MemorySampler) + the
+           # collective tally/fraction (telemetry/collectives.py)
+           (task.id, 'device0.hbm_used', 'series', 10, 9.0e9, ts,
+            'train', None),
+           (task.id, 'device0.hbm_limit', 'series', 10, 1.6e10, ts,
+            'train', None),
+           (task.id, 'device0.hbm_peak', 'series', 10, 9.5e9, ts,
+            'train', None),
+           (task.id, 'comm.all_reduce_bytes', 'gauge', None, 2.0e7,
+            ts, 'train', None),
+           (task.id, 'comm.all_reduce_count', 'gauge', None, 2.0, ts,
+            'train', None),
+           (task.id, 'comm.bytes_per_step', 'gauge', None, 2.0e7, ts,
+            'train', None),
+           (task.id, 'comm.fraction', 'series', 0, 0.12, ts, 'train',
+            None),
            (None, 'supervisor.dispatch_latency_s.p50', 'histogram',
             None, 0.4, ts, 'supervisor', None),
            (None, 'supervisor.dispatch_latency_s.p99', 'histogram',
@@ -173,8 +189,30 @@ def main():
         ('mlcomp_fleet_swaps_total', any(
             l.get('outcome') == 'completed'
             for _, l, v in doc['mlcomp_fleet_swaps']['samples'])),
-        ('mlcomp_scrape_errors == 0',
-         doc['mlcomp_scrape_errors']['samples'][0][2] == 0),
+        ('mlcomp_hbm_bytes used/limit/peak', all(
+            any(l.get('kind') == kind and l.get('device') == '0'
+                and str(l.get('task')) == str(task_id)
+                for l in sample_labels('mlcomp_hbm_bytes'))
+            for kind in ('used', 'limit', 'peak'))),
+        ('mlcomp_comm_bytes per-op', any(
+            l.get('op') == 'all_reduce' and v == 2.0e7
+            for _, l, v in doc['mlcomp_comm_bytes']['samples'])),
+        ('mlcomp_comm_fraction', any(
+            v == 0.12
+            for _, l, v in doc['mlcomp_comm_fraction']['samples'])),
+        # scrape self-observability: one labeled sample per collector,
+        # every one healthy, and the scrape timed itself
+        ('mlcomp_scrape_errors labeled per collector',
+         len(doc['mlcomp_scrape_errors']['samples']) >= 15
+         and all(l.get('collector')
+                 for l in sample_labels('mlcomp_scrape_errors'))),
+        ('mlcomp_scrape_errors all zero', all(
+            v == 0
+            for _, _, v in doc['mlcomp_scrape_errors']['samples'])),
+        ('mlcomp_scrape_duration_seconds', len(
+            doc['mlcomp_scrape_duration_seconds']['samples']) == 1
+         and doc['mlcomp_scrape_duration_seconds']['samples'][0][2]
+         >= 0),
     ]
     failed = [name for name, ok in checks if not ok]
     if failed:

@@ -1,0 +1,313 @@
+"""Collective-communication attribution (telemetry/collectives.py):
+the HLO walk against synthetic text and real compiled sharded steps,
+the measured wire probe, persistence, and the /metrics families."""
+
+import json
+
+import numpy as np
+import pytest
+
+from mlcomp_tpu.telemetry.collectives import (
+    _shape_bytes, collective_stats, measure_collective_ms,
+    persist_collective_stats,
+)
+
+
+class TestShapeBytes:
+    def test_simple_and_layout(self):
+        assert _shape_bytes('f32[64,128]{1,0}') == 64 * 128 * 4
+        assert _shape_bytes('bf16[8,16]') == 8 * 16 * 2
+        assert _shape_bytes('u8[100]{0}') == 100
+
+    def test_tuple_shapes_sum(self):
+        assert _shape_bytes('(f32[64]{0}, f32[64,64]{1,0})') == \
+            64 * 4 + 64 * 64 * 4
+
+    def test_scalar_and_opaque(self):
+        assert _shape_bytes('f32[]') == 4
+        # token/opaque operands move no payload
+        assert _shape_bytes('token[]') == 0
+
+
+SYNTHETIC_HLO = """\
+HloModule synthetic, is_scheduled=true
+
+ENTRY %main (p0: f32[64,64]) -> f32[64,64] {
+  %p0 = f32[64,64]{1,0} parameter(0)
+  %dot = f32[64,64]{1,0} dot(%p0, %p0)
+  %all-reduce = f32[64,64]{1,0} all-reduce(f32[64,64]{1,0} %dot), \
+channel_id=1, replica_groups=[1,4]<=[4], to_apply=%add
+  %ag-start = f32[128,64]{1,0} all-gather-start(f32[64,64]{1,0} %dot), \
+channel_id=2, dimensions={0}
+  %ag-done = f32[128,64]{1,0} all-gather-done(%ag-start)
+  %rs = f32[16,64]{1,0} reduce-scatter(f32[64,64]{1,0} %dot), \
+channel_id=3, dimensions={0}, to_apply=%add
+  %cp = f32[64,64]{1,0} collective-permute(f32[64,64]{1,0} %dot), \
+channel_id=4, source_target_pairs={{0,1},{1,0}}
+  ROOT %out = f32[64,64]{1,0} add(%all-reduce, %cp)
+}
+"""
+
+
+class TestHloWalk:
+    def test_synthetic_module_tally(self):
+        stats = collective_stats(SYNTHETIC_HLO)
+        ops = stats['ops']
+        assert ops['all-reduce'] == {'count': 1, 'bytes': 64 * 64 * 4}
+        # the -start half counts, the -done half does not: an async
+        # pair is ONE collective event
+        assert ops['all-gather'] == {'count': 1,
+                                     'bytes': 128 * 64 * 4}
+        assert ops['reduce-scatter'] == {'count': 1,
+                                         'bytes': 16 * 64 * 4}
+        assert ops['collective-permute'] == {'count': 1,
+                                             'bytes': 64 * 64 * 4}
+        assert stats['total_count'] == 4
+        assert stats['total_bytes'] == \
+            (64 * 64 + 128 * 64 + 16 * 64 + 64 * 64) * 4
+
+    def test_async_start_tuple_counts_destination_only(self):
+        """TPU async lowering bundles the operand alias AND the
+        destination into the -start shape — summing both would inflate
+        every async collective ~2x; the destination (largest
+        component) is the payload."""
+        text = (
+            '%ag = (f32[64,64]{1,0}, f32[128,64]{1,0}) '
+            'all-gather-start(f32[64,64]{1,0} %p), channel_id=1, '
+            'dimensions={0}\n'
+            '%agd = f32[128,64]{1,0} all-gather-done(%ag)\n')
+        stats = collective_stats(text)
+        assert stats['ops']['all-gather'] == {
+            'count': 1, 'bytes': 128 * 64 * 4}
+
+    def test_generic_async_wrapper_is_tallied(self):
+        """Collectives lowered through the generic async-start wrapper
+        (opcode 'async-start', the collective named in calls=) must
+        not tally as zero."""
+        text = (
+            '%ar = ((f32[64,64]{1,0}), f32[64,64]{1,0}, u32[]) '
+            'async-start(f32[64,64]{1,0} %p), '
+            'calls=%wrapped_all_reduce\n'
+            '%ard = f32[64,64]{1,0} async-done(%ar), '
+            'calls=%wrapped_all_reduce\n')
+        stats = collective_stats(text)
+        assert stats['ops']['all-reduce'] == {
+            'count': 1, 'bytes': 64 * 64 * 4}
+
+    def test_non_collective_async_wrapper_ignored(self):
+        text = ('%cp = (f32[8]{0}, f32[8]{0}) '
+                'async-start(f32[8]{0} %p), calls=%wrapped_copy\n')
+        assert collective_stats(text)['total_count'] == 0
+
+    def test_variadic_sync_all_reduce_sums_components(self):
+        """A SYNC tuple-shaped all-reduce is variadic — one reduced
+        buffer per operand — and summing stays correct."""
+        text = ('%ar = (f32[64]{0}, f32[64,64]{1,0}) '
+                'all-reduce(f32[64]{0} %a, f32[64,64]{1,0} %b), '
+                'channel_id=1, to_apply=%add\n')
+        stats = collective_stats(text)
+        assert stats['ops']['all-reduce']['bytes'] == \
+            64 * 4 + 64 * 64 * 4
+
+    def test_non_collective_module_is_zero(self):
+        stats = collective_stats(
+            'ENTRY %main (p: f32[8]) -> f32[8] {\n'
+            '  ROOT %a = f32[8]{0} add(f32[8]{0} %p, f32[8]{0} %p)\n'
+            '}\n')
+        assert stats == {'ops': {}, 'total_bytes': 0,
+                         'total_count': 0}
+
+
+class TestRealCompiledStep:
+    def _mesh(self):
+        from mlcomp_tpu.parallel import mesh_from_spec
+        return mesh_from_spec({'dp': -1})
+
+    def test_sharded_grad_step_has_all_reduce(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self._mesh()
+        w = jax.device_put(np.ones((64, 64), np.float32),
+                           NamedSharding(mesh, P()))
+        x = jax.device_put(np.ones((8, 64), np.float32),
+                           NamedSharding(mesh, P('dp')))
+        g = jax.jit(jax.grad(lambda w, x: ((x @ w) ** 2).sum()))
+        stats = collective_stats(g.lower(w, x).compile())
+        assert stats['total_count'] >= 1
+        assert 'all-reduce' in stats['ops']
+        # the gradient all-reduce moves (at least) w's bytes per device
+        assert stats['ops']['all-reduce']['bytes'] >= 64 * 64 * 4
+
+    def test_unsharded_step_is_zero(self):
+        import jax
+        f = jax.jit(lambda x: x @ x)
+        stats = collective_stats(
+            f.lower(np.ones((32, 32), np.float32)).compile())
+        assert stats['total_count'] == 0
+
+    def test_probe_measures_positive_ms(self):
+        mesh = self._mesh()
+        if len(mesh.devices.flat) <= 1:
+            pytest.skip('single-device mesh: no wire to measure')
+        ms = measure_collective_ms(mesh, 1 << 16, trials=2)
+        assert ms is not None and ms > 0
+
+    def test_probe_declines_without_wire(self):
+        mesh = self._mesh()
+        assert measure_collective_ms(mesh, 0) is None
+        import jax
+        from jax.sharding import Mesh
+        single = Mesh(np.array(jax.devices()[:1]), ('dp',))
+        assert measure_collective_ms(single, 1 << 16) is None
+
+
+class TestPersistAndExport:
+    def _stats(self):
+        return {'ops': {'all-reduce': {'count': 2, 'bytes': 1 << 20},
+                        'all-gather': {'count': 1, 'bytes': 1 << 18}},
+                'total_bytes': (1 << 20) + (1 << 18),
+                'total_count': 3}
+
+    def test_rows_written_per_op_and_totals(self, session):
+        from mlcomp_tpu.db.providers import MetricProvider
+        n = persist_collective_stats(session, 7, self._stats(),
+                                     comm_ms=1.25)
+        assert n == 7     # 2 ops x 2 rows + totals x2 + probe
+        series = MetricProvider(session).series(task_id=7)
+        assert series['comm.all_reduce_bytes'][0]['value'] == 1 << 20
+        assert series['comm.all_gather_count'][0]['value'] == 1
+        assert series['comm.bytes_per_step'][0]['value'] == \
+            (1 << 20) + (1 << 18)
+        # the totals row carries the full tally for the postmortem
+        assert series['comm.bytes_per_step'][0]['tags'][
+            'all-reduce']['count'] == 2
+        assert series['comm.probe_ms'][0]['value'] == 1.25
+
+    def test_metrics_families_export_latest(self, session):
+        from mlcomp_tpu.db.enums import TaskStatus
+        from mlcomp_tpu.db.models import Task
+        from mlcomp_tpu.db.providers import MetricProvider, TaskProvider
+        from mlcomp_tpu.telemetry.export import (
+            parse_openmetrics, render_server_metrics,
+        )
+        from mlcomp_tpu.utils.misc import now
+        task = Task(name='t', executor='e',
+                    status=int(TaskStatus.InProgress),
+                    last_activity=now())
+        TaskProvider(session).add(task)
+        persist_collective_stats(session, task.id, self._stats())
+        ts = now()
+        MetricProvider(session).add_many([
+            (task.id, 'comm.fraction', 'series', 3, 0.2, ts, 'train',
+             None),
+            (task.id, 'device0.hbm_used', 'series', 3, 9e9, ts,
+             'train', None),
+            (task.id, 'device0.hbm_limit', 'series', 3, 16e9, ts,
+             'train', None),
+            (task.id, 'device0.hbm_peak', 'series', 3, 10e9, ts,
+             'train', None)])
+        doc = parse_openmetrics(render_server_metrics(session))
+        comm = doc['mlcomp_comm_bytes']['samples']
+        assert any(labels.get('op') == 'all_reduce'
+                   and value == 1 << 20 for _, labels, value in comm)
+        frac = doc['mlcomp_comm_fraction']['samples']
+        assert any(value == 0.2 and str(labels.get('task'))
+                   == str(task.id) for _, labels, value in frac)
+        hbm = doc['mlcomp_hbm_bytes']['samples']
+        for kind, expect in (('used', 9e9), ('limit', 16e9),
+                             ('peak', 10e9)):
+            assert any(labels.get('kind') == kind
+                       and labels.get('device') == '0'
+                       and value == expect
+                       for _, labels, value in hbm), kind
+        # scrape self-observability: labeled per collector, all clean
+        errors = doc['mlcomp_scrape_errors']['samples']
+        assert all(labels.get('collector')
+                   for _, labels, _ in errors)
+        assert {'hbm', 'comm', 'tasks'} <= {
+            labels['collector'] for _, labels, _ in errors}
+        assert all(value == 0 for _, _, value in errors)
+        assert doc['mlcomp_scrape_duration_seconds']['samples'][0][2] \
+            >= 0
+
+    def test_sick_collector_is_named(self, session):
+        """Per-collector labels: a failing read shows up under ITS
+        name, the rest of the scrape stays clean."""
+        from mlcomp_tpu.telemetry import export as export_mod
+        from mlcomp_tpu.telemetry.export import (
+            parse_openmetrics, render_server_metrics,
+        )
+        original = export_mod._collect_comm
+
+        def boom(*args):
+            raise RuntimeError('sick collector')
+
+        export_mod._collect_comm = boom
+        try:
+            doc = parse_openmetrics(render_server_metrics(session))
+        finally:
+            export_mod._collect_comm = original
+        errors = {labels['collector']: value for _, labels, value in
+                  doc['mlcomp_scrape_errors']['samples']}
+        assert errors['comm'] == 1
+        assert errors['tasks'] == 0
+
+
+class TestMemorySampler:
+    def test_inert_on_cpu_platform(self):
+        from mlcomp_tpu.telemetry import MemorySampler, MetricRecorder
+        rec = MetricRecorder()
+        sampler = MemorySampler(rec)
+        # CPU reports no memory stats: resolved ONCE at construction
+        assert sampler.active is False
+        sampler.sample(step=0)
+        assert rec._pending == []
+
+    def test_active_sampler_emits_triples(self):
+        """Drive the sampler against stub devices the way a TPU would
+        report: used/limit/peak series land with the step."""
+        from mlcomp_tpu.telemetry import MemorySampler, MetricRecorder
+
+        class StubDevice:
+            def __init__(self, dev_id):
+                self.id = dev_id
+                self.platform = 'tpu'
+
+            def memory_stats(self):
+                return {'bytes_in_use': 5e9, 'bytes_limit': 16e9,
+                        'peak_bytes_in_use': 6e9}
+
+        rec = MetricRecorder()
+        sampler = MemorySampler(rec, every=2)
+        sampler._devices = [(0, StubDevice(0)), (1, StubDevice(1))]
+        sampler.sample(step=0)
+        sampler.sample(step=1)   # thinned by every=2
+        sampler.sample(step=2)
+        names = [name for (name, _, _, _) in rec._pending]
+        assert names.count('device0.hbm_used') == 2
+        assert names.count('device1.hbm_peak') == 2
+        assert 'device0.hbm_limit' in names
+        steps = {step for (name, _, step, _) in rec._pending
+                 if name == 'device0.hbm_used'}
+        assert steps == {0, 2}
+
+    def test_memory_attribution_from_compiled(self):
+        import jax
+        from mlcomp_tpu.telemetry import memory_attribution
+        f = jax.jit(lambda x: x @ x)
+        compiled = f.lower(np.ones((64, 64), np.float32)).compile()
+        attribution = memory_attribution(compiled)
+        assert attribution['argument_bytes'] == 64 * 64 * 4
+        assert attribution['output_bytes'] == 64 * 64 * 4
+        assert attribution['total_bytes'] >= 2 * 64 * 64 * 4
+
+    def test_record_device_stats_skips_non_reporting(self, session):
+        """The CPU run renders NO empty 0/0 HBM rows (the satellite:
+        platform-tagged stats gate the emission)."""
+        from mlcomp_tpu.telemetry import (
+            MetricRecorder, record_device_stats,
+        )
+        rec = MetricRecorder()
+        record_device_stats(rec)
+        assert all('hbm' not in name
+                   for (name, _, _, _) in rec._pending)

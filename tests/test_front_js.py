@@ -335,6 +335,55 @@ class TestObservabilityCards:
         assert '>train</span>' in html
         assert 'process(es)' in html
 
+    def test_task_detail_memory_comm_postmortem_cards(self, browser,
+                                                      session):
+        """Deep-step observability cards in the real interpreter: the
+        HBM timeline renders as the memory card, the collective tally
+        as the communication card, and a failed task's frozen bundle
+        as the postmortem card (fetched via the real API)."""
+        from mlcomp_tpu.db.providers import MetricProvider, TaskProvider
+        from mlcomp_tpu.telemetry import (
+            persist_collective_stats, persist_memory_attribution,
+        )
+        from mlcomp_tpu.utils.misc import now
+        task_id = browser.seeded['task']
+        ts = now()
+        MetricProvider(session).add_many(
+            [(task_id, 'device0.hbm_used', 'series', s, 9.1e9, ts,
+              'train', None) for s in (1, 2)]
+            + [(task_id, 'device0.hbm_limit', 'series', s, 1.6e10,
+                ts, 'train', None) for s in (1, 2)]
+            + [(task_id, 'device0.hbm_peak', 'series', 2, 9.9e9, ts,
+                'train', None),
+               (task_id, 'comm.fraction', 'series', 0, 0.18, ts,
+                'train', None)])
+        persist_memory_attribution(
+            session, task_id,
+            {'argument_bytes': int(4e9), 'temp_bytes': int(5e9),
+             'total_bytes': int(9e9)})
+        persist_collective_stats(
+            session, task_id,
+            {'ops': {'all-reduce': {'count': 2, 'bytes': int(3e7)}},
+             'total_bytes': int(3e7), 'total_count': 2},
+            comm_ms=1.5)
+        task = TaskProvider(session).by_id(task_id)
+        TaskProvider(session).fail_with_reason(task, 'oom')
+        browser.call('open_', 'task', task_id)
+        html = browser.html('#main')
+        # memory card: occupancy + compiled-peak split
+        assert '<h3>memory</h3>' in html
+        assert 'worst HBM occupancy' in html
+        assert '9.10 / 16.00 GB' in html and '(peak 9.90)' in html
+        assert 'compiled peak: argument 4.00 GB' in html
+        # communication card: fraction + per-op tally
+        assert '<h3>communication</h3>' in html
+        assert '18.0%' in html and 'measured comm share' in html
+        assert 'all_reduce: 30.0 MB × 2' in html
+        # postmortem card: the frozen at-death bundle
+        assert '<h3>postmortem</h3>' in html
+        assert '>oom</b>' in html
+        assert 'device0.hbm_used' in html
+
     def test_supervisor_tab_alerts_card(self, browser, session):
         from mlcomp_tpu.db.providers import AlertProvider
         AlertProvider(session).raise_alert(

@@ -1,0 +1,414 @@
+"""OOM taxonomy + flight recorder (telemetry/memory.py,
+mlcomp_tpu/recovery.py): RESOURCE_EXHAUSTED classification, the frozen
+postmortem bundle, its CLI/API surfaces, never-auto-retry, and the
+end-to-end acceptance chaos — a real jax_train run killed by an
+injected RESOURCE_EXHAUSTED at the train seam."""
+
+import json
+
+import pytest
+
+from mlcomp_tpu.db.enums import TaskStatus
+from mlcomp_tpu.db.models import Computer, Task
+from mlcomp_tpu.db.providers import (
+    ComputerProvider, DockerProvider, MetricProvider, TaskProvider,
+)
+from mlcomp_tpu.recovery import classify_exception, is_transient
+from mlcomp_tpu.telemetry import (
+    build_postmortem, load_postmortem, persist_memory_attribution,
+    persist_run_snapshot,
+)
+from mlcomp_tpu.utils.misc import now
+
+from tests.test_telemetry import api  # noqa: F401  (live-server fixture)
+
+
+def add_task(session, name='t', status=TaskStatus.InProgress,
+             **kwargs):
+    task = Task(name=name, executor='e', cores=1, cores_max=1,
+                status=int(status), last_activity=now(), **kwargs)
+    TaskProvider(session).add(task)
+    return task
+
+
+def seed_series(session, task_id, n=60):
+    ts = now()
+    MetricProvider(session).add_many(
+        [(task_id, 'loss', 'series', i, 2.0 - i * 0.01, ts, 'train',
+          None) for i in range(n)]
+        + [(task_id, 'step_time_ms', 'series', i, 10.0, ts, 'train',
+            None) for i in range(n)]
+        + [(task_id, 'device0.hbm_used', 'series', i, 1e10 + i * 1e8,
+            ts, 'train', None) for i in range(8)]
+        + [(task_id, 'device0.hbm_limit', 'series', i, 1.6e10, ts,
+            'train', None) for i in range(8)]
+        + [(task_id, 'irrelevant.gauge', 'gauge', None, 1.0, ts,
+            'train', None)])
+
+
+class TestOomTaxonomy:
+    def test_resource_exhausted_text_is_oom(self):
+        exc = RuntimeError(
+            'RESOURCE_EXHAUSTED: Out of memory allocating '
+            '17179869184 bytes')
+        assert classify_exception(exc) == 'oom'
+
+    def test_wrapped_oom_in_cause_chain(self):
+        inner = RuntimeError('RESOURCE_EXHAUSTED: Out of memory')
+        try:
+            raise ValueError('step failed') from inner
+        except ValueError as wrapped:
+            assert classify_exception(wrapped) == 'oom'
+
+    def test_host_memory_error_is_oom(self):
+        assert classify_exception(MemoryError()) == 'oom'
+
+    def test_oom_outranks_gang_carveout(self):
+        """An OOM naming a collective must stay oom (permanent), not
+        slide into the gang-peer-lost carve-out and get retried."""
+        exc = RuntimeError('RESOURCE_EXHAUSTED: Out of memory while '
+                           'allocating buffer for all-reduce')
+        assert classify_exception(exc, gang=True) == 'oom'
+
+    def test_oom_is_permanent(self):
+        assert not is_transient('oom')
+
+    def test_plain_runtime_error_still_executor_error(self):
+        assert classify_exception(RuntimeError('a bug')) == \
+            'executor-error'
+
+    def test_injected_resource_fault_classifies_oom(self):
+        from mlcomp_tpu.testing import faults
+        faults.configure_faults(
+            {'train.epoch': {'action': 'raise', 'exc': 'resource'}})
+        try:
+            with pytest.raises(RuntimeError) as err:
+                faults.fault_point('train.epoch', epoch=1)
+            assert classify_exception(err.value) == 'oom'
+        finally:
+            faults.clear_faults()
+
+
+class TestBundle:
+    def test_build_tails_relevant_series_only(self, session):
+        task = add_task(session)
+        seed_series(session, task.id)
+        bundle = build_postmortem(session, task.id, tail=50)
+        assert len(bundle['series']['loss']) == 50
+        # ascending within the tail, newest samples kept
+        steps = [p['step'] for p in bundle['series']['loss']]
+        assert steps == sorted(steps) and steps[-1] == 59
+        assert 'device0.hbm_used' in bundle['series']
+        assert 'irrelevant.gauge' not in bundle['series']
+        assert bundle['task_card']['name'] == 't'
+
+    def test_context_rows_decoded(self, session):
+        task = add_task(session)
+        persist_run_snapshot(session, task.id,
+                             {'model': 'mlp', 'mesh': {'dp': 8},
+                              'batch_size': 64})
+        persist_memory_attribution(
+            session, task.id,
+            {'argument_bytes': 4, 'temp_bytes': 6, 'total_bytes': 10})
+        bundle = build_postmortem(session, task.id)
+        assert bundle['context']['run.snapshot']['tags']['mesh'] == \
+            {'dp': 8}
+        attribution = bundle['context']['memory.attribution']
+        assert attribution['value'] == 10
+        assert attribution['tags']['temp_bytes'] == 6
+
+    def test_fail_with_reason_freezes_bundle(self, session):
+        task = add_task(session)
+        seed_series(session, task.id)
+        TaskProvider(session).fail_with_reason(task, 'oom')
+        bundle = load_postmortem(session, task.id)
+        assert bundle['reason'] == 'oom'
+        assert bundle['task_card']['failure_reason'] == 'oom'
+        assert len(bundle['series']['loss']) == 50
+        assert bundle['alerts'] == []
+
+    def test_bundle_survives_metric_ageout(self, session):
+        """The point of freezing: delete every metric row after the
+        failure — the bundle still explains the death."""
+        task = add_task(session)
+        seed_series(session, task.id)
+        TaskProvider(session).fail_with_reason(task, 'oom')
+        session.execute('DELETE FROM metric')
+        bundle = load_postmortem(session, task.id)
+        assert len(bundle['series']['loss']) == 50
+
+    def test_retries_append_newest_wins(self, session):
+        task = add_task(session)
+        seed_series(session, task.id, n=10)
+        tp = TaskProvider(session)
+        tp.fail_with_reason(task, 'preempted')
+        seed_series(session, task.id, n=20)
+        tp.fail_with_reason(task, 'oom')
+        from mlcomp_tpu.db.providers import PostmortemProvider
+        rows = PostmortemProvider(session).of_task(task.id)
+        assert [r.reason for r in rows] == ['oom', 'preempted']
+        assert load_postmortem(session, task.id)['reason'] == 'oom'
+
+    def test_no_bundle_without_failure(self, session):
+        task = add_task(session)
+        assert load_postmortem(session, task.id) is None
+
+    def test_retention_prunes_past_keep(self, session):
+        """A flapping task keeps only the newest K bundles — the
+        frozen explanations need the same bound the metric table's
+        age-out gives the raw series."""
+        from mlcomp_tpu.db.providers import PostmortemProvider
+        from mlcomp_tpu.telemetry.memory import (
+            POSTMORTEM_KEEP_PER_TASK, persist_postmortem,
+        )
+        task = add_task(session)
+        seed_series(session, task.id, n=5)
+        for i in range(POSTMORTEM_KEEP_PER_TASK + 3):
+            persist_postmortem(session, task.id, reason=f'r{i}')
+        rows = PostmortemProvider(session).of_task(task.id)
+        assert len(rows) == POSTMORTEM_KEEP_PER_TASK
+        assert rows[0].reason == f'r{POSTMORTEM_KEEP_PER_TASK + 2}'
+        # another task's bundles are untouched by the prune
+        other = add_task(session, name='other')
+        persist_postmortem(session, other.id, reason='keep-me')
+        persist_postmortem(session, task.id, reason='newest')
+        assert PostmortemProvider(session).latest(
+            other.id).reason == 'keep-me'
+
+
+class TestMigrationV10:
+    def test_v9_db_upgrades_in_place(self, session):
+        """A deployment stamped at v9 (no postmortem table) gains it
+        on the next migrate; the flight recorder works immediately."""
+        from mlcomp_tpu.db.migration import migrate
+        session.execute('DROP TABLE postmortem')
+        session.execute('DELETE FROM migration_version WHERE version=10')
+        with pytest.raises(Exception):
+            session.query('SELECT * FROM postmortem')
+        migrate(session)
+        task = add_task(session)
+        seed_series(session, task.id, n=5)
+        TaskProvider(session).fail_with_reason(task, 'oom')
+        assert load_postmortem(session, task.id)['reason'] == 'oom'
+
+
+class TestNeverAutoRetried:
+    def test_supervisor_leaves_oom_alone(self, session):
+        """The taxonomy pin: an oom-Failed task is never requeued —
+        no backoff schedule, no attempt bump, no task.retry row."""
+        from mlcomp_tpu.recovery import RecoveryConfig
+        from mlcomp_tpu.server.supervisor import SupervisorBuilder
+        ComputerProvider(session).create_or_update(
+            Computer(name='host1', cores=8, cpu=16, memory=64,
+                     ip='127.0.0.1', can_process_tasks=True), 'name')
+        DockerProvider(session).heartbeat('host1', 'default')
+        task = add_task(session, status=TaskStatus.NotRan)
+        tp = TaskProvider(session)
+        tp.fail_with_reason(task, 'oom')
+        sup = SupervisorBuilder(
+            session=session,
+            recovery_config=RecoveryConfig(backoff_base_s=0.0))
+        sup.build()
+        sup.build()
+        task = tp.by_id(task.id)
+        assert task.status == int(TaskStatus.Failed)
+        assert task.failure_reason == 'oom'
+        assert task.next_retry_at is None
+        assert (task.attempt or 0) == 0
+        assert session.query(
+            "SELECT * FROM metric WHERE name='task.retry'") == []
+
+
+class TestApiSurface:
+    def _failed_task(self, session):
+        task = add_task(session)
+        seed_series(session, task.id)
+        TaskProvider(session).fail_with_reason(task, 'oom')
+        return task
+
+    def test_post_returns_frozen_bundle(self, api, session):
+        task = self._failed_task(session)
+        bundle = api('/api/task/postmortem', {'task': task.id},
+                     token=None)
+        assert bundle['reason'] == 'oom'
+        assert len(bundle['series']['loss']) == 50
+        assert bundle['task_card']['failure_reason'] == 'oom'
+
+    def test_get_mirror(self, api, session):
+        task = self._failed_task(session)
+        import urllib.request
+        with urllib.request.urlopen(
+                api.base + f'/api/task/postmortem?task={task.id}',
+                timeout=30) as resp:
+            bundle = json.loads(resp.read())
+        assert bundle['reason'] == 'oom'
+
+    def test_live_mode_assembles_running_task(self, api, session):
+        task = add_task(session)
+        seed_series(session, task.id)
+        bundle = api('/api/task/postmortem',
+                     {'task': task.id, 'live': True}, token=None)
+        assert bundle['live'] is True
+        assert len(bundle['series']['loss']) == 50
+
+    def test_404_without_frozen_bundle(self, api, session):
+        import urllib.error
+        task = add_task(session)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            api('/api/task/postmortem', {'task': task.id}, token=None)
+        assert err.value.code == 404
+
+    def test_404_unknown_task(self, api):
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as err:
+            api('/api/task/postmortem', {'task': 99999}, token=None)
+        assert err.value.code == 404
+
+
+class TestCli:
+    def test_postmortem_command(self, session):
+        from click.testing import CliRunner
+        from mlcomp_tpu.__main__ import main as cli
+        task = add_task(session, name='oom_victim')
+        seed_series(session, task.id)
+        persist_run_snapshot(session, task.id,
+                             {'model': 'mlp', 'n_params': 1234,
+                              'mesh': {'dp': 8},
+                              'batch_shape': [64, 8, 8, 1]})
+        TaskProvider(session).fail_with_reason(task, 'oom')
+        runner = CliRunner()
+        out = runner.invoke(cli, ['postmortem', str(task.id)])
+        assert out.exit_code == 0, out.output
+        assert 'failed: oom' in out.output
+        assert 'oom_victim' in out.output
+        assert 'model=mlp' in out.output
+        assert 'loss: 50 samples' in out.output
+        out = runner.invoke(cli, ['postmortem', str(task.id),
+                                  '--json'])
+        bundle = json.loads(out.output)
+        assert bundle['reason'] == 'oom'
+        assert 'device0.hbm_used' in bundle['series']
+
+    def test_postmortem_command_without_bundle_exits_1(self, session):
+        from click.testing import CliRunner
+        from mlcomp_tpu.__main__ import main as cli
+        task = add_task(session)
+        out = runner_out = CliRunner().invoke(
+            cli, ['postmortem', str(task.id)])
+        assert runner_out.exit_code == 1
+        assert 'no postmortem recorded' in out.output
+
+
+class TestEndToEndOomChaos:
+    def test_injected_oom_kills_real_train_run(
+            self, session, tmp_path, monkeypatch):
+        """ISSUE 12 acceptance: a REAL jax_train run (tiny mlp, CPU
+        mesh) dies on an injected RESOURCE_EXHAUSTED at the train
+        seam → the task ends Failed with the ``oom`` reason, the
+        supervisor never auto-retries it, and the postmortem bundle —
+        loss series + run snapshot + compiled-step memory attribution
+        + collective tally, frozen at death — is retrievable via BOTH
+        the CLI and the API."""
+        import mlcomp_tpu.worker.__main__ as wmain
+        from mlcomp_tpu.db.providers import QueueProvider
+        from mlcomp_tpu.recovery import RecoveryConfig
+        from mlcomp_tpu.server.create_dags.standard import dag_standard
+        from mlcomp_tpu.server.supervisor import SupervisorBuilder
+        from mlcomp_tpu.testing import faults
+        from mlcomp_tpu.utils.logging import create_logger
+
+        folder = tmp_path / 'exp'
+        folder.mkdir()
+        config = {
+            'info': {'name': 'oom_dag', 'project': 'p_oom'},
+            'executors': {'train': {
+                'type': 'jax_train',
+                'model': {'name': 'mlp', 'num_classes': 10,
+                          'hidden': [16], 'dtype': 'float32'},
+                'dataset': {'name': 'synthetic_images', 'n_train': 128,
+                            'n_valid': 32, 'image_size': 8,
+                            'channels': 1},
+                'batch_size': 32,
+                'epochs': 3,
+                # force the compiled-step introspection ON for the CPU
+                # harness: memory attribution + collective tally land
+                # before the injected death
+                'telemetry': {'flush_every': 5,
+                              'memory_analysis': True,
+                              'collectives': True,
+                              'cost_analysis': False},
+            }},
+        }
+        dag, tasks = dag_standard(session, config,
+                                  upload_folder=str(folder))
+        task_id = tasks['train'][0]
+        ComputerProvider(session).create_or_update(
+            Computer(name='host1', cores=8, cpu=16, memory=64,
+                     ip='127.0.0.1', can_process_tasks=True), 'name')
+        DockerProvider(session).heartbeat('host1', 'default')
+        monkeypatch.setattr(wmain, 'HOSTNAME', 'host1')
+        sup = SupervisorBuilder(
+            session=session,
+            recovery_config=RecoveryConfig(lease_seconds=30,
+                                           backoff_base_s=0.0))
+        sup.build()
+        logger = create_logger(session)
+        # the injected device OOM: first epoch boundary raises
+        # RESOURCE_EXHAUSTED inside the real train loop
+        faults.configure_faults(
+            {'train.epoch': {'action': 'raise', 'exc': 'resource',
+                             'after': 1}})
+        try:
+            assert wmain._consume_one(session, QueueProvider(session),
+                                      logger, 0, in_process=True)
+        finally:
+            faults.clear_faults()
+
+        tp = TaskProvider(session)
+        task = tp.by_id(task_id)
+        assert task.status == int(TaskStatus.Failed)
+        assert task.failure_reason == 'oom'
+
+        # never blind-retried at the same shape
+        sup.build()
+        task = tp.by_id(task_id)
+        assert task.status == int(TaskStatus.Failed)
+        assert task.next_retry_at is None
+        assert (task.attempt or 0) == 0
+        assert session.query(
+            "SELECT * FROM metric WHERE name='task.retry'") == []
+
+        # the frozen bundle carries the real run's telemetry
+        bundle = load_postmortem(session, task_id)
+        assert bundle['reason'] == 'oom'
+        assert len(bundle['series'].get('loss', [])) > 0
+        snapshot = bundle['context']['run.snapshot']['tags']
+        assert snapshot['model'] == 'mlp'
+        assert snapshot['batch_size'] == 32
+        assert snapshot['mesh'] == {'dp': 8}
+        attribution = bundle['context']['memory.attribution']['tags']
+        assert attribution['total_bytes'] > 0
+        # the 8-way dp mesh's gradient all-reduce was tallied — a ZERO
+        # tally here means the introspection lowered an unsharded
+        # (replicated) abstract batch and certified a collective-free
+        # twin of a step that all-reduces every grad
+        comm = bundle['context']['comm.bytes_per_step']
+        assert comm is not None and comm['value'] > 0
+        assert comm['tags'].get('all-reduce', {}).get('count', 0) >= 1
+        # and the measured wire share landed as a series
+        assert 'comm.fraction' in bundle['series']
+
+        # CLI retrieval
+        from click.testing import CliRunner
+        from mlcomp_tpu.__main__ import main as cli
+        out = CliRunner().invoke(cli, ['postmortem', str(task_id)])
+        assert out.exit_code == 0, out.output
+        assert 'failed: oom' in out.output
+        assert 'compiled peak' in out.output
+
+        # API retrieval
+        from mlcomp_tpu.server.api import api_task_postmortem
+        via_api = api_task_postmortem({'task': task_id}, session)
+        assert via_api['reason'] == 'oom'
+        assert via_api['context']['run.snapshot']['tags']['model'] \
+            == 'mlp'

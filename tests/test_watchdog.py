@@ -241,7 +241,11 @@ class TestHbmRule:
         assert findings[0]['details']['occupancy'] == \
             pytest.approx(0.95)
 
-    def test_rising_trend_warns_before_threshold(self, session):
+    def test_steep_rise_projects_oom_and_escalates(self, session):
+        """The trend upgrade: a steep monotonic climb projects OOM
+        within the horizon ((1.0 - 0.82) / 0.02 = 9 steps here) and
+        the alert is CRITICAL before the threshold is ever crossed —
+        the point of predicting is acting before the crash."""
         task = make_task(session)
         add_series(session, task.id, 'device0.hbm_used',
                    [7.6e9, 7.8e9, 8.0e9, 8.2e9])
@@ -250,8 +254,61 @@ class TestHbmRule:
         findings = [f for f in wd.evaluate()
                     if f['rule'] == 'hbm-pressure']
         assert len(findings) == 1
+        assert findings[0]['severity'] == 'critical'
+        assert findings[0]['details']['rising'] is True
+        assert findings[0]['details']['predicted_steps_to_oom'] == \
+            pytest.approx(9.0, abs=0.2)
+        assert findings[0]['details']['slope_per_step'] == \
+            pytest.approx(0.02, abs=1e-3)
+        assert 'projected OOM' in findings[0]['message']
+
+    def test_shallow_rise_past_horizon_still_warns(self, session):
+        """A rise whose projection lands beyond the horizon keeps the
+        legacy warning verdict: heading for trouble, not imminent."""
+        task = make_task(session)
+        add_series(session, task.id, 'device0.hbm_used',
+                   [7.600e9, 7.601e9, 7.602e9, 7.603e9])
+        add_series(session, task.id, 'device0.hbm_limit', [1e10] * 4)
+        wd = Watchdog(session, fast_config(
+            stall_deadline_s=3600, hbm_oom_horizon_steps=100))
+        findings = [f for f in wd.evaluate()
+                    if f['rule'] == 'hbm-pressure']
+        assert len(findings) == 1
         assert findings[0]['severity'] == 'warning'
         assert findings[0]['details']['rising'] is True
+        # (1.0 - 0.7603) / 1e-5 per step — thousands of steps away
+        assert findings[0]['details']['predicted_steps_to_oom'] > 100
+
+    def test_synthetic_rising_series_prediction_math(self, session):
+        """OOM-trend acceptance: a noisy-but-climbing synthetic series
+        (non-monotonic, so the legacy rising check alone would stay
+        quiet) still projects OOM through the least-squares fit and
+        alerts before the crash."""
+        task = make_task(session)
+        used = [8.8e9, 8.6e9, 8.65e9, 8.5e9, 8.4e9, 8.3e9]  # newest 1st
+        add_series(session, task.id, 'device0.hbm_used',
+                   list(reversed(used)))
+        add_series(session, task.id, 'device0.hbm_limit', [1e10] * 6)
+        wd = Watchdog(session, fast_config(stall_deadline_s=3600))
+        findings = [f for f in wd.evaluate()
+                    if f['rule'] == 'hbm-pressure']
+        assert len(findings) == 1
+        assert findings[0]['severity'] == 'critical'
+        assert findings[0]['details']['rising'] is False
+        predicted = findings[0]['details']['predicted_steps_to_oom']
+        # slope ~0.0103/step from 0.88 → ~12 steps of headroom
+        assert 5 < predicted < 20
+
+    def test_falling_occupancy_never_predicts(self, session):
+        """A falling series must not alert (slope <= 0 → no
+        projection), however high the absolute occupancy once was."""
+        task = make_task(session)
+        add_series(session, task.id, 'device0.hbm_used',
+                   [8.9e9, 8.7e9, 8.5e9, 8.3e9])
+        add_series(session, task.id, 'device0.hbm_limit', [1e10] * 4)
+        wd = Watchdog(session, fast_config(stall_deadline_s=3600))
+        assert [f for f in wd.evaluate()
+                if f['rule'] == 'hbm-pressure'] == []
 
     def test_flat_low_occupancy_is_quiet(self, session):
         task = make_task(session)
