@@ -167,7 +167,13 @@ def bench_grid_dag() -> dict:
         MLCOMP_TPU_ROOT=os.path.join(root, 'root'),
         WEB_HOST='127.0.0.1', WEB_PORT=str(port),
         MLCOMP_TPU_CORES='1',
-        QUEUE_POLL_INTERVAL='0.1',
+        # server + workers are separate processes over sqlite — the
+        # event bus can't cross that boundary (docs/control_plane.md
+        # matrix), so the worker's short poll governs dispatch
+        # latency here. 0.05 s halves the old floor: an empty poll is
+        # one sub-ms indexed read (migration v11's composite claim
+        # index), so 20 Hz idle polling costs ~2% of one core
+        QUEUE_POLL_INTERVAL='0.05',
         JAX_COMPILATION_CACHE_DIR=os.path.join(root, 'jaxcache'),
     )
     cfg = os.path.join(root, 'config.yml')
@@ -1071,6 +1077,46 @@ def bench_serving_int8() -> dict:
     return out
 
 
+def bench_dispatch() -> dict:
+    """Control-plane throughput + event-dispatch latency via the
+    jax-free load harness (scripts/load_smoke.py): 2000 queued tasks
+    over 128 simulated worker slots in a throwaway sqlite root, run in
+    a subprocess so this process's env/jax state never leaks in.
+    Publishes control_plane_tasks_per_s, queue_drain_p99_ms and
+    dispatch_p50/p99_ms — the submit->claimed latency the event bus
+    (db/events.py) holds under the bench_guard 250 ms floor (the old
+    tick+poll floor was ~1.2 s)."""
+    import subprocess
+    import tempfile
+    repo = os.path.dirname(os.path.abspath(__file__))
+    root = tempfile.mkdtemp(prefix='bench_dispatch_')
+    env = dict(os.environ, MLCOMP_TPU_ROOT=root, JAX_PLATFORMS='cpu')
+    try:
+        # --no-assert: the harness's own gate would swallow the
+        # numbers on failure (rc=1 -> dispatch_error -> absent legs
+        # only WARN in bench_guard); publishing unconditionally lets
+        # the guard's floors do the failing
+        sub = subprocess.run(
+            [sys.executable, os.path.join(repo, 'scripts',
+                                          'load_smoke.py'), '--json',
+             '--no-assert'],
+            env=env, cwd=repo, capture_output=True, text=True,
+            timeout=300)
+        if sub.returncode != 0:
+            raise RuntimeError(
+                f'load_smoke rc={sub.returncode}: {sub.stderr[-300:]}')
+        legs = json.loads(sub.stdout.strip().splitlines()[-1])
+        return {k: legs[k] for k in
+                ('control_plane_tasks_per_s', 'queue_drain_p99_ms',
+                 'dispatch_p50_ms', 'dispatch_p99_ms', 'load_tasks',
+                 'load_slots') if k in legs}
+    except Exception as e:
+        return {'dispatch_error': f'{type(e).__name__}: {e}'[:300]}
+    finally:
+        import shutil
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main():
     # the grid-DAG leg runs FIRST, before this process initializes jax:
     # its worker task subprocesses need the chip to themselves (a second
@@ -1078,6 +1124,13 @@ def main():
     grid_result = {}
     if os.environ.get('BENCH_GRID', '1') == '1' and not over_budget():
         grid_result = bench_grid_dag()
+
+    # control-plane load leg: jax-free and cheap (~20 s); runs before
+    # jax init alongside the other subprocess-based legs
+    dispatch_result = {}
+    if os.environ.get('BENCH_DISPATCH', '1') == '1' and \
+            not over_budget():
+        dispatch_result = bench_dispatch()
 
     # the fleet leg is jax-free (stub replicas + the routing gateway on
     # loopback) and cheap (~12 s) — it runs before this process
@@ -1607,6 +1660,7 @@ def main():
     }
     result.update(fused_result)
     result.update(grid_result)
+    result.update(dispatch_result)
     result.update(fleet_result)
 
     # second workload: the flagship long-context LM (skippable, and

@@ -26,6 +26,7 @@ import numpy as np
 from mlcomp_tpu.testing.faults import fault_point
 
 _SQLITE_PREFIX = 'sqlite:///'
+_PG_PREFIX = 'postgresql://'
 
 #: bounded retry on sqlite 'database is locked' (SQLITE_BUSY). The
 #: 30 s busy_timeout below handles most contention, but WAL writers
@@ -35,6 +36,25 @@ _SQLITE_PREFIX = 'sqlite:///'
 #: failure; now it costs at most ~1.5 s of backoff before giving up.
 _BUSY_RETRIES = 5
 _BUSY_BASE_SLEEP_S = 0.05
+
+#: process-wide busy-retry counters. A contended control plane used to
+#: degrade SILENTLY (each retry just slept); these feed the
+#: ``db.busy_retries`` metric series (sampled per supervisor tick) and
+#: the ``mlcomp_db_busy_retries_total`` /metrics family, so lock
+#: pressure is visible before it becomes give-ups.
+_BUSY_STATS_LOCK = threading.Lock()
+_BUSY_STATS = {'retries': 0, 'gave_up': 0}
+
+
+def busy_retry_stats() -> dict:
+    """Snapshot of this process's SQLITE_BUSY retry counters."""
+    with _BUSY_STATS_LOCK:
+        return dict(_BUSY_STATS)
+
+
+def _record_busy(kind: str):
+    with _BUSY_STATS_LOCK:
+        _BUSY_STATS[kind] += 1
 
 
 def _is_busy_error(e) -> bool:
@@ -91,11 +111,22 @@ class Column:
         Column._counter += 1
         self._order = Column._counter
 
-    def ddl(self):
-        parts = [f'"{self.name}"', self.type]
+    #: sqlite type -> postgres type for the DDL generator; INTEGER and
+    #: TEXT are shared, values themselves stay identical on the wire
+    #: (datetimes as '%Y-%m-%d %H:%M:%S.%f' strings, bools as ints)
+    PG_TYPES = {'REAL': 'DOUBLE PRECISION', 'BLOB': 'BYTEA'}
+
+    def ddl(self, dialect: str = 'sqlite'):
+        type_ = self.type
+        if dialect == 'postgresql':
+            type_ = self.PG_TYPES.get(type_, type_)
+        parts = [f'"{self.name}"', type_]
         if self.primary_key:
-            parts.append('PRIMARY KEY AUTOINCREMENT'
-                         if self.type == 'INTEGER' else 'PRIMARY KEY')
+            if dialect == 'postgresql' and self.type == 'INTEGER':
+                parts = [f'"{self.name}"', 'BIGSERIAL PRIMARY KEY']
+            else:
+                parts.append('PRIMARY KEY AUTOINCREMENT'
+                             if self.type == 'INTEGER' else 'PRIMARY KEY')
         if not self.nullable and not self.primary_key:
             parts.append('NOT NULL')
         if self.unique:
@@ -162,8 +193,9 @@ class DBModel(metaclass=_ModelMeta):
         return out
 
     @classmethod
-    def create_table_ddl(cls):
-        cols = ',\n  '.join(c.ddl() for c in cls.__columns__.values())
+    def create_table_ddl(cls, dialect: str = 'sqlite'):
+        cols = ',\n  '.join(
+            c.ddl(dialect) for c in cls.__columns__.values())
         ddl = [f'CREATE TABLE IF NOT EXISTS {cls.__tablename__} (\n  {cols}\n)']
         for c in cls.__columns__.values():
             if c.index:
@@ -222,20 +254,35 @@ class _Result:
 class Session:
     """Keyed singleton DB session (reference db/core/db.py:20-47).
 
+    This class IS the sqlite driver — the default backend. A
+    ``postgresql://`` connection string selects the psycopg-backed
+    :class:`~mlcomp_tpu.db.postgres.PostgresSession` (per-thread pooled
+    connections, ``FOR UPDATE SKIP LOCKED`` claims, ``LISTEN/NOTIFY``
+    events) through :meth:`create_session`; both drivers expose the
+    same statement/object API plus the dialect seam the providers
+    branch on where SQL differs (``dialect``, ``table_columns``,
+    ``publish_event``/``wait_event``).
+
     Thread-safe: a single sqlite3 connection guarded by an RLock. WAL mode
     allows concurrent reader/writer processes on the same host; for true
     multi-host deployments the connection string can point at a shared
-    network filesystem or a server-backed store.
+    network filesystem, a server-backed store, or Postgres.
     """
 
     __session_holder = {}
     _lock = threading.RLock()
 
+    #: SQL dialect providers branch on where statements differ
+    dialect = 'sqlite'
+    #: whether publish_event reaches OTHER processes (sqlite: no — a
+    #: cross-process waiter must keep its short-poll timeout)
+    events_cross_process = False
+
     def __init__(self, connection_string, key):
         self.key = key
         self.connection_string = connection_string
         assert connection_string.startswith(_SQLITE_PREFIX), \
-            'only sqlite:/// connection strings are supported in this build'
+            'only sqlite:/// connection strings reach the sqlite driver'
         self.db_path = connection_string[len(_SQLITE_PREFIX):]
         db_dir = os.path.dirname(self.db_path)
         if db_dir:
@@ -263,6 +310,11 @@ class Session:
                 # server host's /api/db (db/remote.py)
                 from mlcomp_tpu.db.remote import RemoteSession
                 s = RemoteSession(connection_string, key)
+            elif connection_string.startswith(_PG_PREFIX):
+                # the reference's second backend, restored: a shared
+                # PostgreSQL metadata store (db/postgres.py)
+                from mlcomp_tpu.db.postgres import PostgresSession
+                s = PostgresSession(connection_string, key)
             else:
                 s = cls(connection_string, key)
             cls.__session_holder[key] = s
@@ -275,12 +327,15 @@ class Session:
             keys = [key] if key else list(cls.__session_holder)
             for k in keys:
                 s = cls.__session_holder.pop(k, None)
-                conn = getattr(s, '_conn', None)  # RemoteSession has none
-                if conn is not None:
+                close = getattr(s, 'close', None)  # RemoteSession has none
+                if close is not None:
                     try:
-                        conn.close()
+                        close()
                     except Exception:
                         pass
+
+    def close(self):
+        self._conn.close()
 
     def _retry_busy(self, op):
         """Run one statement op with bounded backoff on SQLITE_BUSY.
@@ -293,8 +348,12 @@ class Session:
             try:
                 return op()
             except sqlite3.OperationalError as e:
-                if not _is_busy_error(e) or attempt >= _BUSY_RETRIES:
+                if not _is_busy_error(e):
                     raise
+                if attempt >= _BUSY_RETRIES:
+                    _record_busy('gave_up')
+                    raise
+                _record_busy('retries')
             time.sleep(_BUSY_BASE_SLEEP_S * (2 ** attempt))
 
     def execute(self, sql, params=()):
@@ -342,6 +401,48 @@ class Session:
         with self._lock:
             return self._conn.execute(sql, params).fetchone()
 
+    # ------------------------------------------------------------- dialect
+    def table_columns(self, table: str) -> set:
+        """Column names of ``table`` ({} when absent) — the dialect-
+        neutral face of sqlite's PRAGMA table_info (the Postgres driver
+        answers from information_schema), used by the guarded ALTERs in
+        the shared migration chain."""
+        return {r['name'] for r in
+                self.query(f'PRAGMA table_info({table})')}
+
+    def explain(self, sql, params=()) -> str:
+        """The backend's query plan as one text blob (EXPLAIN QUERY
+        PLAN / EXPLAIN) — index-audit tests assert the dispatch hot
+        path stays indexed through schema changes."""
+        rows = self.query(f'EXPLAIN QUERY PLAN {sql}', params)
+        return '\n'.join(str(tuple(r)) for r in rows)
+
+    # -------------------------------------------------------------- events
+    def publish_event(self, channel: str):
+        """Wake-on-work publication (db/events.py). sqlite has no
+        cross-process signal — only same-process waiters (condition
+        variable) hear this; multi-process deployments keep the
+        short-poll fallback (``events_cross_process`` says which)."""
+        from mlcomp_tpu.db import events
+        events.publish(channel)
+
+    def event_snapshot(self, channels) -> dict:
+        """Channel-sequence snapshot to pass into ``wait_event`` —
+        taken BEFORE the caller's emptiness check so a publish landing
+        in between can never be slept through."""
+        from mlcomp_tpu.db import events
+        return events.snapshot(channels)
+
+    def wait_event(self, channels, timeout: float,
+                   snapshot: dict = None) -> bool:
+        """Block until a watched channel publishes or ``timeout``
+        passes; True when woken by an event. The caller picks the
+        timeout by transport: a cross-process-capable backend can
+        afford a long backstop, plain sqlite multi-process passes its
+        poll interval."""
+        from mlcomp_tpu.db import events
+        return events.wait(channels, timeout, snapshot=snapshot)
+
     # --------------------------------------------------------------- object
     def add(self, obj, commit=True):
         sql, raw_vals = insert_sql(obj)
@@ -387,4 +488,5 @@ class Session:
 
 
 __all__ = ['Session', 'Column', 'DBModel', 'adapt_value',
-           'parse_datetime', 'insert_sql', 'update_sql']
+           'parse_datetime', 'insert_sql', 'update_sql',
+           'busy_retry_stats']

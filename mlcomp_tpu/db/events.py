@@ -1,0 +1,114 @@
+"""Wake-on-work event bus — the control plane's answer to polling.
+
+Dispatch latency used to be bounded below by the supervisor's 1 Hz tick
+plus the worker's 0.2 s queue poll (``dag submit -> task claimed`` paid
+the sum of both floors). This bus removes the floor wherever a wakeup
+can actually be delivered, and degrades to the old short-poll where it
+cannot:
+
+====================  =========================================
+deployment            wakeup transport
+====================  =========================================
+same process          in-process condition variable (always on)
+postgresql://         ``LISTEN/NOTIFY`` across processes/hosts,
+                      feeding the same local condition variable
+plain sqlite,         none — waiters fall back to the short-poll
+multi-process         timeout they pass in (``QUEUE_POLL_INTERVAL``)
+====================  =========================================
+
+Design: ONE process-wide :class:`LocalEventBus` holds a monotonically
+increasing sequence number per channel under a single
+``threading.Condition``. ``publish`` bumps the channel and notifies;
+``wait`` blocks until any watched channel moves past the sequence
+snapshot taken at entry — so a publish that lands between the caller's
+"queue is empty" check and its ``wait`` is never lost (the snapshot
+must be taken by ``wait`` itself, atomically under the lock).
+
+Channels are plain strings. The control plane uses:
+
+- ``queue:{name}``   — a message was enqueued on that queue (workers)
+- ``queue:done``     — a claimed message completed/failed (supervisor)
+- ``tasks``          — a task row appeared or changed status (supervisor)
+
+On Postgres every publish ALSO issues ``pg_notify('mlcomp_events',
+channel)`` and every waiting process runs one daemon listener thread
+that re-publishes remote notifications into its local bus — waiters
+never touch the socket themselves. The session object decides (via
+``Session.publish_event`` / ``Session.wait_event``) which transports
+apply, so providers publish through their session without caring about
+the backend.
+"""
+
+import threading
+
+#: channels the control plane publishes on (documentation + tests)
+CH_QUEUE_PREFIX = 'queue:'
+CH_QUEUE_DONE = 'queue:done'
+CH_TASKS = 'tasks'
+
+
+def queue_channel(queue: str) -> str:
+    return CH_QUEUE_PREFIX + queue
+
+
+class LocalEventBus:
+    """Per-channel sequence counters under one condition variable."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._seq = {}          # channel -> int
+        self.published_count = 0
+
+    def publish(self, channel: str):
+        with self._cond:
+            self._seq[channel] = self._seq.get(channel, 0) + 1
+            self.published_count += 1
+            self._cond.notify_all()
+
+    def snapshot(self, channels):
+        with self._cond:
+            return {c: self._seq.get(c, 0) for c in channels}
+
+    def wait(self, channels, timeout: float,
+             snapshot: dict = None) -> bool:
+        """Block until any of ``channels`` is published past
+        ``snapshot`` (taken at entry when not supplied) or ``timeout``
+        elapses. Returns True when woken by an event. Pass a snapshot
+        taken BEFORE the caller's own emptiness check to close the
+        check-then-wait race entirely."""
+        import time
+        deadline = time.monotonic() + max(0.0, float(timeout))
+        with self._cond:
+            if snapshot is None:
+                snapshot = {c: self._seq.get(c, 0) for c in channels}
+            while True:
+                if any(self._seq.get(c, 0) > snapshot.get(c, 0)
+                       for c in channels):
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+
+
+#: the process-wide bus every Session publishes into
+LOCAL_BUS = LocalEventBus()
+
+
+def publish(channel: str):
+    """Publish into the process-local bus only (cross-process delivery
+    is the session's job — use ``Session.publish_event``)."""
+    LOCAL_BUS.publish(channel)
+
+
+def wait(channels, timeout: float, snapshot: dict = None) -> bool:
+    return LOCAL_BUS.wait(channels, timeout, snapshot=snapshot)
+
+
+def snapshot(channels) -> dict:
+    return LOCAL_BUS.snapshot(channels)
+
+
+__all__ = ['LocalEventBus', 'LOCAL_BUS', 'publish', 'wait', 'snapshot',
+           'queue_channel', 'CH_QUEUE_PREFIX', 'CH_QUEUE_DONE',
+           'CH_TASKS']

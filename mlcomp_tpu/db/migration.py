@@ -145,11 +145,18 @@ DEFAULT_LAYOUTS = {
 }
 
 
+def _dialect(session: Session) -> str:
+    return getattr(session, 'dialect', 'sqlite')
+
+
 def _v1_init(session: Session):
-    """Create all tables + indices (reference versions/001_init.py)."""
+    """Create all tables + indices (reference versions/001_init.py).
+    DDL is generated per dialect (sqlite AUTOINCREMENT vs Postgres
+    BIGSERIAL, REAL vs DOUBLE PRECISION) — one migration chain, two
+    backends, like the reference's shared sqlalchemy-migrate chain."""
     from mlcomp_tpu.db.models import ALL_MODELS
     for model in ALL_MODELS:
-        for stmt in model.create_table_ddl():
+        for stmt in model.create_table_ddl(_dialect(session)):
             session.execute(stmt)
 
 
@@ -169,23 +176,23 @@ def _v3_auth(session: Session):
     """worker_token + db_audit tables (tiered /api/db credential)."""
     from mlcomp_tpu.db.models import DbAudit, WorkerToken
     for model in (WorkerToken, DbAudit):
-        for stmt in model.create_table_ddl():   # IF NOT EXISTS — safe
-            session.execute(stmt)
+        for stmt in model.create_table_ddl(_dialect(session)):
+            session.execute(stmt)           # IF NOT EXISTS — safe
 
 
 def _v4_telemetry(session: Session):
     """metric + telemetry_span tables (telemetry subsystem)."""
     from mlcomp_tpu.db.models import Metric, TelemetrySpan
     for model in (Metric, TelemetrySpan):
-        for stmt in model.create_table_ddl():   # IF NOT EXISTS — safe
-            session.execute(stmt)
+        for stmt in model.create_table_ddl(_dialect(session)):
+            session.execute(stmt)           # IF NOT EXISTS — safe
 
 
 def _v5_preflight(session: Session):
     """dag_preflight table (static-analysis subsystem, analysis/)."""
     from mlcomp_tpu.db.models import DagPreflight
-    for stmt in DagPreflight.create_table_ddl():    # IF NOT EXISTS — safe
-        session.execute(stmt)
+    for stmt in DagPreflight.create_table_ddl(_dialect(session)):
+        session.execute(stmt)               # IF NOT EXISTS — safe
 
 
 def _v6_tracing_alerts(session: Session):
@@ -193,8 +200,7 @@ def _v6_tracing_alerts(session: Session):
     trace propagation) + the alert table (watchdog findings). A fresh
     DB's _v1 already created telemetry_span with the new columns, so
     the ALTERs are guarded by a live pragma check."""
-    have = {r['name'] for r in
-            session.query('PRAGMA table_info(telemetry_span)')}
+    have = session.table_columns('telemetry_span')
     for column in ('trace_id', 'process_role'):
         if column not in have:
             session.execute(
@@ -209,8 +215,8 @@ def _v6_tracing_alerts(session: Session):
         'CREATE INDEX IF NOT EXISTS idx_metric_task_name '
         'ON metric("task", "name")')
     from mlcomp_tpu.db.models import Alert
-    for stmt in Alert.create_table_ddl():   # IF NOT EXISTS — safe
-        session.execute(stmt)
+    for stmt in Alert.create_table_ddl(_dialect(session)):
+        session.execute(stmt)               # IF NOT EXISTS — safe
 
 
 def _v7_recovery(session: Session):
@@ -220,8 +226,8 @@ def _v7_recovery(session: Session):
     the new columns, so the ALTERs are guarded by live pragma checks.
     DEFAULTs matter: legacy rows must read attempt=0/redelivered=0,
     not NULL, for the supervisor's arithmetic and the reclaim guard."""
-    have = {r['name'] for r in session.query('PRAGMA table_info(task)')}
-    if have:        # an empty pragma = table absent (partial legacy DB)
+    have = session.table_columns('task')
+    if have:        # empty = table absent (partial legacy DB)
         for column, ddl in (
                 ('attempt', '"attempt" INTEGER DEFAULT 0'),
                 ('max_retries', '"max_retries" INTEGER'),
@@ -229,8 +235,7 @@ def _v7_recovery(session: Session):
                 ('failure_reason', '"failure_reason" TEXT')):
             if column not in have:
                 session.execute(f'ALTER TABLE task ADD COLUMN {ddl}')
-    have = {r['name'] for r in
-            session.query('PRAGMA table_info(queue_message)')}
+    have = session.table_columns('queue_message')
     if have and 'redelivered' not in have:
         session.execute(
             'ALTER TABLE queue_message ADD COLUMN '
@@ -244,8 +249,8 @@ def _v8_gang(session: Session):
     columns, so the ALTERs are guarded by a live pragma check. The
     gang_generation DEFAULT matters: legacy rows must read 0 ("never
     fanned out"), not NULL, for the supervisor's bump arithmetic."""
-    have = {r['name'] for r in session.query('PRAGMA table_info(task)')}
-    if have:        # an empty pragma = table absent (partial legacy DB)
+    have = session.table_columns('task')
+    if have:        # empty = table absent (partial legacy DB)
         if 'gang_id' not in have:
             session.execute('ALTER TABLE task ADD COLUMN "gang_id" TEXT')
         if 'gang_generation' not in have:
@@ -266,8 +271,8 @@ def _v9_fleet(session: Session):
     is safe on a fresh DB whose _v1 already made them."""
     from mlcomp_tpu.db.models import ServeFleet, ServeReplica
     for model in (ServeFleet, ServeReplica):
-        for stmt in model.create_table_ddl():   # IF NOT EXISTS — safe
-            session.execute(stmt)
+        for stmt in model.create_table_ddl(_dialect(session)):
+            session.execute(stmt)           # IF NOT EXISTS — safe
 
 
 def _v10_postmortem(session: Session):
@@ -276,13 +281,56 @@ def _v10_postmortem(session: Session):
     table only — CREATE IF NOT EXISTS is safe on a fresh DB whose _v1
     already made it."""
     from mlcomp_tpu.db.models import Postmortem
-    for stmt in Postmortem.create_table_ddl():
+    for stmt in Postmortem.create_table_ddl(_dialect(session)):
         session.execute(stmt)
+
+
+def _v11_dispatch_indexes(session: Session):
+    """Index audit for the queue/dispatch hot path (the load harness's
+    findings, scripts/load_smoke.py). Three composite indexes:
+
+    - ``queue_message(status, queue, id)`` — the claim candidate scan
+      (``WHERE status='pending' AND queue IN (...) ORDER BY id``) and
+      the supervisor's per-tick pending index. Without it every claim
+      walks the per-queue index filtering status row by row; under
+      thousands of done rows the pending head costs the whole history.
+    - ``queue_message(status, claimed_at)`` — the lease reclaim and
+      strand sweeps (``status='claimed' AND claimed_at < ?``), per
+      tick, previously a status-index scan sorted by id.
+    - ``task(status, next_retry_at)`` — the retry pass loads the
+      transient-Failed set by status each tick; the composite keeps
+      that read indexed as Failed history accumulates.
+
+    The audit also DROPS the single-column status indexes both tables
+    carried: every status read is a left prefix of its new composite
+    (strictly at least as selective), keeping both would double the
+    write amplification on the two hottest tables, and — concretely —
+    sqlite's planner kept picking the narrower ``idx_*_status`` for
+    the claim scan, pinning the hot path to the worse plan.
+
+    tests/test_control_plane.py asserts the claim query stays on the
+    composite via EXPLAIN, so a future schema change that silently
+    deoptimizes the hot path fails CI. Guarded like every ALTER: a
+    partial legacy DB without the table skips its indexes."""
+    if session.table_columns('queue_message'):
+        session.execute(
+            'CREATE INDEX IF NOT EXISTS idx_queue_message_claim '
+            'ON queue_message("status", "queue", "id")')
+        session.execute(
+            'CREATE INDEX IF NOT EXISTS idx_queue_message_lease '
+            'ON queue_message("status", "claimed_at")')
+        session.execute(
+            'DROP INDEX IF EXISTS idx_queue_message_status')
+    if session.table_columns('task'):
+        session.execute(
+            'CREATE INDEX IF NOT EXISTS idx_task_status_retry '
+            'ON task("status", "next_retry_at")')
+        session.execute('DROP INDEX IF EXISTS idx_task_status')
 
 
 MIGRATIONS = [_v1_init, _v2_data, _v3_auth, _v4_telemetry, _v5_preflight,
               _v6_tracing_alerts, _v7_recovery, _v8_gang, _v9_fleet,
-              _v10_postmortem]
+              _v10_postmortem, _v11_dispatch_indexes]
 
 
 def migrate(session: Session = None):

@@ -15,7 +15,10 @@ class QueueMessage(DBModel):
     id = Column('INTEGER', primary_key=True)
     queue = Column('TEXT', nullable=False, index=True)
     payload = Column('TEXT', nullable=False)   # json {action, task_id, ...}
-    status = Column('TEXT', default='pending', index=True)
+    # status reads ride the v11 composite indexes (status,queue,id) /
+    # (status,claimed_at) — a single-column status index here would
+    # re-pin sqlite's planner to the worse claim plan (migration v11)
+    status = Column('TEXT', default='pending')
     # pending | claimed | done | failed | revoked
     created = Column('TEXT', dtype='datetime')
     # lease timestamp: stamped at claim AND at reclaim (where it times

@@ -14,7 +14,10 @@ class Task(DBModel):
 
     id = Column('INTEGER', primary_key=True)
     name = Column('TEXT', nullable=False)
-    status = Column('INTEGER', default=0, index=True)     # TaskStatus
+    # TaskStatus; status reads ride the v11 composite
+    # (status, next_retry_at) — its left prefix serves every
+    # by_status scan, so no single-column twin (migration v11)
+    status = Column('INTEGER', default=0)
     started = Column('TEXT', dtype='datetime')
     finished = Column('TEXT', dtype='datetime')
     computer = Column('TEXT')             # pinned computer name (or None)

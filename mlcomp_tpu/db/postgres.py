@@ -1,0 +1,420 @@
+"""PostgreSQL driver — the reference's second backend, restored.
+
+The reference MLComp ran on SQLite *or* PostgreSQL behind one provider
+layer (reference db/core/db.py; ``DB_TYPE=POSTGRESQL`` in the .env).
+This module is the Postgres side of that seam for this build:
+``Session.create_session`` hands any ``postgresql://`` connection
+string here, and every provider runs unchanged on top because the
+statement API (execute/executemany/query/query_one/add/add_all/
+update_obj/commit) and the dialect hooks (``dialect``,
+``table_columns``, ``explain``, ``publish_event``/``wait_event``)
+match ``db.core.Session`` exactly.
+
+What is different under the hood — and why it is the scale backend:
+
+- **per-thread pooled connections**: each thread gets its own
+  connection (created on demand, reused for the thread's lifetime), so
+  the supervisor tick, the watchdog, metric flushes and API handlers
+  never serialize on one connection the way the sqlite driver's RLock
+  forces them to;
+- **paramstyle translation**: providers keep writing ``?`` placeholders
+  (the sqlite idiom); statements are rewritten to psycopg's ``%s`` at
+  the driver boundary, so zero provider SQL forks;
+- **RETURNING-id inserts**: Postgres has no ``lastrowid`` — inserts of
+  id-keyed models append ``RETURNING "id"``;
+- **FOR UPDATE SKIP LOCKED** claims (db/providers/queue.py picks the
+  dialect): concurrent workers pop disjoint queue messages without
+  lock waits — the claim throughput path of every modern Postgres job
+  queue;
+- **LISTEN/NOTIFY events**: ``publish_event`` also issues
+  ``pg_notify``, and the first ``wait_event`` starts one daemon
+  listener thread that re-publishes remote notifications into the
+  process-local bus — cross-process AND cross-host wakeups, so workers
+  and supervisor drop their poll floors entirely.
+
+psycopg (v3) is imported lazily: sqlite-only boxes never need it, and a
+missing module surfaces as a clear RuntimeError only when a
+``postgresql://`` string is actually used.
+"""
+
+import re
+import threading
+import time
+
+from mlcomp_tpu.db.core import (
+    _Result, adapt_value, insert_sql, update_sql,
+)
+from mlcomp_tpu.testing.faults import fault_point
+
+#: one NOTIFY channel carries every event; the payload is the local
+#: bus channel string (db/events.py)
+PG_NOTIFY_CHANNEL = 'mlcomp_events'
+
+#: bounded retry on deadlock — the Postgres analogue of the sqlite
+#: driver's SQLITE_BUSY backoff; counted into the same busy stats
+_DEADLOCK_RETRIES = 3
+_DEADLOCK_BASE_SLEEP_S = 0.05
+
+_QMARK = re.compile(r'\?')
+
+
+def _psycopg():
+    try:
+        import psycopg
+        return psycopg
+    except ImportError as e:
+        raise RuntimeError(
+            'a postgresql:// connection string needs the psycopg '
+            'package (pip install "psycopg[binary]"); sqlite remains '
+            'the zero-config default') from e
+
+
+def translate_sql(sql: str) -> str:
+    """qmark -> %s paramstyle. The schema/providers never embed a
+    literal '?' inside string constants, so a plain substitution is
+    exact; '%' literals must be doubled or psycopg reads them as
+    placeholders."""
+    if '%' in sql:
+        sql = sql.replace('%', '%%')
+    return _QMARK.sub('%s', sql)
+
+
+class PostgresSession:
+    """psycopg-backed Session with per-thread pooled connections.
+
+    Keyed-singleton lifecycle, caching and cleanup stay owned by
+    ``db.core.Session.create_session`` — this class is only the
+    driver."""
+
+    dialect = 'postgresql'
+    events_cross_process = True
+
+    def __init__(self, connection_string, key):
+        self.key = key
+        self.connection_string = connection_string
+        # thread ident -> (thread object, connection). Ident-keyed —
+        # NOT threading.local — so dead threads' connections can be
+        # REAPED: the API server is thread-per-request, and a pool
+        # that only ever grows would exhaust Postgres's
+        # max_connections after ~100 requests
+        self._by_thread = {}
+        self._conns_lock = threading.Lock()
+        self._notify_conn = None
+        self._notify_lock = threading.Lock()
+        self._listener = None
+        self._listener_lock = threading.Lock()
+        self._closed = False
+        # fail fast on a bad DSN — create_session must not cache a
+        # session that can never connect
+        self._conn()
+
+    # --------------------------------------------------------- connections
+    def _connect(self, **kwargs):
+        psycopg = _psycopg()
+        from psycopg.rows import dict_row
+        kwargs.setdefault('row_factory', dict_row)
+        return psycopg.connect(self.connection_string, **kwargs)
+
+    def _conn(self):
+        me = threading.current_thread()
+        with self._conns_lock:
+            entry = self._by_thread.get(me.ident)
+            if entry is not None and entry[0] is me \
+                    and not entry[1].closed:
+                return entry[1]
+        conn = self._connect(autocommit=False)
+        with self._conns_lock:
+            stale = self._by_thread.get(me.ident)
+            self._by_thread[me.ident] = (me, conn)
+            # reap: close connections whose owner thread exited (plus
+            # any broken one this ident previously held) — the pool's
+            # steady-state size is the number of LIVE threads
+            dead = [ident for ident, (thr, c) in self._by_thread.items()
+                    if ident != me.ident and not thr.is_alive()]
+            to_close = [self._by_thread.pop(ident)[1] for ident in dead]
+            if stale is not None:
+                to_close.append(stale[1])
+        for c in to_close:
+            try:
+                c.close()
+            except Exception:
+                pass
+        return conn
+
+    def close(self):
+        self._closed = True
+        with self._conns_lock:
+            conns = [c for _, c in self._by_thread.values()]
+            self._by_thread = {}
+        with self._notify_lock:
+            if self._notify_conn is not None:
+                conns.append(self._notify_conn)
+                self._notify_conn = None
+        for conn in conns:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    # ----------------------------------------------------------- statements
+    def _is_deadlock(self, e) -> bool:
+        return 'deadlock' in str(e).lower()
+
+    def _retry_deadlock(self, op):
+        from mlcomp_tpu.db.core import _record_busy
+        for attempt in range(_DEADLOCK_RETRIES + 1):
+            try:
+                return op()
+            except Exception as e:
+                if not self._is_deadlock(e):
+                    raise
+                if attempt >= _DEADLOCK_RETRIES:
+                    _record_busy('gave_up')
+                    raise
+                _record_busy('retries')
+            time.sleep(_DEADLOCK_BASE_SLEEP_S * (2 ** attempt))
+
+    #: INSERT INTO <table> — for the lastrowid shim below
+    _INSERT_TABLE = re.compile(r'^\s*INSERT\s+INTO\s+(["\w]+)',
+                               re.IGNORECASE)
+
+    def _table_has_id(self, table: str) -> bool:
+        table = table.strip('"')
+        cached = getattr(self, '_id_cache', None)
+        if cached is None:
+            cached = self._id_cache = {}
+        if table not in cached:
+            try:
+                cached[table] = 'id' in self.table_columns(table)
+            except Exception:
+                return False        # don't cache a transient failure
+        return cached[table]
+
+    def execute(self, sql, params=()):
+        sql = translate_sql(sql)
+        params = tuple(adapt_value(p) for p in params)
+        # lastrowid shim: sqlite callers — including the /api/db proxy,
+        # whose RemoteSession.add reads result.lastrowid to stamp
+        # obj.id — expect INSERTs to report the new id. Postgres has no
+        # lastrowid, so id-keyed inserts get ' RETURNING "id"' appended
+        # and the synthetic row is HIDDEN from the result (sqlite
+        # returns no rows for a plain INSERT; parity matters to
+        # fetchone() callers).
+        synthesize_id = False
+        m = self._INSERT_TABLE.match(sql)
+        if m and 'RETURNING' not in sql.upper() \
+                and self._table_has_id(m.group(1)):
+            sql += ' RETURNING "id"'
+            synthesize_id = True
+
+        def op():
+            conn = self._conn()
+            try:
+                fault_point('db.execute', sql=sql)  # chaos: outage
+                cur = conn.execute(sql, params)
+                rows = cur.fetchall() if cur.description else []
+                if synthesize_id:
+                    lastrowid = rows[-1]['id'] if rows else None
+                    result = _Result([], lastrowid, cur.rowcount)
+                else:
+                    result = _Result(rows, None, cur.rowcount)
+                conn.commit()
+                return result
+            except Exception:
+                conn.rollback()
+                raise
+
+        return self._retry_deadlock(op)
+
+    def executemany(self, sql, seq):
+        sql = translate_sql(sql)
+        seq = [tuple(adapt_value(p) for p in row) for row in seq]
+
+        def op():
+            conn = self._conn()
+            try:
+                fault_point('db.execute', sql=sql)  # chaos: outage
+                with conn.cursor() as cur:
+                    cur.executemany(sql, seq)
+                    result = _Result([], None, cur.rowcount)
+                conn.commit()
+                return result
+            except Exception:
+                conn.rollback()
+                raise
+
+        return self._retry_deadlock(op)
+
+    def query(self, sql, params=()):
+        sql = translate_sql(sql)
+        params = tuple(adapt_value(p) for p in params)
+        conn = self._conn()
+        try:
+            rows = conn.execute(sql, params).fetchall()
+            # release the snapshot: a read left open would hold back
+            # vacuum and make this thread's NEXT write a long txn
+            conn.commit()
+            return rows
+        except Exception:
+            conn.rollback()
+            raise
+
+    def query_one(self, sql, params=()):
+        rows = self.query(sql, params)
+        return rows[0] if rows else None
+
+    # ------------------------------------------------------------- dialect
+    def table_columns(self, table: str) -> set:
+        rows = self.query(
+            'SELECT column_name FROM information_schema.columns '
+            'WHERE table_name=? AND table_schema=current_schema()',
+            (table,))
+        return {r['column_name'] for r in rows}
+
+    def explain(self, sql, params=()) -> str:
+        rows = self.query(f'EXPLAIN {sql}', params)
+        return '\n'.join(str(list(r.values())[0]) for r in rows)
+
+    # --------------------------------------------------------------- object
+    def add(self, obj, commit=True):
+        sql, raw_vals = insert_sql(obj)
+        vals = tuple(adapt_value(v) for v in raw_vals)
+        assign_id = hasattr(obj, 'id') and getattr(obj, 'id', None) is None
+        if assign_id:
+            sql += ' RETURNING "id"'
+        sql = translate_sql(sql)
+
+        def op():
+            conn = self._conn()
+            try:
+                cur = conn.execute(sql, vals)
+                if assign_id:
+                    obj.id = cur.fetchone()['id']
+                if commit:
+                    conn.commit()
+                return obj
+            except Exception:
+                conn.rollback()
+                raise
+
+        # commit=False rides a caller-managed batch (add_all) on THIS
+        # thread's connection; a deadlock retry there would replay into
+        # a rolled-back transaction, so only self-committing adds retry
+        return self._retry_deadlock(op) if commit else op()
+
+    def add_all(self, objs):
+        for o in objs:
+            self.add(o, commit=False)
+        self._conn().commit()
+
+    def update_obj(self, obj, fields=None):
+        sql, vals = update_sql(obj, fields)
+        self.execute(sql, vals)
+
+    def commit(self):
+        self._conn().commit()
+
+    # -------------------------------------------------------------- events
+    def publish_event(self, channel: str):
+        """Local condition-variable wakeup + cross-process pg_notify.
+        The notify rides a dedicated AUTOCOMMIT connection, not
+        ``execute``: the hot claim/complete path must not pay a second
+        full transaction (BEGIN + COMMIT round trips) per state change
+        just to advertise it. Best-effort by contract: a failed notify
+        must never fail the state change it advertises (waiters keep a
+        timer backstop precisely for lost wakeups) — on failure the
+        connection is dropped and rebuilt on the next publish."""
+        from mlcomp_tpu.db import events
+        events.publish(channel)
+        with self._notify_lock:
+            try:
+                if self._notify_conn is None or self._notify_conn.closed:
+                    self._notify_conn = self._connect(autocommit=True)
+                self._notify_conn.execute(
+                    'SELECT pg_notify(%s, %s)',
+                    (PG_NOTIFY_CHANNEL, channel))
+            except Exception:
+                try:
+                    if self._notify_conn is not None:
+                        self._notify_conn.close()
+                except Exception:
+                    pass
+                self._notify_conn = None
+
+    def event_snapshot(self, channels) -> dict:
+        from mlcomp_tpu.db import events
+        return events.snapshot(channels)
+
+    def wait_event(self, channels, timeout: float,
+                   snapshot: dict = None) -> bool:
+        """Wait on the local bus; remote NOTIFYs are folded into it by
+        the listener daemon (started lazily here, so publish-only
+        processes never hold a LISTEN connection)."""
+        self._ensure_listener()
+        from mlcomp_tpu.db import events
+        return events.wait(channels, timeout, snapshot=snapshot)
+
+    def _ensure_listener(self):
+        # unconditionally under the lock (an uncontended acquire is
+        # ~100 ns against a wait that is about to sleep)
+        with self._listener_lock:
+            if self._listener is not None and self._listener.is_alive():
+                return
+            t = threading.Thread(target=self._listen_loop, daemon=True,
+                                 name='pg-listen')
+            self._listener = t
+            t.start()
+
+    def _listen_loop(self):
+        """One dedicated autocommit connection LISTENing forever; each
+        notification's payload is a local-bus channel republished into
+        this process. Uses the stable low-level pgconn API (works
+        across psycopg3 versions) and reconnects with backoff — a
+        bounced Postgres downgrades waiters to their timer backstop,
+        never crashes them."""
+        import select
+
+        from mlcomp_tpu.db import events
+        psycopg = _psycopg()
+        delay = 1.0
+        while not self._closed:
+            try:
+                conn = psycopg.connect(self.connection_string,
+                                       autocommit=True)
+            except Exception:
+                time.sleep(delay)
+                delay = min(30.0, delay * 2)
+                continue
+            try:
+                conn.execute(f'LISTEN {PG_NOTIFY_CHANNEL}')
+                # a full LISTEN round trip succeeded — only NOW is the
+                # server known healthy enough to reset the backoff (a
+                # failover window where connect() succeeds but the
+                # first statement dies must keep backing off, not
+                # hammer a connect/fail cycle)
+                delay = 1.0
+                while not self._closed:
+                    ready, _, _ = select.select([conn.fileno()], [], [],
+                                                1.0)
+                    if not ready:
+                        continue
+                    conn.pgconn.consume_input()
+                    while True:
+                        note = conn.pgconn.notifies()
+                        if note is None:
+                            break
+                        channel = bytes(note.extra).decode(
+                            'utf-8', 'replace')
+                        if channel:
+                            events.publish(channel)
+            except Exception:
+                time.sleep(delay)
+                delay = min(30.0, delay * 2)
+            finally:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
+
+__all__ = ['PostgresSession', 'translate_sql', 'PG_NOTIFY_CHANNEL']

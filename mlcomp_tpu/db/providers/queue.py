@@ -22,6 +22,7 @@ import datetime
 import json
 import sqlite3
 
+from mlcomp_tpu.db.events import CH_QUEUE_DONE, queue_channel
 from mlcomp_tpu.db.models import QueueMessage
 from mlcomp_tpu.db.providers.base import BaseDataProvider
 from mlcomp_tpu.testing.faults import fault_point
@@ -40,23 +41,74 @@ def _is_returning_syntax_error(e: Exception) -> bool:
 class QueueProvider(BaseDataProvider):
     model = QueueMessage
 
+    def _publish(self, channel: str):
+        """Wake-on-work event (db/events.py) — best-effort by contract:
+        a lost wakeup costs one poll/backstop interval, never
+        correctness, so event failures must not fail the state change
+        they advertise."""
+        try:
+            self.session.publish_event(channel)
+        except Exception:
+            pass
+
     def enqueue(self, queue: str, payload: dict) -> int:
         fault_point('queue.enqueue', queue=queue)   # chaos: slow-dispatch
         msg = QueueMessage(
             queue=queue, payload=json.dumps(payload), status='pending',
             created=now())
         self.add(msg)
+        self._publish(queue_channel(queue))
         return msg.id
+
+    def enqueue_many(self, items) -> int:
+        """Batch enqueue — ``items`` is [(queue, payload_dict), ...].
+        One INSERT batch instead of len(items) round trips (a grid
+        fan-out or a load-harness submit burst is one statement), one
+        wakeup per distinct queue. Returns the number inserted; callers
+        that need per-message ids (the supervisor's ``task.queue_id``
+        bookkeeping) use ``enqueue`` — ids of a batch insert are not
+        portable across backends."""
+        items = list(items)
+        if not items:
+            return 0
+        fault_point('queue.enqueue', queue=items[0][0])
+        stamp = now()
+        self.session.executemany(
+            "INSERT INTO queue_message (queue, payload, status, created) "
+            "VALUES (?, ?, 'pending', ?)",
+            [(queue, json.dumps(payload), stamp)
+             for queue, payload in items])
+        for queue in {queue for queue, _ in items}:
+            self._publish(queue_channel(queue))
+        return len(items)
 
     def claim(self, queues, worker: str):
         """Atomically claim the oldest pending message on any of `queues`.
         Returns (msg_id, payload dict) or None."""
-        if not queues:
-            return None
+        claims = self.claim_many(queues, worker, 1)
+        return claims[0] if claims else None
+
+    def claim_many(self, queues, worker: str, n: int):
+        """Atomically claim up to ``n`` oldest pending messages across
+        ``queues`` in ONE conditional statement — a multi-slot worker
+        takes all its work in a single round trip instead of n
+        SELECT+UPDATE pairs. Returns [(msg_id, payload dict), ...]
+        (possibly empty), oldest first.
+
+        Dialect split: Postgres claims via ``FOR UPDATE SKIP LOCKED``
+        (concurrent workers pop disjoint rows with no lock waits);
+        sqlite >= 3.35 uses a single UPDATE..RETURNING (atomic under
+        the file's single-writer lock); older sqlite keeps the
+        SELECT-candidates + conditional-UPDATE loop whose
+        ``status='pending'`` guard preserves at-most-once."""
+        if not queues or n < 1:
+            return []
+        if getattr(self.session, 'dialect', 'sqlite') == 'postgresql':
+            return self._claim_pg(queues, worker, n)
         global _RETURNING_OK
         if _RETURNING_OK:
             try:
-                return self._claim_returning(queues, worker)
+                return self._claim_returning(queues, worker, n)
             except (sqlite3.OperationalError, RuntimeError) as e:
                 # RuntimeError: a RemoteSession surfaces the SERVER
                 # sqlite's syntax error as 'remote db error: ...' —
@@ -64,54 +116,84 @@ class QueueProvider(BaseDataProvider):
                 if not _is_returning_syntax_error(e):
                     raise
                 _RETURNING_OK = False
-        return self._claim_fallback(queues, worker)
+        return self._claim_fallback(queues, worker, n)
 
-    def _claim_returning(self, queues, worker: str):
+    def _claim_pg(self, queues, worker: str, n: int):
         marks = ','.join('?' * len(queues))
         cur = self.session.execute(
             f"UPDATE queue_message SET status='claimed', claimed_by=?, "
-            f"claimed_at=? WHERE id = ("
+            f"claimed_at=? WHERE id IN ("
             f"SELECT id FROM queue_message WHERE queue IN ({marks}) "
-            f"AND status='pending' ORDER BY id LIMIT 1) "
+            f"AND status='pending' ORDER BY id LIMIT ? "
+            f"FOR UPDATE SKIP LOCKED) "
             f"AND status='pending' RETURNING id, payload",
-            (worker, now()) + tuple(queues))
-        row = cur.fetchone()
-        if row is None:
-            return None
-        return row['id'], json.loads(row['payload'])
+            (worker, now()) + tuple(queues) + (n,))
+        rows = sorted(cur.fetchall(), key=lambda r: r['id'])
+        return [(r['id'], json.loads(r['payload'])) for r in rows]
 
-    def _claim_fallback(self, queues, worker: str):
-        """sqlite < 3.35: pick a candidate, then claim it with a
-        conditional UPDATE. The status='pending' guard keeps the claim
-        at-most-once under concurrent pollers — a raced-away candidate
-        shows rowcount 0 and the loop moves to the next oldest."""
+    def _claim_returning(self, queues, worker: str, n: int):
         marks = ','.join('?' * len(queues))
-        skip = []
-        while True:
+        cur = self.session.execute(
+            f"UPDATE queue_message SET status='claimed', claimed_by=?, "
+            f"claimed_at=? WHERE id IN ("
+            f"SELECT id FROM queue_message WHERE queue IN ({marks}) "
+            f"AND status='pending' ORDER BY id LIMIT ?) "
+            f"AND status='pending' RETURNING id, payload",
+            (worker, now()) + tuple(queues) + (n,))
+        rows = sorted(cur.fetchall(), key=lambda r: r['id'])
+        return [(r['id'], json.loads(r['payload'])) for r in rows]
+
+    def _claim_fallback(self, queues, worker: str, n: int):
+        """sqlite < 3.35: pick a candidate batch, then claim it with a
+        conditional UPDATE. The status='pending' guard keeps the claim
+        at-most-once under concurrent pollers — raced-away candidates
+        drop out of the won set and the loop moves to the next
+        oldest."""
+        marks = ','.join('?' * len(queues))
+        claimed, skip = [], []
+        while len(claimed) < n:
             not_in = ''
             params = list(queues)
             if skip:
                 not_in = (' AND id NOT IN ('
                           + ','.join('?' * len(skip)) + ')')
                 params += skip
-            row = self.session.query_one(
+            rows = self.session.query(
                 f"SELECT id, payload FROM queue_message "
                 f"WHERE queue IN ({marks}) AND status='pending'"
-                f"{not_in} ORDER BY id LIMIT 1", tuple(params))
-            if row is None:
-                return None
-            # chaos: the claim-race window — a rival may steal the
+                f"{not_in} ORDER BY id LIMIT ?",
+                tuple(params) + (n - len(claimed),))
+            if not rows:
+                break
+            ids = [r['id'] for r in rows]
+            payloads = {r['id']: r['payload'] for r in rows}
+            # chaos: the claim-race window — a rival may steal any
             # candidate between the SELECT above and the UPDATE below
-            fault_point('queue.claim', msg_id=row['id'],
-                        session=self.session)
+            for mid in ids:
+                fault_point('queue.claim', msg_id=mid,
+                            session=self.session)
+            id_marks = ','.join('?' * len(ids))
             cur = self.session.execute(
-                "UPDATE queue_message SET status='claimed', "
-                "claimed_by=?, claimed_at=? "
-                "WHERE id=? AND status='pending'",
-                (worker, now(), row['id']))
-            if cur.rowcount == 1:
-                return row['id'], json.loads(row['payload'])
-            skip.append(row['id'])      # raced away — try the next one
+                f"UPDATE queue_message SET status='claimed', "
+                f"claimed_by=?, claimed_at=? "
+                f"WHERE id IN ({id_marks}) AND status='pending'",
+                (worker, now()) + tuple(ids))
+            if cur.rowcount == len(ids):
+                won = set(ids)
+            else:
+                # some candidates raced away — ask which ones we won
+                # (a pending->claimed-by-me transition on these ids can
+                # only be OUR update; rivals stamp their own identity)
+                won = {r['id'] for r in self.session.query(
+                    f"SELECT id FROM queue_message "
+                    f"WHERE id IN ({id_marks}) AND claimed_by=? "
+                    f"AND status='claimed'", tuple(ids) + (worker,))}
+            for mid in ids:
+                if mid in won:
+                    claimed.append((mid, json.loads(payloads[mid])))
+                else:
+                    skip.append(mid)    # raced away — try the next one
+        return claimed
 
     def find_active(self, queue: str, payload: dict):
         """id of a PENDING message with exactly this payload on this
@@ -128,6 +210,17 @@ class QueueProvider(BaseDataProvider):
             "AND status='pending' ORDER BY id LIMIT 1",
             (queue, json.dumps(payload)))
         return row['id'] if row else None
+
+    def pending_index(self) -> dict:
+        """{(queue, payload_json): oldest pending id} — ONE set query
+        replacing the per-dispatch ``find_active`` round trip in the
+        supervisor tick (the N-queries-per-task pattern). Iterating
+        id-descending makes the dict's surviving value the OLDEST id,
+        matching find_active's ORDER BY id LIMIT 1 pick."""
+        rows = self.session.query(
+            "SELECT id, queue, payload FROM queue_message "
+            "WHERE status='pending' ORDER BY id DESC")
+        return {(r['queue'], r['payload']): r['id'] for r in rows}
 
     def complete(self, msg_id: int, result: str = None,
                  worker: str = None) -> bool:
@@ -155,7 +248,12 @@ class QueueProvider(BaseDataProvider):
             sql += ' AND claimed_by=?'
             params.append(worker)
         cur = self.session.execute(sql, tuple(params))
-        return cur.rowcount > 0
+        if cur.rowcount > 0:
+            # wake the supervisor: a completion frees capacity and may
+            # unblock dependent tasks this very moment
+            self._publish(CH_QUEUE_DONE)
+            return True
+        return False
 
     def revoke(self, msg_id: int) -> bool:
         """Revoke a pending message (celery revoke parity,
@@ -193,7 +291,14 @@ class QueueProvider(BaseDataProvider):
             "claimed_by=NULL, claimed_at=?, redelivered=1 "
             "WHERE id=? AND status='claimed' "
             "AND COALESCE(redelivered, 0)=0", (now(), msg_id))
-        return cur.rowcount > 0
+        if cur.rowcount > 0:
+            # the message is pending again — wake its queue's workers
+            row = self.session.query_one(
+                'SELECT queue FROM queue_message WHERE id=?', (msg_id,))
+            if row is not None:
+                self._publish(queue_channel(row['queue']))
+            return True
+        return False
 
     def expire_claim(self, msg_id: int) -> bool:
         """Fail a CLAIMED message that already spent its one

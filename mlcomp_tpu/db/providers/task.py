@@ -9,6 +9,7 @@ dependencies of each task are in which status), ``parent_tasks_stats``
 import json
 
 from mlcomp_tpu.db.enums import TaskStatus, TaskType
+from mlcomp_tpu.db.events import CH_TASKS
 from mlcomp_tpu.db.models import Task, TaskDependence
 from mlcomp_tpu.db.providers.base import BaseDataProvider, PaginatorOptions
 from mlcomp_tpu.utils.misc import now
@@ -16,6 +17,26 @@ from mlcomp_tpu.utils.misc import now
 
 class TaskProvider(BaseDataProvider):
     model = Task
+
+    def _publish_tasks(self):
+        """Wake the supervisor: a new or transitioned task row may be
+        schedulable (or may unblock dependents) right now. Best-effort
+        — a lost wakeup costs one backstop interval, not correctness."""
+        try:
+            self.session.publish_event(CH_TASKS)
+        except Exception:
+            pass
+
+    def add(self, obj, commit: bool = True):
+        obj = super().add(obj, commit=commit)
+        # Task rows only: dependence edges ride through this same
+        # add() (add_dependency) and waking — on Postgres, one
+        # pg_notify round trip — per EDGE would double a submit's
+        # publish cost for wakeups the task-row publishes already
+        # delivered
+        if isinstance(obj, Task):
+            self._publish_tasks()
+        return obj
 
     # --------------------------------------------------------- dependencies
     def add_dependency(self, task_id: int, depend_id: int):
@@ -54,23 +75,37 @@ class TaskProvider(BaseDataProvider):
     def parent_tasks_stats(self):
         """For each unfinished parent task: its children grouped by status
         (reference db/providers/task.py:224-258). Returns a list of
-        (parent_task, started, finished, [(status, count)])."""
+        (parent_task, started, finished, [(status, count)]).
+
+        Two set queries total — the per-parent GROUP BY round trip
+        (1 + N queries for N live parents) was one of the supervisor
+        tick's N-queries-per-task patterns; all parents' child stats
+        now arrive in one grouped read."""
         unfinished = [int(s) for s in TaskStatus.unfinished()]
         marks = ','.join('?' * len(unfinished))
-        parents = self.session.query(
+        parents = [Task.from_row(p) for p in self.session.query(
             f'SELECT * FROM task WHERE status IN ({marks}) AND id IN '
             f'(SELECT DISTINCT parent FROM task WHERE parent IS NOT NULL)',
-            tuple(unfinished))
+            tuple(unfinished))]
+        if not parents:
+            return []
+        id_marks = ','.join('?' * len(parents))
+        rows = self.session.query(
+            f'SELECT parent, status, COUNT(*) AS c, MIN(started) AS s, '
+            f'MAX(finished) AS f FROM task WHERE parent IN ({id_marks}) '
+            f'GROUP BY parent, status',
+            tuple(p.id for p in parents))
+        by_parent = {}
+        for r in rows:
+            by_parent.setdefault(r['parent'], []).append(r)
         res = []
-        for p in parents:
-            parent = Task.from_row(p)
-            rows = self.session.query(
-                'SELECT status, COUNT(*) AS c, MIN(started) AS s, '
-                'MAX(finished) AS f FROM task WHERE parent=? '
-                'GROUP BY status', (parent.id,))
-            stats = {r['status']: r['c'] for r in rows}
-            started = min((r['s'] for r in rows if r['s']), default=None)
-            finished = max((r['f'] for r in rows if r['f']), default=None)
+        for parent in parents:
+            grouped = by_parent.get(parent.id, [])
+            stats = {r['status']: r['c'] for r in grouped}
+            started = min((r['s'] for r in grouped if r['s']),
+                          default=None)
+            finished = max((r['f'] for r in grouped if r['f']),
+                           default=None)
             res.append((parent, started, finished, stats))
         return res
 
@@ -101,6 +136,12 @@ class TaskProvider(BaseDataProvider):
                 fields.append('failure_reason')
         task.last_activity = now()
         self.update(task, fields)
+        # a finished/failed/skipped transition may unblock dependents
+        # or free capacity — wake the supervisor instead of letting it
+        # sleep out its backstop
+        if status in TaskStatus.finished() or \
+                status == TaskStatus.NotRan:
+            self._publish_tasks()
 
     def fail_with_reason(self, task, reason: str):
         """Mark Failed with a recovery-taxonomy reason

@@ -56,6 +56,14 @@ def decode_row(row: dict) -> dict:
 class RemoteSession:
     """Session facade proxying statements to a server's ``/api/db``."""
 
+    #: the server's durable store is sqlite — providers picking
+    #: dialect-specific SQL must generate for what actually executes
+    dialect = 'sqlite'
+    #: publishes land in THIS process's local bus only — the server
+    #: host's waiters can't hear them, so remote workers keep their
+    #: short-poll fallback
+    events_cross_process = False
+
     def __init__(self, url: str, key: str = 'default',
                  token: Optional[str] = None, timeout: float = 30.0):
         self.key = key
@@ -140,6 +148,22 @@ class RemoteSession:
 
     def commit(self):
         pass  # every proxied statement commits server-side
+
+    # -------------------------------------------------------------- events
+    def publish_event(self, channel: str):
+        """Local-bus only (see ``events_cross_process``); kept so the
+        providers' wake-on-work calls work unchanged over the proxy."""
+        from mlcomp_tpu.db import events
+        events.publish(channel)
+
+    def event_snapshot(self, channels) -> dict:
+        from mlcomp_tpu.db import events
+        return events.snapshot(channels)
+
+    def wait_event(self, channels, timeout: float,
+                   snapshot: dict = None) -> bool:
+        from mlcomp_tpu.db import events
+        return events.wait(channels, timeout, snapshot=snapshot)
 
 
 __all__ = ['RemoteSession', 'encode_row', 'decode_row', 'encode_params']

@@ -23,6 +23,7 @@ Celery/Redis; queue naming keeps the reference scheme
 """
 
 import json
+import threading
 import traceback
 from mlcomp_tpu import MASTER_PORT_RANGE
 from mlcomp_tpu.db.core import Session
@@ -84,6 +85,16 @@ class SupervisorBuilder:
         # dag id -> [error findings] ([] = passed); filled lazily the
         # first time a NotRan task of that dag reaches placement
         self._preflight_cache = {}
+        # (queue, payload) -> pending msg id, loaded ONCE per tick
+        # (create_base) so dispatch's restart-idempotency check stops
+        # paying a find_active round trip per task; None outside a
+        # tick (direct dispatch calls fall back to find_active)
+        self._pending_execute = None
+        # busy-retry watermark: per-tick deltas of the process-wide
+        # counters feed the db.busy_retries series (satellite:
+        # contention must not degrade silently)
+        from mlcomp_tpu.db.core import busy_retry_stats
+        self._busy_seen = busy_retry_stats()
 
     # ----------------------------------------------------------- base state
     def create_base(self):
@@ -99,6 +110,12 @@ class SupervisorBuilder:
         # liveness source
         self.alive_computers = {d.computer for d in alive}
         self.aux['queues'] = list(self.queues)
+        # one set query for the whole tick's dispatch-idempotency
+        # lookups (queue.py pending_index docstring)
+        try:
+            self._pending_execute = self.queue_provider.pending_index()
+        except Exception:
+            self._pending_execute = None
 
     # -------------------------------------------------------- parent tasks
     def process_parent_tasks(self):
@@ -359,7 +376,21 @@ class SupervisorBuilder:
         with span('supervisor.dispatch', task=task.id,
                   trace_id=trace_id, role='supervisor',
                   tags={'queue': queue, 'cores': len(cores)}):
-            msg_id = self.queue_provider.find_active(queue, payload)
+            if self._pending_execute is not None:
+                # tick path: the per-tick set query answers the COMMON
+                # case (no pre-existing message) with zero round
+                # trips. A HIT is the rare restart-recovery case and
+                # is re-validated through find_active: the snapshot
+                # was taken at tick start, and a same-process revoke
+                # landing mid-tick must not hand the task a dead
+                # message id.
+                msg_id = self._pending_execute.get(
+                    (queue, json.dumps(payload)))
+                if msg_id is not None:
+                    msg_id = self.queue_provider.find_active(
+                        queue, payload)
+            else:
+                msg_id = self.queue_provider.find_active(queue, payload)
             if msg_id is None:
                 msg_id = self.queue_provider.enqueue(queue, payload)
             task.queue_id = msg_id
@@ -994,6 +1025,17 @@ class SupervisorBuilder:
         tel = self.telemetry
         if self.aux.get('duration') is not None:
             tel.gauge('supervisor.tick_ms', self.aux['duration'] * 1e3)
+        # busy-retry deltas since the previous tick -> db.busy_retries
+        # series (exported as mlcomp_db_busy_retries_total): lock
+        # contention on the control plane stops degrading silently
+        from mlcomp_tpu.db.core import busy_retry_stats
+        stats = busy_retry_stats()
+        for kind, series in (('retries', 'db.busy_retries'),
+                             ('gave_up', 'db.busy_gave_up')):
+            delta = stats[kind] - self._busy_seen.get(kind, 0)
+            if delta > 0:
+                tel.count(series, delta)
+        self._busy_seen = stats
         dispatched = self.aux.get('dispatched')
         if dispatched:
             tel.count('supervisor.dispatched', len(dispatched))
@@ -1126,6 +1168,11 @@ class SupervisorBuilder:
             self.aux['duration'] = (now() - start).total_seconds()
             self.write_auxiliary()
             self.record_tick_telemetry()
+            # the pending index is a TICK-scoped snapshot — holding it
+            # across ticks would serve dispatch decisions from stale
+            # queue state (its documented contract: None outside a
+            # tick)
+            self._pending_execute = None
         except Exception:
             # heal-by-recreating-session (reference supervisor.py:423-427)
             if self.logger:
@@ -1148,14 +1195,111 @@ class SupervisorBuilder:
                           fleet_probe=self.fleet_probe)
 
 
+class SupervisorLoop(threading.Thread):
+    """Wake-on-work supervisor loop — the fixed 1 Hz tick, made
+    event-driven (ROADMAP item 1).
+
+    The thread runs ``builder.build()`` then sleeps on the event bus
+    (db/events.py) until a new/transitioned task (``tasks``) or a queue
+    completion (``queue:done``) publishes — so ``dag submit -> task
+    dispatched`` stops paying the tick floor wherever a wakeup can be
+    delivered (same process always; cross-process on Postgres via
+    LISTEN/NOTIFY). ``interval`` stays as the TIMER BACKSTOP: lease
+    reclaim, watchdog deadlines and fleet reconcile are clock-driven
+    work that must run even when no event ever fires (and a lost
+    wakeup on a poll-only deployment degrades to exactly the old
+    cadence, never worse).
+
+    The event snapshot is taken BEFORE build() runs: work submitted
+    while a tick is in flight wakes the NEXT wait immediately instead
+    of being slept through."""
+
+    WAKE_CHANNELS = ('tasks', 'queue:done')
+
+    #: pause between an event wakeup and its tick: a submit burst (a
+    #: grid fan-out publishes per task) coalesces into ONE build
+    #: instead of a thundering rebuild per publish, and the
+    #: event-driven build rate is bounded at ~1/debounce even under a
+    #: publish firehose. Costs 50 ms of dispatch latency against the
+    #: 250 ms acceptance budget (and the ~1.2 s floor it replaced).
+    DEBOUNCE_S = 0.05
+
+    def __init__(self, builder: SupervisorBuilder, interval: float = 1.0):
+        super().__init__(daemon=True, name='supervisor-loop')
+        self.builder = builder
+        self.interval = interval
+        self.wake_events = 0        # ticks triggered by an event
+        self.wake_timer = 0         # ticks triggered by the backstop
+        # NOT named _stop: threading.Thread.join() calls self._stop()
+        self._stop_evt = threading.Event()
+
+    def run(self):
+        while not self._stop_evt.is_set():
+            session = self.builder.session
+            try:
+                snapshot = session.event_snapshot(self.WAKE_CHANNELS)
+            except Exception:
+                snapshot = None
+            try:
+                self.builder.build()
+            except Exception:
+                # build() heals its own tick failures, but the heal
+                # path itself can raise (e.g. a down Postgres fails
+                # create_session fast) — the loop must survive and
+                # retry at the backstop, as the old interval scheduler
+                # did, instead of dying silently with it
+                import traceback as _tb
+                logger = self.builder.logger
+                msg = (f'supervisor loop tick crashed past the heal '
+                       f'path:\n{_tb.format_exc()}')
+                try:
+                    if logger is not None:
+                        logger.error(msg, ComponentType.Supervisor)
+                    else:
+                        print(msg)
+                except Exception:
+                    pass
+                self._stop_evt.wait(self.interval)
+                continue
+            if self._stop_evt.is_set():
+                break
+            try:
+                woke = session.wait_event(
+                    self.WAKE_CHANNELS, self.interval,
+                    snapshot=snapshot)
+            except Exception:
+                self._stop_evt.wait(self.interval)
+                continue
+            if woke:
+                self.wake_events += 1
+                # debounce: let the rest of the burst land before the
+                # tick that serves it
+                self._stop_evt.wait(self.DEBOUNCE_S)
+            else:
+                self.wake_timer += 1
+
+    def stop(self):
+        self._stop_evt.set()
+        # unblock a waiting loop now instead of at the backstop
+        try:
+            from mlcomp_tpu.db import events
+            events.publish('tasks')
+        except Exception:
+            pass
+
+
 def register_supervisor(session: Session = None, logger=None,
                         interval: float = 1.0):
-    """Start the supervisor loop on a background thread
-    (reference supervisor.py:432-434 — APScheduler 1 s interval)."""
-    from mlcomp_tpu.utils.schedule import start_schedule
+    """Start the supervisor loop on a background thread. The reference
+    ran APScheduler at a fixed 1 s interval (supervisor.py:432-434);
+    here the interval is only the timer backstop — enqueues and
+    completions wake the loop immediately (SupervisorLoop)."""
     builder = SupervisorBuilder(session=session, logger=logger)
-    jobs = start_schedule([(builder.build, interval)], logger=logger)
-    return builder, jobs
+    loop = SupervisorLoop(builder, interval=interval)
+    loop.start()
+    # (builder, jobs) shape kept for callers that stop the old
+    # schedule-based loop via jobs[0].stop()
+    return builder, [loop]
 
 
-__all__ = ['SupervisorBuilder', 'register_supervisor']
+__all__ = ['SupervisorBuilder', 'SupervisorLoop', 'register_supervisor']

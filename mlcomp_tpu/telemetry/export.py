@@ -41,7 +41,8 @@ REQUIRED_FAMILIES = (
     'mlcomp_worker_slots', 'mlcomp_alerts_open',
     'mlcomp_dispatch_latency_seconds', 'mlcomp_step_phase_ms',
     'mlcomp_pipeline_efficiency', 'mlcomp_compile_events',
-    'mlcomp_task_retries', 'mlcomp_gang_generations',
+    'mlcomp_task_retries', 'mlcomp_db_busy_retries',
+    'mlcomp_gang_generations',
     'mlcomp_serving_latency_ms',
     'mlcomp_fleet_replicas', 'mlcomp_fleet_generation',
     'mlcomp_fleet_shed', 'mlcomp_fleet_respawns',
@@ -423,6 +424,26 @@ def _collect_task_retries(session, samples):
         samples.append(('_total', {'task': task, 'reason': reason}, n))
 
 
+def _collect_db_busy(session, samples):
+    """``mlcomp_db_busy_retries_total{kind=retry|gave_up}`` — control-
+    plane lock pressure, no longer silent. Summed from the
+    ``db.busy_retries``/``db.busy_gave_up`` delta rows alone: the
+    supervisor samples its own process per tick (the shipped server
+    runs the supervisor in-process, so the API server's contention is
+    already in the series) and the host agent flushes its process in
+    the usage loop — adding THIS process's live counters on top would
+    double-count everything those samplers flushed."""
+    totals = {'retry': 0.0, 'gave_up': 0.0}
+    for r in session.query(
+            "SELECT name, SUM(value) AS total FROM metric "
+            "WHERE name IN ('db.busy_retries', 'db.busy_gave_up') "
+            "GROUP BY name"):
+        kind = 'retry' if r['name'] == 'db.busy_retries' else 'gave_up'
+        totals[kind] += float(r['total'] or 0)
+    for kind in ('retry', 'gave_up'):
+        samples.append(('_total', {'kind': kind}, totals[kind]))
+
+
 def _collect_gang_generations(session, samples):
     """``mlcomp_gang_generations_total{gang,reason}`` from the
     per-event ``gang.generation`` metric rows the supervisor writes at
@@ -597,7 +618,7 @@ def collect_server_families(session):
 
     tasks, queues, slots, alerts = [], [], [], []
     dispatch, phases, eff, compiles, serving = [], [], [], [], []
-    retries, gangs = [], []
+    retries, gangs, busy = [], [], []
     freplicas, fgens, fshed, frespawns, fswaps = [], [], [], [], []
     hbm, comm_bytes, comm_frac = [], [], []
     guarded('tasks', _collect_tasks, session, tasks)
@@ -607,6 +628,7 @@ def collect_server_families(session):
     guarded('dispatch_latency', _collect_dispatch_latency, session,
             dispatch)
     guarded('task_retries', _collect_task_retries, session, retries)
+    guarded('db_busy', _collect_db_busy, session, busy)
     guarded('gang_generations', _collect_gang_generations, session,
             gangs)
     guarded('fleet_replicas', _collect_fleet_replicas, session,
@@ -661,6 +683,10 @@ def collect_server_families(session):
         family('mlcomp_task_retries', 'counter',
                'automatic task retries by failure reason '
                '(recovery subsystem; recent event window)', retries),
+        family('mlcomp_db_busy_retries', 'counter',
+               'sqlite SQLITE_BUSY retry/give-up events on the '
+               'control plane (sum of flushed db.busy_* deltas)',
+               busy),
         family('mlcomp_gang_generations', 'counter',
                'gang-atomic requeue events by gang and failure reason '
                '(elastic multi-host recovery; recent event window)',

@@ -196,6 +196,53 @@ def _consume_one(session, queue_provider, logger, index: int,
     return True
 
 
+#: wait horizon when the backend delivers cross-process wakeups
+#: (Postgres LISTEN/NOTIFY) — purely a lost-wakeup backstop, NOT a
+#: latency floor: enqueues interrupt the wait immediately
+EVENT_WAIT_BACKSTOP_S = 5.0
+
+#: ceiling for the worker loop's exponential error backoff — a sick DB
+#: must not spin the log at 1 Hz forever, but recovery should be
+#: noticed within a minute
+ERROR_BACKOFF_MAX_S = 60.0
+
+
+def _error_backoff_delay(failures: int) -> float:
+    """1, 2, 4, ... seconds for the Nth consecutive loop failure,
+    capped at ERROR_BACKOFF_MAX_S."""
+    return min(ERROR_BACKOFF_MAX_S, 2.0 ** (max(1, failures) - 1))
+
+
+def _queue_channels(index: int):
+    from mlcomp_tpu.db.events import queue_channel
+    return [queue_channel(q) for q in queue_names(index)]
+
+
+def _event_snapshot(session, index: int):
+    """Channel-sequence snapshot taken BEFORE the claim attempt — an
+    enqueue landing between an empty claim and the wait bumps past
+    this snapshot and wakes the wait instantly (db/events.py)."""
+    try:
+        return session.event_snapshot(_queue_channels(index))
+    except Exception:
+        return None
+
+
+def _idle_wait(session, index: int, snapshot=None):
+    """Sleep until work may exist: wake on this worker's queue
+    channels, falling back to the short poll where no cross-process
+    wakeup can reach us (plain sqlite multi-process — the fallback
+    row of the docs/control_plane.md matrix)."""
+    timeout = EVENT_WAIT_BACKSTOP_S \
+        if getattr(session, 'events_cross_process', False) \
+        else QUEUE_POLL_INTERVAL
+    try:
+        session.wait_event(_queue_channels(index), timeout,
+                           snapshot=snapshot)
+    except Exception:
+        time.sleep(QUEUE_POLL_INTERVAL)
+
+
 @main.command()
 @click.argument('index', type=int)
 @click.option('--in-process', is_flag=True,
@@ -208,23 +255,36 @@ def worker(index, in_process):
     queue_provider = QueueProvider(session)
     logger.info(f'worker {index} consuming {queue_names(index)}',
                 ComponentType.Worker, HOSTNAME)
+    failures = 0
     while True:
         try:
+            snapshot = _event_snapshot(session, index)
             if not _consume_one(session, queue_provider, logger, index,
                                 in_process):
-                time.sleep(QUEUE_POLL_INTERVAL)
+                _idle_wait(session, index, snapshot=snapshot)
+            # THIS process runs the contended claim/complete loop the
+            # busy-retry metric exists for — flush its own deltas (an
+            # in-memory no-op when nothing retried since last flush)
+            _flush_busy_retry_deltas(session)
+            failures = 0
         except KeyboardInterrupt:
             break
         except Exception:
+            # bounded exponential backoff (was a flat 1 s sleep): a
+            # sick DB backs the loop off to ERROR_BACKOFF_MAX_S with
+            # the reason in the log, instead of spinning at 1 Hz
+            failures += 1
+            delay = _error_backoff_delay(failures)
             logger.error(
-                f'worker loop error:\n{traceback.format_exc()}',
+                f'worker loop error (consecutive failure {failures}, '
+                f'backing off {delay:.0f}s):\n{traceback.format_exc()}',
                 ComponentType.Worker, HOSTNAME)
             # drop the cached singleton so a fresh connection is built
             Session.cleanup(f'worker{index}')
             session = Session.create_session(key=f'worker{index}')
             queue_provider = QueueProvider(session)
             logger = create_logger(session)
-            time.sleep(1)
+            time.sleep(delay)
 
 
 @main.command(name='run-task')
@@ -290,6 +350,41 @@ def worker_usage(session, logger):
     }
     provider.current_usage(HOSTNAME, usage)
     provider.add_usage_history(HOSTNAME, usage)
+    _flush_busy_retry_deltas(session)
+
+
+#: watermark for _flush_busy_retry_deltas (this process only)
+_BUSY_FLUSHED = {'retries': 0, 'gave_up': 0}
+
+
+def _flush_busy_retry_deltas(session):
+    """Feed this process's SQLITE_BUSY retry counters into the
+    ``db.busy_retries`` series as DELTAS — same protocol as the
+    supervisor's per-tick sampling, so ``mlcomp_db_busy_retries_total``
+    (a plain SUM over the series) stays double-count-free. Called from
+    the worker consume loop AND the host agent's usage loop (each in
+    its own process, each covering only itself); an in-memory no-op
+    when nothing retried since the last flush. Best-effort:
+    observability must never fail the loop it rides."""
+    from mlcomp_tpu.db.core import busy_retry_stats
+    from mlcomp_tpu.utils.misc import now as _now
+    stats = busy_retry_stats()
+    rows = []
+    for kind, series in (('retries', 'db.busy_retries'),
+                         ('gave_up', 'db.busy_gave_up')):
+        delta = stats[kind] - _BUSY_FLUSHED[kind]
+        if delta > 0:
+            rows.append((None, series, 'counter', None, float(delta),
+                         _now(), 'worker_supervisor', None))
+    if not rows:
+        return
+    try:
+        from mlcomp_tpu.db.providers.telemetry import MetricProvider
+        MetricProvider(session).add_many(rows)
+        _BUSY_FLUSHED.update(
+            {k: stats[k] for k in ('retries', 'gave_up')})
+    except Exception:
+        pass
 
 
 def _tpu_usage():
@@ -333,27 +428,29 @@ def consume_control_queue(session, logger):
     queue = f'{HOSTNAME}_{DOCKER_IMG}_supervisor'
     me = f'{HOSTNAME}:supervisor'
     while True:
-        claim = queue_provider.claim([queue], me)
-        if claim is None:
+        # batched drain: a pile of routed kills (a gang abort fans one
+        # per rank) comes back in ONE conditional claim statement
+        claims = queue_provider.claim_many([queue], me, 32)
+        if not claims:
             return
-        msg_id, payload = claim
-        action = payload.get('action')
-        task_id = payload.get('task_id')
-        try:
-            if action == 'kill':
-                from mlcomp_tpu.worker.tasks import kill_task
-                kill_task(task_id, session=session)
-                queue_provider.complete(msg_id, worker=me)
-            else:
-                queue_provider.fail(msg_id, f'unknown action {action!r}',
-                                    worker=me)
-        except Exception:
-            queue_provider.fail(msg_id, traceback.format_exc()[-4000:],
-                                worker=me)
-            logger.error(
-                f'control message {msg_id} ({action} task {task_id}) '
-                f'failed:\n{traceback.format_exc()}',
-                ComponentType.WorkerSupervisor, HOSTNAME, task_id)
+        for msg_id, payload in claims:
+            action = payload.get('action')
+            task_id = payload.get('task_id')
+            try:
+                if action == 'kill':
+                    from mlcomp_tpu.worker.tasks import kill_task
+                    kill_task(task_id, session=session)
+                    queue_provider.complete(msg_id, worker=me)
+                else:
+                    queue_provider.fail(
+                        msg_id, f'unknown action {action!r}', worker=me)
+            except Exception:
+                queue_provider.fail(
+                    msg_id, traceback.format_exc()[-4000:], worker=me)
+                logger.error(
+                    f'control message {msg_id} ({action} task {task_id}) '
+                    f'failed:\n{traceback.format_exc()}',
+                    ComponentType.WorkerSupervisor, HOSTNAME, task_id)
 
 
 @main.command(name='worker-supervisor')

@@ -27,3 +27,35 @@ def session():
     """Fresh migrated DB per test (parity: reference utils/tests.py:12-21)."""
     from mlcomp_tpu.utils.tests import fresh_session
     yield fresh_session()
+
+
+@pytest.fixture(params=['sqlite', 'postgres'])
+def backend_session(request):
+    """Both control-plane backends behind one fixture: sqlite always
+    (fresh file per test), Postgres only where ``MLCOMP_TEST_PG_DSN``
+    points at a disposable database (the CI service container) — and a
+    clean skip everywhere else, so tier-1 stays green on sqlite-only
+    boxes. The Postgres schema is dropped and re-migrated per test for
+    the same isolation the sqlite fixture gets by deleting the file."""
+    if request.param == 'sqlite':
+        from mlcomp_tpu.utils.tests import fresh_session
+        yield fresh_session()
+        return
+    import os as _os
+    dsn = _os.environ.get('MLCOMP_TEST_PG_DSN')
+    if not dsn:
+        pytest.skip('MLCOMP_TEST_PG_DSN not set — Postgres parity '
+                    'leg runs only against a disposable database')
+    try:
+        import psycopg  # noqa: F401
+    except ImportError:
+        pytest.skip('psycopg not installed')
+    from mlcomp_tpu.db.core import Session
+    from mlcomp_tpu.db.migration import migrate
+    Session.cleanup('pg_test')
+    s = Session.create_session(key='pg_test', connection_string=dsn)
+    s.execute('DROP SCHEMA public CASCADE')
+    s.execute('CREATE SCHEMA public')
+    migrate(s)
+    yield s
+    Session.cleanup('pg_test')

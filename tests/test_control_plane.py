@@ -1,0 +1,250 @@
+"""High-throughput control plane (ISSUE 13): event-driven dispatch,
+batched queue operations, the index audit, and the worker's error
+backoff — the seams scripts/load_smoke.py drives at scale, verified
+here at unit granularity.
+"""
+import json
+import threading
+import time
+
+import pytest
+
+from mlcomp_tpu.db.enums import TaskStatus
+from mlcomp_tpu.db.providers import QueueProvider, TaskProvider
+from mlcomp_tpu.server.supervisor import SupervisorBuilder, SupervisorLoop
+
+from tests.test_supervisor import add_computer, add_task, dag_id  # noqa: F401
+
+
+class TestEventBus:
+    def test_wait_times_out_without_publish(self, session):
+        t0 = time.monotonic()
+        assert session.wait_event(['queue:idle'], 0.05) is False
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_snapshot_closes_check_then_wait_race(self, session):
+        """A publish BETWEEN the snapshot and the wait must wake the
+        waiter instantly — the supervisor/worker pattern (snapshot,
+        check for work, wait) must never sleep through work that
+        arrived mid-check."""
+        snap = session.event_snapshot(['tasks'])
+        session.publish_event('tasks')          # lands "mid-check"
+        t0 = time.monotonic()
+        assert session.wait_event(['tasks'], 5.0, snapshot=snap) is True
+        assert time.monotonic() - t0 < 1.0
+
+    def test_enqueue_wakes_queue_channel_only(self, session):
+        q = QueueProvider(session)
+        snap = session.event_snapshot(['queue:a', 'queue:b'])
+        q.enqueue('b', {'action': 'execute', 'task_id': 1})
+        assert session.wait_event(['queue:a'], 0.05) is False
+        assert session.wait_event(['queue:b'], 0.05,
+                                  snapshot={'queue:b':
+                                            snap['queue:b']}) is True
+
+    def test_completion_wakes_queue_done(self, session):
+        q = QueueProvider(session)
+        m = q.enqueue('c', {'action': 'execute', 'task_id': 1})
+        q.claim(['c'], 'w1')
+        snap = session.event_snapshot(['queue:done'])
+        q.complete(m, worker='w1')
+        assert session.wait_event(['queue:done'], 0.05,
+                                  snapshot=snap) is True
+
+
+class TestBatchedQueueOps:
+    def test_claim_many_orders_and_bounds(self, session):
+        q = QueueProvider(session)
+        q.enqueue_many([('bq', {'action': 'execute', 'task_id': i})
+                        for i in range(5)])
+        claims = q.claim_many(['bq'], 'w1', 3)
+        assert [c[1]['task_id'] for c in claims] == [0, 1, 2]
+        assert len(q.claim_many(['bq'], 'w2', 10)) == 2
+
+    def test_claim_many_fallback_parity(self, session, monkeypatch):
+        """The sqlite<3.35 SELECT+conditional-UPDATE loop must hand a
+        batch the same at-most-once set the RETURNING path does."""
+        import mlcomp_tpu.db.providers.queue as qmod
+        monkeypatch.setattr(qmod, '_RETURNING_OK', False)
+        q = QueueProvider(session)
+        q.enqueue_many([('fq', {'action': 'execute', 'task_id': i})
+                        for i in range(6)])
+        a = q.claim_many(['fq'], 'w1', 4)
+        b = q.claim_many(['fq'], 'w2', 4)
+        assert len(a) == 4 and len(b) == 2
+        assert {m for m, _ in a} & {m for m, _ in b} == set()
+
+    def test_enqueue_many_spans_queues(self, session):
+        q = QueueProvider(session)
+        q.enqueue_many([(f'mq{i % 2}', {'action': 'execute',
+                                        'task_id': i})
+                        for i in range(4)])
+        assert len(q.pending('mq0')) == 2
+        assert len(q.pending('mq1')) == 2
+
+
+class TestIndexAudit:
+    def test_claim_query_stays_indexed(self, session):
+        """EXPLAIN gate for the dispatch hot path: the claim candidate
+        scan must ride migration v11's composite
+        ``queue_message(status, queue, id)`` index — a schema change
+        that silently deoptimizes it fails here, not in production."""
+        plan = session.explain(
+            "SELECT id FROM queue_message WHERE queue IN (?, ?) "
+            "AND status='pending' ORDER BY id LIMIT 1", ('a', 'b'))
+        assert 'idx_queue_message_claim' in plan
+
+    def test_lease_sweep_stays_indexed(self, session):
+        plan = session.explain(
+            "SELECT * FROM queue_message WHERE status='claimed' "
+            "AND claimed_at IS NOT NULL AND claimed_at < ? "
+            "ORDER BY id", ('2026-01-01 00:00:00.000000',))
+        assert 'idx_queue_message_lease' in plan
+
+    def test_retry_scan_stays_indexed(self, session):
+        plan = session.explain(
+            'SELECT * FROM task WHERE status=? AND parent IS NULL',
+            (int(TaskStatus.Failed),))
+        assert 'idx_task_status_retry' in plan
+
+
+class TestEventDrivenSupervisor:
+    def test_submit_dispatches_without_tick(self, session, dag_id):  # noqa: F811
+        """The acceptance scenario at unit scale: with the timer
+        backstop parked far away (30 s), a task submitted while the
+        loop sleeps must still dispatch promptly — the ``tasks``
+        event, not the tick, triggers the build."""
+        add_computer(session)
+        builder = SupervisorBuilder(session)
+        loop = SupervisorLoop(builder, interval=30.0)
+        loop.start()
+        try:
+            time.sleep(0.3)             # loop is parked on the bus now
+            task = add_task(session, dag_id, name='noop_exec')
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                got = TaskProvider(session).by_id(task.id)
+                if got.status == int(TaskStatus.Queued):
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail('submit was not dispatched until the '
+                            'backstop — event wakeup lost')
+            assert loop.wake_events >= 1
+        finally:
+            loop.stop()
+            loop.join(timeout=5)
+
+    def test_dispatch_uses_tick_pending_index(self, session, dag_id):  # noqa: F811
+        """Inside a tick the restart-idempotency lookup is answered
+        from the per-tick set query, and an already-pending execute
+        message is reused instead of duplicated."""
+        add_computer(session)
+        builder = SupervisorBuilder(session)
+        task = add_task(session, dag_id, name='noop_exec')
+        queue = 'host1_default'
+        payload = {'action': 'execute', 'task_id': task.id}
+        existing = QueueProvider(session).enqueue(queue, payload)
+        builder.build()
+        got = TaskProvider(session).by_id(task.id)
+        assert got.status == int(TaskStatus.Queued)
+        assert got.queue_id == existing     # reused, not re-enqueued
+        same_payload = [m for m in QueueProvider(session).pending(queue)
+                        if json.loads(m.payload) == payload]
+        assert [m.id for m in same_payload] == [existing]
+
+    def test_loop_backstop_still_ticks(self, session):
+        builder = SupervisorBuilder(session)
+        loop = SupervisorLoop(builder, interval=0.05)
+        loop.start()
+        try:
+            time.sleep(0.5)
+            assert loop.wake_timer >= 2     # clock-driven work ran
+        finally:
+            loop.stop()
+            loop.join(timeout=5)
+
+
+class TestBusyRetryObservability:
+    def test_retry_and_giveup_are_counted(self, session, monkeypatch):
+        import sqlite3
+
+        from mlcomp_tpu.db import core
+        before = core.busy_retry_stats()
+        calls = {'n': 0}
+
+        def flaky():
+            calls['n'] += 1
+            if calls['n'] == 1:
+                raise sqlite3.OperationalError('database is locked')
+            return 'ok'
+
+        monkeypatch.setattr(core, '_BUSY_BASE_SLEEP_S', 0.0)
+        assert session._retry_busy(flaky) == 'ok'
+        after = core.busy_retry_stats()
+        assert after['retries'] == before['retries'] + 1
+
+        def always_locked():
+            raise sqlite3.OperationalError('database is locked')
+
+        with pytest.raises(sqlite3.OperationalError):
+            session._retry_busy(always_locked)
+        assert core.busy_retry_stats()['gave_up'] == \
+            before['gave_up'] + 1
+
+    def test_supervisor_tick_flushes_delta_series(self, session,
+                                                  monkeypatch):
+        from mlcomp_tpu.db import core
+        builder = SupervisorBuilder(session)
+        monkeypatch.setattr(
+            core, 'busy_retry_stats',
+            lambda: {'retries': builder._busy_seen['retries'] + 3,
+                     'gave_up': builder._busy_seen['gave_up']})
+        builder.aux = {'duration': 0.001}
+        builder.record_tick_telemetry()
+        builder.telemetry.flush()
+        row = session.query_one(
+            "SELECT SUM(value) AS total FROM metric "
+            "WHERE name='db.busy_retries'")
+        assert float(row['total']) == 3.0
+
+    def test_metrics_family_renders(self, session):
+        from mlcomp_tpu.telemetry.export import (
+            parse_openmetrics, render_server_metrics,
+        )
+        families = parse_openmetrics(render_server_metrics(session))
+        samples = families['mlcomp_db_busy_retries']['samples']
+        kinds = {labels['kind'] for _name, labels, _v in samples}
+        assert kinds == {'retry', 'gave_up'}
+
+
+class TestWorkerBackoff:
+    def test_exponential_and_bounded(self):
+        from mlcomp_tpu.worker.__main__ import (
+            ERROR_BACKOFF_MAX_S, _error_backoff_delay,
+        )
+        assert _error_backoff_delay(1) == 1.0
+        assert _error_backoff_delay(2) == 2.0
+        assert _error_backoff_delay(4) == 8.0
+        assert _error_backoff_delay(50) == ERROR_BACKOFF_MAX_S
+
+    def test_idle_wait_uses_poll_interval_on_sqlite(self, session,
+                                                    monkeypatch):
+        """Plain sqlite multi-process cannot deliver cross-process
+        wakeups — the idle wait must keep the short-poll timeout (the
+        fallback row of the docs/control_plane.md matrix)."""
+        from mlcomp_tpu.worker import __main__ as wmod
+        seen = {}
+
+        def spy_wait(channels, timeout, snapshot=None):
+            seen['timeout'] = timeout
+            return False
+
+        monkeypatch.setattr(session, 'wait_event', spy_wait)
+        wmod._idle_wait(session, 0)
+        assert seen['timeout'] == wmod.QUEUE_POLL_INTERVAL
+
+        monkeypatch.setattr(type(session), 'events_cross_process',
+                            True)
+        wmod._idle_wait(session, 0)
+        assert seen['timeout'] == wmod.EVENT_WAIT_BACKSTOP_S

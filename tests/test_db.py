@@ -224,34 +224,35 @@ class TestQueueReturningFallback:
 
     def test_fallback_skips_raced_away_candidate(self, session,
                                                  monkeypatch):
-        """Two pollers SELECT the same oldest pending id; the loser's
-        conditional UPDATE hits rowcount 0 and must move on to the
-        next message instead of returning a message someone else
-        owns."""
+        """Two pollers SELECT the same oldest pending candidate; the
+        loser's conditional UPDATE claims fewer rows than it selected
+        and must move on to the next message instead of returning a
+        message someone else owns."""
         import mlcomp_tpu.db.providers.queue as qmod
         monkeypatch.setattr(qmod, '_RETURNING_OK', False)
         q = QueueProvider(session)
         m1 = q.enqueue('rq', {'action': 'execute', 'task_id': 1})
         m2 = q.enqueue('rq', {'action': 'execute', 'task_id': 2})
 
-        real_query_one = type(session).query_one
+        real_query = type(session).query
         stolen = {'done': False}
 
         def steal_between_select_and_update(self_s, sql, params=()):
-            row = real_query_one(self_s, sql, params)
-            if not stolen['done'] and row is not None \
-                    and 'queue_message' in sql and 'pending' in sql:
+            rows = real_query(self_s, sql, params)
+            if not stolen['done'] and rows \
+                    and 'queue_message' in sql and 'pending' in sql \
+                    and 'ORDER BY id' in sql:
                 stolen['done'] = True
                 # another worker wins the candidate mid-flight
                 session.execute(
                     "UPDATE queue_message SET status='claimed', "
-                    "claimed_by='rival' WHERE id=?", (row['id'],))
-            return row
+                    "claimed_by='rival' WHERE id=?", (rows[0]['id'],))
+            return rows
 
-        monkeypatch.setattr(type(session), 'query_one',
+        monkeypatch.setattr(type(session), 'query',
                             steal_between_select_and_update)
         claimed = q.claim(['rq'], 'slow-worker')
-        monkeypatch.setattr(type(session), 'query_one', real_query_one)
+        monkeypatch.setattr(type(session), 'query', real_query)
         assert claimed is not None
         assert claimed[0] == m2          # m1 was stolen — moved on
         assert q.status(m1) == 'claimed'

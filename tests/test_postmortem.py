@@ -182,7 +182,7 @@ class TestMigrationV10:
         on the next migrate; the flight recorder works immediately."""
         from mlcomp_tpu.db.migration import migrate
         session.execute('DROP TABLE postmortem')
-        session.execute('DELETE FROM migration_version WHERE version=10')
+        session.execute('DELETE FROM migration_version WHERE version>=10')
         with pytest.raises(Exception):
             session.query('SELECT * FROM postmortem')
         migrate(session)
