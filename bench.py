@@ -1109,7 +1109,9 @@ def bench_dispatch() -> dict:
         return {k: legs[k] for k in
                 ('control_plane_tasks_per_s', 'queue_drain_p99_ms',
                  'dispatch_p50_ms', 'dispatch_p99_ms', 'load_tasks',
-                 'load_slots') if k in legs}
+                 'load_slots', 'supervisor_failover_s',
+                 'supervisor_release_failover_ms', 'failover_lease_s')
+                if k in legs}
     except Exception as e:
         return {'dispatch_error': f'{type(e).__name__}: {e}'[:300]}
     finally:

@@ -27,6 +27,9 @@
   compile series, run snapshot, compiled-step memory attribution,
   collective tally and alerts, frozen at the failure; ``--json`` for
   scripts, ``--live`` to assemble from current telemetry
+- ``mlcomp_tpu supervisors``    — supervisor HA roster (server/ha.py):
+  who holds the leader lease, at which fencing epoch, until when, and
+  every live standby; ``--json`` for scripts
 - ``mlcomp_tpu fleets``         — serving-fleet state (server/fleet.py):
   per fleet, the active generation and model, desired vs healthy
   replica counts, the replica roster with endpoints/states/respawn
@@ -511,6 +514,74 @@ def postmortem(task, as_json, live):
     for a in bundle.get('alerts') or []:
         flag = '!' if a.get('severity') == 'critical' else '~'
         click.echo(f'  {flag} [{a.get("rule")}] {a.get("message")}')
+
+
+@main.command()
+@click.option('--json', 'as_json', is_flag=True,
+              help='machine-readable output')
+@click.option('--stale-after', type=float, default=30.0,
+              help='seconds of roster silence before an instance '
+                   'reads as stale')
+def supervisors(as_json, stale_after):
+    """Supervisor HA roster (server/ha.py): who holds the leader
+    lease, at which fencing epoch and until when, plus every
+    supervisor instance (leader or hot standby) that heartbeated the
+    roster — the `kubectl get nodes` of the control plane's brain."""
+    from mlcomp_tpu.db.core import parse_datetime
+    from mlcomp_tpu.db.providers import SupervisorLeaseProvider
+    from mlcomp_tpu.utils.misc import now
+    session = Session.create_session()
+    migrate(session)
+    provider = SupervisorLeaseProvider(session)
+    lease = provider.current()
+    now_dt = now()
+    expires = parse_datetime(lease.expires_at) if lease else None
+    lease_live = bool(lease and lease.holder and expires is not None
+                      and expires > now_dt)
+    instances = []
+    for inst in provider.instances():
+        last = parse_datetime(inst.last_seen)
+        age = (now_dt - last).total_seconds() if last else None
+        instances.append({
+            'holder': inst.holder,
+            'computer': inst.computer,
+            'pid': inst.pid,
+            'role': 'leader' if lease_live
+            and inst.holder == lease.holder else (inst.role or '?'),
+            'epoch': inst.epoch or 0,
+            'last_seen': str(inst.last_seen or ''),
+            'stale': bool(age is None or age > stale_after),
+        })
+    payload = {
+        'leader': lease.holder if lease_live else None,
+        'epoch': (lease.epoch or 0) if lease else 0,
+        'expires_at': str(lease.expires_at or '') if lease else '',
+        'lease_live': lease_live,
+        'instances': instances,
+    }
+    if as_json:
+        click.echo(json.dumps(payload))
+        return
+    if lease is None:
+        click.echo('no supervisor lease (run a supervisor once to '
+                   'seed it)')
+        return
+    if lease_live:
+        remain = (expires - now_dt).total_seconds()
+        click.echo(f'leader: {lease.holder} (epoch {lease.epoch}, '
+                   f'lease expires in {remain:.1f}s)')
+    else:
+        click.echo(f'leader: none (lease vacant/expired; last epoch '
+                   f'{(lease.epoch or 0)})')
+    if not instances:
+        click.echo('no supervisor instances on the roster')
+        return
+    for it in instances:
+        mark = '*' if payload['leader'] == it['holder'] else ' '
+        stale = ' [stale]' if it['stale'] else ''
+        click.echo(f"{mark} {it['holder']} [{it['role']}] "
+                   f"epoch {it['epoch']} on {it['computer']}"
+                   f" — last seen {it['last_seen']}{stale}")
 
 
 @main.command()

@@ -45,6 +45,27 @@ import threading
 CH_QUEUE_PREFIX = 'queue:'
 CH_QUEUE_DONE = 'queue:done'
 CH_TASKS = 'tasks'
+#: supervisor leader election: published on explicit lease release so
+#: hot standbys promote instantly (db/providers/supervisor.py)
+CH_SUPERVISOR_LEASE = 'supervisor:lease'
+
+#: cross-process listener health (the Postgres LISTEN daemon,
+#: db/postgres.py): reconnect events counted here feed the
+#: ``db.listener_reconnects`` series the supervisor samples per tick —
+#: a flapping listener connection must not degrade silently.
+_LISTENER_STATS_LOCK = threading.Lock()
+_LISTENER_STATS = {'reconnects': 0}
+
+
+def listener_stats() -> dict:
+    """Snapshot of this process's listener reconnect counter."""
+    with _LISTENER_STATS_LOCK:
+        return dict(_LISTENER_STATS)
+
+
+def record_listener_reconnect():
+    with _LISTENER_STATS_LOCK:
+        _LISTENER_STATS['reconnects'] += 1
 
 
 def queue_channel(queue: str) -> str:
@@ -111,4 +132,5 @@ def snapshot(channels) -> dict:
 
 __all__ = ['LocalEventBus', 'LOCAL_BUS', 'publish', 'wait', 'snapshot',
            'queue_channel', 'CH_QUEUE_PREFIX', 'CH_QUEUE_DONE',
-           'CH_TASKS']
+           'CH_TASKS', 'CH_SUPERVISOR_LEASE', 'listener_stats',
+           'record_listener_reconnect']

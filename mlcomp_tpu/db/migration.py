@@ -328,9 +328,30 @@ def _v11_dispatch_indexes(session: Session):
         session.execute('DROP INDEX IF EXISTS idx_task_status')
 
 
+def _v12_supervisor_ha(session: Session):
+    """Supervisor high availability: the ``supervisor_lease`` leader-
+    election singleton (holder / fencing epoch / expiry) plus the
+    ``supervisor_instance`` roster (db/models/supervisor.py). The
+    lease row is SEEDED here (id=1, vacant, epoch 0) so acquisition is
+    always one conditional UPDATE — never an INSERT race between two
+    booting supervisors. CREATE IF NOT EXISTS is safe on a fresh DB
+    whose _v1 already made the tables; the seed is guarded the same
+    way."""
+    from mlcomp_tpu.db.models import SupervisorInstance, SupervisorLease
+    for model in (SupervisorLease, SupervisorInstance):
+        for stmt in model.create_table_ddl(_dialect(session)):
+            session.execute(stmt)           # IF NOT EXISTS — safe
+    row = session.query_one(
+        'SELECT id FROM supervisor_lease WHERE id=1')
+    if row is None:
+        session.execute(
+            'INSERT INTO supervisor_lease (id, holder, epoch) '
+            'VALUES (1, NULL, 0)')
+
+
 MIGRATIONS = [_v1_init, _v2_data, _v3_auth, _v4_telemetry, _v5_preflight,
               _v6_tracing_alerts, _v7_recovery, _v8_gang, _v9_fleet,
-              _v10_postmortem, _v11_dispatch_indexes]
+              _v10_postmortem, _v11_dispatch_indexes, _v12_supervisor_ha]
 
 
 def migrate(session: Session = None):

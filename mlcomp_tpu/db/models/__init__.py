@@ -19,6 +19,9 @@ from mlcomp_tpu.db.models.telemetry import (
     Alert, Metric, Postmortem, TelemetrySpan,
 )
 from mlcomp_tpu.db.models.fleet import ServeFleet, ServeReplica
+from mlcomp_tpu.db.models.supervisor import (
+    SupervisorInstance, SupervisorLease,
+)
 
 ALL_MODELS = [
     Project, Report, ReportLayout, Dag, Task, TaskDependence, TaskSynced,
@@ -27,6 +30,7 @@ ALL_MODELS = [
     WorkerToken, DbAudit, Metric, TelemetrySpan, DagPreflight, Alert,
     Postmortem,
     ServeFleet, ServeReplica,
+    SupervisorLease, SupervisorInstance,
 ]
 
 __all__ = [m.__name__ for m in ALL_MODELS] + ['ALL_MODELS']

@@ -87,11 +87,18 @@ class PostgresSession:
     driver."""
 
     dialect = 'postgresql'
-    events_cross_process = True
 
     def __init__(self, connection_string, key):
         self.key = key
         self.connection_string = connection_string
+        # listener health: True while no listener is needed yet OR the
+        # LISTEN connection is live; False from the moment a listener
+        # loses its connection until the re-LISTEN round trip
+        # succeeds. events_cross_process (the property below) reads
+        # it, so waiters fall back to their short-poll backstop while
+        # wakeups cannot actually be delivered instead of parking on
+        # a dead socket's promise.
+        self._listener_ok = True
         # thread ident -> (thread object, connection). Ident-keyed —
         # NOT threading.local — so dead threads' connections can be
         # REAPED: the API server is thread-per-request, and a pool
@@ -104,9 +111,22 @@ class PostgresSession:
         self._listener = None
         self._listener_lock = threading.Lock()
         self._closed = False
+        # per-thread open-transaction depth for atomic() — statements
+        # inside the block defer their commit to the block's end
+        self._txn_local = threading.local()
         # fail fast on a bad DSN — create_session must not cache a
         # session that can never connect
         self._conn()
+
+    @property
+    def events_cross_process(self) -> bool:
+        """Whether a publish from ANOTHER process can wake this one —
+        i.e. whether the LISTEN daemon's connection is live. Waiters
+        size their timeout off this per wait (worker/__main__.py
+        ``_idle_wait``), so a dropped listener connection downgrades
+        them to the poll backstop until the reconnect succeeds rather
+        than leaving them parked on a wakeup that can never arrive."""
+        return self._listener_ok
 
     # --------------------------------------------------------- connections
     def _connect(self, **kwargs):
@@ -155,6 +175,42 @@ class PostgresSession:
                 conn.close()
             except Exception:
                 pass
+
+    # -------------------------------------------------------- transactions
+    def _txn_depth(self) -> int:
+        return getattr(self._txn_local, 'depth', 0)
+
+    def atomic(self):
+        """Group this THREAD's statements into one transaction —
+        the crash-consistent dispatch pair (enqueue message + pair it
+        to the task) commits or rolls back as a unit, so a supervisor
+        crash between the halves cannot strand a half-dispatch on this
+        backend. Reentrant (depth-counted); per-statement deadlock
+        retry is disabled inside the block (a retry would replay into
+        a transaction whose earlier statements the rollback discarded
+        — the caller owns the whole unit)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _txn():
+            conn = self._conn()
+            depth = self._txn_depth()
+            self._txn_local.depth = depth + 1
+            try:
+                yield self
+            except BaseException:
+                self._txn_local.depth = depth
+                if depth == 0:
+                    try:
+                        conn.rollback()
+                    except Exception:
+                        pass
+                raise
+            else:
+                self._txn_local.depth = depth
+                if depth == 0:
+                    conn.commit()
+        return _txn()
 
     # ----------------------------------------------------------- statements
     def _is_deadlock(self, e) -> bool:
@@ -209,6 +265,7 @@ class PostgresSession:
 
         def op():
             conn = self._conn()
+            in_txn = self._txn_depth() > 0
             try:
                 fault_point('db.execute', sql=sql)  # chaos: outage
                 cur = conn.execute(sql, params)
@@ -218,13 +275,18 @@ class PostgresSession:
                     result = _Result([], lastrowid, cur.rowcount)
                 else:
                     result = _Result(rows, None, cur.rowcount)
-                conn.commit()
+                if not in_txn:
+                    conn.commit()
                 return result
             except Exception:
-                conn.rollback()
+                if not in_txn:
+                    conn.rollback()
                 raise
 
-        return self._retry_deadlock(op)
+        # inside atomic(): no per-statement retry (the block owns
+        # commit/rollback) — errors surface to the block
+        return op() if self._txn_depth() > 0 \
+            else self._retry_deadlock(op)
 
     def executemany(self, sql, seq):
         sql = translate_sql(sql)
@@ -232,31 +294,40 @@ class PostgresSession:
 
         def op():
             conn = self._conn()
+            in_txn = self._txn_depth() > 0
             try:
                 fault_point('db.execute', sql=sql)  # chaos: outage
                 with conn.cursor() as cur:
                     cur.executemany(sql, seq)
                     result = _Result([], None, cur.rowcount)
-                conn.commit()
+                if not in_txn:
+                    conn.commit()
                 return result
             except Exception:
-                conn.rollback()
+                if not in_txn:
+                    conn.rollback()
                 raise
 
-        return self._retry_deadlock(op)
+        return op() if self._txn_depth() > 0 \
+            else self._retry_deadlock(op)
 
     def query(self, sql, params=()):
         sql = translate_sql(sql)
         params = tuple(adapt_value(p) for p in params)
         conn = self._conn()
+        in_txn = self._txn_depth() > 0
         try:
             rows = conn.execute(sql, params).fetchall()
             # release the snapshot: a read left open would hold back
-            # vacuum and make this thread's NEXT write a long txn
-            conn.commit()
+            # vacuum and make this thread's NEXT write a long txn.
+            # Inside atomic() the block owns the commit — a read must
+            # not commit the half-open transaction under the caller.
+            if not in_txn:
+                conn.commit()
             return rows
         except Exception:
-            conn.rollback()
+            if not in_txn:
+                conn.rollback()
             raise
 
     def query_one(self, sql, params=()):
@@ -286,21 +357,25 @@ class PostgresSession:
 
         def op():
             conn = self._conn()
+            in_txn = self._txn_depth() > 0
             try:
                 cur = conn.execute(sql, vals)
                 if assign_id:
                     obj.id = cur.fetchone()['id']
-                if commit:
+                if commit and not in_txn:
                     conn.commit()
                 return obj
             except Exception:
-                conn.rollback()
+                if not in_txn:
+                    conn.rollback()
                 raise
 
         # commit=False rides a caller-managed batch (add_all) on THIS
-        # thread's connection; a deadlock retry there would replay into
-        # a rolled-back transaction, so only self-committing adds retry
-        return self._retry_deadlock(op) if commit else op()
+        # thread's connection — and so does any statement inside
+        # atomic(); a deadlock retry there would replay into a
+        # rolled-back transaction, so only self-committing adds retry
+        return self._retry_deadlock(op) \
+            if commit and self._txn_depth() == 0 else op()
 
     def add_all(self, objs):
         for o in objs:
@@ -377,11 +452,13 @@ class PostgresSession:
         from mlcomp_tpu.db import events
         psycopg = _psycopg()
         delay = 1.0
+        ever_listened = False
         while not self._closed:
             try:
                 conn = psycopg.connect(self.connection_string,
                                        autocommit=True)
             except Exception:
+                self._listener_ok = False
                 time.sleep(delay)
                 delay = min(30.0, delay * 2)
                 continue
@@ -391,7 +468,15 @@ class PostgresSession:
                 # server known healthy enough to reset the backoff (a
                 # failover window where connect() succeeds but the
                 # first statement dies must keep backing off, not
-                # hammer a connect/fail cycle)
+                # hammer a connect/fail cycle). A RE-establishment
+                # (not the first) is a reconnect event: counted into
+                # db.listener_reconnects so a flapping bus is visible
+                # on /metrics instead of silently costing waiters
+                # their wakeups.
+                if ever_listened:
+                    events.record_listener_reconnect()
+                ever_listened = True
+                self._listener_ok = True
                 delay = 1.0
                 while not self._closed:
                     ready, _, _ = select.select([conn.fileno()], [], [],
@@ -408,6 +493,10 @@ class PostgresSession:
                         if channel:
                             events.publish(channel)
             except Exception:
+                # the LISTEN connection died: report the bus down so
+                # waiters fall back to polling, then retry with the
+                # bounded exponential backoff (1 s -> 30 s cap)
+                self._listener_ok = False
                 time.sleep(delay)
                 delay = min(30.0, delay * 2)
             finally:

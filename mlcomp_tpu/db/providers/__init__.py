@@ -27,9 +27,10 @@ from mlcomp_tpu.db.providers.telemetry import (
     TelemetrySpanProvider,
 )
 from mlcomp_tpu.db.providers.fleet import FleetProvider, ReplicaProvider
+from mlcomp_tpu.db.providers.supervisor import SupervisorLeaseProvider
 
 __all__ = [
-    'FleetProvider', 'ReplicaProvider',
+    'FleetProvider', 'ReplicaProvider', 'SupervisorLeaseProvider',
     'WorkerTokenProvider', 'DbAuditProvider', 'AlertProvider',
     'MetricProvider', 'TelemetrySpanProvider', 'PostmortemProvider',
     'DagPreflightProvider',

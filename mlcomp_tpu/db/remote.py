@@ -21,10 +21,30 @@ per-task/per-epoch write rates; bulk work stays on the data plane.
 import base64
 import datetime
 import json
+import os
+import socket
+import time
+import urllib.error
 import urllib.request
 from typing import Optional
 
 from mlcomp_tpu.db.core import _Result, adapt_value
+
+#: client-side request timeout (seconds). Without one, a hung API
+#: server — accepting connections but never answering — hangs every
+#: worker's control-plane call FOREVER (no exception, no retry, no
+#: io-error classification: the task just stalls until the watchdog
+#: kills it). Overridable per deployment via the env.
+DEFAULT_TIMEOUT_S = float(os.environ.get(
+    'MLCOMP_REMOTE_DB_TIMEOUT_S', '30'))
+
+#: bounded retry on CONNECTION-LEVEL failures (refused / DNS / reset
+#: before any byte of response). Deliberately narrow: a timeout or a
+#: mid-response death is AMBIGUOUS for a write (the statement may have
+#: executed server-side), so those surface immediately and classify
+#: through the io-error taxonomy instead of risking a double-apply.
+_CONNECT_RETRIES = 3
+_CONNECT_BASE_SLEEP_S = 0.2
 
 
 def encode_value(v):
@@ -65,7 +85,8 @@ class RemoteSession:
     events_cross_process = False
 
     def __init__(self, url: str, key: str = 'default',
-                 token: Optional[str] = None, timeout: float = 30.0):
+                 token: Optional[str] = None,
+                 timeout: float = DEFAULT_TIMEOUT_S):
         self.key = key
         self.connection_string = url
         self.base = url.rstrip('/')
@@ -78,6 +99,23 @@ class RemoteSession:
         self.timeout = timeout
 
     # ------------------------------------------------------------- plumbing
+    @staticmethod
+    def _is_connect_error(e) -> bool:
+        """True only for failures where the request provably never
+        reached the server (safe to retry even for writes): a refused
+        or unreachable connection, DNS failure, or a reset during
+        connection setup. urllib wraps these as URLError whose
+        ``reason`` is the underlying OSError."""
+        if isinstance(e, urllib.error.HTTPError):
+            return False        # the server answered — not retryable here
+        if isinstance(e, urllib.error.URLError):
+            reason = getattr(e, 'reason', None)
+            return isinstance(reason, (ConnectionRefusedError,
+                                       ConnectionResetError,
+                                       ConnectionAbortedError,
+                                       socket.gaierror))
+        return isinstance(e, ConnectionRefusedError)
+
     def _post(self, payload: dict) -> dict:
         req = urllib.request.Request(
             f'{self.base}/api/db',
@@ -86,9 +124,22 @@ class RemoteSession:
                      'Authorization': self.token},
             method='POST')
         try:
-            with urllib.request.urlopen(req,
-                                        timeout=self.timeout) as resp:
-                out = json.loads(resp.read())
+            for attempt in range(_CONNECT_RETRIES + 1):
+                try:
+                    with urllib.request.urlopen(
+                            req, timeout=self.timeout) as resp:
+                        out = json.loads(resp.read())
+                    break
+                except Exception as e:
+                    # bounded backoff on connection-level failures only
+                    # (the request never reached the server — no
+                    # double-apply risk); everything else surfaces now
+                    # and classifies io-error through the taxonomy's
+                    # OSError family
+                    if attempt >= _CONNECT_RETRIES or \
+                            not self._is_connect_error(e):
+                        raise
+                    time.sleep(_CONNECT_BASE_SLEEP_S * (2 ** attempt))
         except urllib.error.HTTPError as e:
             # surface the server's reason for ANY error status — the
             # 403 default-token gate's guidance in particular must

@@ -1352,7 +1352,26 @@ def start_server(host: str = None, port: int = None, logger=None,
     migrate(session)
     if with_supervisor:
         from mlcomp_tpu.server.supervisor import register_supervisor
-        register_supervisor(logger=logger)
+        _builder, jobs = register_supervisor(logger=logger)
+        # graceful supervisor shutdown: SIGTERM releases the leader
+        # lease in the SAME tick (SupervisorLoop.stop → explicit lease
+        # drop + event publish), so a rolling restart's hot standby
+        # promotes in milliseconds instead of waiting out a full lease
+        # window. Signal handlers only install from the main thread —
+        # a background start_server keeps the expiry backstop.
+        import signal as _signal
+
+        def _graceful(_signum, _frame):
+            for job in jobs:
+                try:
+                    job.stop()
+                except Exception:
+                    pass
+            raise SystemExit(0)
+        try:
+            _signal.signal(_signal.SIGTERM, _graceful)
+        except ValueError:
+            pass
     server = ApiServer(host=host, port=port, logger=logger)
     if background:
         return server.start_background()

@@ -217,9 +217,15 @@ class FleetReconciler:
         contained per fleet — the serving control loop must never take
         the scheduling tick down."""
         self.aux = {}
+        from mlcomp_tpu.db.fencing import FenceLostError
         for fleet in self.fleets.active():
             try:
                 self._reconcile(fleet)
+            except FenceLostError:
+                # not a sick fleet — a NEWER SUPERVISOR LEADER exists
+                # and the store rejected this zombie's write: stop the
+                # whole tick so the HA loop demotes (db/fencing.py)
+                raise
             except Exception:
                 if self.logger:
                     self.logger.error(

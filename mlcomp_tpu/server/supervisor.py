@@ -22,11 +22,14 @@ Celery/Redis; queue naming keeps the reference scheme
 ``{computer}_{docker}`` (worker/__main__.py:130-144).
 """
 
+import contextlib
 import json
 import threading
 import traceback
 from mlcomp_tpu import MASTER_PORT_RANGE
 from mlcomp_tpu.db.core import Session
+from mlcomp_tpu.db.fencing import FenceLostError
+from mlcomp_tpu.testing.faults import fault_point
 from mlcomp_tpu.db.enums import ComponentType, TaskStatus, TaskType
 from mlcomp_tpu.db.models import Task
 from mlcomp_tpu.db.providers import (
@@ -41,9 +44,24 @@ class SupervisorBuilder:
     def __init__(self, session: Session = None, logger=None,
                  queue_liveness_window: float = 15.0,
                  recovery_config=None, fleet_config=None,
-                 fleet_probe=None):
+                 fleet_probe=None, lease=None):
         from mlcomp_tpu.recovery import RecoveryConfig
-        self.session = session or Session.create_session(key='supervisor')
+        session = session or Session.create_session(key='supervisor')
+        # HA mode (server/ha.py): with a LeaderLease handle, every
+        # control-state mutation this builder issues — dispatch,
+        # requeue, kill, fleet reconcile — rides a FencedSession that
+        # stamps the leader's epoch into the statement, so a zombie
+        # ex-leader resuming after a pause has its writes rejected in
+        # the DB instead of double-dispatching (db/fencing.py). The
+        # RAW session is kept for the heal path and the lease protocol
+        # itself.
+        from mlcomp_tpu.db.fencing import FencedSession
+        if isinstance(session, FencedSession):    # heal-path re-init
+            session = session._session
+        self.raw_session = session
+        self.lease = lease
+        self.session = FencedSession(session, lease) \
+            if lease is not None else session
         self.logger = logger
         self.queue_liveness_window = queue_liveness_window
         self.recovery_config = recovery_config or RecoveryConfig()
@@ -95,6 +113,10 @@ class SupervisorBuilder:
         # contention must not degrade silently)
         from mlcomp_tpu.db.core import busy_retry_stats
         self._busy_seen = busy_retry_stats()
+        from mlcomp_tpu.db.events import listener_stats
+        self._listener_seen = listener_stats()
+        from mlcomp_tpu.db.fencing import fence_rejections
+        self._fence_seen = fence_rejections()
 
     # ----------------------------------------------------------- base state
     def create_base(self):
@@ -198,6 +220,8 @@ class SupervisorBuilder:
                 kill_task(child.id, session=self.session)
                 if is_rank:
                     aborted.append(child.id)
+            except FenceLostError:
+                raise       # zombie leader: stop the tick, demote
             except Exception:
                 if self.logger:
                     self.logger.error(
@@ -376,32 +400,145 @@ class SupervisorBuilder:
         with span('supervisor.dispatch', task=task.id,
                   trace_id=trace_id, role='supervisor',
                   tags={'queue': queue, 'cores': len(cores)}):
-            if self._pending_execute is not None:
-                # tick path: the per-tick set query answers the COMMON
-                # case (no pre-existing message) with zero round
-                # trips. A HIT is the rare restart-recovery case and
-                # is re-validated through find_active: the snapshot
-                # was taken at tick start, and a same-process revoke
-                # landing mid-tick must not hand the task a dead
-                # message id.
-                msg_id = self._pending_execute.get(
-                    (queue, json.dumps(payload)))
-                if msg_id is not None:
+            # crash-consistent ORDER: (1) placement pre-stamped on the
+            # still-NotRan row, (2) the execute message goes out,
+            # (3) queue_id + the Queued transition pair them. On
+            # Postgres steps 2-3 ride ONE transaction (atomic()); on
+            # sqlite the ordered conditional writes leave exactly one
+            # torn shape — an assigned NotRan row next to a pending
+            # message — which ``reconcile_dispatches`` re-pairs (or
+            # rolls back) at the next leader's promotion, so a
+            # supervisor crash between the halves can never strand a
+            # task half-dispatched or double-dispatch it after
+            # failover.
+            self.provider.update(
+                task, ['computer_assigned', 'cores_assigned'])
+            txn = self.session.atomic() \
+                if getattr(self.session, 'dialect', '') == 'postgresql' \
+                and hasattr(self.session, 'atomic') \
+                else contextlib.nullcontext()
+            with txn:
+                if self._pending_execute is not None:
+                    # tick path: the per-tick set query answers the
+                    # COMMON case (no pre-existing message) with zero
+                    # round trips. A HIT is the rare restart-recovery
+                    # case and is re-validated through find_active:
+                    # the snapshot was taken at tick start, and a
+                    # same-process revoke landing mid-tick must not
+                    # hand the task a dead message id.
+                    msg_id = self._pending_execute.get(
+                        (queue, json.dumps(payload)))
+                    if msg_id is not None:
+                        msg_id = self.queue_provider.find_active(
+                            queue, payload)
+                else:
                     msg_id = self.queue_provider.find_active(
                         queue, payload)
-            else:
-                msg_id = self.queue_provider.find_active(queue, payload)
-            if msg_id is None:
-                msg_id = self.queue_provider.enqueue(queue, payload)
-            task.queue_id = msg_id
-            self.provider.update(
-                task, ['computer_assigned', 'cores_assigned', 'queue_id'])
-            self.provider.change_status(task, TaskStatus.Queued)
+                if msg_id is None:
+                    msg_id = self.queue_provider.enqueue(queue, payload)
+                # chaos seam: a leader SIGKILL'd here (between the two
+                # halves of the pair) is the torn dispatch the
+                # promotion sweep must repair exactly once
+                fault_point('supervisor.dispatch', task=task.id,
+                            queue=queue)
+                task.queue_id = msg_id
+                self.provider.update(task, ['queue_id'])
+                self.provider.change_status(task, TaskStatus.Queued)
         for core in cores:
             comp['cores'][core] = True
         comp['cpu'] -= task.cpu or 0
         comp['memory'] -= task.memory or 0
         return queue
+
+    def reconcile_dispatches(self) -> dict:
+        """The promotion sweep: repair half-dispatches a dead leader
+        left behind, exactly once, before the first tick of a new
+        epoch. Two torn shapes exist (dispatch order pins them):
+
+        - a PENDING execute message whose task never got its
+          ``queue_id``/Queued write (crash between the halves) — if
+          the task is still NotRan with its placement pre-stamped, the
+          pair is completed (**adopted**: queue_id set, Queued); a
+          message whose task moved on in any other way is **revoked**
+          (rolled back) so it can never execute twice;
+        - a QUEUED task whose message row is missing or revoked (a
+          rolled-back or purged half) — reset to NotRan so the normal
+          placement path re-dispatches it this tick.
+
+        Claimed/done/failed messages are deliberately untouched: the
+        lease-reclaim and strand sweeps own those lifecycles. Runs on
+        the FENCED session, so even the repair is epoch-guarded."""
+        out = {'adopted': [], 'revoked': [], 'requeued': []}
+        qp = self.queue_provider
+        rows = self.session.query(
+            "SELECT * FROM queue_message WHERE status='pending'")
+        from mlcomp_tpu.db.models import QueueMessage
+        for msg in [QueueMessage.from_row(r) for r in rows]:
+            try:
+                payload = json.loads(msg.payload)
+            except (TypeError, ValueError):
+                continue
+            if payload.get('action') != 'execute':
+                continue
+            task = self._message_task(msg)
+            if task is None:
+                qp.revoke(msg.id)
+                out['revoked'].append(msg.id)
+                continue
+            if task.status == int(TaskStatus.Queued) \
+                    and task.queue_id == msg.id:
+                continue        # consistent pair
+            if task.status == int(TaskStatus.NotRan) \
+                    and task.computer_assigned \
+                    and task.queue_id in (None, msg.id):
+                # the torn pair: message out, pairing write lost —
+                # complete it (the worker-side status guard accepts
+                # Queued, and the placement was already stamped)
+                task.queue_id = msg.id
+                self.provider.update(task, ['queue_id'])
+                self.provider.change_status(task, TaskStatus.Queued)
+                out['adopted'].append(
+                    {'task': task.id, 'msg': msg.id})
+            else:
+                # the task moved on without this message (requeued by
+                # a newer leader, finished, stopped...) — a live
+                # duplicate dispatch must not survive the failover
+                if qp.revoke(msg.id):
+                    out['revoked'].append(msg.id)
+        # Queued tasks whose dispatch message no longer exists in a
+        # deliverable state: re-place them through the normal path.
+        # One grouped read for ALL their messages — the sweep runs
+        # inside the promotion window the failover budget times, and a
+        # per-task round trip here would be the 1+N pattern the
+        # parent_tasks_stats collapse already evicted from the tick.
+        queued = [t for t in self.provider.by_status(TaskStatus.Queued)
+                  if t.queue_id is not None and t.parent is None]
+        msg_status = {}
+        if queued:
+            ids = sorted({t.queue_id for t in queued})
+            marks = ','.join('?' * len(ids))
+            msg_status = {r['id']: r['status'] for r in self.session.query(
+                f'SELECT id, status FROM queue_message '
+                f'WHERE id IN ({marks})', tuple(ids))}
+        for task in queued:
+            status = msg_status.get(task.queue_id)
+            if status in (None, 'revoked'):
+                task.queue_id = None
+                task.pid = None
+                self.provider.update(task, ['queue_id', 'pid'])
+                self.provider.change_status(task, TaskStatus.NotRan)
+                out['requeued'].append(task.id)
+        if any(out.values()):
+            self.aux.setdefault('dispatch_reconciled', out)
+            if self.logger:
+                self.logger.warning(
+                    f'promotion sweep repaired half-dispatches: '
+                    f'{sum(len(v) for v in out.values())} '
+                    f'(adopted={out["adopted"]}, '
+                    f'revoked={out["revoked"]}, '
+                    f'requeued={out["requeued"]})',
+                    ComponentType.Supervisor)
+        return out
 
     def create_service_task(self, task: Task, comp, cores,
                             distr_info: dict, index: int) -> Task:
@@ -620,6 +757,8 @@ class SupervisorBuilder:
         try:
             self._reclaim_leases()
             self._retry_failed()
+        except FenceLostError:
+            raise           # zombie leader: stop the tick, demote
         except Exception:
             if self.logger:
                 self.logger.error(
@@ -930,6 +1069,8 @@ class SupervisorBuilder:
             fleet_aux = self.fleet_reconciler.tick()
             if fleet_aux:
                 self.aux['fleets'] = fleet_aux
+        except FenceLostError:
+            raise           # zombie leader: stop the tick, demote
         except Exception:
             if self.logger:
                 self.logger.error(
@@ -1004,6 +1145,8 @@ class SupervisorBuilder:
                 continue
             try:
                 self.process_task(task)
+            except FenceLostError:
+                raise       # zombie leader: stop the tick, demote
             except Exception:
                 if self.logger:
                     self.logger.error(
@@ -1036,6 +1179,28 @@ class SupervisorBuilder:
             if delta > 0:
                 tel.count(series, delta)
         self._busy_seen = stats
+        # LISTEN/NOTIFY listener health (db/events.py): reconnect
+        # deltas feed db.listener_reconnects the same way — a flapping
+        # Postgres connection stops degrading dispatch latency
+        # silently (while down, waiters are on the poll backstop)
+        from mlcomp_tpu.db.events import listener_stats
+        lstats = listener_stats()
+        delta = lstats['reconnects'] - \
+            self._listener_seen.get('reconnects', 0)
+        if delta > 0:
+            tel.count('db.listener_reconnects', delta)
+        self._listener_seen = lstats
+        # fencing observability: rejected zombie writes are rare and
+        # each one is a failover story — surface every event
+        from mlcomp_tpu.db.fencing import fence_rejections
+        rejections = fence_rejections()
+        delta = rejections - self._fence_seen
+        if delta > 0:
+            tel.count('supervisor.fenced_writes', delta)
+        self._fence_seen = rejections
+        if self.lease is not None:
+            tel.gauge('supervisor.epoch',
+                      float(self.lease.epoch or 0))
         dispatched = self.aux.get('dispatched')
         if dispatched:
             tel.count('supervisor.dispatched', len(dispatched))
@@ -1111,6 +1276,8 @@ class SupervisorBuilder:
                         f'watchdog: {finding["message"]} — task marked '
                         f'Failed (alert {finding.get("alert_id")})',
                         ComponentType.Supervisor, None, task_id)
+            except FenceLostError:
+                raise       # zombie leader: stop the tick, demote
             except Exception:
                 if self.logger:
                     self.logger.error(
@@ -1144,6 +1311,8 @@ class SupervisorBuilder:
                     f'worker-lost, gang aborted (alert '
                     f'{finding.get("alert_id")})',
                     ComponentType.Supervisor, None, task_id)
+        except FenceLostError:
+            raise           # zombie leader: stop the tick, demote
         except Exception:
             if self.logger:
                 self.logger.error(
@@ -1173,6 +1342,12 @@ class SupervisorBuilder:
             # queue state (its documented contract: None outside a
             # tick)
             self._pending_execute = None
+        except FenceLostError:
+            # not a sick DB — a NEWER LEADER exists and the store
+            # rejected this zombie's write mid-tick. Re-raise so the
+            # HA loop demotes to standby instead of healing the
+            # session and retrying the same stale writes.
+            raise
         except Exception:
             # heal-by-recreating-session (reference supervisor.py:423-427)
             if self.logger:
@@ -1181,18 +1356,28 @@ class SupervisorBuilder:
                     ComponentType.Supervisor)
             # create_session is a keyed singleton — drop the cached
             # (possibly wedged) connection first so a FRESH one is built
-            Session.cleanup('supervisor')
-            self.session = Session.create_session(key='supervisor')
+            key = getattr(self.raw_session, 'key', 'supervisor')
+            Session.cleanup(key)
+            fresh = Session.create_session(key=key)
             if self.logger is not None:
                 # rebind the cached logger's DbHandler to the new session
                 # (the old handler would write to a closed connection)
                 from mlcomp_tpu.utils.logging import create_logger
-                self.logger = create_logger(self.session)
-            self.__init__(session=self.session, logger=self.logger,
+                self.logger = create_logger(fresh)
+            lease = self.lease
+            if lease is not None:
+                # the lease handle must follow the healed connection
+                lease.session = fresh
+                from mlcomp_tpu.db.providers.supervisor import (
+                    SupervisorLeaseProvider,
+                )
+                lease.provider = SupervisorLeaseProvider(fresh)
+            self.__init__(session=fresh, logger=self.logger,
                           queue_liveness_window=self.queue_liveness_window,
                           recovery_config=self.recovery_config,
                           fleet_config=self.fleet_config,
-                          fleet_probe=self.fleet_probe)
+                          fleet_probe=self.fleet_probe,
+                          lease=lease)
 
 
 class SupervisorLoop(threading.Thread):
@@ -1212,7 +1397,18 @@ class SupervisorLoop(threading.Thread):
 
     The event snapshot is taken BEFORE build() runs: work submitted
     while a tick is in flight wakes the NEXT wait immediately instead
-    of being slept through."""
+    of being slept through.
+
+    **High availability** (server/ha.py): with a ``lease`` handle the
+    loop is one contender in the supervisor leader election. A standby
+    parks on the ``supervisor:lease`` channel and promotes within one
+    lease window of leader silence — or within milliseconds of an
+    explicit release (graceful shutdown). Promotion runs the
+    ``reconcile_dispatches`` sweep before the first tick, so a dead
+    leader's half-dispatches are repaired exactly once; demotion (a
+    failed renew, or a ``FenceLostError`` escaping a tick) drops this
+    process back to standby with its stale epoch already rejected by
+    the store-side fence."""
 
     WAKE_CHANNELS = ('tasks', 'queue:done')
 
@@ -1224,17 +1420,108 @@ class SupervisorLoop(threading.Thread):
     #: 250 ms acceptance budget (and the ~1.2 s floor it replaced).
     DEBOUNCE_S = 0.05
 
-    def __init__(self, builder: SupervisorBuilder, interval: float = 1.0):
+    def __init__(self, builder: SupervisorBuilder, interval: float = 1.0,
+                 lease=None):
         super().__init__(daemon=True, name='supervisor-loop')
         self.builder = builder
         self.interval = interval
+        self.lease = lease if lease is not None else builder.lease
         self.wake_events = 0        # ticks triggered by an event
         self.wake_timer = 0         # ticks triggered by the backstop
+        self.promotions = 0         # standby -> leader transitions
+        self.demotions = 0          # leader -> standby transitions
+        self._was_leader = False
         # NOT named _stop: threading.Thread.join() calls self._stop()
         self._stop_evt = threading.Event()
 
+    # ------------------------------------------------------------- HA
+    def _ha_gate(self) -> bool:
+        """One election step. True = this process leads and should
+        tick; False = standby (the gate already parked on the lease
+        channel). Promotion runs the half-dispatch sweep and writes
+        the ``supervisor.failover`` event the /metrics counter and the
+        chaos suite read."""
+        try:
+            leading = self.lease.ensure()
+        except Exception:
+            # election needs the DB; treat a sick store as standby and
+            # retry at the backstop — never crash the loop over it
+            self._stop_evt.wait(self.interval)
+            return False
+        if leading and not self._was_leader:
+            self._was_leader = True
+            self.promotions += 1
+            self._on_promote()
+        elif not leading and self._was_leader:
+            self._was_leader = False
+            self.demotions += 1
+            self._log(f'supervisor {self.lease.holder}: demoted — a '
+                      f'newer leader holds the lease')
+        if not leading and not self._stop_evt.is_set():
+            self.lease.wait_standby()
+        return leading
+
+    def _fence_demote(self):
+        """Demote after a FenceLostError. ``_was_leader`` must reset
+        too: if this process later RE-acquires (the newer leader
+        released), that is a fresh promotion — the reconcile sweep and
+        the failover event must run again, not be skipped because the
+        flag still remembers the fenced-off incarnation."""
+        if self.lease is not None:
+            self.lease.epoch = None
+            self.lease.demotions += 1
+        if self._was_leader:
+            self._was_leader = False
+            self.demotions += 1
+            self._log(f'supervisor {self.lease.holder}: demoted — a '
+                      f'write was fenced off by a newer epoch')
+
+    def _on_promote(self):
+        epoch = self.lease.epoch
+        self._log(f'supervisor {self.lease.holder}: promoted to '
+                  f'leader at epoch {epoch}')
+        builder = self.builder
+        try:
+            # the aux dict may not exist before the first tick
+            builder.aux = getattr(builder, 'aux', None) or {}
+            builder.create_base()
+            builder.reconcile_dispatches()
+        except Exception:
+            self._log(f'promotion sweep failed (continuing):\n'
+                      f'{traceback.format_exc()}', error=True)
+        try:
+            # per-EVENT metric row (like task.retry): the
+            # mlcomp_supervisor_failovers counter and the dashboards
+            # count these. Epoch 1 is first boot, not a failover —
+            # recorded with its own tag so the counter can exclude it.
+            from mlcomp_tpu.db.providers import MetricProvider
+            from mlcomp_tpu.utils.misc import now as _now
+            MetricProvider(builder.raw_session).add_many([
+                (None, 'supervisor.failover', 'counter',
+                 int(epoch or 0), 1.0, _now(), 'supervisor',
+                 json.dumps({'holder': self.lease.holder,
+                             'epoch': int(epoch or 0),
+                             'first_boot': int(epoch == 1)}))])
+        except Exception:
+            pass
+
+    def _log(self, msg, error=False):
+        logger = self.builder.logger
+        try:
+            if logger is not None:
+                if error:
+                    logger.error(msg, ComponentType.Supervisor)
+                else:
+                    logger.warning(msg, ComponentType.Supervisor)
+            else:
+                print(msg)
+        except Exception:
+            pass
+
     def run(self):
         while not self._stop_evt.is_set():
+            if self.lease is not None and not self._ha_gate():
+                continue
             session = self.builder.session
             try:
                 snapshot = session.event_snapshot(self.WAKE_CHANNELS)
@@ -1242,6 +1529,13 @@ class SupervisorLoop(threading.Thread):
                 snapshot = None
             try:
                 self.builder.build()
+            except FenceLostError:
+                # the store rejected this process's epoch mid-tick: a
+                # newer leader exists. Demote NOW (the next _ha_gate
+                # round observes the lost renew too, but the fence is
+                # faster) and fall back to standby.
+                self._fence_demote()
+                continue
             except Exception:
                 # build() heals its own tick failures, but the heal
                 # path itself can raise (e.g. a down Postgres fails
@@ -1279,23 +1573,56 @@ class SupervisorLoop(threading.Thread):
                 self.wake_timer += 1
 
     def stop(self):
+        """Graceful shutdown: the lease is RELEASED in the same tick
+        (explicit drop, not expiry wait), so a rolling restart's
+        standby promotes in milliseconds — the release publishes on
+        the lease channel every parked standby waits on."""
         self._stop_evt.set()
-        # unblock a waiting loop now instead of at the backstop
+        if self.lease is not None:
+            try:
+                self.lease.release()
+            except Exception:
+                pass        # expiry remains the backstop
+        # unblock a waiting loop now instead of at the backstop —
+        # whichever channel it is parked on (the lease release above
+        # already published supervisor:lease cross-process; this local
+        # publish covers a standby whose release was a no-op)
         try:
             from mlcomp_tpu.db import events
+            from mlcomp_tpu.db.providers.supervisor import (
+                CH_SUPERVISOR_LEASE,
+            )
             events.publish('tasks')
+            events.publish(CH_SUPERVISOR_LEASE)
         except Exception:
             pass
 
 
 def register_supervisor(session: Session = None, logger=None,
-                        interval: float = 1.0):
+                        interval: float = 1.0, ha: bool = True,
+                        lease_seconds: float = None):
     """Start the supervisor loop on a background thread. The reference
     ran APScheduler at a fixed 1 s interval (supervisor.py:432-434);
     here the interval is only the timer backstop — enqueues and
-    completions wake the loop immediately (SupervisorLoop)."""
-    builder = SupervisorBuilder(session=session, logger=logger)
-    loop = SupervisorLoop(builder, interval=interval)
+    completions wake the loop immediately (SupervisorLoop).
+
+    With ``ha=True`` (default) the loop contends for the
+    ``supervisor_lease`` leader election (server/ha.py): on a
+    single-supervisor deployment it acquires instantly and behaves
+    exactly as before, and any ADDITIONAL ``mlcomp_tpu server``
+    process becomes a hot standby that promotes within one lease
+    window of leader silence. Every control-state write is epoch-
+    fenced either way (db/fencing.py)."""
+    session = session or Session.create_session(key='supervisor')
+    lease = None
+    if ha:
+        from mlcomp_tpu.server.ha import DEFAULT_LEASE_SECONDS, LeaderLease
+        lease = LeaderLease(
+            session,
+            lease_seconds=lease_seconds or DEFAULT_LEASE_SECONDS)
+    builder = SupervisorBuilder(session=session, logger=logger,
+                                lease=lease)
+    loop = SupervisorLoop(builder, interval=interval, lease=lease)
     loop.start()
     # (builder, jobs) shape kept for callers that stop the old
     # schedule-based loop via jobs[0].stop()

@@ -48,6 +48,9 @@ REQUIRED_FAMILIES = (
     'mlcomp_fleet_shed', 'mlcomp_fleet_respawns',
     'mlcomp_fleet_swaps',
     'mlcomp_hbm_bytes', 'mlcomp_comm_bytes', 'mlcomp_comm_fraction',
+    'mlcomp_supervisor_leader', 'mlcomp_supervisor_epoch',
+    'mlcomp_supervisor_failovers', 'mlcomp_supervisor_fenced_writes',
+    'mlcomp_db_listener_reconnects',
     'mlcomp_scrape_errors', 'mlcomp_scrape_duration_seconds',
 )
 
@@ -598,6 +601,61 @@ def _collect_fleet_events(session, respawns, swaps):
                       n))
 
 
+def _collect_supervisor_ha(session, leader, epoch, failovers, fenced):
+    """Supervisor HA families (migration v12 + server/ha.py):
+
+    - ``mlcomp_supervisor_leader{computer,holder}`` — 1 while a live
+      (unexpired) lease names a leader; the vacant/expired state is a
+      MISSING sample, which is what an alert should page on;
+    - ``mlcomp_supervisor_epoch`` — the current fencing token; a bump
+      without a deploy is a failover;
+    - ``mlcomp_supervisor_failovers_total`` — promotion events from
+      the ``supervisor.failover`` metric rows (first-boot acquisitions
+      excluded: epoch 1 is a start, not a failover);
+    - ``mlcomp_supervisor_fenced_writes_total`` — zombie writes the
+      epoch fence rejected (db/fencing.py); nonzero means a paused
+      ex-leader actually came back and was actually stopped."""
+    row = session.query_one('SELECT * FROM supervisor_lease WHERE id=1')
+    if row is not None:
+        epoch.append(('', None, row['epoch'] or 0))
+        from mlcomp_tpu.db.core import parse_datetime
+        from mlcomp_tpu.utils.misc import now as _now
+        expires = parse_datetime(row['expires_at'])
+        if row['holder'] and expires is not None and expires > _now():
+            leader.append(
+                ('', {'computer': row['holder'].split(':', 1)[0],
+                      'holder': row['holder']}, 1))
+    n_failovers = 0
+    for r in session.query(
+            "SELECT tags FROM metric "
+            "WHERE id > (SELECT COALESCE(MAX(id), 0) FROM metric) - ? "
+            "AND name='supervisor.failover'", (_RETRY_SCAN_WINDOW,)):
+        try:
+            if not json.loads(r['tags'] or '{}').get('first_boot'):
+                n_failovers += 1
+        except ValueError:
+            n_failovers += 1
+    failovers.append(('_total', {}, n_failovers))
+    r = session.query_one(
+        "SELECT SUM(value) AS total FROM metric "
+        "WHERE name='supervisor.fenced_writes'")
+    fenced.append(
+        ('_total', {}, float(r['total'] or 0) if r else 0.0))
+
+
+def _collect_listener_reconnects(session, samples):
+    """``mlcomp_db_listener_reconnects_total`` — LISTEN/NOTIFY daemon
+    reconnect events (sum of flushed ``db.listener_reconnects``
+    deltas, same protocol as the busy-retry family). A climbing count
+    means cross-process wakeups keep flapping back to the poll
+    backstop — dispatch latency degrades before anything errors."""
+    r = session.query_one(
+        "SELECT SUM(value) AS total FROM metric "
+        "WHERE name='db.listener_reconnects'")
+    samples.append(
+        ('_total', {}, float(r['total'] or 0) if r else 0.0))
+
+
 def collect_server_families(session):
     """The API server's /metrics families, each collected defensively
     from the DB. Scrape self-observability: ``mlcomp_scrape_errors``
@@ -621,6 +679,7 @@ def collect_server_families(session):
     retries, gangs, busy = [], [], []
     freplicas, fgens, fshed, frespawns, fswaps = [], [], [], [], []
     hbm, comm_bytes, comm_frac = [], [], []
+    leader, epoch, failovers, fenced, reconnects = [], [], [], [], []
     guarded('tasks', _collect_tasks, session, tasks)
     guarded('queue_depth', _collect_queue_depth, session, queues)
     guarded('worker_slots', _collect_worker_slots, session, slots)
@@ -638,6 +697,10 @@ def collect_server_families(session):
     guarded('fleet_shed', _collect_fleet_shed, session, fshed)
     guarded('fleet_events', _collect_fleet_events, session, frespawns,
             fswaps)
+    guarded('supervisor_ha', _collect_supervisor_ha, session, leader,
+            epoch, failovers, fenced)
+    guarded('listener_reconnects', _collect_listener_reconnects,
+            session, reconnects)
     running = []
     errors.setdefault('running_tasks', 0)
     try:
@@ -720,6 +783,24 @@ def collect_server_families(session):
                'measured collective share of the step (wire probe / '
                f'step time; newest {_RUNNING_TASKS_CAP} running '
                'tasks)', comm_frac),
+        family('mlcomp_supervisor_leader', 'gauge',
+               '1 while a live supervisor lease names a leader '
+               '(labels: computer, holder) — a missing sample means '
+               'the lease is vacant or expired', leader),
+        family('mlcomp_supervisor_epoch', 'gauge',
+               'current supervisor fencing epoch (bumps on every '
+               'acquisition; a bump without a deploy is a failover)',
+               epoch),
+        family('mlcomp_supervisor_failovers', 'counter',
+               'supervisor leader promotions excluding first boot '
+               '(recent event window)', failovers),
+        family('mlcomp_supervisor_fenced_writes', 'counter',
+               'zombie ex-leader writes rejected by the epoch fence '
+               '(sum of flushed supervisor.fenced_writes deltas)',
+               fenced),
+        family('mlcomp_db_listener_reconnects', 'counter',
+               'LISTEN/NOTIFY listener reconnect events (sum of '
+               'flushed db.listener_reconnects deltas)', reconnects),
         family('mlcomp_scrape_errors', 'gauge',
                'failures during this scrape, labeled by collector '
                '(the endpoint never 500s on a sick DB — the label '

@@ -486,8 +486,17 @@ def kill_task(task_id: int, session: Session = None):
             # naming {host}_{docker}_supervisor, worker/__main__.py:147-181)
             docker = task.docker_assigned or 'default'
             queue = f'{task.computer_assigned}_{docker}_supervisor'
-            QueueProvider(session).enqueue(
-                queue, {'action': 'kill', 'task_id': task.id})
+            payload = {'action': 'kill', 'task_id': task.id}
+            # HA supervisors: stamp the issuing leader's fencing epoch
+            # into the routed kill so the control-queue log says WHICH
+            # incarnation ordered it (the enqueue itself is already
+            # epoch-fenced through the session — a zombie's kill never
+            # reaches the queue; the stamp is forensics, not the
+            # guard). Consumers ignore unknown payload fields.
+            epoch = getattr(session, 'fence_epoch', None)
+            if epoch is not None:
+                payload['epoch'] = int(epoch)
+            QueueProvider(session).enqueue(queue, payload)
     if task.status < int(TaskStatus.Failed):
         provider.change_status(task, TaskStatus.Stopped)
     return True

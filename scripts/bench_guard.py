@@ -87,6 +87,14 @@ FLOORS = {
     'control_plane_tasks_per_s': ('min', 500.0,
                                   'queue claim+complete throughput '
                                   'over 128 simulated slots'),
+    # round-10 leg (ISSUE 14: supervisor HA). The load harness runs
+    # the failover leg with a 1 s lease window; the acceptance bar is
+    # promotion within <= 2 windows of leader silence, with headroom
+    # for a loaded CI runner's scheduler jitter on top.
+    'supervisor_failover_s': ('max', 3.0,
+                              'leader-silence to standby-promotion '
+                              'latency (1 s lease window; <= 2 '
+                              'windows + CI jitter)'),
     # round-8 leg (ISSUE 12: deep-step observability). The per-step
     # HBM timeline must stay effectively free — the sampler is one
     # allocator-stats read per reporting device (telemetry/memory.py),

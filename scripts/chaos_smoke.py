@@ -54,6 +54,19 @@ simulated by rewinding the stored timestamps, never by sleeping):
    attribution) is frozen at the failure, and the scrape
    self-observability families (per-collector
    ``mlcomp_scrape_errors``) stay clean
+9. supervisor failover (HA, server/ha.py + db/fencing.py): a LEADER
+   supervisor subprocess dispatching a task burst is killed by the
+   ``supervisor.dispatch`` seam EXACTLY between the two halves of a
+   dispatch (execute message enqueued, task not yet paired to it —
+   the torn shape ``exit`` leaves, ``os._exit``, no finally blocks,
+   real SIGKILL semantics); the hot standby promotes once the lease
+   window lapses (epoch 2), its promotion sweep re-pairs the torn
+   dispatch EXACTLY once, the remaining tasks dispatch normally —
+   zero lost, zero duplicated execute messages across the whole
+   failover — a zombie write replayed at the dead leader's epoch is
+   rejected by the store-side fence, and the failover counters
+   (``mlcomp_supervisor_epoch``/``_leader``/``_failovers``/
+   ``_fenced_writes``) are visible on /metrics
 """
 
 import datetime
@@ -700,6 +713,153 @@ def scenario_oom_flight_recorder(session, sup):
           str(errors[:3]))
 
 
+#: leader-supervisor subprocess for the failover scenario: acquires
+#: the lease, then dispatches the seeded burst — and dies at the
+#: supervisor.dispatch seam (armed via MLCOMP_FAULTS in its env)
+#: between the enqueue and the pairing write, the torn half-dispatch
+#: the new leader's promotion sweep must repair
+_LEADER_DRIVER = r'''
+import sys
+sys.path.insert(0, sys.argv[1])
+from mlcomp_tpu.db.core import Session
+from mlcomp_tpu.server.ha import LeaderLease
+from mlcomp_tpu.server.supervisor import SupervisorBuilder
+session = Session.create_session(key='chaos_leader')
+lease = LeaderLease(session, holder='chaos:leader:aaa',
+                    lease_seconds=30.0)
+assert lease.ensure(), 'leader subprocess failed to acquire'
+print('LEADING', lease.epoch, flush=True)
+sup = SupervisorBuilder(session=session, lease=lease)
+sup.build()     # dies at the armed supervisor.dispatch hit (os._exit)
+print('SURVIVED', flush=True)     # reaching here fails the scenario
+'''
+
+
+def scenario_supervisor_failover(session):
+    """SIGKILL the leader mid-dispatch; the standby must take over
+    within the lease window with exactly-once dispatch accounting."""
+    import json as _json
+    import subprocess
+    from mlcomp_tpu.db.fencing import FencedSession, FenceLostError
+    from mlcomp_tpu.server.ha import LeaderLease, StaticLease
+    from mlcomp_tpu.server.supervisor import (
+        SupervisorBuilder, SupervisorLoop,
+    )
+
+    session.execute('UPDATE computer SET can_process_tasks=0')
+    # retire scenario 7's fleet: its reconciler runs BEFORE load_tasks
+    # in every tick, and a live desired-count would mint replica tasks
+    # that consume this scenario's deterministic dispatch-seam hits
+    session.execute(
+        "UPDATE serve_fleet SET status='stopped', desired=0")
+    for host in ('ha_a', 'ha_b', 'ha_c'):
+        add_computer(session, host)
+    tp = TaskProvider(session)
+    n_tasks, kill_at = 20, 8
+    tasks = []
+    for i in range(n_tasks):
+        task = Task(name=f'ha_{i}', executor='noop', cores=1,
+                    cores_max=1, status=int(TaskStatus.NotRan),
+                    last_activity=now())
+        tp.add(task)
+        tasks.append(task)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env['MLCOMP_FAULTS'] = _json.dumps({'supervisor.dispatch': {
+        'action': 'exit', 'after': kill_at}})
+    proc = subprocess.run(
+        [sys.executable, '-c', _LEADER_DRIVER, repo],
+        env=env, capture_output=True, text=True, timeout=120)
+    check('leader subprocess died mid-dispatch (not SURVIVED)',
+          'LEADING 1' in proc.stdout
+          and 'SURVIVED' not in proc.stdout
+          and proc.returncode == 137,
+          f'rc={proc.returncode} out={proc.stdout!r} '
+          f'err={proc.stderr[-300:]!r}')
+    torn = session.query(
+        "SELECT COUNT(*) AS n FROM queue_message "
+        "WHERE status='pending' AND queue LIKE 'ha\\_%' ESCAPE '\\'"
+        )[0]['n']
+    queued = sum(1 for t in tp.by_status(TaskStatus.Queued)
+                 if t.name.startswith('ha_'))
+    check('dead leader left exactly one torn half-dispatch',
+          torn == kill_at and queued == kill_at - 1,
+          f'pending={torn} queued={queued}')
+
+    # the hot standby: its gate refuses while the lease is live, then
+    # promotes once the window lapses (rewound — never slept on)
+    standby = LeaderLease(session, holder='chaos:standby:bbb',
+                          lease_seconds=30.0)
+    sup2 = SupervisorBuilder(session=session, lease=standby)
+    loop = SupervisorLoop(sup2, interval=0.05, lease=standby)
+    loop._stop_evt.set()        # gate runs inline; never parks
+    check('standby holds back while the leader lease is live',
+          loop._ha_gate() is False and standby.epoch is None)
+    rewind(session, 'supervisor_lease', 'expires_at', 1, 3600)
+    check('standby promotes within the lease window',
+          loop._ha_gate() is True and standby.epoch == 2,
+          f'epoch={standby.epoch}')
+    adopted = (sup2.aux.get('dispatch_reconciled') or {}).get(
+        'adopted') or []
+    check('promotion sweep re-paired the torn dispatch exactly once',
+          len(adopted) == 1, str(sup2.aux.get('dispatch_reconciled')))
+
+    # a zombie write replayed at the dead leader's epoch: fenced
+    victim = tp.by_id(tasks[0].id)
+    zombie = FencedSession(session, StaticLease(1))
+    try:
+        TaskProvider(zombie).fail_with_reason(victim, 'worker-lost')
+        check('zombie ex-leader write rejected by the fence', False)
+    except FenceLostError:
+        fresh = tp.by_id(victim.id)
+        check('zombie ex-leader write rejected by the fence',
+              fresh.status == int(TaskStatus.Queued)
+              and fresh.failure_reason is None,
+              f'{TaskStatus(fresh.status).name}/{fresh.failure_reason}')
+
+    # the new leader finishes the burst: exactly-once accounting
+    sup2.build()
+    sup2.telemetry.flush()      # persist the fenced-write delta
+    by_status = {}
+    for task in [tp.by_id(t.id) for t in tasks]:
+        by_status[task.status] = by_status.get(task.status, 0) + 1
+    check('every task dispatched after failover',
+          by_status == {int(TaskStatus.Queued): n_tasks},
+          str(by_status))
+    dup = session.query(
+        "SELECT payload, COUNT(*) AS n FROM queue_message "
+        "WHERE queue LIKE 'ha\\_%' ESCAPE '\\' "
+        "GROUP BY payload HAVING COUNT(*) > 1")
+    per_task = session.query(
+        "SELECT COUNT(*) AS n FROM queue_message WHERE "
+        "status IN ('pending', 'claimed') "
+        "AND queue LIKE 'ha\\_%' ESCAPE '\\'")
+    check('zero lost and zero duplicated dispatches',
+          not dup and per_task[0]['n'] == n_tasks,
+          f'dups={[(r["payload"], r["n"]) for r in dup]} '
+          f'live={per_task[0]["n"]}')
+
+    from mlcomp_tpu.telemetry.export import (
+        parse_openmetrics, render_server_metrics,
+    )
+    doc = parse_openmetrics(render_server_metrics(session))
+    leader = doc.get('mlcomp_supervisor_leader', {}).get('samples', [])
+    epoch = doc.get('mlcomp_supervisor_epoch', {}).get('samples', [])
+    failovers = doc.get('mlcomp_supervisor_failovers', {}) \
+        .get('samples', [])
+    fenced = doc.get('mlcomp_supervisor_fenced_writes', {}) \
+        .get('samples', [])
+    check('failover visible on /metrics (leader/epoch/counters)',
+          any(labels.get('holder') == 'chaos:standby:bbb'
+              for _, labels, _ in leader)
+          and any(v == 2 for _, _, v in epoch)
+          and any(v >= 1 for _, _, v in failovers)
+          and any(v >= 1 for _, _, v in fenced),
+          f'leader={leader} epoch={epoch} failovers={failovers} '
+          f'fenced={fenced}')
+
+
 def main():
     session = Session.create_session(key='chaos_smoke')
     migrate(session)
@@ -710,6 +870,7 @@ def main():
     scenario_gang_preemption(session)
     scenario_fleet_self_healing(session)
     scenario_oom_flight_recorder(session, sup)
+    scenario_supervisor_failover(session)
     if FAILURES:
         print(f'FAIL: {len(FAILURES)} scenario check(s): {FAILURES}')
         return 1

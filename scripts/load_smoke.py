@@ -21,6 +21,11 @@ real task, and publishes the numbers the bench guard floors:
    supervisor-tick + worker-poll (~1.2 s). The harness ASSERTS p99
    under ``--p99-budget-ms`` (default 250) so an event-bus regression
    fails CI like a failed test.
+3. **supervisor-failover leg** — leader leases (server/ha.py): a
+   leader that goes silent must be replaced by a hot standby within
+   <= 2 lease windows (``supervisor_failover_s``, asserted + floored
+   by bench_guard), and an explicit release must promote the parked
+   standby in milliseconds (``supervisor_release_failover_ms``).
 
 Backends: sqlite in a throwaway root by default (zero-config, same as
 CI's ``control-plane-load`` job); ``--dsn postgresql://...`` runs the
@@ -192,6 +197,70 @@ def run_dispatch_latency(session, slots: int, probes: int) -> dict:
     }
 
 
+def run_failover(session, lease_seconds: float) -> dict:
+    """Supervisor failover latency, measured two ways (server/ha.py):
+
+    - **expiry** — the leader goes silent (SIGKILL-shaped: it simply
+      stops renewing); a hot standby retrying acquire at its normal
+      cadence must hold the lease within <= 2 lease windows. Published
+      as ``supervisor_failover_s`` (the bench_guard ceiling).
+    - **explicit release** — graceful shutdown drops the lease and
+      publishes the ``supervisor:lease`` channel; the parked standby
+      must promote in milliseconds, not windows. Published as
+      ``supervisor_release_failover_ms``.
+    """
+    from mlcomp_tpu.db.events import CH_SUPERVISOR_LEASE
+    from mlcomp_tpu.server.ha import LeaderLease
+
+    leader = LeaderLease(session, holder='load:leader:aaa',
+                         lease_seconds=lease_seconds)
+    if not leader.ensure():
+        raise RuntimeError('failover leg: initial acquire failed')
+    standby = LeaderLease(session, holder='load:standby:bbb',
+                          lease_seconds=lease_seconds)
+
+    # --- expiry path: leader dies silently at t0; the standby polls
+    # acquire at standby_wait_s cadence until the window lapses
+    t0 = time.monotonic()
+    while not standby.ensure():
+        standby.wait_standby(min(0.05, standby.standby_wait_s))
+        if time.monotonic() - t0 > lease_seconds * 10:
+            raise RuntimeError('failover leg: standby never promoted')
+    expiry_s = time.monotonic() - t0
+
+    # --- explicit-release path: the (new) leader releases; a parked
+    # contender must wake off the event and win immediately
+    contender = LeaderLease(session, holder='load:contender:ccc',
+                            lease_seconds=lease_seconds)
+    assert not contender.ensure()
+    result = {}
+    release_done = threading.Event()
+
+    def promoter():
+        t1 = time.monotonic()
+        while not contender.ensure():
+            session.wait_event([CH_SUPERVISOR_LEASE], 0.5)
+            if time.monotonic() - t1 > lease_seconds * 10:
+                return
+        result['release_ms'] = (time.monotonic() - t1) * 1e3
+        release_done.set()
+
+    thread = threading.Thread(target=promoter, daemon=True)
+    thread.start()
+    time.sleep(0.05)             # let the contender park on the bus
+    standby.release()
+    release_done.wait(lease_seconds * 10)
+    contender.release()
+    if 'release_ms' not in result:
+        raise RuntimeError('failover leg: release promotion lost')
+    return {
+        'supervisor_failover_s': round(expiry_s, 3),
+        'supervisor_release_failover_ms': round(result['release_ms'],
+                                                1),
+        'failover_lease_s': lease_seconds,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
     ap.add_argument('--dsn', default=None,
@@ -208,6 +277,10 @@ def main(argv=None) -> int:
     ap.add_argument('--p99-budget-ms', type=float, default=250.0,
                     help='dispatch_p99_ms assertion (the event bus '
                          'must beat the ~1.2 s tick+poll floor)')
+    ap.add_argument('--failover-lease-s', type=float, default=1.0,
+                    help='lease window for the supervisor-failover '
+                         'leg (small so the leg stays cheap; the '
+                         'assertion scales with it)')
     ap.add_argument('--json', action='store_true')
     ap.add_argument('--no-assert', action='store_true',
                     help='publish numbers without gating')
@@ -232,9 +305,16 @@ def main(argv=None) -> int:
                                  args.queues, args.threads))
     result.update(run_dispatch_latency(session, args.slots,
                                        args.probes))
+    result.update(run_failover(session, args.failover_lease_s))
 
     failures = []
     if not args.no_assert:
+        if result['supervisor_failover_s'] > 2 * args.failover_lease_s:
+            failures.append(
+                f"supervisor_failover_s {result['supervisor_failover_s']}"
+                f' over the 2-lease-window budget '
+                f'({2 * args.failover_lease_s}s) — standby promotion '
+                f'is not keeping up with leader silence')
         if args.tasks < 2000:
             failures.append(f'--tasks {args.tasks} below the 2000 '
                             f'acceptance scale')
@@ -256,7 +336,9 @@ def main(argv=None) -> int:
               f"{args.slots} slots; drain p99 "
               f"{result['queue_drain_p99_ms']} ms; dispatch p50/p99 "
               f"{result['dispatch_p50_ms']}/"
-              f"{result['dispatch_p99_ms']} ms")
+              f"{result['dispatch_p99_ms']} ms; failover "
+              f"{result['supervisor_failover_s']}s expiry / "
+              f"{result['supervisor_release_failover_ms']}ms release")
     for line in failures:
         print(f'load_smoke: FAIL {line}', file=sys.stderr)
     return 1 if failures else 0

@@ -101,7 +101,27 @@ def seed(session):
                                       'reason': 'replica-unhealthy'})),
            (None, 'fleet.swap', 'counter', None, 2.0, ts,
             'supervisor', json.dumps({'fleet': 'smokefleet',
-                                      'outcome': 'completed'}))])
+                                      'outcome': 'completed'}))]
+        # supervisor HA signals (migration v12 + server/ha.py): one
+        # first-boot acquisition, one real failover, a fenced zombie
+        # write, and a listener reconnect delta
+        + [(None, 'supervisor.failover', 'counter', 1, 1.0, ts,
+            'supervisor', json.dumps({'holder': 'smoke:1:aaa',
+                                      'epoch': 1, 'first_boot': 1})),
+           (None, 'supervisor.failover', 'counter', 2, 1.0, ts,
+            'supervisor', json.dumps({'holder': 'smoke:2:bbb',
+                                      'epoch': 2, 'first_boot': 0})),
+           (None, 'supervisor.fenced_writes', 'counter', None, 1.0,
+            ts, 'supervisor', None),
+           (None, 'db.listener_reconnects', 'counter', None, 2.0, ts,
+            'supervisor', None)])
+    # the live lease: holder smoke:2:bbb leads at epoch 2
+    import datetime
+    session.execute(
+        'UPDATE supervisor_lease SET holder=?, epoch=2, expires_at=?, '
+        'acquired_at=?, renewed_at=? WHERE id=1',
+        ('smoke:2:bbb', now() + datetime.timedelta(seconds=300),
+         now(), now()))
     # serving-fleet roster (serve_fleet/serve_replica, migration v9)
     from mlcomp_tpu.db.models import ServeFleet, ServeReplica
     from mlcomp_tpu.db.providers import FleetProvider, ReplicaProvider
@@ -200,6 +220,22 @@ def main():
         ('mlcomp_comm_fraction', any(
             v == 0.12
             for _, l, v in doc['mlcomp_comm_fraction']['samples'])),
+        ('mlcomp_supervisor_leader', any(
+            l.get('computer') == 'smoke'
+            and l.get('holder') == 'smoke:2:bbb' and v == 1
+            for _, l, v in doc['mlcomp_supervisor_leader']['samples'])),
+        ('mlcomp_supervisor_epoch', any(
+            v == 2
+            for _, _, v in doc['mlcomp_supervisor_epoch']['samples'])),
+        ('mlcomp_supervisor_failovers excludes first boot', any(
+            v == 1 for _, _, v in
+            doc['mlcomp_supervisor_failovers']['samples'])),
+        ('mlcomp_supervisor_fenced_writes', any(
+            v == 1 for _, _, v in
+            doc['mlcomp_supervisor_fenced_writes']['samples'])),
+        ('mlcomp_db_listener_reconnects', any(
+            v == 2 for _, _, v in
+            doc['mlcomp_db_listener_reconnects']['samples'])),
         # scrape self-observability: one labeled sample per collector,
         # every one healthy, and the scrape timed itself
         ('mlcomp_scrape_errors labeled per collector',

@@ -1,0 +1,506 @@
+"""Supervisor high availability (ISSUE 14): leader leases with
+fencing epochs, hot-standby failover, and crash-consistent dispatch —
+the failover interleavings verified at unit granularity (the end-to-end
+SIGKILL story lives in scripts/chaos_smoke.py scenario 9).
+"""
+import datetime
+import json
+import threading
+import time
+
+import pytest
+
+from mlcomp_tpu.db.enums import TaskStatus
+from mlcomp_tpu.db.fencing import (
+    FencedSession, FenceLostError, fence_statement,
+)
+from mlcomp_tpu.db.models import Task
+from mlcomp_tpu.db.providers import (
+    QueueProvider, SupervisorLeaseProvider, TaskProvider,
+)
+from mlcomp_tpu.server.ha import LeaderLease, StaticLease
+from mlcomp_tpu.server.supervisor import SupervisorBuilder, SupervisorLoop
+from mlcomp_tpu.utils.misc import now
+
+from tests.test_supervisor import add_computer  # noqa: F401
+
+
+def _expire_lease(session):
+    session.execute(
+        'UPDATE supervisor_lease SET expires_at=? WHERE id=1',
+        (now() - datetime.timedelta(seconds=1),))
+
+
+def _add_task(session, name='t', **kw):
+    task = Task(name=name, executor='noop', cores=1, cores_max=1,
+                status=int(TaskStatus.NotRan), last_activity=now(),
+                **kw)
+    TaskProvider(session).add(task)
+    return task
+
+
+class TestLeaseProtocol:
+    def test_migration_seeds_singleton(self, session):
+        row = SupervisorLeaseProvider(session).current()
+        assert row is not None
+        assert row.holder is None and (row.epoch or 0) == 0
+
+    def test_acquire_bumps_epoch_renew_keeps_it(self, session):
+        p = SupervisorLeaseProvider(session)
+        assert p.try_acquire('a:1:x', 30.0) == 1
+        assert p.renew('a:1:x', 1, 30.0) is True
+        row = p.current()
+        assert row.epoch == 1 and row.holder == 'a:1:x'
+
+    def test_live_lease_blocks_rival(self, session):
+        p = SupervisorLeaseProvider(session)
+        assert p.try_acquire('a:1:x', 30.0) == 1
+        assert p.try_acquire('b:2:y', 30.0) is None
+
+    def test_expired_lease_is_taken_with_new_epoch(self, session):
+        p = SupervisorLeaseProvider(session)
+        assert p.try_acquire('a:1:x', 30.0) == 1
+        _expire_lease(session)
+        assert p.try_acquire('b:2:y', 30.0) == 2
+        # the old holder's renew now loses: that IS its demotion signal
+        assert p.renew('a:1:x', 1, 30.0) is False
+
+    def test_release_is_conditional_on_holder_and_epoch(self, session):
+        p = SupervisorLeaseProvider(session)
+        assert p.try_acquire('a:1:x', 30.0) == 1
+        _expire_lease(session)
+        assert p.try_acquire('b:2:y', 30.0) == 2
+        # the stale ex-leader cannot vacate the NEW leader's lease
+        assert p.release('a:1:x', 1) is False
+        assert p.current().holder == 'b:2:y'
+        assert p.release('b:2:y', 2) is True
+        row = p.current()
+        assert row.holder is None and row.epoch == 2  # epoch survives
+
+    def test_racing_acquire_exactly_one_winner(self, backend_session):
+        """Two supervisors racing the vacant lease — on sqlite AND on
+        the Postgres parity fixture — produce exactly one leader and
+        exactly one epoch bump (the conditional UPDATE is the whole
+        protocol on both backends)."""
+        session = backend_session
+        p = SupervisorLeaseProvider(session)
+        results = {}
+        barrier = threading.Barrier(2)
+
+        def contend(who):
+            barrier.wait()
+            results[who] = p.try_acquire(who, 30.0)
+
+        threads = [threading.Thread(target=contend, args=(w,))
+                   for w in ('racer:1:a', 'racer:2:b')]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        wins = [w for w, epoch in results.items() if epoch is not None]
+        assert len(wins) == 1, results
+        row = p.current()
+        assert row.holder == wins[0] and row.epoch == 1
+
+
+class TestPromotionLatency:
+    def test_explicit_release_promotes_via_event(self, session):
+        """A parked standby promotes in milliseconds off the lease
+        channel when the leader releases — no lease window waited."""
+        leader = LeaderLease(session, holder='l:1:a', lease_seconds=30)
+        assert leader.ensure() is True
+        standby = LeaderLease(session, holder='s:2:b',
+                              lease_seconds=30)
+        assert standby.ensure() is False
+        promoted = {}
+
+        def promote():
+            t0 = time.monotonic()
+            deadline = t0 + 10
+            while time.monotonic() < deadline:
+                if standby.ensure():
+                    promoted['s'] = time.monotonic() - t0
+                    return
+                standby.wait_standby(5.0)
+
+        thread = threading.Thread(target=promote, daemon=True)
+        thread.start()
+        time.sleep(0.1)             # parked on the lease channel
+        leader.release()
+        thread.join(10)
+        # well under a lease window (30 s) — the event did the work
+        assert promoted.get('s') is not None and promoted['s'] < 2.0
+
+    def test_expiry_promotes_within_window(self, session):
+        """Leader silence: the standby wins only once the window
+        lapses (simulated by rewinding the stored expiry — the suite
+        never sleeps out real windows)."""
+        leader = LeaderLease(session, holder='l:1:a', lease_seconds=30)
+        assert leader.ensure() is True
+        standby = LeaderLease(session, holder='s:2:b',
+                              lease_seconds=30)
+        assert standby.ensure() is False        # window still live
+        _expire_lease(session)
+        assert standby.ensure() is True
+        assert standby.epoch == 2
+        # the silent ex-leader discovers the loss at its next renew
+        leader._renew_deadline = 0.0
+        assert leader.ensure() is False
+        assert leader.epoch is None and leader.demotions == 1
+
+    def test_loop_stop_releases_lease_same_tick(self, session):
+        """Graceful shutdown drops the lease explicitly — a rolling
+        restart's standby never waits out the expiry."""
+        lease = LeaderLease(session, holder='l:1:a', lease_seconds=30)
+        assert lease.ensure() is True
+        builder = SupervisorBuilder(session=session, lease=lease)
+        loop = SupervisorLoop(builder, interval=30.0, lease=lease)
+        loop.stop()
+        row = SupervisorLeaseProvider(session).current()
+        assert row.holder is None
+        rival = LeaderLease(session, holder='r:2:b', lease_seconds=30)
+        assert rival.ensure() is True           # instantly
+
+
+class TestFencing:
+    def test_fence_statement_rewrites(self):
+        sql, params, fenced = fence_statement(
+            'UPDATE task SET "status"=? WHERE "id"=?', (3, 7), 5)
+        assert fenced and params == (3, 7, 5)
+        assert sql.endswith(
+            'AND (SELECT epoch FROM supervisor_lease WHERE id=1)=?')
+        sql, params, fenced = fence_statement(
+            "INSERT INTO queue_message (queue, payload, status, "
+            "created) VALUES (?, ?, 'pending', ?)", ('q', 'p', 't'), 5)
+        assert fenced and 'SELECT ?, ?' in sql and 'VALUES' not in sql
+        # RETURNING stays terminal
+        sql, _, fenced = fence_statement(
+            "UPDATE queue_message SET status='claimed' WHERE id=? "
+            "RETURNING id", (1,), 5)
+        assert fenced and sql.endswith('RETURNING id')
+        # non-control tables and reads pass through untouched
+        for stmt in ('INSERT INTO metric (name) VALUES (?)',
+                     'SELECT * FROM task',
+                     'UPDATE computer SET cpu=?'):
+            _, _, fenced = fence_statement(stmt, (), 5)
+            assert fenced is False
+
+    def test_zombie_write_rejected_after_newer_epoch(self, session):
+        """THE fencing story: epoch-1 writes replayed after epoch 2
+        exists are rejected by the store and raise loudly."""
+        lease = LeaderLease(session, holder='l:1:a', lease_seconds=30)
+        assert lease.ensure() is True
+        task = _add_task(session)
+        fenced = FencedSession(session, StaticLease(1))
+        TaskProvider(fenced).change_status(task, TaskStatus.Queued)
+        # a newer leader appears
+        _expire_lease(session)
+        rival = LeaderLease(session, holder='r:2:b', lease_seconds=30)
+        assert rival.ensure() is True
+        stale_view = TaskProvider(fenced).by_id(task.id)
+        with pytest.raises(FenceLostError):
+            TaskProvider(fenced).fail_with_reason(
+                stale_view, 'worker-lost')
+        fresh = TaskProvider(session).by_id(task.id)
+        assert fresh.status == int(TaskStatus.Queued)
+        assert fresh.failure_reason is None
+        with pytest.raises(FenceLostError):
+            QueueProvider(fenced).enqueue(
+                'q', {'action': 'execute', 'task_id': task.id})
+        assert QueueProvider(session).pending('q') == []
+
+    def test_non_leader_wrapper_never_writes(self, session):
+        """A FencedSession whose lease is not held (epoch None) stamps
+        an impossible epoch — control-state writes cannot land even if
+        a code path skips the leadership check."""
+        fenced = FencedSession(session, StaticLease(None))
+        with pytest.raises(FenceLostError):
+            _add_task(fenced)
+        assert TaskProvider(session).count() == 0
+
+    def test_unfenced_tables_pass_through(self, session):
+        """Telemetry must survive fencing: metric writes ride the
+        wrapper untouched even at a dead epoch."""
+        from mlcomp_tpu.db.providers import MetricProvider
+        fenced = FencedSession(session, StaticLease(None))
+        MetricProvider(fenced).add_many(
+            [(None, 'x', 'gauge', None, 1.0, now(), 'test', None)])
+        assert session.query_one(
+            "SELECT COUNT(*) AS c FROM metric WHERE name='x'")['c'] == 1
+
+    def test_batch_insert_fenced_loudly(self, session):
+        """executemany keeps the loud-rejection contract: a zombie's
+        batch enqueue must raise, not silently insert nothing while
+        reporting success."""
+        lease = LeaderLease(session, holder='l:1:a', lease_seconds=30)
+        assert lease.ensure() is True
+        zombie = FencedSession(session, StaticLease(1))
+        _expire_lease(session)
+        rival = LeaderLease(session, holder='r:2:b', lease_seconds=30)
+        assert rival.ensure() is True
+        with pytest.raises(FenceLostError):
+            QueueProvider(zombie).enqueue_many([
+                ('q', {'action': 'execute', 'task_id': i})
+                for i in range(3)])
+        assert session.query_one(
+            'SELECT COUNT(*) AS c FROM queue_message')['c'] == 0
+        # at the live epoch the same batch lands whole
+        live = FencedSession(session, rival)
+        assert QueueProvider(live).enqueue_many([
+            ('q', {'action': 'execute', 'task_id': i})
+            for i in range(3)]) == 3
+        assert session.query_one(
+            'SELECT COUNT(*) AS c FROM queue_message')['c'] == 3
+
+    def test_benign_conditional_loss_not_a_fence_error(self, session):
+        """A conditional UPDATE that legitimately matches zero rows
+        (the revoke-already-claimed pattern) must NOT read as a fence
+        loss while the epoch is intact."""
+        lease = LeaderLease(session, holder='l:1:a', lease_seconds=30)
+        assert lease.ensure() is True
+        fenced = FencedSession(session, lease)
+        qp = QueueProvider(fenced)
+        msg = qp.enqueue('q', {'action': 'execute', 'task_id': 1})
+        assert qp.claim(['q'], 'w1') is not None
+        assert qp.revoke(msg) is False      # claimed — benign loss
+
+
+class TestCrashConsistentDispatch:
+    def _leader_builder(self, session):
+        lease = LeaderLease(session, holder='l:1:a', lease_seconds=30)
+        assert lease.ensure() is True
+        sup = SupervisorBuilder(session=session, lease=lease)
+        sup.aux = {}
+        sup.create_base()
+        return sup
+
+    def test_sweep_repairs_torn_dispatch_exactly_once(self, session):
+        """Crash between enqueue and the pairing write: the next
+        leader's sweep adopts the pending message (queue_id + Queued)
+        — once; a second sweep finds a consistent pair."""
+        add_computer(session, 'h1')
+        task = _add_task(session, computer_assigned='h1',
+                         cores_assigned=json.dumps([0]))
+        msg = QueueProvider(session).enqueue(
+            'h1_default', {'action': 'execute', 'task_id': task.id})
+        sup = self._leader_builder(session)
+        out = sup.reconcile_dispatches()
+        assert out['adopted'] == [{'task': task.id, 'msg': msg}]
+        task = TaskProvider(session).by_id(task.id)
+        assert task.status == int(TaskStatus.Queued)
+        assert task.queue_id == msg
+        assert not any(sup.reconcile_dispatches().values())
+
+    def test_sweep_rolls_back_orphan_message(self, session):
+        """A pending execute message whose task moved on (stopped,
+        finished, requeued by a newer leader) is revoked — it must
+        never execute twice."""
+        add_computer(session, 'h1')
+        task = _add_task(session)
+        TaskProvider(session).change_status(task, TaskStatus.Stopped)
+        msg = QueueProvider(session).enqueue(
+            'h1_default', {'action': 'execute', 'task_id': task.id})
+        ghost = QueueProvider(session).enqueue(
+            'h1_default', {'action': 'execute', 'task_id': 99999})
+        sup = self._leader_builder(session)
+        out = sup.reconcile_dispatches()
+        assert sorted(out['revoked']) == sorted([msg, ghost])
+        statuses = {r['id']: r['status'] for r in session.query(
+            'SELECT id, status FROM queue_message')}
+        assert statuses[msg] == 'revoked'
+        assert statuses[ghost] == 'revoked'
+
+    def test_sweep_requeues_queued_task_with_dead_message(self,
+                                                         session):
+        """A Queued task whose dispatch message vanished (rolled-back
+        other half) resets to NotRan and re-places this tick."""
+        add_computer(session, 'h1')
+        task = _add_task(session, computer_assigned='h1',
+                         queue_id=424242)
+        TaskProvider(session).change_status(task, TaskStatus.Queued)
+        sup = self._leader_builder(session)
+        out = sup.reconcile_dispatches()
+        assert out['requeued'] == [task.id]
+        task = TaskProvider(session).by_id(task.id)
+        assert task.status == int(TaskStatus.NotRan)
+        assert task.queue_id is None
+
+    def test_promotion_runs_sweep_and_counts_failover(self, session):
+        """The loop's promotion path: sweep + the supervisor.failover
+        event row (first boot tagged so the /metrics counter can
+        exclude it)."""
+        add_computer(session, 'h1')
+        task = _add_task(session, computer_assigned='h1',
+                         cores_assigned=json.dumps([0]))
+        QueueProvider(session).enqueue(
+            'h1_default', {'action': 'execute', 'task_id': task.id})
+        lease = LeaderLease(session, holder='l:1:a', lease_seconds=30)
+        sup = SupervisorBuilder(session=session, lease=lease)
+        loop = SupervisorLoop(sup, interval=0.05, lease=lease)
+        loop._stop_evt.set()        # gate inline, no parking
+        assert loop._ha_gate() is True
+        assert loop.promotions == 1
+        assert (sup.aux.get('dispatch_reconciled') or {}).get('adopted')
+        rows = session.query(
+            "SELECT step, tags FROM metric "
+            "WHERE name='supervisor.failover'")
+        assert len(rows) == 1
+        assert json.loads(rows[0]['tags'])['first_boot'] == 1
+
+    def test_reacquire_after_fence_demotion_repromotes(self, session):
+        """A fenced-off ex-leader that later RE-acquires (the newer
+        leader released) is a fresh promotion: the sweep and the
+        failover event must run again — _was_leader resets on the
+        fence demotion."""
+        lease = LeaderLease(session, holder='l:1:a', lease_seconds=30)
+        sup = SupervisorBuilder(session=session, lease=lease)
+        loop = SupervisorLoop(sup, interval=0.05, lease=lease)
+        loop._stop_evt.set()
+        assert loop._ha_gate() is True and loop.promotions == 1
+        # a rival takes over; this process's write gets fenced
+        _expire_lease(session)
+        rival = LeaderLease(session, holder='r:2:b', lease_seconds=30)
+        assert rival.ensure() is True
+        loop._fence_demote()
+        assert loop._was_leader is False and loop.demotions == 1
+        # the rival releases (rolling restart) — re-acquisition must
+        # run the promotion path again, not skip it
+        rival.release()
+        assert loop._ha_gate() is True
+        assert loop.promotions == 2
+        rows = session.query(
+            "SELECT id FROM metric WHERE name='supervisor.failover'")
+        assert len(rows) == 2
+
+    def test_dispatch_order_prestamps_placement(self, session):
+        """The crash-consistent ordering contract the sweep relies on:
+        by the time the execute message exists, the task row already
+        carries its placement — killed between the halves, the torn
+        row is adoptable. Verified by observing the row from the
+        enqueue seam."""
+        from mlcomp_tpu.testing.faults import (
+            clear_faults, register_handler,
+        )
+        add_computer(session, 'h1')
+        task = _add_task(session)
+        seen = {}
+
+        def probe(queue=None, **_):
+            row = session.query_one(
+                'SELECT computer_assigned, status, queue_id FROM task '
+                'WHERE id=?', (task.id,))
+            seen.update(dict(row))
+
+        register_handler('queue.enqueue', probe)
+        try:
+            sup = SupervisorBuilder(session=session)
+            sup.build()
+        finally:
+            clear_faults()
+        assert seen.get('computer_assigned') == 'h1'
+        assert seen.get('status') == int(TaskStatus.NotRan)
+        assert seen.get('queue_id') is None
+
+
+class TestListenerHealth:
+    def test_reconnect_counter(self):
+        from mlcomp_tpu.db import events
+        before = events.listener_stats()['reconnects']
+        events.record_listener_reconnect()
+        assert events.listener_stats()['reconnects'] == before + 1
+
+    def test_events_cross_process_tracks_listener(self):
+        """The worker's _idle_wait reads events_cross_process per
+        wait: a dropped LISTEN connection must flip it False so the
+        waiter falls back to the poll backstop instead of parking on
+        a wakeup that can never arrive."""
+        from mlcomp_tpu.db.postgres import PostgresSession
+        s = PostgresSession.__new__(PostgresSession)
+        s._listener_ok = True
+        assert s.events_cross_process is True
+        s._listener_ok = False
+        assert s.events_cross_process is False
+
+    def test_supervisor_samples_listener_deltas(self, session):
+        from mlcomp_tpu.db import events
+        sup = SupervisorBuilder(session=session)
+        sup.aux = {}
+        events.record_listener_reconnect()
+        events.record_listener_reconnect()
+        sup.record_tick_telemetry()
+        sup.telemetry.flush()
+        row = session.query_one(
+            "SELECT SUM(value) AS total FROM metric "
+            "WHERE name='db.listener_reconnects'")
+        assert row['total'] == 2.0
+
+
+class TestRemoteSessionResilience:
+    def _session(self):
+        from mlcomp_tpu.db.remote import RemoteSession
+        return RemoteSession('http://127.0.0.1:9', key='t',
+                             token='x', timeout=3.0)
+
+    def test_timeout_is_always_set(self, monkeypatch):
+        """No RemoteSession request may go out without a client
+        timeout — a hung API server must not hang workers forever."""
+        import urllib.request
+        s = self._session()
+        captured = {}
+
+        def fake_urlopen(req, timeout=None):
+            captured['timeout'] = timeout
+            raise ConnectionResetError('boom')
+
+        monkeypatch.setattr(urllib.request, 'urlopen', fake_urlopen)
+        with pytest.raises(Exception):
+            s.query('SELECT 1')
+        assert captured['timeout'] == 3.0
+
+    def test_connect_refused_retries_then_succeeds(self, monkeypatch):
+        import io
+        import urllib.error
+        import urllib.request
+        s = self._session()
+        calls = {'n': 0}
+
+        class _Resp(io.BytesIO):
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        def fake_urlopen(req, timeout=None):
+            calls['n'] += 1
+            if calls['n'] < 3:
+                raise urllib.error.URLError(
+                    ConnectionRefusedError(111, 'refused'))
+            return _Resp(json.dumps(
+                {'success': True, 'rows': []}).encode())
+
+        monkeypatch.setattr(urllib.request, 'urlopen', fake_urlopen)
+        monkeypatch.setattr('mlcomp_tpu.db.remote._CONNECT_BASE_SLEEP_S',
+                            0.001)
+        assert s.query('SELECT 1') == []
+        assert calls['n'] == 3
+
+    def test_ambiguous_failures_never_retried(self, monkeypatch):
+        """A timeout (the request may have executed server-side) must
+        surface immediately — retrying a write there risks a
+        double-apply. It still classifies io-error downstream."""
+        import socket
+        import urllib.request
+        s = self._session()
+        calls = {'n': 0}
+
+        def fake_urlopen(req, timeout=None):
+            calls['n'] += 1
+            raise socket.timeout('timed out')
+
+        monkeypatch.setattr(urllib.request, 'urlopen', fake_urlopen)
+        with pytest.raises(OSError):
+            s.execute('UPDATE task SET status=1')
+        assert calls['n'] == 1
+        from mlcomp_tpu.recovery import classify_exception
+        assert classify_exception(socket.timeout('x')) == 'io-error'
