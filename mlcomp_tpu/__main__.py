@@ -34,6 +34,10 @@
   per fleet, the active generation and model, desired vs healthy
   replica counts, the replica roster with endpoints/states/respawn
   lineage, ``--json`` for scripts
+- ``mlcomp_tpu sweeps``         — ASHA sweep state (server/sweep.py):
+  per sweep, the policy knobs, the rung ladder (promoted/pruned per
+  rung) and the per-cell verdict audit trail — which cell was pruned
+  at which rung, at what score, against what cutoff; ``--json``
 """
 
 import json
@@ -644,6 +648,56 @@ def fleets(as_json, show_all):
                 line += f" — {r['failure_reason']}"
             if r['respawned_from']:
                 line += f" (replaced {r['respawned_from']})"
+            click.echo(line)
+
+
+@main.command()
+@click.option('--json', 'as_json', is_flag=True,
+              help='machine-readable output')
+@click.option('--all', 'show_all', is_flag=True,
+              help='include finished sweeps')
+def sweeps(as_json, show_all):
+    """ASHA sweep state (server/sweep.py): one block per sweep — the
+    policy (metric/mode/eta/rung base), the rung ladder, and every
+    cell with its live status and prune/promote audit trail."""
+    from mlcomp_tpu.server.api import api_sweeps
+    session = Session.create_session()
+    migrate(session)
+    items = api_sweeps({'all': show_all}, session)['data']
+    if as_json:
+        click.echo(json.dumps(items))
+        return
+    if not items:
+        click.echo('no ' + ('' if show_all else 'active ') + 'sweeps')
+        return
+    for it in items:
+        unit = 'epoch' if it['unit'] == 'epochs' else 'step'
+        head = (f"{it['name']} [{it['status']}] {it['metric']}/"
+                f"{it['mode']} eta={it['eta']:g} rungs at "
+                f"{unit} {it['rung_base']}*eta^r")
+        if it['best_task'] is not None:
+            head += (f" — best cell {it['best_task']} "
+                     f"score {it['best_score']:.6g}")
+        click.echo(head)
+        for rung in it['rungs']:
+            click.echo(f"  rung {rung['rung']}: "
+                       f"{rung['promoted']} promoted, "
+                       f"{rung['pruned']} pruned")
+        for c in it['cells']:
+            line = (f"  cell {c['task']} [{c['status']}] {c['name']}"
+                    + (f" score {c['score']:.6g}"
+                       if c['score'] is not None else ''))
+            # a recorded prune verdict outranks the task row: in the
+            # window before the kill lands (a leader dying mid-prune)
+            # the cell is already a judged loser, never "promoted"
+            d = next((d for d in c['decisions']
+                      if d['verdict'] == 'prune'), None)
+            if d is not None:
+                line += (f" — pruned at rung {d['rung']} "
+                         f"({d['score']:.6g} vs cutoff "
+                         f"{d['cutoff']:.6g})")
+            elif c['pruned']:
+                line += ' — pruned'
             click.echo(line)
 
 

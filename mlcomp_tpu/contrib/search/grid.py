@@ -8,9 +8,16 @@ A grid spec is a list of axes. Axis forms:
 - ``{_folder: path}``            — every ``*.yml`` in the folder is an option
 
 Cells are the cartesian product of all axes; each cell is the merged dict
-of its options, paired with a human-readable name (last 300 chars of the
-flattened ``k=v`` string, reference grid.py:10-16).
+of its options, paired with a human-readable name (the flattened ``k=v``
+string, reference grid.py:10-16). Large cells are truncated to the last
+300 chars with a short stable hash of the FULL flattened cell appended:
+the reference's bare tail truncation gave two cells differing only in
+EARLY params identical names in the dashboard/CLI, so a sweep's verdict
+table could not tell them apart. The hash suffix rides at the END so
+downstream tail-preserving truncations (task names) keep it.
 """
+
+import hashlib
 
 from glob import glob
 from itertools import product
@@ -19,11 +26,18 @@ from os.path import join
 from mlcomp_tpu.utils.io import yaml_load
 from mlcomp_tpu.utils.misc import dict_flatten
 
+#: human-readable budget for a cell name before the hash suffix kicks in
+_NAME_BUDGET = 300
+
 
 def cell_name(cell: dict) -> str:
     flat = dict_flatten(cell)
     text = ' '.join(f'{k}={v}' for k, v in flat.items())
-    return text[-300:]
+    if len(text) <= _NAME_BUDGET:
+        return text
+    digest = hashlib.sha256(text.encode()).hexdigest()[:8]
+    suffix = f' #{digest}'
+    return text[-(_NAME_BUDGET - len(suffix)):] + suffix
 
 
 def _axis_options(row, position: int):

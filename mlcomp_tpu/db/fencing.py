@@ -51,9 +51,13 @@ import threading
 
 from mlcomp_tpu.db.core import insert_sql, update_sql
 
-#: control-state tables whose supervisor-issued mutations are fenced
+#: control-state tables whose supervisor-issued mutations are fenced.
+#: sweep/sweep_decision belong here: a zombie ex-leader recording a
+#: prune verdict — or acting on one — would kill a cell the live
+#: leader may have judged differently
 FENCED_TABLES = frozenset(
-    {'task', 'queue_message', 'serve_fleet', 'serve_replica'})
+    {'task', 'queue_message', 'serve_fleet', 'serve_replica',
+     'sweep', 'sweep_decision'})
 
 #: the store-side fence predicate (one indexed read of a 1-row table)
 FENCE_PREDICATE = '(SELECT epoch FROM supervisor_lease WHERE id=1)=?'

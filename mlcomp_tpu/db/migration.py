@@ -349,9 +349,28 @@ def _v12_supervisor_ha(session: Session):
             'VALUES (1, NULL, 0)')
 
 
+def _v13_sweep(session: Session):
+    """ASHA sweep scheduling (server/sweep.py): the ``sweep`` policy
+    table and the ``sweep_decision`` audit trail recording every
+    promote/prune verdict with its rung, score, cutoff and fencing
+    epoch. CREATE IF NOT EXISTS is safe on a fresh DB whose _v1
+    already made the tables; the UNIQUE index is the store-level
+    backstop of the scheduler's exactly-once conditional insert (a
+    raced double tick or a failover replay can never mint a second
+    verdict for the same cell and rung)."""
+    from mlcomp_tpu.db.models import Sweep, SweepDecision
+    for model in (Sweep, SweepDecision):
+        for stmt in model.create_table_ddl(_dialect(session)):
+            session.execute(stmt)           # IF NOT EXISTS — safe
+    session.execute(
+        'CREATE UNIQUE INDEX IF NOT EXISTS idx_sweep_decision_once '
+        'ON sweep_decision("sweep", "task", "rung")')
+
+
 MIGRATIONS = [_v1_init, _v2_data, _v3_auth, _v4_telemetry, _v5_preflight,
               _v6_tracing_alerts, _v7_recovery, _v8_gang, _v9_fleet,
-              _v10_postmortem, _v11_dispatch_indexes, _v12_supervisor_ha]
+              _v10_postmortem, _v11_dispatch_indexes, _v12_supervisor_ha,
+              _v13_sweep]
 
 
 def migrate(session: Session = None):

@@ -22,6 +22,7 @@ from mlcomp_tpu.db.models.fleet import ServeFleet, ServeReplica
 from mlcomp_tpu.db.models.supervisor import (
     SupervisorInstance, SupervisorLease,
 )
+from mlcomp_tpu.db.models.sweep import Sweep, SweepDecision
 
 ALL_MODELS = [
     Project, Report, ReportLayout, Dag, Task, TaskDependence, TaskSynced,
@@ -31,6 +32,7 @@ ALL_MODELS = [
     Postmortem,
     ServeFleet, ServeReplica,
     SupervisorLease, SupervisorInstance,
+    Sweep, SweepDecision,
 ]
 
 __all__ = [m.__name__ for m in ALL_MODELS] + ['ALL_MODELS']

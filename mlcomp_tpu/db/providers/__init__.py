@@ -28,9 +28,13 @@ from mlcomp_tpu.db.providers.telemetry import (
 )
 from mlcomp_tpu.db.providers.fleet import FleetProvider, ReplicaProvider
 from mlcomp_tpu.db.providers.supervisor import SupervisorLeaseProvider
+from mlcomp_tpu.db.providers.sweep import (
+    SweepDecisionProvider, SweepProvider,
+)
 
 __all__ = [
     'FleetProvider', 'ReplicaProvider', 'SupervisorLeaseProvider',
+    'SweepProvider', 'SweepDecisionProvider',
     'WorkerTokenProvider', 'DbAuditProvider', 'AlertProvider',
     'MetricProvider', 'TelemetrySpanProvider', 'PostmortemProvider',
     'DagPreflightProvider',

@@ -30,6 +30,13 @@ gang-aborted    transient  the supervisor's gang-abort sweep killed this
 replica-unhealthy transient  the fleet reconciler's health probes gave
                            up on a serving replica (server/fleet.py) —
                            it is killed and respawned elsewhere
+sweep-pruned    permanent  the ASHA sweep scheduler's rung verdict
+                           (server/sweep.py): the cell lost its rung
+                           and was killed to recycle the slot. Never
+                           retried — resurrecting a judged loser would
+                           burn the very compute the sweep exists to
+                           save; the ``sweep_decision`` row is the
+                           audit trail
 oom             permanent  RESOURCE_EXHAUSTED / device out-of-memory
                            (also host MemoryError): the same shapes
                            OOM again on retry — blind-retrying burns a

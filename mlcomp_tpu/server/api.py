@@ -28,6 +28,9 @@ the behavior is subtle):
   (replica states, generations, respawn lineage);
   ``/api/fleet/create|scale|swap|stop`` (auth) — mutate the desired
   state the supervisor's fleet reconciler drives (server/fleet.py)
+- ``/api/sweeps`` (GET or POST, no auth) — ASHA sweep roster
+  (rung ladder, per-cell promote/prune verdicts with score/cutoff/
+  fencing epoch; server/sweep.py)
 - ``/api/telemetry/series|spans|trace`` (also GET ``/telemetry/series``,
   ``/telemetry/spans``, ``/telemetry/trace/<id>``, no auth) and
   ``/api/telemetry/profile`` — telemetry subsystem reads, the
@@ -599,6 +602,58 @@ def api_fleets(data, s):
     return {'data': out}
 
 
+def api_sweeps(data, s):
+    """ASHA sweep roster (server/sweep.py): every sweep with its rung
+    ladder and per-cell verdict table — which cell was pruned at which
+    rung, at what score, against what cutoff, by which leader epoch.
+    Same no-auth introspection tier as /api/fleets; the dashboard's
+    sweep card and the `mlcomp_tpu sweeps` CLI read this."""
+    from mlcomp_tpu.db.providers import (
+        SweepDecisionProvider, SweepProvider,
+    )
+    sp, dp = SweepProvider(s), SweepDecisionProvider(s)
+    include_done = bool(data.get('all'))
+    out = []
+    for sweep in sp.all():
+        if sweep.status == 'done' and not include_done:
+            continue
+        cells = sp.cell_tasks(sweep)
+        decisions = dp.for_sweep(sweep.id)
+        by_cell = {}
+        for d in decisions:
+            by_cell.setdefault(d.task, []).append({
+                'rung': d.rung, 'verdict': d.verdict,
+                'score': d.score, 'cutoff': d.cutoff,
+                'cells_seen': d.cells_seen, 'epoch': d.epoch,
+                'time': str(d.time or '')})
+        rungs = {}
+        for d in decisions:
+            entry = rungs.setdefault(
+                d.rung, {'rung': d.rung, 'promoted': 0, 'pruned': 0})
+            entry['promoted' if d.verdict == 'promote'
+                  else 'pruned'] += 1
+        out.append({
+            'id': sweep.id, 'name': sweep.name, 'dag': sweep.dag,
+            'executor': sweep.executor, 'status': sweep.status,
+            'metric': sweep.metric, 'mode': sweep.mode,
+            'eta': sweep.eta, 'rung_base': sweep.rung_base,
+            'unit': sweep.unit,
+            'min_cells_per_rung': sweep.min_cells_per_rung,
+            'best_task': sweep.best_task,
+            'best_score': sweep.best_score,
+            'rungs': [rungs[r] for r in sorted(rungs)],
+            'cells': [{
+                'task': c.id, 'name': c.name,
+                'status': TaskStatus(c.status).name,
+                'score': c.score,
+                'computer': c.computer_assigned,
+                'pruned': c.failure_reason == 'sweep-pruned',
+                'decisions': by_cell.get(c.id, []),
+            } for c in cells],
+        })
+    return {'data': out}
+
+
 def _fleet_or_404(data, s):
     from mlcomp_tpu.db.providers import FleetProvider
     fleet = None
@@ -1037,6 +1092,8 @@ _ROUTES = {
     # serving-fleet tier (server/fleet.py): the roster read is the
     # same introspection tier as auxiliary; mutations need the token
     '/api/fleets': (api_fleets, False),
+    # ASHA sweep roster (server/sweep.py): read-only audit surface
+    '/api/sweeps': (api_sweeps, False),
     '/api/fleet/create': (api_fleet_create, True),
     '/api/fleet/scale': (api_fleet_scale, True),
     '/api/fleet/swap': (api_fleet_swap, True),
@@ -1072,7 +1129,7 @@ _READ_ONLY_ROUTES = frozenset({
     '/api/img_classify', '/api/img_segment', '/api/config', '/api/graph',
     '/api/dags', '/api/code', '/api/tasks', '/api/task/info',
     '/api/task/steps', '/api/dag/preflight', '/api/auxiliary',
-    '/api/fleets', '/api/logs', '/api/reports',
+    '/api/fleets', '/api/sweeps', '/api/logs', '/api/reports',
     '/api/report', '/api/report/update_layout_start',
     '/api/telemetry/series', '/api/telemetry/spans',
     '/api/telemetry/trace', '/api/alerts', '/api/task/postmortem',
@@ -1270,7 +1327,7 @@ class ApiHandler(BaseHTTPRequestHandler):
                     {'success': False, 'reason': 'internal error'}, 500)
             return
         if parsed.path in ('/telemetry/series', '/telemetry/spans',
-                           '/api/alerts', '/api/fleets',
+                           '/api/alerts', '/api/fleets', '/api/sweeps',
                            '/api/task/postmortem') \
                 or parsed.path.startswith('/telemetry/trace/'):
             # GET mirrors of the POST routes (curl-friendly:
@@ -1288,6 +1345,8 @@ class ApiHandler(BaseHTTPRequestHandler):
                 handler = api_alerts
             elif parsed.path == '/api/fleets':
                 handler = api_fleets
+            elif parsed.path == '/api/sweeps':
+                handler = api_sweeps
             elif parsed.path == '/api/task/postmortem':
                 handler = api_task_postmortem
             else:

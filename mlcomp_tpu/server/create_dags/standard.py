@@ -163,17 +163,69 @@ class DagStandardBuilder:
     def _create_executor_tasks(self, name, spec, depends, created):
         grid = spec.get('grid')
         cells = grid_cells(grid) if grid else [(None, None)]
+        # ASHA sweep scheduling (server/sweep.py): a `sweep:` block on
+        # a grid executor persists a sweep row the supervisor's
+        # scheduler drives, and every cell carries the normalized spec
+        # in additional_info so the train loop knows to report rung
+        # scores and checkpoint at rung boundaries. Validated HERE so
+        # a bad block rejects the submission, not silently never
+        # prunes.
+        sweep_info = None
+        if spec.get('sweep') is not None:
+            if not grid:
+                raise ValueError(
+                    f'executor {name!r}: sweep requires a grid (a '
+                    f'sweep schedules grid cells)')
+            from mlcomp_tpu.contrib.search.asha import \
+                normalize_sweep_spec
+            norm = normalize_sweep_spec(spec['sweep'])
+            # cross-check against the trainer's own score contract: a
+            # jax_train cell reports its main_metric under the sweep's
+            # direction — a mismatch here would judge the sweep on the
+            # wrong series, or prune the WINNERS (mode max over a
+            # minimized loss) with a perfectly clean audit trail
+            if Executor.is_trainable(spec.get('type', name)):
+                # resolve like Executor._parse_config: the params:
+                # block feeds constructor kwargs too, top-level keys
+                # win — checking only the top level would false-reject
+                # params-specified trainers and wave through the exact
+                # mismatch this guard exists to stop
+                params = dict(spec.get('params') or {})
+                resolved = {**params,
+                            **{k: v for k, v in spec.items()
+                               if k != 'params'}}
+                main_metric = resolved.get('main_metric', 'accuracy')
+                if norm['metric'] != main_metric:
+                    raise ValueError(
+                        f'executor {name!r}: sweep.metric '
+                        f'{norm["metric"]!r} != the trainer\'s '
+                        f'main_metric {main_metric!r} — cells report '
+                        f'main_metric, so the sweep would judge a '
+                        f'different series than the spec names')
+                minimize = bool(resolved.get('minimize', False))
+                if (norm['mode'] == 'min') != minimize:
+                    raise ValueError(
+                        f'executor {name!r}: sweep.mode '
+                        f'{norm["mode"]!r} contradicts the trainer\'s '
+                        f'minimize={minimize} — the sweep would prune '
+                        f'the best cells')
+            from mlcomp_tpu.server.sweep import create_sweep
+            sweep = create_sweep(self.session, self.dag, name, norm,
+                                 len(cells))
+            sweep_info = dict(norm, id=sweep.id)
         tasks = []
         for cell_index, (cell, cell_name_str) in enumerate(cells):
             task = self._create_task(
-                name, spec, cell, cell_name_str, cell_index)
+                name, spec, cell, cell_name_str, cell_index,
+                sweep_info=sweep_info)
             for dep in depends:
                 for dep_task in created[dep]:
                     self.task_provider.add_dependency(task.id, dep_task.id)
             tasks.append(task)
         return tasks
 
-    def _create_task(self, name, spec, cell, cell_name_str, cell_index):
+    def _create_task(self, name, spec, cell, cell_name_str, cell_index,
+                     sweep_info=None):
         cores, cores_max = parse_cores(
             spec.get('cores', spec.get('gpu', 0)))
         executor_type = spec.get('type', name)
@@ -181,11 +233,26 @@ class DagStandardBuilder:
         task_name = name
         if cell_name_str:
             task_name = f'{name} {cell_name_str}'
+            if len(task_name) > 180:
+                # truncate the CELL part, keeping its tail (grid.py
+                # puts the disambiguating hash suffix at the end) AND
+                # the executor-name prefix — two executors sharing a
+                # big cell must not collapse to the same tail, which
+                # is the cross-executor flavor of the collision the
+                # hash fixed within one grid. A pathologically long
+                # executor name is itself truncated first so the cell
+                # tail (and its hash) ALWAYS survives the 180 cap.
+                prefix = name if len(name) <= 120 else name[:119] + '…'
+                cell_budget = 180 - len(prefix) - 2
+                task_name = (f'{prefix} …'
+                             f'{cell_name_str[-cell_budget:]}')
 
         additional_info = {'trace_id': self.trace_id}
         if cell is not None:
             additional_info['grid_cell'] = cell_index
             additional_info['grid'] = cell
+        if sweep_info is not None:
+            additional_info['sweep'] = dict(sweep_info)
         if spec.get('env'):
             additional_info['env'] = spec['env']
         if self.info.get('stages'):

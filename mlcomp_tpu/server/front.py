@@ -27,9 +27,11 @@ inline SVG) covering the same surfaces:
   computer/reason, the gang.generation bump timeline — elastic
   gang-atomic recovery), and on-demand profiler start/stop buttons
 - supervisor tab: watchdog alerts card (open alerts + resolve button,
-  telemetry/watchdog.py) above the decision trace, and a serving-
+  telemetry/watchdog.py) above the decision trace, a serving-
   fleets card (server/fleet.py: per-fleet generation/model, desired vs
-  healthy, replica roster with endpoints/states/respawn lineage)
+  healthy, replica roster with endpoints/states/respawn lineage), and
+  a sweep card (server/sweep.py: per-sweep rung ladder + per-cell
+  promote/prune verdicts with score vs cutoff)
 - report detail: LAYOUT-DRIVEN rendering (reference
   db/report_info/info.py:28-129 consumed by the SPA's report renderer):
   panels of metric series, img_classify gallery with confusion-matrix
@@ -703,6 +705,47 @@ async function viewSupervisor(el) {
           <td>${esc(r.failure_reason||'')}</td></tr>`).join('')}
         </table></div>`;
         }).join('') + '</div>'));
+  // ASHA sweeps (server/sweep.py): the rung ladder + per-cell verdict
+  // audit — why each pruned cell was killed (rung, score, cutoff).
+  // Pruned rows render dim: they are the sweep working as intended.
+  let sweeps = {data: []};
+  try { sweeps = await api('sweeps', {all: true}); } catch (e) {}
+  if (sweeps && sweeps.success === false) sweeps = {data: []};
+  if ((sweeps.data||[]).length) {
+    el.appendChild(h('<h3>sweeps (ASHA early stopping)</h3>'));
+    el.appendChild(h('<div class="cards">'
+      + sweeps.data.map(sw => {
+          const ladder = (sw.rungs||[]).map(r =>
+            `rung ${r.rung}: ${r.promoted}&#9650; ${r.pruned}&#9660;`)
+            .join(' · ') || 'no rungs judged yet';
+          const best = sw.best_task != null
+            ? ` · best cell ${sw.best_task} (${sw.best_score})` : '';
+          return `<div class="card">
+        <h3>${esc(sw.name)} [${esc(sw.status)}]</h3>
+        <div>${esc(sw.metric)}/${esc(sw.mode)} · eta ${sw.eta}
+          · rungs at ${sw.rung_base}&times;eta^r ${esc(sw.unit)}
+          ${best}</div>
+        <div class="dim">${ladder}</div>
+        <table><tr><th>cell</th><th>status</th><th>score</th>
+          <th>verdict</th></tr>
+        ${(sw.cells||[]).map(c => {
+          const d = (c.decisions||[]).filter(
+            x => x.verdict === 'prune')[0];
+          const verdict = d
+            ? `pruned rung ${d.rung} (${d.score} vs ${d.cutoff})`
+            : (c.decisions||[]).length
+              ? `promoted through rung ${Math.max(...c.decisions
+                  .map(x => x.rung))}` : '';
+          return `<tr${(c.pruned || d) ? ' class="dim"' : ''}>
+          <td><a href="#task/${c.task}">${c.task}</a>
+            ${esc(c.name)}</td>
+          <td>${esc(c.status)}</td>
+          <td>${c.score == null ? '' : esc(c.score)}</td>
+          <td>${verdict}</td></tr>`;
+        }).join('')}
+        </table></div>`;
+        }).join('') + '</div>'));
+  }
   const np = sup.not_placed || {};
   if (Object.keys(np).length)
     el.appendChild(h('<h3>not placed (reasons)</h3><table>'

@@ -99,6 +99,18 @@ class SupervisorBuilder:
         self.fleet_reconciler = FleetReconciler(
             self.session, logger=logger, config=fleet_config,
             probe=fleet_probe, telemetry=self.telemetry)
+        # ASHA sweep scheduler (server/sweep.py): judges grid cells at
+        # budget rungs off the sweep.score series and prunes the
+        # losers — runs before load_tasks so a pruned cell's slot
+        # re-places into the next queued cell in the SAME tick
+        from mlcomp_tpu.server.sweep import SweepScheduler
+        self.sweep_scheduler = SweepScheduler(
+            self.session, logger=logger, telemetry=self.telemetry,
+            gang_abort=self.gang_abort)
+        # per-tick cache for the sweep cells' preemption-aware
+        # placement: computer -> transient-failure count (recovery
+        # taxonomy history); None = not computed this tick
+        self._retry_prone = None
         self._last_claim_ts = now()
         # dag id -> [error findings] ([] = passed); filled lazily the
         # first time a NotRan task of that dag reaches placement
@@ -138,6 +150,9 @@ class SupervisorBuilder:
             self._pending_execute = self.queue_provider.pending_index()
         except Exception:
             self._pending_execute = None
+        # retry-prone history is tick-scoped like the pending index:
+        # recomputed lazily on the first sweep-cell placement
+        self._retry_prone = None
 
     # -------------------------------------------------------- parent tasks
     def process_parent_tasks(self):
@@ -345,7 +360,50 @@ class SupervisorBuilder:
         # most-free-cores first (single-node packing,
         # reference supervisor.py:200-226)
         fits.sort(key=lambda c: -len(self._free_cores(c)))
+        # preemption-aware placement for SWEEP cells (server/sweep.py,
+        # ROADMAP item 5's second half): a pruned/retried cell is
+        # cheap, disposable work — steer it off hosts whose recovery
+        # history says they eat tasks (transient failure verdicts:
+        # preemptions, lost workers, expired leases), keeping the
+        # clean hosts' slots deterministic for it. The sort is stable,
+        # so equal-history hosts keep the packing order; non-sweep
+        # tasks are untouched (their exclusion logic is retry_exclude
+        # above).
+        if (info or {}).get('sweep') and len(fits) > 1:
+            prone = self._retry_prone_counts()
+            if any(prone.get(c['name']) for c in fits):
+                fits.sort(key=lambda c: prone.get(c['name'], 0))
         return fits, reasons
+
+    def _retry_prone_counts(self) -> dict:
+        """computer -> count of transient-failure verdicts currently
+        attributed to it (task rows whose ``failure_reason`` is in the
+        recovery taxonomy's transient set — the per-computer failure
+        history the ROADMAP's spot/preempt scheduling item names).
+        One grouped query, cached per tick; filtered to terminal
+        statuses IN SQL so the v11 status composite bounds the read —
+        an unfiltered failure_reason scan would be the O(history)
+        per-tick pattern the index audit evicted (a retried-and-
+        recovered row clears its reason on Success anyway)."""
+        if self._retry_prone is not None:
+            return self._retry_prone
+        from mlcomp_tpu.recovery import TRANSIENT_REASONS
+        reasons = sorted(TRANSIENT_REASONS)
+        marks = ','.join('?' * len(reasons))
+        try:
+            self._retry_prone = {
+                r['computer_assigned']: r['n']
+                for r in self.session.query(
+                    f'SELECT computer_assigned, COUNT(*) AS n '
+                    f'FROM task WHERE status IN (?, ?) '
+                    f'AND failure_reason IN ({marks}) '
+                    f'AND computer_assigned IS NOT NULL '
+                    f'GROUP BY computer_assigned',
+                    (int(TaskStatus.Failed), int(TaskStatus.Stopped),
+                     *reasons))}
+        except Exception:
+            self._retry_prone = {}
+        return self._retry_prone
 
     def find_port(self, comp) -> int:
         """Coordinator port from the per-computer range
@@ -1078,6 +1136,30 @@ class SupervisorBuilder:
                     f'{traceback.format_exc()}',
                     ComponentType.Supervisor)
 
+    def process_sweeps(self):
+        """ASHA sweep scheduling (server/sweep.py): judge every cell
+        that reported a budget rung since the last tick, prune the
+        losers through the kill/taxonomy path, finish completed
+        sweeps. Runs BEFORE load_tasks so a pruned cell's cores are
+        free when this tick's placement runs — the freed slot recycles
+        into the next queued cell with no tick-latency gap (the prune
+        transition also publishes on the tasks channel, so a parked
+        loop wakes for it). Same containment contract as recovery and
+        fleets: a scheduler crash never takes the tick down, a fence
+        loss demotes this leader NOW."""
+        try:
+            sweep_aux = self.sweep_scheduler.tick()
+            if sweep_aux:
+                self.aux['sweeps'] = sweep_aux
+        except FenceLostError:
+            raise           # zombie leader: stop the tick, demote
+        except Exception:
+            if self.logger:
+                self.logger.error(
+                    f'sweep scheduling failed:\n'
+                    f'{traceback.format_exc()}',
+                    ComponentType.Supervisor)
+
     # ------------------------------------------------------------ preflight
     def dag_preflight_errors(self, dag_id: int) -> list:
         """Error findings for a dag, computed once per supervisor
@@ -1329,6 +1411,10 @@ class SupervisorBuilder:
             # recovery BEFORE load_tasks: a task requeued this tick
             # re-loads as NotRan below and can re-dispatch immediately
             self.process_recovery()
+            # sweeps BEFORE load_tasks for the same reason as recovery:
+            # a cell pruned this tick frees its cores for the placement
+            # below, so the next queued cell dispatches immediately
+            self.process_sweeps()
             self.process_fleets()
             self.load_tasks()
             self.load_computers()

@@ -47,6 +47,7 @@ REQUIRED_FAMILIES = (
     'mlcomp_fleet_replicas', 'mlcomp_fleet_generation',
     'mlcomp_fleet_shed', 'mlcomp_fleet_respawns',
     'mlcomp_fleet_swaps',
+    'mlcomp_sweep_cells', 'mlcomp_sweep_prunes', 'mlcomp_sweep_rung',
     'mlcomp_hbm_bytes', 'mlcomp_comm_bytes', 'mlcomp_comm_fraction',
     'mlcomp_supervisor_leader', 'mlcomp_supervisor_epoch',
     'mlcomp_supervisor_failovers', 'mlcomp_supervisor_fenced_writes',
@@ -601,6 +602,72 @@ def _collect_fleet_events(session, respawns, swaps):
                       n))
 
 
+def _collect_sweeps(session, cells, prunes, rungs):
+    """ASHA sweep families (server/sweep.py, migration v13):
+
+    - ``mlcomp_sweep_cells{sweep,state}`` — the cell roster folded
+      from task rows: waiting/queued/running plus the terminal split
+      the sweep exists to create (``pruned`` = Failed with the
+      ``sweep-pruned`` verdict, ``finished`` = Success, ``failed`` =
+      everything else terminal);
+    - ``mlcomp_sweep_prunes_total{sweep,rung}`` — prune verdicts per
+      rung straight off the ``sweep_decision`` audit table (durable:
+      counter semantics survive restarts because the decisions do);
+    - ``mlcomp_sweep_rung{sweep}`` — the highest rung judged so far
+      (-1 until the first verdict): the sweep's ladder position."""
+    from mlcomp_tpu.db.enums import TaskStatus
+    sweeps = {r['id']: r['name'] for r in session.query(
+        'SELECT id, name FROM sweep')}
+    if not sweeps:
+        return
+    # label sets are keyed by the sweep ID (name rides along for
+    # humans): sweep names repeat across resubmissions of the same
+    # config, and duplicate labelsets would fail the whole scrape
+    def labels(sweep_id, **extra):
+        return {'sweep': sweeps[sweep_id], 'id': str(sweep_id),
+                **extra}
+    state_of = {
+        int(TaskStatus.NotRan): 'waiting',
+        int(TaskStatus.Queued): 'queued',
+        int(TaskStatus.InProgress): 'running',
+        int(TaskStatus.Success): 'finished',
+    }
+    counts = {}     # (sweep id, state) -> n
+    for r in session.query(
+            'SELECT s.id AS sid, t.status AS status, '
+            "SUM(CASE WHEN t.failure_reason='sweep-pruned' "
+            'THEN 1 ELSE 0 END) AS pruned, COUNT(*) AS n '
+            'FROM sweep s JOIN task t '
+            'ON t.dag = s.dag AND t.executor = s.executor '
+            'WHERE t.parent IS NULL GROUP BY s.id, t.status'):
+        state = state_of.get(r['status'], 'failed')
+        pruned = r['pruned'] or 0
+        rest = r['n'] - (pruned if state == 'failed' else 0)
+        if state == 'failed' and pruned:
+            key = (r['sid'], 'pruned')
+            counts[key] = counts.get(key, 0) + pruned
+        if rest:
+            key = (r['sid'], state)
+            counts[key] = counts.get(key, 0) + rest
+    for (sid, state), n in sorted(counts.items()):
+        cells.append(('', labels(sid, state=state), n))
+    top_rung = {}
+    for r in session.query(
+            'SELECT d.sweep AS sweep, d.rung AS rung, d.verdict AS v, '
+            'COUNT(*) AS n FROM sweep_decision d '
+            'GROUP BY d.sweep, d.rung, d.verdict'):
+        if r['sweep'] not in sweeps:
+            continue
+        top_rung[r['sweep']] = max(top_rung.get(r['sweep'], -1),
+                                   r['rung'])
+        if r['v'] == 'prune':
+            prunes.append(('_total',
+                           labels(r['sweep'], rung=str(r['rung'])),
+                           r['n']))
+    for sid in sorted(sweeps):
+        rungs.append(('', labels(sid), top_rung.get(sid, -1)))
+
+
 def _collect_supervisor_ha(session, leader, epoch, failovers, fenced):
     """Supervisor HA families (migration v12 + server/ha.py):
 
@@ -678,6 +745,7 @@ def collect_server_families(session):
     dispatch, phases, eff, compiles, serving = [], [], [], [], []
     retries, gangs, busy = [], [], []
     freplicas, fgens, fshed, frespawns, fswaps = [], [], [], [], []
+    sweep_cells, sweep_prunes, sweep_rungs = [], [], []
     hbm, comm_bytes, comm_frac = [], [], []
     leader, epoch, failovers, fenced, reconnects = [], [], [], [], []
     guarded('tasks', _collect_tasks, session, tasks)
@@ -697,6 +765,8 @@ def collect_server_families(session):
     guarded('fleet_shed', _collect_fleet_shed, session, fshed)
     guarded('fleet_events', _collect_fleet_events, session, frespawns,
             fswaps)
+    guarded('sweeps', _collect_sweeps, session, sweep_cells,
+            sweep_prunes, sweep_rungs)
     guarded('supervisor_ha', _collect_supervisor_ha, session, leader,
             epoch, failovers, fenced)
     guarded('listener_reconnects', _collect_listener_reconnects,
@@ -771,6 +841,15 @@ def collect_server_families(session):
         family('mlcomp_fleet_swaps', 'counter',
                'rolling-swap events by outcome (recent event window)',
                fswaps),
+        family('mlcomp_sweep_cells', 'gauge',
+               'ASHA sweep cells by state (pruned = killed by a rung '
+               'verdict; server/sweep.py)', sweep_cells),
+        family('mlcomp_sweep_prunes', 'counter',
+               'prune verdicts per sweep and rung (sweep_decision '
+               'audit table — durable counter)', sweep_prunes),
+        family('mlcomp_sweep_rung', 'gauge',
+               'highest rung judged per sweep (-1 before the first '
+               'verdict)', sweep_rungs),
         family('mlcomp_hbm_bytes', 'gauge',
                'latest HBM timeline point per running task and device '
                '(kind=used|limit|peak; telemetry memory sampler, '

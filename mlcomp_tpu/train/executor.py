@@ -209,6 +209,49 @@ class JaxTrain(Executor):
             task=self.task.id, time=now(), epoch=int(epoch),
             value=float(value), name=name, part=part, stage=stage))
 
+    def _sweep_info(self):
+        info = dict(getattr(self, 'additional_info', None) or {})
+        sweep = info.get('sweep')
+        return dict(sweep) if isinstance(sweep, dict) else None
+
+    def _report_sweep(self, global_epoch: int, steps_per_epoch: int,
+                      score) -> bool:
+        """ASHA rung reporting for sweep cells (contrib/search/asha.py
+        contract): one ``sweep.score`` row per epoch boundary, budget
+        in the sweep's unit, attributed to the CELL task (the parent
+        for a fanned-out distributed cell — the supervisor judges
+        cells, not ranks). Returns True when this epoch ended exactly
+        ON a rung boundary, which is the train loop's cue to force a
+        checkpoint there. Best-effort like every observability write.
+        """
+        sweep = self._sweep_info()
+        if sweep is None or self.session is None or self.task is None:
+            return False
+        if not getattr(self, '_is_main', True):
+            return False
+        from mlcomp_tpu.contrib.search.asha import (
+            report_sweep_score, rung_boundaries,
+        )
+        epochs_done = global_epoch + 1
+        per_epoch = 1 if sweep.get('unit', 'epochs') == 'epochs' \
+            else int(steps_per_epoch)
+        budget = epochs_done * per_epoch
+        if score is not None:
+            cell_id = self.task.parent or self.task.id
+            report_sweep_score(self.session, cell_id, budget, score)
+        try:
+            base = int(sweep.get('base') or sweep.get('rung_epochs', 1))
+            eta = float(sweep.get('eta', 2))
+        except (TypeError, ValueError):
+            return False
+        # "crossed this epoch", not exact membership: step-unit rung
+        # boundaries generically fall MID-epoch (rung_steps=100 with 64
+        # steps/epoch), and the checkpoint contract is per-boundary,
+        # not per-exact-hit
+        prev_budget = budget - per_epoch
+        return any(prev_budget < b <= budget
+                   for b in rung_boundaries(base, eta, budget))
+
     def _update_scores(self, score):
         """task.score + Model.score_local best tracking
         (reference catalyst.py:131-145, valid.py:74-81)."""
@@ -957,6 +1000,13 @@ class JaxTrain(Executor):
                 if is_best:
                     best = score
                     self._update_scores(score)
+                # ASHA sweep cell (additional_info['sweep'], stamped
+                # at submission): report the rung score the supervisor
+                # judges on — immediate row + supervisor wakeup, so a
+                # losing cell is pruned at the next tick instead of
+                # training a whole extra rung
+                sweep_rung = self._report_sweep(
+                    global_epoch, steps_per_epoch, score)
                 # checkpoint cadence: pulling the full state to host is
                 # the dominant per-epoch cost on slow host links — save
                 # on best, every checkpoint_every-th epoch, and at the
@@ -970,10 +1020,15 @@ class JaxTrain(Executor):
                 # runs cannot resume or export — incompatible consumers
                 # (stage_per_dispatch, model_name, infer_valid
                 # best_only) are rejected in __init__
+                # sweep rung boundaries force a save: promotion is
+                # checkpoint-aware — a promoted cell that later dies
+                # transiently resumes from its RUNG checkpoint through
+                # the ordinary retry path (checkpoint_every: 0 still
+                # wins: throwaway cells stay saveless by contract)
                 should_save = self.checkpoint_every != 0 and (
                     is_best or self.checkpoint_every <= 1
                     or (global_epoch + 1) % self.checkpoint_every == 0
-                    or last_of_stage)
+                    or last_of_stage or sweep_rung)
                 if should_save:
                     meta_d = {'stage': stage_name,
                               'stage_epoch': epoch,

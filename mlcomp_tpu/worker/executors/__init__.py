@@ -16,6 +16,7 @@ Executor._builtin_modules = (
     'mlcomp_tpu.worker.executors.model',
     'mlcomp_tpu.worker.executors.kaggle',
     'mlcomp_tpu.worker.executors.serve_replica',
+    'mlcomp_tpu.worker.executors.sweep_probe',
     'mlcomp_tpu.train.executor',
 )
 

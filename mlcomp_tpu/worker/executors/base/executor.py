@@ -93,7 +93,7 @@ class Executor(ABC):
         """Default: pass every non-framework key as a constructor kwarg."""
         skip = {'type', 'gpu', 'cores', 'cpu', 'memory', 'depends', 'grid',
                 'env', 'distr', 'single_node', 'computer', 'params',
-                'report', 'slot', 'slots'}
+                'report', 'slot', 'slots', 'sweep'}
         kwargs = dict(executor_spec.get('params', {}))
         for k, v in executor_spec.items():
             if k not in skip and k != 'params':

@@ -268,6 +268,15 @@ class ExecuteBuilder:
                     return self._requeue()
                 # debug mode: loop stages in-process
                 return self.build()
+        # a supervisor verdict may have landed MID-RUN (sweep prune,
+        # watchdog stall-kill) without a signal reaching us — in
+        # in-process worker mode there is no subprocess to SIGTERM.
+        # Re-read before the Success transition: a terminal verdict on
+        # the row wins over this worker's late "it returned fine".
+        current = self.provider.by_id(self.task.id)
+        if current is not None and \
+                current.status >= int(TaskStatus.Failed):
+            return TaskStatus(current.status).name.lower()
         self.provider.change_status(self.task, TaskStatus.Success)
         return 'success'
 

@@ -95,6 +95,23 @@ FLOORS = {
                               'leader-silence to standby-promotion '
                               'latency (1 s lease window; <= 2 '
                               'windows + CI jitter)'),
+    # round-11 legs (ISSUE 15: ASHA sweep scheduling). The jax-free
+    # sweep_probe grid run exhaustive vs sweep-scheduled on the same
+    # worker pool (bench.py bench_grid_asha). The acceptance bars:
+    # the sweep reaches the same best configuration (deterministic
+    # probe curve — the gap must be numerical noise only) in well
+    # under half the exhaustive wallclock, with every prune recorded
+    # as an auditable sweep_decision row and zero pruned cells ever
+    # auto-retried (audit_ok folds both).
+    'dag_grid_asha_speedup': ('min', 1.8,
+                              'sweep-scheduled vs exhaustive grid '
+                              'wallclock speedup (same pool)'),
+    'dag_grid_asha_best_gap': ('max', 1e-6,
+                               'best-score gap sweep vs exhaustive '
+                               '(must agree on the winner)'),
+    'dag_grid_asha_audit_ok': ('min', 1.0,
+                               'every prune audited exactly once, no '
+                               'pruned cell retried (1 = holds)'),
     # round-8 leg (ISSUE 12: deep-step observability). The per-step
     # HBM timeline must stay effectively free — the sampler is one
     # allocator-stats read per reporting device (telemetry/memory.py),

@@ -67,6 +67,16 @@ simulated by rewinding the stored timestamps, never by sleeping):
    rejected by the store-side fence, and the failover counters
    (``mlcomp_supervisor_epoch``/``_leader``/``_failovers``/
    ``_fenced_writes``) are visible on /metrics
+10. sweep prune failover (ASHA scheduling, server/sweep.py): the
+   leader is killed at the ``sweep.prune`` seam — the prune VERDICT is
+   recorded in ``sweep_decision`` but the cell not yet killed; the
+   standby promotes, its repair pass finishes the recorded prune and
+   judges the remaining cells, and the decision log shows EXACTLY ONE
+   prune per pruned cell across the failover; a zombie verdict at the
+   dead leader's epoch is fenced; pruned cells are never auto-retried
+   (no attempt consumed, no backoff scheduled); the prune counters
+   (``mlcomp_sweep_prunes_total``/``mlcomp_sweep_cells``) are visible
+   on /metrics
 """
 
 import datetime
@@ -860,6 +870,144 @@ def scenario_supervisor_failover(session):
           f'fenced={fenced}')
 
 
+def scenario_sweep_prune_failover(session):
+    """Kill the leader MID-PRUNE (verdict recorded, kill not yet
+    applied — the ``sweep.prune`` seam sits exactly between the two);
+    the standby must promote, FINISH the recorded prune, judge the
+    remaining cells, and the decision log must show exactly one prune
+    per pruned cell across the whole failover. A zombie verdict
+    replayed at the dead leader's epoch is rejected by the fence, and
+    a pruned cell is never auto-retried."""
+    import json as _json
+    import subprocess
+    from mlcomp_tpu.contrib.search.asha import report_sweep_score
+    from mlcomp_tpu.db.fencing import FencedSession, FenceLostError
+    from mlcomp_tpu.db.models import Dag, Sweep
+    from mlcomp_tpu.db.providers import (
+        DagProvider, ProjectProvider, SweepDecisionProvider,
+        SweepProvider,
+    )
+    from mlcomp_tpu.server.ha import LeaderLease, StaticLease
+    from mlcomp_tpu.server.supervisor import SupervisorBuilder
+
+    # scenario 9 left its standby holding the lease for 30 s — expire
+    # it (simulated clock, never a sleep) so this scenario's leader
+    # can acquire
+    rewind(session, 'supervisor_lease', 'expires_at', 1, 3600)
+    project = ProjectProvider(session).add_project('chaos_sweep')
+    dag = Dag(name='chaos_sweep', project=project.id, config='{}',
+              created=now())
+    DagProvider(session).add(dag)
+    sweep = Sweep(dag=dag.id, executor='sweep_cells',
+                  name='chaos_sweep/cells', metric='score', mode='max',
+                  eta=2.0, rung_base=1, unit='epochs',
+                  min_cells_per_rung=2, cells=4, status='active',
+                  created=now())
+    SweepProvider(session).add(sweep)
+    tp = TaskProvider(session)
+    cells = []
+    for i, score in enumerate((0.9, 0.8, 0.2, 0.1)):
+        cell = Task(name=f'sweep_cell_{i}', executor='sweep_cells',
+                    dag=dag.id, status=int(TaskStatus.InProgress),
+                    computer_assigned='ha_a', last_activity=now())
+        tp.add(cell)
+        report_sweep_score(session, cell.id, 1, score)
+        cells.append(cell)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env['MLCOMP_FAULTS'] = _json.dumps({'sweep.prune': {
+        'action': 'exit', 'after': 1}})
+    proc = subprocess.run(
+        [sys.executable, '-c', _LEADER_DRIVER, repo],
+        env=env, capture_output=True, text=True, timeout=120)
+    check('leader subprocess died mid-prune (not SURVIVED)',
+          'LEADING' in proc.stdout and 'SURVIVED' not in proc.stdout
+          and proc.returncode == 137,
+          f'rc={proc.returncode} out={proc.stdout!r} '
+          f'err={proc.stderr[-300:]!r}')
+    dp = SweepDecisionProvider(session)
+    decisions = dp.for_sweep(sweep.id)
+    prunes = [d for d in decisions if d.verdict == 'prune']
+    victim = tp.by_id(prunes[0].task) if prunes else None
+    check('dead leader left a recorded-but-unapplied prune',
+          len(prunes) == 1 and victim is not None
+          and victim.status == int(TaskStatus.InProgress),
+          f'prunes={[(d.task, d.rung) for d in prunes]} '
+          f'victim={victim and TaskStatus(victim.status).name}')
+    dead_epoch = int(prunes[0].epoch) if prunes else 0
+
+    # the hot standby: expire the dead leader's lease, promote, tick —
+    # the repair pass must FINISH the recorded prune and the judge
+    # pass must handle the remaining cell, all exactly once
+    rewind(session, 'supervisor_lease', 'expires_at', 1, 3600)
+    standby = LeaderLease(session, holder='chaos:sweep-standby:ccc',
+                          lease_seconds=30.0)
+    sup2 = SupervisorBuilder(session=session, lease=standby)
+    check('standby promotes past the dead leader',
+          standby.ensure() and standby.epoch == dead_epoch + 1,
+          f'epoch={standby.epoch} vs leader {dead_epoch}')
+    sup2.build()
+    rows = [tp.by_id(c.id) for c in cells]
+    check('both losers pruned, winners untouched, across the failover',
+          [r.failure_reason for r in rows] ==
+          [None, None, 'sweep-pruned', 'sweep-pruned']
+          and rows[0].status == int(TaskStatus.InProgress)
+          and rows[2].status == int(TaskStatus.Failed),
+          str([(r.status, r.failure_reason) for r in rows]))
+    dup = session.query(
+        'SELECT task, COUNT(*) AS n FROM sweep_decision WHERE sweep=? '
+        "AND verdict='prune' GROUP BY task HAVING COUNT(*) > 1",
+        (sweep.id,))
+    decisions = dp.for_sweep(sweep.id)
+    check('decision log: exactly one prune per pruned cell',
+          not dup and sorted(
+              d.task for d in decisions if d.verdict == 'prune') ==
+          [cells[2].id, cells[3].id],
+          f'dup={[(r["task"], r["n"]) for r in dup]} '
+          f'decisions={[(d.task, d.verdict) for d in decisions]}')
+
+    # a zombie verdict replayed at the dead leader's epoch: fenced.
+    # A FRESH rung (no existing row) isolates the FENCE as the thing
+    # rejecting the insert — a rung with an existing decision would
+    # zero out on the once-guard before the fence is even consulted
+    zombie = SweepDecisionProvider(
+        FencedSession(session, StaticLease(dead_epoch)))
+    try:
+        zombie.record(sweep.id, cells[0].id, 7, 'prune', 0.0, 1.0,
+                      4, dead_epoch)
+        check('zombie prune verdict rejected by the fence', False)
+    except FenceLostError:
+        check('zombie prune verdict rejected by the fence',
+              (cells[0].id, 7) not in dp.decided(sweep.id))
+
+    # pruned cells are exempt from the retry pass: another tick (and
+    # an explicit recovery pass) must leave them Failed, budget
+    # untouched, no backoff ever scheduled
+    sup2.build()
+    rows = [tp.by_id(c.id) for c in (cells[2], cells[3])]
+    check('sweep-pruned is never auto-retried',
+          all(r.status == int(TaskStatus.Failed)
+              and (r.attempt or 0) == 0 and r.next_retry_at is None
+              for r in rows),
+          str([(r.status, r.attempt, r.next_retry_at) for r in rows]))
+
+    from mlcomp_tpu.telemetry.export import (
+        parse_openmetrics, render_server_metrics,
+    )
+    doc = parse_openmetrics(render_server_metrics(session))
+    prunes_fam = doc.get('mlcomp_sweep_prunes', {}).get('samples', [])
+    cells_fam = doc.get('mlcomp_sweep_cells', {}).get('samples', [])
+    check('prunes and pruned cells visible on /metrics',
+          any(labels.get('sweep') == 'chaos_sweep/cells'
+              and labels.get('rung') == '0' and v == 2
+              for _, labels, v in prunes_fam)
+          and any(labels.get('sweep') == 'chaos_sweep/cells'
+                  and labels.get('state') == 'pruned' and v == 2
+                  for _, labels, v in cells_fam),
+          f'prunes={prunes_fam} cells={cells_fam}')
+
+
 def main():
     session = Session.create_session(key='chaos_smoke')
     migrate(session)
@@ -871,6 +1019,7 @@ def main():
     scenario_fleet_self_healing(session)
     scenario_oom_flight_recorder(session, sup)
     scenario_supervisor_failover(session)
+    scenario_sweep_prune_failover(session)
     if FAILURES:
         print(f'FAIL: {len(FAILURES)} scenario check(s): {FAILURES}')
         return 1

@@ -134,6 +134,40 @@ def seed(session):
     rp.add(ServeReplica(fleet=fleet.id, generation=1, state='dead',
                         failure_reason='replica-unhealthy',
                         created=now()))
+    # ASHA sweep roster (sweep/sweep_decision, migration v13): one
+    # sweep over a 3-cell grid — one running, one pruned at rung 0,
+    # one finished — with the matching decision audit rows
+    from mlcomp_tpu.db.models import Dag, Project, Sweep
+    from mlcomp_tpu.db.providers import (
+        DagProvider, ProjectProvider, SweepDecisionProvider,
+        SweepProvider,
+    )
+    project = ProjectProvider(session).add_project('smoke_sweep')
+    dag = Dag(name='smoke_sweep', project=project.id, config='{}',
+              created=now())
+    DagProvider(session).add(dag)
+    sweep = Sweep(dag=dag.id, executor='cells', name='smoke_sweep',
+                  metric='score', mode='max', eta=2.0, rung_base=1,
+                  unit='epochs', min_cells_per_rung=2, cells=3,
+                  status='active', created=now())
+    SweepProvider(session).add(sweep)
+    tp = TaskProvider(session)
+    cells = []
+    for i, (status, reason) in enumerate((
+            # Queued, not InProgress: the in_progress==1 check above
+            # pins the smoke_train task's exact count
+            (TaskStatus.Queued, None),
+            (TaskStatus.Failed, 'sweep-pruned'),
+            (TaskStatus.Success, None))):
+        cell = Task(name=f'cells lr={i}', executor='cells',
+                    dag=dag.id, status=int(status),
+                    failure_reason=reason, last_activity=now())
+        tp.add(cell)
+        cells.append(cell)
+    dp = SweepDecisionProvider(session)
+    dp.record(sweep.id, cells[0].id, 0, 'promote', 0.9, 0.5, 3, 1)
+    dp.record(sweep.id, cells[1].id, 0, 'prune', 0.2, 0.5, 3, 1)
+    dp.record(sweep.id, cells[2].id, 1, 'promote', 0.95, 0.6, 2, 1)
     return task.id
 
 
@@ -209,6 +243,18 @@ def main():
         ('mlcomp_fleet_swaps_total', any(
             l.get('outcome') == 'completed'
             for _, l, v in doc['mlcomp_fleet_swaps']['samples'])),
+        ('mlcomp_sweep_cells states', all(
+            any(l.get('sweep') == 'smoke_sweep'
+                and l.get('state') == state and v == 1
+                for _, l, v in doc['mlcomp_sweep_cells']['samples'])
+            for state in ('queued', 'pruned', 'finished'))),
+        ('mlcomp_sweep_prunes_total per rung', any(
+            l.get('sweep') == 'smoke_sweep' and l.get('rung') == '0'
+            and v == 1
+            for _, l, v in doc['mlcomp_sweep_prunes']['samples'])),
+        ('mlcomp_sweep_rung ladder position', any(
+            l.get('sweep') == 'smoke_sweep' and v == 1
+            for _, l, v in doc['mlcomp_sweep_rung']['samples'])),
         ('mlcomp_hbm_bytes used/limit/peak', all(
             any(l.get('kind') == kind and l.get('device') == '0'
                 and str(l.get('task')) == str(task_id)

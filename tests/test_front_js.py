@@ -400,6 +400,49 @@ class TestObservabilityCards:
         html = browser.html('#main')
         assert 'no open alerts' in html
 
+    def test_supervisor_tab_sweep_card(self, browser, session):
+        """An ASHA sweep renders its rung ladder and per-cell verdicts
+        (real sweep/decision rows -> real /api/sweeps -> real JS)."""
+        from mlcomp_tpu.db.enums import TaskStatus
+        from mlcomp_tpu.db.models import Dag, Task
+        from mlcomp_tpu.db.providers import (
+            DagProvider, ProjectProvider, SweepDecisionProvider,
+            SweepProvider, TaskProvider,
+        )
+        from mlcomp_tpu.db.models import Sweep
+        from mlcomp_tpu.utils.misc import now
+        project = ProjectProvider(session).add_project('p_sweep_js')
+        dag = Dag(name='jsdag', project=project.id, config='{}',
+                  created=now())
+        DagProvider(session).add(dag)
+        sweep = Sweep(dag=dag.id, executor='cells',
+                      name='jsdag/cells', metric='accuracy',
+                      mode='max', eta=2.0, rung_base=1,
+                      unit='epochs', cells=2, status='active',
+                      created=now())
+        SweepProvider(session).add(sweep)
+        tp = TaskProvider(session)
+        winner = Task(name='cells lr=0.1', executor='cells',
+                      dag=dag.id, status=int(TaskStatus.InProgress),
+                      score=0.91, last_activity=now())
+        loser = Task(name='cells lr=0.5', executor='cells',
+                     dag=dag.id, status=int(TaskStatus.Failed),
+                     failure_reason='sweep-pruned', score=0.34,
+                     last_activity=now())
+        tp.add(winner)
+        tp.add(loser)
+        dp = SweepDecisionProvider(session)
+        dp.record(sweep.id, winner.id, 0, 'promote', 0.91, 0.6, 2, 1)
+        dp.record(sweep.id, loser.id, 0, 'prune', 0.34, 0.6, 2, 1)
+        browser.call('go', 'supervisor')
+        html = browser.html('#main')
+        assert 'sweeps (ASHA early stopping)' in html
+        assert 'jsdag/cells' in html
+        assert 'accuracy/max' in html
+        assert 'rung 0: 1' in html                 # the ladder line
+        assert 'pruned rung 0 (0.34 vs 0.6)' in html
+        assert 'promoted through rung 0' in html
+
 
 class TestJsrtRegressions:
     def test_return_multiline_template_no_asi(self):

@@ -1,0 +1,798 @@
+"""ASHA sweep scheduling tests (server/sweep.py + contrib/search/asha.py).
+
+Covers the rung quantile math on synthetic series (ties, the
+min_cells_per_rung guard, maximize vs minimize), prune-exactly-once
+under a raced double tick, the non-retryable ``sweep-pruned`` verdict,
+fenced prunes from a stale epoch, same-tick slot recycling through the
+event bus, the v12→v13 migration upgrade-in-place, the cell-name
+collision fix, preemption-aware placement, and the acceptance chaos
+run: a sweep through the REAL supervisor loop + threaded worker pool
+reaching the exhaustive best in under half the exhaustive wallclock.
+"""
+
+import threading
+import time
+import uuid
+
+import pytest
+
+from mlcomp_tpu.contrib.search import asha
+from mlcomp_tpu.db.core import Session
+from mlcomp_tpu.db.enums import TaskStatus
+from mlcomp_tpu.db.models import Computer, Task
+from mlcomp_tpu.db.providers import (
+    ComputerProvider, DockerProvider, QueueProvider,
+    SweepDecisionProvider, SweepProvider, TaskProvider,
+)
+from mlcomp_tpu.server.create_dags.standard import dag_standard
+from mlcomp_tpu.server.supervisor import SupervisorBuilder
+from mlcomp_tpu.server.sweep import SWEEP_PRUNED_REASON
+from mlcomp_tpu.utils.io import yaml_load
+from mlcomp_tpu.utils.misc import hostname, now
+from mlcomp_tpu.worker.executors.sweep_probe import probe_score
+
+
+# ------------------------------------------------------------- pure math
+class TestAshaMath:
+    def test_cutoff_is_running_top_quantile(self):
+        assert asha.promote_cutoff([1, 2, 3, 4], 2, 'max') == 3
+        assert asha.promote_cutoff([1, 2, 3, 4], 2, 'min') == 2
+        # k = floor(n/eta), never below 1: the best reporter always
+        # promotes, even alone against eta
+        assert asha.promote_cutoff([5], 2, 'max') == 5
+        assert asha.promote_cutoff([1, 2, 3], 4, 'max') == 3
+
+    def test_judge_maximize_vs_minimize(self):
+        scores = [0.1, 0.5, 0.9, 0.7]
+        assert asha.judge(0.9, scores, 2, 'max') == 'promote'
+        assert asha.judge(0.7, scores, 2, 'max') == 'promote'
+        assert asha.judge(0.5, scores, 2, 'max') == 'prune'
+        assert asha.judge(0.1, scores, 2, 'min') == 'promote'
+        assert asha.judge(0.9, scores, 2, 'min') == 'prune'
+
+    def test_ties_at_the_cutoff_promote(self):
+        # 4 reporters, k=2, cutoff 0.5 — BOTH 0.5 cells survive: the
+        # verdict must not depend on report order among equals
+        scores = [0.5, 0.5, 0.9, 0.1]
+        assert asha.judge(0.5, scores, 2, 'max') == 'promote'
+        assert asha.judge(0.1, scores, 2, 'max') == 'prune'
+
+    def test_rung_boundaries(self):
+        assert asha.rung_boundaries(1, 2, 8) == [1, 2, 4, 8]
+        assert asha.rung_boundaries(3, 3, 30) == [3, 9, 27]
+        assert asha.rung_boundaries(1, 2, 0) == []
+        # fractional eta stays strictly monotone (no rung judged twice)
+        bounds = asha.rung_boundaries(1, 1.5, 20)
+        assert bounds == sorted(set(bounds))
+
+    def test_score_at_rung_is_first_report_past_boundary(self):
+        reports = [(1, 0.3), (2, 0.5), (4, 0.8)]
+        assert asha.score_at_rung(reports, 1) == 0.3
+        assert asha.score_at_rung(reports, 3) == 0.8
+        assert asha.score_at_rung(reports, 5) is None
+
+    def test_spec_validation(self):
+        good = asha.normalize_sweep_spec(
+            {'metric': 'accuracy', 'rung_epochs': 2})
+        assert good == {'metric': 'accuracy', 'mode': 'max',
+                        'eta': 2.0, 'base': 2, 'unit': 'epochs',
+                        'min_cells_per_rung': 2}
+        for bad in (
+                {'rung_epochs': 1},                         # no metric
+                {'metric': 'a'},                            # no rung
+                {'metric': 'a', 'rung_epochs': 1,
+                 'rung_steps': 5},                          # both
+                {'metric': 'a', 'rung_epochs': 1, 'eta': 1},
+                {'metric': 'a', 'rung_epochs': 0},
+                {'metric': 'a', 'rung_epochs': 1, 'mode': 'best'},
+                {'metric': 'a', 'rung_epochs': 1,
+                 'min_cells_per_rung': 1},
+                {'metric': 'a', 'rung_epochs': 1, 'typo': 3},
+        ):
+            with pytest.raises(ValueError):
+                asha.normalize_sweep_spec(bad)
+
+
+# ------------------------------------------------- cell-name collisions
+class TestCellNames:
+    def test_large_cells_differing_early_get_distinct_names(self):
+        from mlcomp_tpu.contrib.search.grid import cell_name
+        filler = {f'param_{i}': f'value_{i}' for i in range(40)}
+        a = cell_name({'lr': 0.1, **filler})
+        b = cell_name({'lr': 0.2, **filler})
+        # the old tail truncation made these identical
+        assert a != b
+        assert len(a) <= 300 and len(b) <= 300
+
+    def test_short_cells_stay_human_readable(self):
+        from mlcomp_tpu.contrib.search.grid import cell_name
+        assert cell_name({'lr': 0.1, 'seed': 3}) == 'lr=0.1 seed=3'
+
+    def test_colliding_cells_unique_within_dag(self, session):
+        filler = {f'p{i:02d}': [f'v{i}'] for i in range(60)}
+        grid = [{'lr': [0.1, 0.2]}] + [{k: v} for k, v in
+                                       filler.items()]
+        config = {
+            'info': {'name': 'collide', 'project': 'p_collide'},
+            'executors': {'noop': {'type': 'noop_exec', 'grid': grid}},
+        }
+        _, tasks = dag_standard(session, config)
+        provider = TaskProvider(session)
+        names = [provider.by_id(t).name for t in tasks['noop']]
+        assert len(names) == 2
+        assert names[0] != names[1]
+        assert all(len(n) <= 180 for n in names)
+
+
+# ------------------------------------------------------------- fixtures
+def add_computer(session, name='host1', cores=2, heartbeat=True):
+    ComputerProvider(session).create_or_update(
+        Computer(name=name, cores=cores, cpu=16, memory=64,
+                 ip='127.0.0.1', can_process_tasks=True), 'name')
+    if heartbeat:
+        DockerProvider(session).heartbeat(name, 'default')
+
+
+SWEEP_CONFIG = {
+    'info': {'name': 'sweep_dag', 'project': 'p_sweep'},
+    'executors': {'cells': {
+        'type': 'sweep_probe', 'cores': 1, 'cpu': 0, 'memory': 0.001,
+        'grid': [{'seed': [0, 1, 2]}, {'lr': [0.05, 0.1]}],
+        'sweep': {'metric': 'score', 'mode': 'max', 'eta': 2,
+                  'rung_epochs': 1, 'min_cells_per_rung': 2},
+        'epochs': 4, 'epoch_s': 0.0,
+    }},
+}
+
+
+def make_sweep(session, config=None):
+    import copy
+    dag, tasks = dag_standard(
+        session, copy.deepcopy(config or SWEEP_CONFIG))
+    sweep = SweepProvider(session).by_dag(dag.id)[0]
+    return dag, tasks['cells'], sweep
+
+
+# ---------------------------------------------------------- scheduler
+class TestSweepScheduler:
+    def test_submission_persists_sweep_and_stamps_cells(self, session):
+        dag, cell_ids, sweep = make_sweep(session)
+        assert (sweep.metric, sweep.mode, sweep.eta) == \
+            ('score', 'max', 2.0)
+        assert sweep.cells == 6 and sweep.status == 'active'
+        info = yaml_load(TaskProvider(session).by_id(
+            cell_ids[0]).additional_info)
+        assert info['sweep']['id'] == sweep.id
+        assert info['sweep']['unit'] == 'epochs'
+
+    def test_sweep_requires_grid(self, session):
+        config = {
+            'info': {'name': 'x', 'project': 'p'},
+            'executors': {'cells': {
+                'type': 'sweep_probe',
+                'sweep': {'metric': 'score', 'rung_epochs': 1}}},
+        }
+        with pytest.raises(ValueError, match='requires a grid'):
+            dag_standard(session, config)
+
+    def test_bad_sweep_spec_rejects_submission(self, session):
+        import copy
+        config = copy.deepcopy(SWEEP_CONFIG)
+        config['executors']['cells']['sweep']['eta'] = 0.5
+        with pytest.raises(ValueError, match='eta'):
+            dag_standard(session, config)
+
+    def test_trainer_metric_mode_mismatch_rejected(self, session):
+        """A jax_train sweep judging a different series than the
+        trainer reports — or maximizing a minimized metric — would
+        prune the winners with a clean audit trail; both reject at
+        submission."""
+        import copy
+        base = {
+            'info': {'name': 'mm', 'project': 'p_mm'},
+            'executors': {'train': {
+                'type': 'jax_train', 'cores': 1,
+                'grid': [{'lr': [0.1, 0.2]}],
+                'main_metric': 'loss', 'minimize': True,
+                'sweep': {'metric': 'loss', 'mode': 'min',
+                          'rung_epochs': 1}}},
+        }
+        wrong_metric = copy.deepcopy(base)
+        wrong_metric['executors']['train']['sweep']['metric'] = \
+            'accuracy'
+        with pytest.raises(ValueError, match='main_metric'):
+            dag_standard(session, wrong_metric)
+        wrong_mode = copy.deepcopy(base)
+        wrong_mode['executors']['train']['sweep']['mode'] = 'max'
+        with pytest.raises(ValueError, match='minimize'):
+            dag_standard(session, wrong_mode)
+        # the consistent spec submits fine
+        dag_standard(session, base)
+        # params:-block resolution (Executor._parse_config semantics):
+        # a trainer configured THROUGH params must validate the same
+        via_params = copy.deepcopy(base)
+        ex = via_params['executors']['train']
+        ex['params'] = {'main_metric': ex.pop('main_metric'),
+                        'minimize': ex.pop('minimize')}
+        dag_standard(session, via_params)       # consistent: fine
+        via_params_bad = copy.deepcopy(via_params)
+        via_params_bad['executors']['train']['sweep']['mode'] = 'max'
+        with pytest.raises(ValueError, match='minimize'):
+            dag_standard(session, via_params_bad)
+
+    def test_prune_and_same_tick_recycle(self, session):
+        """The acceptance mechanics in one tick: the loser is judged,
+        failed ``sweep-pruned``, its queue message revoked, and the
+        freed core re-placed into the next queued cell in the SAME
+        build — with the prune published on the tasks channel so a
+        parked loop would wake for it."""
+        from mlcomp_tpu.db import events
+        add_computer(session, cores=2)
+        _, cell_ids, sweep = make_sweep(session)
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        tp = TaskProvider(session)
+        running = [tp.by_id(t) for t in cell_ids
+                   if tp.by_id(t).status == int(TaskStatus.Queued)]
+        assert len(running) == 2        # 2 cores
+        for cell, score in zip(running, (0.9, 0.2)):
+            tp.change_status(cell, TaskStatus.InProgress)
+            asha.report_sweep_score(session, cell.id, 1, score)
+        snapshot = events.snapshot(['tasks'])
+        sup.build()
+        loser = tp.by_id(running[1].id)
+        assert loser.status == int(TaskStatus.Failed)
+        assert loser.failure_reason == SWEEP_PRUNED_REASON
+        assert loser.queue_id is not None
+        msg = session.query_one(
+            'SELECT status FROM queue_message WHERE id=?',
+            (loser.queue_id,))
+        assert msg['status'] == 'revoked'
+        # the freed slot went to the next queued cell IN THIS TICK
+        queued_now = [t for t in cell_ids
+                      if tp.by_id(t).status == int(TaskStatus.Queued)]
+        assert len(queued_now) == 1
+        # and the prune transition woke the tasks channel
+        assert events.snapshot(['tasks'])['tasks'] > snapshot['tasks']
+        decisions = SweepDecisionProvider(session).for_sweep(sweep.id)
+        assert {(d.task, d.verdict) for d in decisions} == {
+            (running[0].id, 'promote'), (running[1].id, 'prune')}
+
+    def test_min_cells_per_rung_guard(self, session):
+        add_computer(session, cores=1)
+        _, cell_ids, sweep = make_sweep(session)
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        tp = TaskProvider(session)
+        first = next(t for t in map(tp.by_id, cell_ids)
+                     if t.status == int(TaskStatus.Queued))
+        tp.change_status(first, TaskStatus.InProgress)
+        asha.report_sweep_score(session, first.id, 1, 0.01)
+        sup.build()
+        # a lone terrible reporter is NOT judged: quantiles over one
+        # straggler would prune on noise
+        assert SweepDecisionProvider(session).for_sweep(sweep.id) == []
+        assert tp.by_id(first.id).status == int(TaskStatus.InProgress)
+
+    def test_async_judging_no_rung_barrier(self, session):
+        """A cell is judged at rung 1 the moment IT reports, even
+        while peers are still mid-rung-0 — and rung-0 history from
+        terminal cells stays in the population."""
+        add_computer(session, cores=6)
+        _, cell_ids, sweep = make_sweep(session)
+        tp = TaskProvider(session)
+        cells = [tp.by_id(t) for t in cell_ids]
+        for cell in cells[:4]:
+            tp.change_status(cell, TaskStatus.InProgress)
+        for cell, s0 in zip(cells[:4], (0.8, 0.7, 0.3, 0.2)):
+            asha.report_sweep_score(session, cell.id, 1, s0)
+        # the two front-runners already reported rung 1 (budget 2)
+        # while cells 2/3 sit mid-rung-0 and cells 4/5 never started
+        asha.report_sweep_score(session, cells[0].id, 2, 0.9)
+        asha.report_sweep_score(session, cells[1].id, 2, 0.85)
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        decided = SweepDecisionProvider(session).decided(sweep.id)
+        assert decided[(cells[0].id, 0)] == 'promote'
+        assert decided[(cells[2].id, 0)] == 'prune'
+        assert decided[(cells[3].id, 0)] == 'prune'
+        # rung 1 judged from its TWO reporters only — no barrier
+        # waiting for the rest of the population
+        assert decided[(cells[0].id, 1)] == 'promote'
+        assert decided[(cells[1].id, 1)] == 'prune'
+        tp2 = TaskProvider(session)
+        assert tp2.by_id(cells[1].id).failure_reason == \
+            SWEEP_PRUNED_REASON
+
+    def test_prune_exactly_once_raced_double_tick(self, session):
+        """Two builders (a raced double tick) judge the same rung:
+        exactly one decision row lands, the second conditional insert
+        is a benign no-op, and the repair path never re-records."""
+        add_computer(session, cores=2)
+        _, cell_ids, sweep = make_sweep(session)
+        sup1 = SupervisorBuilder(session=session)
+        sup1.build()
+        tp = TaskProvider(session)
+        running = [tp.by_id(t) for t in cell_ids
+                   if tp.by_id(t).status == int(TaskStatus.Queued)]
+        for cell, score in zip(running, (0.9, 0.2)):
+            tp.change_status(cell, TaskStatus.InProgress)
+            asha.report_sweep_score(session, cell.id, 1, score)
+        sup2 = SupervisorBuilder(session=session)
+        sup1.build()
+        sup2.build()
+        rows = session.query(
+            'SELECT task, rung, COUNT(*) AS n FROM sweep_decision '
+            'GROUP BY task, rung')
+        assert all(r['n'] == 1 for r in rows)
+        # and the provider-level guard is race-safe on its own
+        dp = SweepDecisionProvider(session)
+        assert not dp.record(sweep.id, running[1].id, 0, 'prune',
+                             0.2, 0.9, 2, 0)
+
+    def test_idle_ticks_skip_report_materialization(self, session,
+                                                    monkeypatch):
+        """The judge pass short-circuits on the sweep.score watermark:
+        a tick with no new reports must not re-fetch a big sweep's
+        whole score history (repair/finish still run every tick)."""
+        add_computer(session, cores=2)
+        _, cell_ids, sweep = make_sweep(session)
+        tp = TaskProvider(session)
+        cell = tp.by_id(cell_ids[0])
+        tp.change_status(cell, TaskStatus.InProgress)
+        asha.report_sweep_score(session, cell.id, 1, 0.5)
+        sup = SupervisorBuilder(session=session)
+        calls = []
+        original = SweepProvider.rung_reports
+        monkeypatch.setattr(
+            SweepProvider, 'rung_reports',
+            lambda self, ids: calls.append(1) or original(self, ids))
+        sup.build()                     # first tick always judges
+        sup.build()                     # no new reports: skipped
+        sup.build()
+        assert len(calls) == 1
+        asha.report_sweep_score(session, cell.id, 2, 0.6)
+        sup.build()                     # watermark moved: judged
+        assert len(calls) == 2
+
+    def test_sweep_pruned_never_retried(self, session):
+        from mlcomp_tpu.recovery import TRANSIENT_REASONS, is_transient
+        assert SWEEP_PRUNED_REASON not in TRANSIENT_REASONS
+        assert not is_transient(SWEEP_PRUNED_REASON)
+        add_computer(session, cores=2)
+        _, cell_ids, sweep = make_sweep(session)
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        tp = TaskProvider(session)
+        running = [tp.by_id(t) for t in cell_ids
+                   if tp.by_id(t).status == int(TaskStatus.Queued)]
+        for cell, score in zip(running, (0.9, 0.2)):
+            tp.change_status(cell, TaskStatus.InProgress)
+            asha.report_sweep_score(session, cell.id, 1, score)
+        sup.build()
+        loser_id = running[1].id
+        for _ in range(3):      # retry pass runs every tick
+            sup.build()
+        loser = tp.by_id(loser_id)
+        assert loser.status == int(TaskStatus.Failed)
+        assert loser.failure_reason == SWEEP_PRUNED_REASON
+        assert (loser.attempt or 0) == 0
+        assert loser.next_retry_at is None
+        # and the watchdog's finished-task handling leaves it be: no
+        # alert rows ever reference the pruned cell
+        rows = session.query('SELECT * FROM alert WHERE task=?',
+                             (loser_id,))
+        assert rows == []
+
+    def test_fenced_prune_rejected_from_stale_epoch(self, session):
+        """A zombie ex-leader (StaticLease at an old epoch) may judge
+        a rung, but the store rejects both the decision row and the
+        kill — FenceLostError propagates so the HA loop demotes."""
+        from mlcomp_tpu.db.fencing import FenceLostError
+        from mlcomp_tpu.server.ha import StaticLease
+        add_computer(session, cores=2)
+        _, cell_ids, sweep = make_sweep(session)
+        session.execute(
+            'UPDATE supervisor_lease SET epoch=5, holder=? WHERE id=1',
+            ('live:leader:xyz',))
+        tp = TaskProvider(session)
+        cells = [tp.by_id(t) for t in cell_ids[:2]]
+        for cell, score in zip(cells, (0.9, 0.2)):
+            tp.change_status(cell, TaskStatus.InProgress)
+            asha.report_sweep_score(session, cell.id, 1, score)
+        zombie = SupervisorBuilder(session=session,
+                                   lease=StaticLease(3))
+        with pytest.raises(FenceLostError):
+            zombie.sweep_scheduler.tick()
+        assert SweepDecisionProvider(session).for_sweep(sweep.id) == []
+        assert tp.by_id(cells[1].id).status == \
+            int(TaskStatus.InProgress)
+
+    def test_leader_crash_mid_prune_repaired_exactly_once(self,
+                                                          session):
+        """The chaos shape in-process: verdict recorded, apply never
+        ran (simulated by recording the decision directly) — the next
+        tick's repair pass finishes the kill, once."""
+        add_computer(session, cores=2)
+        _, cell_ids, sweep = make_sweep(session)
+        tp = TaskProvider(session)
+        cell = tp.by_id(cell_ids[0])
+        tp.change_status(cell, TaskStatus.InProgress)
+        asha.report_sweep_score(session, cell.id, 1, 0.2)
+        SweepDecisionProvider(session).record(
+            sweep.id, cell.id, 0, 'prune', 0.2, 0.9, 4, 1)
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        fixed = tp.by_id(cell.id)
+        assert fixed.status == int(TaskStatus.Failed)
+        assert fixed.failure_reason == SWEEP_PRUNED_REASON
+        rows = session.query(
+            "SELECT COUNT(*) AS n FROM sweep_decision WHERE task=? "
+            "AND verdict='prune'", (cell.id,))
+        assert rows[0]['n'] == 1
+
+    def test_distributed_cell_prune_gang_aborts(self, session):
+        add_computer(session, cores=2)
+        _, cell_ids, sweep = make_sweep(session)
+        tp = TaskProvider(session)
+        cells = [tp.by_id(t) for t in cell_ids[:2]]
+        for cell, score in zip(cells, (0.9, 0.2)):
+            cell.gang_id = f'g{cell.id}'
+            tp.update(cell, ['gang_id'])
+            tp.change_status(cell, TaskStatus.InProgress)
+            asha.report_sweep_score(session, cell.id, 1, score)
+        sup = SupervisorBuilder(session=session)
+        aborted = []
+        sup.sweep_scheduler.gang_abort = aborted.append
+        sup.sweep_scheduler.tick()
+        assert aborted == [cells[1].id]     # only the loser's gang
+
+    def test_sweep_finishes_with_best(self, session):
+        add_computer(session, cores=2)
+        _, cell_ids, sweep = make_sweep(session)
+        tp = TaskProvider(session)
+        for i, t in enumerate(cell_ids[:-1]):
+            cell = tp.by_id(t)
+            cell.score = 0.1 * (i + 1)
+            tp.update(cell, ['score'])
+            tp.change_status(cell, TaskStatus.Success)
+        # a pruned cell with the HIGHEST best-so-far score (a rung-0
+        # noise spike): a finisher must still win — a killed loser was
+        # never trained to completion
+        spike = tp.by_id(cell_ids[-1])
+        spike.score = 0.99
+        tp.update(spike, ['score'])
+        tp.fail_with_reason(spike, SWEEP_PRUNED_REASON)
+        SupervisorBuilder(session=session).build()
+        done = SweepProvider(session).by_id(sweep.id)
+        assert done.status == 'done'
+        assert done.best_task == cell_ids[-2]
+        assert done.best_score == pytest.approx(0.5)
+
+    def test_preemption_aware_placement(self, session):
+        """Sweep cells steer off hosts whose recovery history shows
+        transient failures, even when packing would prefer them;
+        non-sweep tasks keep the packing order."""
+        add_computer(session, name='flaky', cores=8)
+        add_computer(session, name='calm', cores=4)
+        # recovery history: two transient verdicts on 'flaky'
+        tp = TaskProvider(session)
+        for i in range(2):
+            ghost = Task(name=f'ghost{i}', executor='noop_exec',
+                         status=int(TaskStatus.Stopped),
+                         computer_assigned='flaky',
+                         failure_reason='preempted',
+                         last_activity=now())
+            tp.add(ghost)
+        _, cell_ids, _ = make_sweep(session)
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        cells = [tp.by_id(t) for t in cell_ids]
+        placed = {c.computer_assigned for c in cells
+                  if c.status == int(TaskStatus.Queued)}
+        # 6 cells over calm(4) first, overflow onto flaky(8)
+        assert tp.by_id(cell_ids[0]).computer_assigned == 'calm'
+        assert placed == {'calm', 'flaky'}
+        dispatched_calm = sum(
+            1 for c in cells if c.computer_assigned == 'calm')
+        assert dispatched_calm == 4
+
+    def test_api_sweeps_roster(self, session):
+        from mlcomp_tpu.server.api import api_sweeps
+        add_computer(session, cores=2)
+        _, cell_ids, sweep = make_sweep(session)
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        tp = TaskProvider(session)
+        running = [tp.by_id(t) for t in cell_ids
+                   if tp.by_id(t).status == int(TaskStatus.Queued)]
+        for cell, score in zip(running, (0.9, 0.2)):
+            tp.change_status(cell, TaskStatus.InProgress)
+            asha.report_sweep_score(session, cell.id, 1, score)
+        sup.build()
+        roster = api_sweeps({}, session)['data']
+        assert len(roster) == 1
+        entry = roster[0]
+        assert entry['id'] == sweep.id
+        assert entry['rungs'] == [
+            {'rung': 0, 'promoted': 1, 'pruned': 1}]
+        by_task = {c['task']: c for c in entry['cells']}
+        assert by_task[running[1].id]['pruned'] is True
+        prune = by_task[running[1].id]['decisions'][0]
+        assert prune['verdict'] == 'prune'
+        assert prune['score'] == pytest.approx(0.2)
+        assert prune['cutoff'] == pytest.approx(0.9)
+
+    def test_executor_rung_report_contract(self, session):
+        """JaxTrain._report_sweep: emits the sweep.score row for the
+        CELL task (parent for a fanned-out rank), in the sweep's
+        budget unit, and flags rung-boundary epochs for the forced
+        checkpoint."""
+        from mlcomp_tpu.train.executor import JaxTrain
+        ex = JaxTrain(model={'name': 'mlp'}, epochs=1)
+        task = Task(name='cell', executor='cells',
+                    status=int(TaskStatus.InProgress),
+                    last_activity=now())
+        TaskProvider(session).add(task)
+        ex.session = session
+        ex.task = task
+        ex.additional_info = {'sweep': {
+            'id': 1, 'metric': 'accuracy', 'mode': 'max', 'eta': 2.0,
+            'base': 1, 'unit': 'epochs', 'min_cells_per_rung': 2}}
+        assert ex._report_sweep(0, 10, 0.5) is True      # epoch 1 = rung
+        assert ex._report_sweep(2, 10, 0.6) is False     # epoch 3: no
+        assert ex._report_sweep(3, 10, 0.7) is True      # epoch 4 = rung
+        rows = session.query(
+            "SELECT step, value FROM metric WHERE name=? AND task=? "
+            "ORDER BY id", (asha.SWEEP_SCORE_METRIC, task.id))
+        assert [(r['step'], r['value']) for r in rows] == [
+            (1, 0.5), (3, 0.6), (4, 0.7)]
+        # steps unit: budget = epochs_done * steps_per_epoch
+        ex.additional_info['sweep'].update(unit='steps', base=20)
+        assert ex._report_sweep(1, 10, 0.8) is True      # 20 steps
+        row = session.query(
+            'SELECT step FROM metric WHERE name=? AND task=? '
+            'ORDER BY id DESC LIMIT 1',
+            (asha.SWEEP_SCORE_METRIC, task.id))
+        assert row[0]['step'] == 20
+        # a step-unit boundary falling MID-epoch still forces the
+        # checkpoint at the epoch that CROSSED it (base=15 with 10
+        # steps/epoch: epoch 2 crosses 15, epoch 3 crosses 30)
+        ex.additional_info['sweep'].update(unit='steps', base=15)
+        assert ex._report_sweep(0, 10, 0.1) is False     # 10 < 15
+        assert ex._report_sweep(1, 10, 0.2) is True      # crossed 15
+        assert ex._report_sweep(2, 10, 0.3) is True      # crossed 30
+        assert ex._report_sweep(3, 10, 0.4) is False     # 40: none
+
+
+# ----------------------------------------------------------- migration
+class TestMigrationV13:
+    def test_v12_to_v13_upgrade_in_place(self, tmp_path):
+        from mlcomp_tpu.db.migration import MIGRATIONS, migrate
+        key = f'v13_{uuid.uuid4().hex[:8]}'
+        s = Session.create_session(
+            key=key, connection_string=f'sqlite:///{tmp_path}/up.db')
+        try:
+            # a live v12 deployment: all chains up to HA, plus data
+            s.execute('CREATE TABLE IF NOT EXISTS migration_version '
+                      '(version INTEGER)')
+            for i, fn in enumerate(MIGRATIONS[:12], start=1):
+                fn(s)
+                s.execute('INSERT INTO migration_version (version) '
+                          'VALUES (?)', (i,))
+            s.execute('DROP TABLE sweep')
+            s.execute('DROP TABLE sweep_decision')
+            tp = TaskProvider(s)
+            task = Task(name='legacy', executor='x',
+                        status=int(TaskStatus.Success),
+                        last_activity=now())
+            tp.add(task)
+            assert migrate(s) == 13
+            row = s.query_one('SELECT MAX(version) AS v '
+                              'FROM migration_version')
+            assert row['v'] == 13
+            # tables exist, legacy data intact, unique index enforced
+            assert s.table_columns('sweep')
+            assert s.table_columns('sweep_decision')
+            assert tp.by_id(task.id).name == 'legacy'
+            from mlcomp_tpu.db.models import Dag, Project, Sweep
+            from mlcomp_tpu.db.providers import (
+                DagProvider, ProjectProvider,
+            )
+            project = ProjectProvider(s).add_project('up_p')
+            dag = Dag(name='up_dag', project=project.id, config='{}',
+                      created=now())
+            DagProvider(s).add(dag)
+            sweep = Sweep(dag=dag.id, executor='cells', name='up',
+                          metric='score', created=now())
+            SweepProvider(s).add(sweep)
+            dp = SweepDecisionProvider(s)
+            assert dp.record(sweep.id, task.id, 0, 'prune',
+                             0.1, 0.5, 2, 1)
+            assert not dp.record(sweep.id, task.id, 0, 'promote',
+                                 0.9, 0.5, 2, 1)
+            import sqlite3
+            with pytest.raises(sqlite3.IntegrityError):
+                s.execute(
+                    'INSERT INTO sweep_decision (sweep, task, rung, '
+                    'verdict, time) VALUES (?, ?, 0, ?, ?)',
+                    (sweep.id, task.id, 'prune', now()))
+        finally:
+            Session.cleanup(key)
+
+
+# --------------------------------------------------------- end to end
+HOST = hostname()
+
+
+def _worker_loop(worker_id, queue, epochs, epoch_s, stop_evt):
+    """One slot of the pool: claim, 'train' (sleep + deterministic
+    probe_score reports per epoch), notice prunes, finish."""
+    sess = Session.create_session(key=f'sweep_pool_{worker_id}')
+    qp, tp = QueueProvider(sess), TaskProvider(sess)
+    me = f'pool:{worker_id}'
+    while not stop_evt.is_set():
+        claim = qp.claim([queue], me)
+        if claim is None:
+            time.sleep(0.01)
+            continue
+        msg_id, payload = claim
+        if payload.get('action') != 'execute':
+            qp.complete(msg_id, worker=me)
+            continue
+        task = tp.by_id(payload['task_id'])
+        # NotRan is claimable: a message can be claimed in the window
+        # between its enqueue and the task's Queued pairing write —
+        # the real ExecuteBuilder.check_status accepts it for the
+        # same reason
+        if task is None or task.status > int(TaskStatus.Queued):
+            qp.complete(msg_id, worker=me)
+            continue
+        tp.change_status(task, TaskStatus.InProgress)
+        info = yaml_load(task.additional_info) or {}
+        cell = info.get('grid') or {}
+        lr, seed = float(cell.get('lr', 0.1)), int(cell.get('seed', 0))
+        best = None
+        for epoch in range(1, epochs + 1):
+            time.sleep(epoch_s)
+            row = tp.by_id(task.id)
+            if row is None or row.status >= int(TaskStatus.Failed):
+                break               # pruned mid-run
+            score = probe_score(lr, seed, epoch)
+            if best is None or score > best:
+                best = score
+                task.score = float(score)
+                tp.update(task, ['score'])
+            asha.report_sweep_score(sess, task.id, epoch, score)
+        row = tp.by_id(task.id)
+        if row is not None and row.status < int(TaskStatus.Failed):
+            tp.change_status(row, TaskStatus.Success)
+        qp.complete(msg_id, worker=me)
+
+
+def _run_sweep_dag(n_seeds, epochs, epoch_s, slots, sweep: bool,
+                   timeout_s: float = 120.0):
+    """One dag through the REAL supervisor loop (event-driven, 50 ms
+    backstop) + a threaded worker pool; returns (wallclock, session,
+    dag). The in-process event bus crosses threads, so rung reports
+    wake the judge immediately — the no-tick-latency-gap contract."""
+    import copy
+
+    from mlcomp_tpu.server.supervisor import SupervisorLoop
+    from mlcomp_tpu.utils.tests import fresh_session
+    session = fresh_session()
+    add_computer(session, name=HOST, cores=slots)
+    config = copy.deepcopy(SWEEP_CONFIG)
+    spec = config['executors']['cells']
+    spec['grid'] = [{'seed': list(range(n_seeds))},
+                    {'lr': [0.05, 0.1]}]
+    spec['epochs'] = epochs
+    if not sweep:
+        del spec['sweep']
+    run_id = uuid.uuid4().hex[:8]
+    stop_evt = threading.Event()
+    workers = [threading.Thread(
+        target=_worker_loop,
+        args=(f'{run_id}_{i}', f'{HOST}_default', epochs, epoch_s,
+              stop_evt),
+        daemon=True) for i in range(slots)]
+    builder = SupervisorBuilder(
+        session=Session.create_session(key=f'sweep_sup_{run_id}'))
+    loop = SupervisorLoop(builder, interval=0.05)
+    t0 = time.monotonic()
+    dag, tasks = dag_standard(session, config)
+    loop.start()
+    for w in workers:
+        w.start()
+    tp = TaskProvider(session)
+    finished = set(int(s) for s in TaskStatus.finished())
+    try:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            rows = [tp.by_id(t) for t in tasks['cells']]
+            if all(r.status in finished for r in rows):
+                break
+            time.sleep(0.05)
+        else:
+            raise TimeoutError(
+                f'sweep dag did not finish: '
+                f'{[(r.id, r.status) for r in rows]}')
+        wallclock = time.monotonic() - t0
+    finally:
+        stop_evt.set()
+        loop.stop()
+        loop.join(timeout=10)
+        for w in workers:
+            w.join(timeout=10)
+    # one post-pool tick so the sweep summary (_maybe_finish) reflects
+    # the final cell states even if the loop stopped mid-transition;
+    # deliberately outside the timed window
+    builder.build()
+    return wallclock, session, dag, tasks['cells']
+
+
+def _audit(session, dag, cell_ids):
+    """The acceptance audit: every pruned cell has exactly one prune
+    decision row, and no pruned cell ever consumed a retry."""
+    tp = TaskProvider(session)
+    cells = [tp.by_id(t) for t in cell_ids]
+    pruned = [c for c in cells
+              if c.failure_reason == SWEEP_PRUNED_REASON]
+    sweep = SweepProvider(session).by_dag(dag.id)[0]
+    decisions = SweepDecisionProvider(session).for_sweep(sweep.id)
+    prune_rows = [d for d in decisions if d.verdict == 'prune']
+    assert sorted(d.task for d in prune_rows) == \
+        sorted(c.id for c in pruned)
+    assert all((c.attempt or 0) == 0 and c.next_retry_at is None
+               for c in pruned)
+    others = [c for c in cells if c not in pruned]
+    assert all(c.status == int(TaskStatus.Success) for c in others)
+    return cells, pruned, sweep
+
+
+class TestSweepEndToEnd:
+    def test_six_cell_sweep_prunes_and_keeps_the_best(self):
+        """The tier-1 leg of the acceptance: a 6-cell sweep through
+        the real loop + pool prunes losers, finishes, and its best
+        equals the analytic exhaustive best exactly."""
+        epochs = 4
+        _, session, dag, cell_ids = _run_sweep_dag(
+            n_seeds=3, epochs=epochs, epoch_s=0.10, slots=2,
+            sweep=True)
+        cells, pruned, sweep = _audit(session, dag, cell_ids)
+        assert len(pruned) >= 1
+        true_best = max(
+            probe_score(lr, seed, epochs)
+            for seed in range(3) for lr in (0.05, 0.1))
+        best = max(c.score for c in cells if c.score is not None)
+        assert best == pytest.approx(true_best, abs=1e-9)
+        done = SweepProvider(session).by_id(sweep.id)
+        assert done.status == 'done'
+        assert done.best_score == pytest.approx(true_best, abs=1e-9)
+
+    @pytest.mark.slow
+    def test_24_cell_sweep_under_half_exhaustive_wallclock(self):
+        """The acceptance chaos run (ROADMAP item 5): the same
+        24-cell grid exhaustive vs sweep-scheduled on the same
+        threaded pool — same best score, under HALF the wallclock,
+        every prune audited, zero pruned cells retried."""
+        # 12 epochs → rungs at 1/2/4/8 with a 12-epoch final budget:
+        # deep enough that rung savings dominate the fixed submit +
+        # pool-startup overhead both wallclocks share
+        epochs, epoch_s, slots = 12, 0.15, 4
+        full_wall, _, _, _ = _run_sweep_dag(
+            n_seeds=12, epochs=epochs, epoch_s=epoch_s, slots=slots,
+            sweep=False, timeout_s=240)
+        asha_wall, session, dag, cell_ids = _run_sweep_dag(
+            n_seeds=12, epochs=epochs, epoch_s=epoch_s, slots=slots,
+            sweep=True, timeout_s=240)
+        cells, pruned, _ = _audit(session, dag, cell_ids)
+        assert len(cells) == 24
+        assert len(pruned) >= 10
+        true_best = max(
+            probe_score(lr, seed, epochs)
+            for seed in range(12) for lr in (0.05, 0.1))
+        best = max(c.score for c in cells if c.score is not None)
+        assert best == pytest.approx(true_best, abs=1e-9)
+        assert asha_wall < 0.5 * full_wall, (
+            f'sweep {asha_wall:.2f}s vs exhaustive {full_wall:.2f}s')
