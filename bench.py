@@ -1325,6 +1325,108 @@ def bench_dispatch() -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_economy() -> dict:
+    """Steady-state overhead of the cluster-economy passes (ISSUE 18):
+    the usage-ledger fold (``process_usage`` on a drained worklist —
+    the per-tick common case) and one full SLO burn-rate evaluation
+    (``telemetry/slo.py``), each timed in isolation on a seeded
+    throwaway sqlite root and amortized at PRODUCTION CADENCE — the
+    fold runs every supervisor tick (1 s loop interval), the SLO
+    engine every ``evaluate_every_s`` (10 s) — as a percentage of
+    that cadence's wall-clock budget. The bench_guard floors hold
+    both under 1%: the economy layer must stay effectively free."""
+    import datetime as _dt
+    import tempfile
+    from mlcomp_tpu.db.core import Session
+    from mlcomp_tpu.db.enums import TaskStatus
+    from mlcomp_tpu.db.migration import migrate
+    from mlcomp_tpu.db.models import Computer, Task
+    from mlcomp_tpu.db.providers import (
+        ComputerProvider, MetricProvider, TaskProvider,
+    )
+    from mlcomp_tpu.server.supervisor import SupervisorBuilder
+    from mlcomp_tpu.telemetry.slo import SloConfig, SloEngine
+    from mlcomp_tpu.utils.misc import now
+
+    db = tempfile.mktemp(suffix='.db', prefix='bench_economy_')
+    key = 'bench_economy'
+    try:
+        s = Session.create_session(
+            key=key, connection_string=f'sqlite:///{db}')
+        migrate(s)
+        ComputerProvider(s).create_or_update(
+            Computer(name='bench', cores=8, cpu=16, memory=64,
+                     ip='127.0.0.1', can_process_tasks=True), 'name')
+        tp = TaskProvider(s)
+        fin = now()
+        # a lived-in control plane: folded history + a live cohort +
+        # a metric table big enough that unindexed scans would show
+        for i in range(200):
+            tp.add(Task(name=f'hist_{i}', executor='train',
+                        status=int(TaskStatus.Success), owner='o',
+                        project='p', cores_assigned='[0]',
+                        started=fin - _dt.timedelta(seconds=60),
+                        finished=fin, last_activity=now()))
+        for i in range(50):
+            tp.add(Task(name=f'live_{i}', executor='train',
+                        status=int(TaskStatus.InProgress),
+                        computer_assigned='bench',
+                        cores_assigned='[0]', started=now(),
+                        last_activity=now()))
+        ts = now()
+        mp = MetricProvider(s)
+        mp.add_many([(1, 'train.loss', 'series', i, 0.5, ts, 'train',
+                      None) for i in range(20000)])
+        mp.add_many(
+            [(None, 'supervisor.dispatch_latency_s.p99', 'histogram',
+              None, 0.4, ts, 'supervisor', None)]
+            + [(None, f'queue.wait_s.{c}.p95', 'histogram', None, 5.0,
+                ts, 'supervisor', None)
+               for c in ('train', 'sweep', 'serve-replica',
+                         'service')])
+        sup = SupervisorBuilder(session=s)
+        sup.build()                       # folds the seeded backlog
+        reps = 100
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            sup.process_usage()
+        fold_ms = (time.perf_counter() - t0) * 1000 / reps
+        engine = SloEngine(s, config=SloConfig(evaluate_every_s=0.0))
+        engine.evaluate()                 # warm: first SLI rows land
+        reps = 50
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            engine.evaluate()
+        eval_ms = (time.perf_counter() - t0) * 1000 / reps
+        tick_interval_ms = 1000.0         # SupervisorLoop backstop
+        eval_period_ms = SloConfig.evaluate_every_s * 1000.0
+        return {
+            'usage_fold_overhead_pct':
+                round(100.0 * fold_ms / tick_interval_ms, 4),
+            'usage_fold_overhead_note':
+                f'steady-state usage fold ({fold_ms * 1000:.1f} '
+                f'us/tick, drained worklist, 200 folded + 50 live '
+                f'tasks) per 1 s supervisor tick interval; '
+                f'budget <1%',
+            'slo_eval_overhead_pct':
+                round(100.0 * eval_ms / eval_period_ms, 4),
+            'slo_eval_overhead_note':
+                f'full SLO burn-rate evaluation ({eval_ms:.2f} '
+                f'ms/eval: every objective measured + 3 windows '
+                f'averaged + SLI/burn gauges persisted, 20k-row '
+                f'metric table) per 10 s evaluation period; '
+                f'budget <1%',
+        }
+    except Exception as e:
+        return {'economy_error': f'{type(e).__name__}: {e}'[:300]}
+    finally:
+        Session.cleanup(key)
+        try:
+            os.unlink(db)
+        except OSError:
+            pass
+
+
 def main():
     # the grid-DAG leg runs FIRST, before this process initializes jax:
     # its worker task subprocesses need the chip to themselves (a second
@@ -1346,6 +1448,14 @@ def main():
     if os.environ.get('BENCH_DISPATCH', '1') == '1' and \
             not over_budget():
         dispatch_result = bench_dispatch()
+
+    # cluster-economy overhead leg: jax-free and cheap (~3 s); the
+    # usage fold + SLO evaluation must stay effectively free at
+    # production cadence (bench_guard floors <1%)
+    economy_result = {}
+    if os.environ.get('BENCH_ECONOMY', '1') == '1' and \
+            not over_budget():
+        economy_result = bench_economy()
 
     # the fleet leg is jax-free (stub replicas + the routing gateway on
     # loopback) and cheap (~12 s) — it runs before this process
@@ -1878,6 +1988,7 @@ def main():
     result.update(asha_result)
     result.update(dispatch_result)
     result.update(fleet_result)
+    result.update(economy_result)
 
     # second workload: the flagship long-context LM (skippable, and
     # skipped automatically on CPU where a T=8192 dense step is

@@ -92,7 +92,8 @@ def _preflight(config_path: str, params=()):
     return findings, config, folder
 
 
-def _dag(config_path: str, params=(), debug: bool = False):
+def _dag(config_path: str, params=(), debug: bool = False,
+         owner: str = None):
     from mlcomp_tpu.analysis import format_report, split_findings
     from mlcomp_tpu.server.create_dags import dag_pipe, dag_standard
 
@@ -108,6 +109,10 @@ def _dag(config_path: str, params=(), debug: bool = False):
     session = Session.create_session()
     migrate(session)
     config, text = _load_config(config_path, params, config=raw)
+    if owner:
+        # --owner beats info.owner: the submitting human outranks a
+        # config checked in by someone else (usage-ledger tenant label)
+        config.setdefault('info', {})['owner'] = owner
     logger = create_logger(session)
     if 'pipes' in config:
         # pipe registration (reference __main__.py:49-52): nothing runs
@@ -127,9 +132,12 @@ def _dag(config_path: str, params=(), debug: bool = False):
 @click.argument('config')
 @click.option('--params', multiple=True,
               help='override config values, e.g. --params lr:0.01')
-def dag(config, params):
+@click.option('--owner', default=None,
+              help='tenant label for the usage ledger '
+                   '(overrides info.owner; default "default")')
+def dag(config, params, owner):
     """Submit a DAG (or register a pipe) to the scheduler."""
-    _, dag_row, tasks, _ = _dag(config, params)
+    _, dag_row, tasks, _ = _dag(config, params, owner=owner)
     total = sum(len(v) for v in tasks.values())
     click.echo(f'dag {dag_row.id} created with {total} tasks')
 
@@ -699,6 +707,90 @@ def sweeps(as_json, show_all):
             elif c['pruned']:
                 line += ' — pruned'
             click.echo(line)
+
+
+@main.command()
+@click.option('--json', 'as_json', is_flag=True,
+              help='machine-readable output')
+@click.option('--group-by', 'group_by', default='owner',
+              type=click.Choice(['owner', 'project', 'task_class',
+                                 'computer']),
+              help='aggregation key for the totals table')
+@click.option('--owner', default=None,
+              help='filter the recent rows to one owner')
+@click.option('--project', default=None,
+              help='filter the recent rows to one project')
+@click.option('--limit', default=20, help='recent rows to show')
+def usage(as_json, group_by, owner, project, limit):
+    """Usage ledger (migration v14): per-tenant TPU core-seconds,
+    queue-wait and peak-HBM totals folded exactly once per terminal
+    task attempt, plus the newest folded rows."""
+    from mlcomp_tpu.server.api import api_usage
+    session = Session.create_session()
+    migrate(session)
+    data = api_usage({'group_by': group_by, 'owner': owner,
+                      'project': project, 'limit': limit},
+                     session)['data']
+    if as_json:
+        click.echo(json.dumps(data))
+        return
+    if not data['totals']:
+        click.echo('usage ledger is empty')
+        return
+    click.echo(f"usage by {data['group_by']} "
+               f"({data['count']} ledger rows):")
+    for t in data['totals']:
+        line = (f"  {t['key'] or 'default'}: "
+                f"{t['core_seconds'] or 0:.1f} core-s "
+                f"over {t['tasks']} tasks")
+        if t['queue_wait_s_max'] is not None:
+            line += f", max queue wait {t['queue_wait_s_max']:.1f}s"
+        if t['hbm_peak_bytes']:
+            line += (f", peak HBM "
+                     f"{t['hbm_peak_bytes'] / 2 ** 30:.2f} GiB")
+        click.echo(line)
+    if data['recent']:
+        click.echo('recent:')
+        for r in data['recent']:
+            line = (f"  task {r['task']} attempt {r['attempt']} "
+                    f"[{r['status']}] {r['owner']}/{r['project']} "
+                    f"{r['task_class']}: "
+                    f"{r['core_seconds'] or 0:.1f} core-s")
+            if r['queue_wait_s'] is not None:
+                line += f", waited {r['queue_wait_s']:.1f}s"
+            click.echo(line)
+
+
+@main.command()
+@click.option('--json', 'as_json', is_flag=True,
+              help='machine-readable output')
+def slos(as_json):
+    """SLO scoreboard (telemetry/slo.py): every objective the burn-
+    rate engine evaluates — latest bad-fraction, fast (5m) and slow
+    (6h) error-budget burn rates, and the open alert when burning."""
+    from mlcomp_tpu.server.api import api_slos
+    session = Session.create_session()
+    migrate(session)
+    items = api_slos({}, session)['data']
+    if as_json:
+        click.echo(json.dumps(items))
+        return
+    if not items:
+        click.echo('no SLO objectives evaluated yet '
+                   '(the supervisor records them while running)')
+        return
+    for it in items:
+        line = f"  {it['key']} [{it['status']}]"
+        if it['bad'] is not None:
+            line += f" bad={it['bad']:.4f}"
+        if it.get('burn_fast') is not None:
+            line += f" burn_fast={it['burn_fast']:.2f}"
+        if it.get('burn_slow') is not None:
+            line += f" burn_slow={it['burn_slow']:.2f}"
+        if it.get('alert'):
+            line += (f" — {it['alert']['severity']}: "
+                     f"{it['alert']['message']}")
+        click.echo(line)
 
 
 if __name__ == '__main__':

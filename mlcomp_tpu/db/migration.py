@@ -367,10 +367,65 @@ def _v13_sweep(session: Session):
         'ON sweep_decision("sweep", "task", "rung")')
 
 
+def _v14_usage(session: Session):
+    """Cluster-economy accounting: owner/project tenant labels on
+    dag/task plus the ``usage`` ledger table (db/models/usage.py). The
+    ALTERs are guarded by live pragma checks like every column
+    migration; the UNIQUE index is the store-level backstop of the
+    supervisor fold's exactly-once conditional insert (a raced double
+    tick or a failover replay can never double-bill an attempt — the
+    sweep_decision pattern, v13). The backfill then folds every
+    ALREADY-terminal task from its existing started/finished/
+    cores_assigned columns so an upgraded deployment's /api/usage
+    shows its history instead of a cold-start-empty ledger."""
+    from mlcomp_tpu.db.models import Usage
+    have = session.table_columns('dag')
+    if have and 'owner' not in have:
+        session.execute('ALTER TABLE dag ADD COLUMN "owner" TEXT')
+    have = session.table_columns('task')
+    if have:        # empty = table absent (partial legacy DB)
+        for column in ('owner', 'project'):
+            if column not in have:
+                session.execute(
+                    f'ALTER TABLE task ADD COLUMN "{column}" TEXT')
+    for stmt in Usage.create_table_ddl(_dialect(session)):
+        session.execute(stmt)               # IF NOT EXISTS — safe
+    session.execute(
+        'CREATE UNIQUE INDEX IF NOT EXISTS idx_usage_once '
+        'ON usage("task", "attempt")')
+    # the per-tick queue_wait/starvation queries LEFT JOIN task on
+    # queue_id — previously an unindexed column
+    if session.table_columns('task'):
+        session.execute(
+            'CREATE INDEX IF NOT EXISTS idx_task_queue_id '
+            'ON task("queue_id")')
+    # the SLO engine's point lookups and window averages (WHERE name=?
+    # AND time >= ?) and the export collectors' name-scans cannot be
+    # served by the (task, name) index — name-first access needs its
+    # own
+    if session.table_columns('metric'):
+        session.execute(
+            'CREATE INDEX IF NOT EXISTS idx_metric_name_time '
+            'ON metric("name", "time")')
+    # backfill: one ledger row per already-terminal attempt. Metric
+    # history may have aged out (hbm NULL) and old queue messages may
+    # be gone (queue_wait NULL) — the fold degrades per-fact, it never
+    # skips the row.
+    if session.table_columns('task'):
+        from mlcomp_tpu.db.providers.usage import UsageProvider
+        provider = UsageProvider(session)
+        while True:
+            batch = provider.unfolded_terminal_tasks(limit=500)
+            if not batch:
+                break
+            for task in batch:
+                provider.fold_task(task)
+
+
 MIGRATIONS = [_v1_init, _v2_data, _v3_auth, _v4_telemetry, _v5_preflight,
               _v6_tracing_alerts, _v7_recovery, _v8_gang, _v9_fleet,
               _v10_postmortem, _v11_dispatch_indexes, _v12_supervisor_ha,
-              _v13_sweep]
+              _v13_sweep, _v14_usage]
 
 
 def migrate(session: Session = None):

@@ -23,6 +23,7 @@ from mlcomp_tpu.db.models.supervisor import (
     SupervisorInstance, SupervisorLease,
 )
 from mlcomp_tpu.db.models.sweep import Sweep, SweepDecision
+from mlcomp_tpu.db.models.usage import Usage
 
 ALL_MODELS = [
     Project, Report, ReportLayout, Dag, Task, TaskDependence, TaskSynced,
@@ -33,6 +34,7 @@ ALL_MODELS = [
     ServeFleet, ServeReplica,
     SupervisorLease, SupervisorInstance,
     Sweep, SweepDecision,
+    Usage,
 ]
 
 __all__ = [m.__name__ for m in ALL_MODELS] + ['ALL_MODELS']

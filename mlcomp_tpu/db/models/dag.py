@@ -17,6 +17,10 @@ class Dag(DBModel):
     file_size = Column('INTEGER', default=0)
     type = Column('INTEGER', default=0)       # DagType
     report = Column('INTEGER')                # Report.id
+    # tenant label (migration v14): who submitted this dag. The
+    # usage ledger and queue accounting group by it; defaults to
+    # 'default' when the config/CLI did not say.
+    owner = Column('TEXT')
 
 
 class DagPreflight(DBModel):

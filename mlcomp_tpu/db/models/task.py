@@ -59,6 +59,12 @@ class Task(DBModel):
     # once" accounting the chaos suite asserts on.
     gang_id = Column('TEXT', index=True)
     gang_generation = Column('INTEGER', default=0)
+    # cluster-economy labels (migration v14): which tenant submitted
+    # this work and which project NAME it bills to — denormalized onto
+    # the task so the usage ledger folds and the queue-wait gauges
+    # group without a dag/project join on the tick hot path.
+    owner = Column('TEXT')
+    project = Column('TEXT')
 
 
 class TaskDependence(DBModel):

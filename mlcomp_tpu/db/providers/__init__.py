@@ -31,10 +31,11 @@ from mlcomp_tpu.db.providers.supervisor import SupervisorLeaseProvider
 from mlcomp_tpu.db.providers.sweep import (
     SweepDecisionProvider, SweepProvider,
 )
+from mlcomp_tpu.db.providers.usage import UsageProvider
 
 __all__ = [
     'FleetProvider', 'ReplicaProvider', 'SupervisorLeaseProvider',
-    'SweepProvider', 'SweepDecisionProvider',
+    'SweepProvider', 'SweepDecisionProvider', 'UsageProvider',
     'WorkerTokenProvider', 'DbAuditProvider', 'AlertProvider',
     'MetricProvider', 'TelemetrySpanProvider', 'PostmortemProvider',
     'DagPreflightProvider',

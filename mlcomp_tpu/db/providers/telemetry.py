@@ -342,6 +342,16 @@ class AlertProvider(BaseDataProvider):
             "WHERE id=? AND status='open'", (now(), int(alert_id)))
         return cur.rowcount > 0
 
+    def resolve_rule(self, rule: str) -> int:
+        """Close every open TASK-LESS alert of one rule — the SLO
+        engine's auto-resolve path. ``resolve_for_task`` requires a
+        task id, and burn-rate alerts describe the platform, not a
+        task, so they dedup and resolve on (rule, task IS NULL)."""
+        return self.session.execute(
+            "UPDATE alert SET status='resolved', resolved_time=? "
+            "WHERE rule=? AND task IS NULL AND status='open'",
+            (now(), rule)).rowcount
+
     def resolve_for_task(self, task_id: int, rule: str = None) -> int:
         """Close every open alert of a task (optionally one rule) —
         called when the condition clears or the task leaves the

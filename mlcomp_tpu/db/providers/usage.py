@@ -1,0 +1,197 @@
+"""Usage-ledger provider — exactly-once folds + grouped aggregation.
+
+The supervisor calls ``fold_task`` at every terminal transition (and
+the v14 migration calls it once per already-terminal legacy task); the
+insert is conditional on no existing row for the same (task, attempt),
+race-safe as a single statement on both backends and backstopped by
+the v14 unique index — the same decision-row pattern sweep_decision
+uses (db/providers/sweep.py). Through a FencedSession the statement
+additionally carries the leader's epoch predicate, so a zombie
+ex-leader can never double-bill an attempt across a failover.
+
+``aggregate`` is the read side: plain GROUP BYs over the settled rows,
+the shape ``/api/usage`` and the ``mlcomp_tpu usage`` CLI serve.
+"""
+
+import json
+
+from mlcomp_tpu.db.core import parse_datetime
+from mlcomp_tpu.db.enums import TaskStatus, TaskType
+from mlcomp_tpu.db.models import Usage
+from mlcomp_tpu.db.providers.base import BaseDataProvider
+from mlcomp_tpu.utils.misc import now
+
+#: the scheduling classes usage and queue-wait accounting group by —
+#: shared with the per-class wait histograms (supervisor tick) and the
+#: SLO objectives (telemetry/slo.py) so every surface buckets alike
+TASK_CLASSES = ('train', 'sweep', 'serve-replica', 'service')
+
+
+def task_class_of(task) -> str:
+    """Scheduling class of a task row for accounting purposes.
+
+    Works on both Task model objects and raw dict rows (the migration
+    backfill folds rows predating the Task model's newest columns).
+    Priority order matters: a sweep cell is 'sweep' even though its
+    executor is a trainer, a serve replica is 'serve-replica' even
+    though its type is Service.
+    """
+    get = task.get if isinstance(task, dict) else \
+        lambda k, d=None: getattr(task, k, d)
+    info = get('additional_info') or ''
+    if 'sweep' in str(info):
+        return 'sweep'
+    if get('executor') == 'serve_replica':
+        return 'serve-replica'
+    if get('type') == int(TaskType.Service):
+        return 'service'
+    return 'train'
+
+
+class UsageProvider(BaseDataProvider):
+    model = Usage
+
+    # ------------------------------------------------------------ fold
+    def fold_task(self, task) -> bool:
+        """Fold one terminal task attempt into the ledger EXACTLY
+        ONCE. Returns True when THIS call wrote the row. Facts are
+        derived at fold time from columns the task already carries:
+
+        - core-seconds: assigned core count (cores_assigned json list,
+          falling back to the requested ``cores``) x started->finished
+        - queue-wait: enqueue->claim of the task's queue message
+          (NULL when the message aged out or was never claimed)
+        - peak HBM: MAX over the PR 10 ``device*.hbm_used`` series
+          (NULL for uninstrumented tasks) — one indexed (task, name)
+          scan
+        """
+        started = parse_datetime(task.started)
+        finished = parse_datetime(task.finished)
+        cores = self._billed_cores(task)
+        core_seconds = None
+        if started and finished and finished >= started:
+            core_seconds = cores * (finished - started).total_seconds()
+        cur = self.session.execute(
+            'INSERT INTO usage '
+            '(task, attempt, dag, owner, project, task_class, computer, '
+            'cores, core_seconds, queue_wait_s, hbm_peak_bytes, '
+            'started, finished, status, created) '
+            'SELECT ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ? '
+            'WHERE NOT EXISTS (SELECT 1 FROM usage '
+            'WHERE task=? AND attempt=?)',
+            (int(task.id), int(task.attempt or 0), task.dag,
+             getattr(task, 'owner', None) or 'default',
+             getattr(task, 'project', None) or 'default',
+             task_class_of(task), task.computer_assigned, cores,
+             core_seconds, self.queue_wait(task), self.hbm_peak(task.id),
+             task.started, task.finished, int(task.status), now(),
+             int(task.id), int(task.attempt or 0)))
+        return cur.rowcount > 0
+
+    @staticmethod
+    def _billed_cores(task) -> int:
+        assigned = getattr(task, 'cores_assigned', None)
+        if assigned:
+            try:
+                return len(json.loads(assigned))
+            except (ValueError, TypeError):
+                pass
+        return int(task.cores or 0)
+
+    def queue_wait(self, task):
+        """enqueue->claim seconds of the task's queue message, or None
+        when unknowable (no message, never claimed, aged out)."""
+        if not getattr(task, 'queue_id', None):
+            return None
+        # legacy upgrade-in-place DBs can predate the queue_message
+        # table entirely; the fold degrades per-fact, never skips a row
+        if not self.session.table_columns('queue_message'):
+            return None
+        row = self.session.query_one(
+            'SELECT created, claimed_at FROM queue_message WHERE id=?',
+            (int(task.queue_id),))
+        if row is None:
+            return None
+        created = parse_datetime(row['created'])
+        claimed = parse_datetime(row['claimed_at'])
+        if created is None or claimed is None or claimed < created:
+            return None
+        return (claimed - created).total_seconds()
+
+    def hbm_peak(self, task_id: int):
+        """Peak HBM bytes across every device of a task, or None for
+        uninstrumented tasks. Rides the (task, name) composite."""
+        # same per-fact degradation as queue_wait: a v7-era DB being
+        # upgraded in place has no metric table to scan
+        if not self.session.table_columns('metric'):
+            return None
+        row = self.session.query_one(
+            "SELECT MAX(value) AS peak FROM metric "
+            "WHERE task=? AND name LIKE 'device%.hbm_used'",
+            (int(task_id),))
+        return row['peak'] if row else None
+
+    def unfolded_terminal_tasks(self, limit: int = 500):
+        """Terminal task rows with no ledger row for their current
+        attempt — the per-tick fold worklist. The anti-join keeps a
+        replayed tick (or a failover) cheap: settled history matches
+        its usage row and drops out of the scan."""
+        from mlcomp_tpu.db.models import Task
+        marks = ','.join('?' * len(TaskStatus.finished()))
+        rows = self.session.query(
+            f'SELECT t.* FROM task t WHERE t.status IN ({marks}) '
+            f'AND NOT EXISTS (SELECT 1 FROM usage u WHERE u.task=t.id '
+            f'AND u.attempt=COALESCE(t.attempt, 0)) '
+            f'ORDER BY t.id LIMIT ?',
+            tuple(int(s) for s in TaskStatus.finished()) + (int(limit),))
+        return [Task.from_row(r) for r in rows]
+
+    # ------------------------------------------------------------ reads
+    def aggregate(self, group_by: str = 'owner'):
+        """Grouped totals: ``[{key, tasks, core_seconds,
+        queue_wait_s_total, queue_wait_s_max, hbm_peak_bytes}, ...]``
+        ordered by core-seconds descending. ``group_by`` is one of
+        owner | project | task_class | computer (validated — it is
+        interpolated into SQL)."""
+        if group_by not in ('owner', 'project', 'task_class',
+                            'computer'):
+            raise ValueError(f'cannot group usage by {group_by!r}')
+        rows = self.session.query(
+            f'SELECT {group_by} AS key, COUNT(*) AS tasks, '
+            f'SUM(core_seconds) AS core_seconds, '
+            f'SUM(queue_wait_s) AS queue_wait_s_total, '
+            f'MAX(queue_wait_s) AS queue_wait_s_max, '
+            f'MAX(hbm_peak_bytes) AS hbm_peak_bytes '
+            f'FROM usage GROUP BY {group_by} '
+            f'ORDER BY SUM(core_seconds) DESC, key')
+        return [{'key': r['key'], 'tasks': r['tasks'],
+                 'core_seconds': r['core_seconds'],
+                 'queue_wait_s_total': r['queue_wait_s_total'],
+                 'queue_wait_s_max': r['queue_wait_s_max'],
+                 'hbm_peak_bytes': r['hbm_peak_bytes']}
+                for r in rows]
+
+    def recent(self, limit: int = 100, owner: str = None,
+               project: str = None):
+        """Newest ledger rows, optionally filtered by label."""
+        where, params = [], []
+        if owner is not None:
+            where.append('owner=?')
+            params.append(owner)
+        if project is not None:
+            where.append('project=?')
+            params.append(project)
+        sql = 'SELECT * FROM usage'
+        if where:
+            sql += ' WHERE ' + ' AND '.join(where)
+        sql += ' ORDER BY id DESC LIMIT ?'
+        params.append(int(limit))
+        return [Usage.from_row(r)
+                for r in self.session.query(sql, tuple(params))]
+
+    def count(self) -> int:
+        row = self.session.query_one('SELECT COUNT(*) AS n FROM usage')
+        return row['n'] if row else 0
+
+
+__all__ = ['UsageProvider', 'task_class_of', 'TASK_CLASSES']

@@ -654,6 +654,47 @@ def api_sweeps(data, s):
     return {'data': out}
 
 
+def api_usage(data, s):
+    """Usage-ledger read (migration v14): per-tenant totals grouped by
+    ``group_by`` (owner|project|task_class|computer, default owner)
+    plus the newest folded rows, filterable by owner/project. Same
+    no-auth introspection tier as /api/sweeps; the dashboard's usage
+    card and the `mlcomp_tpu usage` CLI read this."""
+    from mlcomp_tpu.db.providers import UsageProvider
+    up = UsageProvider(s)
+    group_by = data.get('group_by') or 'owner'
+    limit = _int_arg(data, 'limit') if data.get('limit') else 20
+    rows = up.recent(limit=limit, owner=data.get('owner') or None,
+                     project=data.get('project') or None)
+    return {'data': {
+        'group_by': group_by,
+        'totals': up.aggregate(group_by),
+        'count': up.count(),
+        'recent': [{
+            'task': r.task, 'attempt': r.attempt, 'dag': r.dag,
+            'owner': r.owner, 'project': r.project,
+            'task_class': r.task_class, 'computer': r.computer,
+            'cores': r.cores, 'core_seconds': r.core_seconds,
+            'queue_wait_s': r.queue_wait_s,
+            'hbm_peak_bytes': r.hbm_peak_bytes,
+            'status': TaskStatus(r.status).name
+            if r.status is not None else None,
+            'started': str(r.started or ''),
+            'finished': str(r.finished or ''),
+        } for r in rows],
+    }}
+
+
+def api_slos(data, s):
+    """SLO scoreboard (telemetry/slo.py): every objective the burn-
+    rate engine has evaluated — latest bad-fraction, fast/slow burn
+    rates, and the open slo-* alert when one is burning. Same no-auth
+    introspection tier as /api/alerts; the dashboard's SLO card and
+    the `mlcomp_tpu slos` CLI read this."""
+    from mlcomp_tpu.telemetry import slo_status
+    return {'data': slo_status(s)}
+
+
 def _fleet_or_404(data, s):
     from mlcomp_tpu.db.providers import FleetProvider
     fleet = None
@@ -1094,6 +1135,10 @@ _ROUTES = {
     '/api/fleets': (api_fleets, False),
     # ASHA sweep roster (server/sweep.py): read-only audit surface
     '/api/sweeps': (api_sweeps, False),
+    # cluster-economy reads (migration v14 + telemetry/slo.py):
+    # aggregates + objective verdicts, no secrets — introspection tier
+    '/api/usage': (api_usage, False),
+    '/api/slos': (api_slos, False),
     '/api/fleet/create': (api_fleet_create, True),
     '/api/fleet/scale': (api_fleet_scale, True),
     '/api/fleet/swap': (api_fleet_swap, True),
@@ -1129,7 +1174,8 @@ _READ_ONLY_ROUTES = frozenset({
     '/api/img_classify', '/api/img_segment', '/api/config', '/api/graph',
     '/api/dags', '/api/code', '/api/tasks', '/api/task/info',
     '/api/task/steps', '/api/dag/preflight', '/api/auxiliary',
-    '/api/fleets', '/api/sweeps', '/api/logs', '/api/reports',
+    '/api/fleets', '/api/sweeps', '/api/usage', '/api/slos',
+    '/api/logs', '/api/reports',
     '/api/report', '/api/report/update_layout_start',
     '/api/telemetry/series', '/api/telemetry/spans',
     '/api/telemetry/trace', '/api/alerts', '/api/task/postmortem',
@@ -1328,6 +1374,7 @@ class ApiHandler(BaseHTTPRequestHandler):
             return
         if parsed.path in ('/telemetry/series', '/telemetry/spans',
                            '/api/alerts', '/api/fleets', '/api/sweeps',
+                           '/api/usage', '/api/slos',
                            '/api/task/postmortem') \
                 or parsed.path.startswith('/telemetry/trace/'):
             # GET mirrors of the POST routes (curl-friendly:
@@ -1347,6 +1394,10 @@ class ApiHandler(BaseHTTPRequestHandler):
                 handler = api_fleets
             elif parsed.path == '/api/sweeps':
                 handler = api_sweeps
+            elif parsed.path == '/api/usage':
+                handler = api_usage
+            elif parsed.path == '/api/slos':
+                handler = api_slos
             elif parsed.path == '/api/task/postmortem':
                 handler = api_task_postmortem
             else:

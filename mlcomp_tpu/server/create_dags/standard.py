@@ -114,6 +114,11 @@ class DagStandardBuilder:
             type=int(DagType.Standard),
             created=now(),
             report=self.dag_report_id,
+            # tenant label for the usage ledger (migration v14):
+            # info.owner from the config or --owner on submit; every
+            # task inherits it below so the supervisor's fold never
+            # joins back to the dag row
+            owner=str(self.info.get('owner') or 'default'),
         )
         self.dag_provider.add(dag)
         self.dag = dag
@@ -286,6 +291,8 @@ class DagStandardBuilder:
             single_node=bool(spec.get('single_node', True)),
             additional_info=yaml_dump(additional_info),
             last_activity=now(),
+            owner=str(self.info.get('owner') or 'default'),
+            project=self.project.name,
         )
         self.task_provider.add(task)
 

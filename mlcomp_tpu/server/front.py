@@ -746,6 +746,48 @@ async function viewSupervisor(el) {
         </table></div>`;
         }).join('') + '</div>'));
   }
+  // SLO scoreboard (telemetry/slo.py): every objective the burn-rate
+  // engine evaluates — latest bad fraction, fast/slow burn, and the
+  // open alert while burning. Burning rows render with the severity.
+  let slos = {data: []};
+  try { slos = await api('slos'); } catch (e) {}
+  if (slos && slos.success === false) slos = {data: []};
+  if ((slos.data||[]).length) {
+    el.appendChild(h('<h3>SLOs (burn rates)</h3>'));
+    el.appendChild(h('<div class="card"><table>'
+      + '<tr><th>objective</th><th>status</th><th>bad</th>'
+      + '<th>burn 5m</th><th>burn 6h</th><th>alert</th></tr>'
+      + slos.data.map(o => `<tr${o.status==='ok' ? '' :
+          ' style="color:' + (o.status==='critical'
+            ? 'var(--bad,#e66)' : 'var(--warn,#ea3)') + '"'}>
+        <td>${esc(o.key)}</td><td>${esc(o.status)}</td>
+        <td>${o.bad==null?'':esc(o.bad)}</td>
+        <td>${o.burn_fast==null?'':esc(o.burn_fast)}</td>
+        <td>${o.burn_slow==null?'':esc(o.burn_slow)}</td>
+        <td class="dim">${o.alert?esc(o.alert.message||''):''}</td>
+        </tr>`).join('') + '</table></div>'));
+  }
+  // usage ledger (migration v14): per-tenant core-seconds + wait +
+  // peak HBM, folded exactly once per terminal attempt
+  let usage = {data: {totals: [], recent: []}};
+  try { usage = await api('usage', {group_by: 'owner'}); } catch (e) {}
+  if (usage && usage.success === false)
+    usage = {data: {totals: [], recent: []}};
+  const ut = (usage.data && usage.data.totals) || [];
+  if (ut.length) {
+    el.appendChild(h('<h3>usage (core-seconds by owner)</h3>'));
+    el.appendChild(h('<div class="card"><table>'
+      + '<tr><th>owner</th><th>tasks</th><th>core-s</th>'
+      + '<th>max wait s</th><th>peak HBM</th></tr>'
+      + ut.map(t => `<tr><td>${esc(t.key||'default')}</td>
+        <td>${t.tasks}</td>
+        <td>${(t.core_seconds||0).toFixed(1)}</td>
+        <td>${t.queue_wait_s_max==null?''
+              :t.queue_wait_s_max.toFixed(1)}</td>
+        <td>${t.hbm_peak_bytes
+              ?(t.hbm_peak_bytes/1073741824).toFixed(2)+' GiB':''}</td>
+        </tr>`).join('') + '</table></div>'));
+  }
   const np = sup.not_placed || {};
   if (Object.keys(np).length)
     el.appendChild(h('<h3>not placed (reasons)</h3><table>'

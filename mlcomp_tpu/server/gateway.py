@@ -44,7 +44,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlsplit
 
 from mlcomp_tpu import TOKEN
-from mlcomp_tpu.server.serve import LATENCY_BUCKETS_MS
+# TRACE_HEADER: stamped on every proxied upstream request (and honored
+# when a client supplies its own) — serve.py reads it back, so a
+# serving request's gateway hop and replica handling assemble into one
+# ``GET /telemetry/trace/<id>`` tree like the DAG/worker path
+from mlcomp_tpu.server.serve import LATENCY_BUCKETS_MS, TRACE_HEADER
 
 #: header that marks a request as a health probe — never shed
 PROBE_HEADER = 'X-MLComp-Probe'
@@ -458,17 +462,19 @@ class FleetGateway:
 
     # ------------------------------------------------------------ proxy
     def _forward(self, backend: _Backend, path: str, body: bytes,
-                 timeout: float):
+                 timeout: float, trace_id: str = None):
         """POST over a pooled persistent connection. Returns
         (status, payload) for EVERY HTTP status — unlike urllib,
         http.client does not raise on 4xx/5xx, so the caller sees the
         replica's verdict directly; only transport failures raise."""
         conn = backend.acquire(timeout)
         reusable = False
+        headers = {'Authorization': self.token,
+                   'Content-Type': 'application/json'}
+        if trace_id:
+            headers[TRACE_HEADER] = trace_id
         try:
-            conn.request('POST', path, body=body,
-                         headers={'Authorization': self.token,
-                                  'Content-Type': 'application/json'})
+            conn.request('POST', path, body=body, headers=headers)
             resp = conn.getresponse()
             payload = resp.read()
             reusable = not resp.will_close
@@ -476,10 +482,17 @@ class FleetGateway:
         finally:
             backend.release(conn, reusable)
 
-    def proxy_predict(self, name: str, body: bytes, probe: bool = False):
+    def proxy_predict(self, name: str, body: bytes, probe: bool = False,
+                      trace_id: str = None):
         """The full admission + routing + hedge path for one request.
         Returns (status, payload_bytes). Separated from the HTTP
-        handler so tests and the bench drive it directly."""
+        handler so tests and the bench drive it directly.
+
+        Every admitted request gets a trace id (the caller's, or one
+        minted here), stamped on the upstream hop (``X-MLComp-Trace``,
+        read back by serve.py) and recorded as a ``role='gateway'``
+        span — the serving path's entry into the cross-process trace
+        forest."""
         route = self.route(name)
         if route is None:
             return 404, json.dumps(
@@ -495,18 +508,31 @@ class FleetGateway:
             return 429, json.dumps(
                 {'error': 'shedding load — rolling p99 over SLO '
                           'or queue full', 'retry_after_s': 1}).encode()
+        from mlcomp_tpu.telemetry.spans import new_trace_id, record_span
+        trace_id = trace_id or new_trace_id()
+        started = time.time()
         t0 = time.monotonic()
+        status = None
         try:
-            return self._proxy_with_hedge(route, name, body)
+            status, payload = self._proxy_with_hedge(
+                route, name, body, trace_id=trace_id)
+            return status, payload
         finally:
             route.release()
             ms = (time.monotonic() - t0) * 1e3
             route.slo.observe(ms)
             self.telemetry.observe(f'fleet.{name}.latency_ms', ms,
                                    buckets=LATENCY_BUCKETS_MS)
+            record_span(
+                'gateway.predict', started, ms / 1e3,
+                tags={'fleet': name,
+                      'status': status if status is not None else 'exc'},
+                status='ok' if status is not None and status < 500
+                else 'error',
+                trace_id=trace_id, role='gateway')
 
     def _proxy_with_hedge(self, route: _FleetRoute, name: str,
-                          body: bytes):
+                          body: bytes, trace_id: str = None):
         first = route.pick()
         if first is None:
             with route.lock:
@@ -515,7 +541,7 @@ class FleetGateway:
                 {'error': f'no healthy replica for {name!r}',
                  'retry_after_s': 1}).encode()
         try:
-            return self._attempt(route, first, body)
+            return self._attempt(route, first, body, trace_id=trace_id)
         except (_ReplicaReply, http.client.HTTPException,
                 OSError) as exc:
             # predicts are idempotent: one hedged retry on a DIFFERENT
@@ -534,7 +560,8 @@ class FleetGateway:
                 with route.lock:
                     route.hedges += 1
                 try:
-                    result = self._attempt(route, second, body)
+                    result = self._attempt(route, second, body,
+                                           trace_id=trace_id)
                     with route.lock:
                         route.failovers += 1
                     return result
@@ -549,12 +576,13 @@ class FleetGateway:
                 {'error': f'replica unreachable: {exc}'}).encode()
 
     def _attempt(self, route: _FleetRoute, backend: _Backend,
-                 body: bytes):
+                 body: bytes, trace_id: str = None):
         with route.lock:
             backend.requests += 1
         try:
             status, payload = self._forward(
-                backend, '/predict', body, self.request_timeout_s)
+                backend, '/predict', body, self.request_timeout_s,
+                trace_id=trace_id)
         except (http.client.HTTPException, OSError):
             with route.lock:
                 backend.errors += 1
@@ -645,8 +673,10 @@ class FleetGateway:
                              'fleets': names}).encode())
                     name = names[0]
                 probe = self.headers.get(PROBE_HEADER) is not None
+                trace_id = (self.headers.get(TRACE_HEADER) or '') \
+                    .strip() or None
                 status, payload = gateway.proxy_predict(
-                    name, body, probe=probe)
+                    name, body, probe=probe, trace_id=trace_id)
                 self._send(status, payload,
                            retry_after=1 if status in (429, 503)
                            else None)
@@ -733,6 +763,11 @@ class FleetGateway:
             self.telemetry.gauge(f'fleet.{name}.requests_cum',
                                  snap['requests'])
         self.telemetry.flush(session)
+        # the gateway spans minted per proxied predict ride the same
+        # flush cadence — without this the trace forest never sees the
+        # gateway hop
+        from mlcomp_tpu.telemetry.spans import flush_spans
+        flush_spans(session)
 
     # -------------------------------------------------------- lifecycle
     def bind(self):
@@ -782,4 +817,4 @@ class FleetGateway:
 
 
 __all__ = ['FleetGateway', 'CircuitBreaker', 'HedgeBudget',
-           'RollingSlo', 'PROBE_HEADER']
+           'RollingSlo', 'PROBE_HEADER', 'TRACE_HEADER']

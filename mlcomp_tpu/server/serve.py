@@ -64,6 +64,12 @@ class Backpressure(RuntimeError):
 LATENCY_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
                       500.0, 1000.0, 2500.0)
 
+#: trace-context header (canonical definition — the gateway imports it
+#: to stamp proxied requests): a predict carrying it gets its replica
+#: handling recorded as a ``role='serving'`` span under that trace, so
+#: ``GET /telemetry/trace/<id>`` assembles gateway hop + replica work
+TRACE_HEADER = 'X-MLComp-Trace'
+
 
 def resolve_model(name_or_path: str, project: str = None) -> str:
     """An explicit path wins; otherwise look under
@@ -567,15 +573,40 @@ class ModelServer:
                     self.end_headers()
                     self.wfile.write(blob)
                     return
+                # trace read-back: a gateway-stamped (or client-
+                # supplied) trace id joins this replica's handling to
+                # the cross-process trace; traceless requests pay one
+                # header read and nothing else
+                trace_id = (self.headers.get(TRACE_HEADER) or '') \
+                    .strip() or None
+                started = time.time()
+                t0 = time.monotonic()
+                status = 'ok'
                 try:
                     body = json.loads(raw or '{}')
                     self._send(200, model.handle_predict(body))
                 except Backpressure as e:
+                    status = 'backpressure'
                     self._send(429, {'error': str(e)})
                 except (ValueError, TypeError) as e:
+                    status = 'bad-request'
                     self._send(400, {'error': str(e)})
                 except Exception as e:  # noqa — keep the server up
+                    status = 'error'
                     self._send(500, {'error': str(e)})
+                finally:
+                    if trace_id:
+                        from mlcomp_tpu.telemetry.spans import (
+                            record_span,
+                        )
+                        record_span(
+                            'serve.predict', started,
+                            time.monotonic() - t0,
+                            tags={'model': model.name,
+                                  'outcome': status},
+                            status='ok' if status != 'error'
+                            else 'error',
+                            trace_id=trace_id, role='serving')
 
         return Handler
 
@@ -674,6 +705,10 @@ class ModelServer:
                             'ts': time.time(),
                             'updated': str(now())})
                     self.telemetry.flush(session)
+                    # serving spans (trace read-back in _do_post) ride
+                    # the same cadence as the metric flush
+                    from mlcomp_tpu.telemetry.spans import flush_spans
+                    flush_spans(session)
                     last_err[0] = None
                 except Exception as e:
                     # a DB hiccup must not kill serving, but a BROKEN
@@ -758,4 +793,5 @@ class ModelServer:
             self.httpd.server_close()
 
 
-__all__ = ['ModelServer', 'resolve_model', 'Backpressure']
+__all__ = ['ModelServer', 'resolve_model', 'Backpressure',
+           'TRACE_HEADER']

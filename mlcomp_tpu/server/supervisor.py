@@ -25,6 +25,7 @@ Celery/Redis; queue naming keeps the reference scheme
 import contextlib
 import json
 import threading
+import time
 import traceback
 from mlcomp_tpu import MASTER_PORT_RANGE
 from mlcomp_tpu.db.core import Session
@@ -38,6 +39,12 @@ from mlcomp_tpu.db.providers import (
 )
 from mlcomp_tpu.utils.io import yaml_dump, yaml_load
 from mlcomp_tpu.utils.misc import now
+
+#: queue-wait histogram bucket bounds (seconds) — spread covers an
+#: event-driven same-tick claim (~sub-second) through a starved class
+#: waiting hours; +Inf is implicit (telemetry Histogram)
+QUEUE_WAIT_BUCKETS_S = (1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0,
+                        600.0, 1800.0, 3600.0)
 
 
 class SupervisorBuilder:
@@ -107,6 +114,16 @@ class SupervisorBuilder:
         self.sweep_scheduler = SweepScheduler(
             self.session, logger=logger, telemetry=self.telemetry,
             gang_abort=self.gang_abort)
+        # cluster-economy plane (migration v14): the usage ledger fold
+        # (one exactly-once row per terminal task attempt) and the SLO
+        # burn-rate engine (telemetry/slo.py) — both run inside the
+        # tick, both rate-limit/bound themselves, both ride the fenced
+        # session so a zombie ex-leader can neither double-bill nor
+        # flap alerts
+        from mlcomp_tpu.db.providers.usage import UsageProvider
+        self.usage_provider = UsageProvider(self.session)
+        from mlcomp_tpu.telemetry import SloEngine
+        self.slo_engine = SloEngine(self.session, logger=logger)
         # per-tick cache for the sweep cells' preemption-aware
         # placement: computer -> transient-failure count (recovery
         # taxonomy history); None = not computed this tick
@@ -1290,10 +1307,16 @@ class SupervisorBuilder:
             tel.gauge('supervisor.not_placed',
                       len(self.aux['not_placed']))
         from mlcomp_tpu.db.core import parse_datetime
+        from mlcomp_tpu.db.providers.usage import task_class_of
         try:
+            # task join (idx_task_queue_id, v14) classifies each wait
+            # into its scheduling class for the per-class histograms;
+            # messages whose task is gone degrade to class 'train'
             rows = self.session.query(
-                'SELECT created, claimed_at FROM queue_message '
-                'WHERE claimed_at IS NOT NULL AND claimed_at > ?',
+                'SELECT qm.created, qm.claimed_at, t.executor, '
+                't.type, t.additional_info FROM queue_message qm '
+                'LEFT JOIN task t ON t.queue_id = qm.id '
+                'WHERE qm.claimed_at IS NOT NULL AND qm.claimed_at > ?',
                 (self._last_claim_ts,))
         except Exception:
             rows = []
@@ -1302,16 +1325,59 @@ class SupervisorBuilder:
             created = parse_datetime(r['created'])
             claimed = parse_datetime(r['claimed_at'])
             if created and claimed:
-                tel.observe('supervisor.dispatch_latency_s',
-                            (claimed - created).total_seconds())
+                wait = (claimed - created).total_seconds()
+                tel.observe('supervisor.dispatch_latency_s', wait)
+                cls = task_class_of({'executor': r['executor'],
+                                     'type': r['type'],
+                                     'additional_info':
+                                         r['additional_info']})
+                tel.observe(f'queue.wait_s.{cls}', wait,
+                            buckets=QUEUE_WAIT_BUCKETS_S)
             if claimed and (latest is None or claimed > latest):
                 latest = claimed
         if latest is not None:
             self._last_claim_ts = latest
+        self._record_starvation_gauges(tel)
         # the dispatch trace spans buffered this tick — one batched
         # insert, a no-op on ticks that dispatched nothing
         from mlcomp_tpu.telemetry import flush_spans
         flush_spans(self.session)
+
+    def _record_starvation_gauges(self, tel):
+        """Per-class ``queue.max_wait_s.<class>`` starvation gauges
+        over the LIVE pending queue — the "no tenant starves (max wait
+        bounded)" acceptance metric of ROADMAP item 3, computed every
+        tick so /metrics shows the oldest unclaimed dispatch per class
+        while it is still waiting (the claim-time histograms above
+        only see waits that already ended). Classes with an empty
+        queue gauge 0 — absence of starvation is a fact, not a gap."""
+        from mlcomp_tpu.db.providers.usage import (
+            TASK_CLASSES, task_class_of,
+        )
+        from mlcomp_tpu.db.core import parse_datetime
+        try:
+            rows = self.session.query(
+                "SELECT qm.created, t.executor, t.type, "
+                "t.additional_info FROM queue_message qm "
+                "LEFT JOIN task t ON t.queue_id = qm.id "
+                "WHERE qm.status='pending'")
+        except Exception:
+            return
+        now_dt = now()
+        max_wait = {cls: 0.0 for cls in TASK_CLASSES}
+        for r in rows:
+            created = parse_datetime(r['created'])
+            if created is None:
+                continue
+            wait = (now_dt - created).total_seconds()
+            cls = task_class_of({'executor': r['executor'],
+                                 'type': r['type'],
+                                 'additional_info':
+                                     r['additional_info']})
+            if wait > max_wait.get(cls, 0.0):
+                max_wait[cls] = wait
+        for cls, wait in max_wait.items():
+            tel.gauge(f'queue.max_wait_s.{cls}', round(wait, 3))
 
     # ------------------------------------------------------------ watchdog
     def run_watchdog(self):
@@ -1402,6 +1468,67 @@ class SupervisorBuilder:
                     f'{task_id}:\n{traceback.format_exc()}',
                     ComponentType.Supervisor)
 
+    # ------------------------------------------------------------- economy
+    def process_usage(self):
+        """Fold every terminal task attempt without a ledger row into
+        the ``usage`` table — one exactly-once row per (task, attempt)
+        carrying core-seconds, queue-wait and peak HBM. The fold is a
+        conditional insert backstopped by ``idx_usage_once``, so a
+        raced double tick (two leaders around a failover) books each
+        attempt once no matter who wins. Accounting crashes never take
+        the tick down."""
+        t0 = time.monotonic()
+        folded = 0
+        try:
+            while True:
+                batch = self.usage_provider.unfolded_terminal_tasks(
+                    limit=500)
+                if not batch:
+                    break
+                for task in batch:
+                    if self.usage_provider.fold_task(task):
+                        folded += 1
+        except FenceLostError:
+            raise       # zombie leader: stop the tick, demote
+        except Exception:
+            if self.logger:
+                self.logger.error(
+                    f'usage fold failed:\n{traceback.format_exc()}',
+                    ComponentType.Supervisor)
+        fold_ms = (time.monotonic() - t0) * 1e3
+        self.telemetry.gauge('supervisor.usage_fold_ms',
+                             round(fold_ms, 3))
+        if folded:
+            self.telemetry.count('supervisor.usage_folds', folded)
+            self.aux['usage_folded'] = folded
+
+    def run_slo(self):
+        """Evaluate the SLO burn-rate engine (rate-limited inside the
+        engine). Objectives that breach their fast/slow burn
+        thresholds raise deduped ``slo-*`` alert rows through the same
+        path as the watchdog; recovered objectives auto-resolve. Like
+        the watchdog, SLO judging is a consumer of telemetry — its
+        crashes never take the scheduling tick down."""
+        t0 = time.monotonic()
+        try:
+            findings = self.slo_engine.maybe_evaluate()
+        except FenceLostError:
+            raise       # zombie leader: stop the tick, demote
+        except Exception:
+            if self.logger:
+                self.logger.error(
+                    f'slo evaluation failed:\n{traceback.format_exc()}',
+                    ComponentType.Supervisor)
+            findings = None
+        eval_ms = (time.monotonic() - t0) * 1e3
+        self.telemetry.gauge('supervisor.slo_eval_ms',
+                             round(eval_ms, 3))
+        if findings:
+            self.aux['slo'] = [
+                {k: f.get(k) for k in ('rule', 'severity', 'burn',
+                                       'message')}
+                for f in findings]
+
     # ---------------------------------------------------------------- main
     def build(self):
         start = now()
@@ -1419,7 +1546,11 @@ class SupervisorBuilder:
             self.load_tasks()
             self.load_computers()
             self.process_tasks()
+            # usage AFTER task processing so attempts that went
+            # terminal this tick are folded in the same tick
+            self.process_usage()
             self.run_watchdog()
+            self.run_slo()
             self.aux['duration'] = (now() - start).total_seconds()
             self.write_auxiliary()
             self.record_tick_telemetry()

@@ -22,6 +22,13 @@ package wired through every layer of this framework:
   per-task rolling baseline, straggler workers, HBM-pressure trends,
   recompile storms — persisted as ``alert`` rows and served via
   ``GET /api/alerts`` and ``mlcomp_tpu alerts``.
+- ``slo`` — the platform-side counterpart of the watchdog: declarative
+  service-level objectives (dispatch p99, per-class queue-wait p95,
+  serving availability/p99 vs ``serve_fleet.slo_p99_ms``, step-time vs
+  rolling baseline) reduced to ``slo.<key>.bad`` SLI series and judged
+  with multi-window multi-burn-rate logic (fast 5m/1h -> critical,
+  slow 6h -> warning), alerting through the same ``alert`` rows and
+  auto-resolving on recovery.
 - ``attribution`` — per-step phase split (data-wait / h2d / compute /
   telemetry) around boundaries the loop already crosses, persisted as
   ``step.phase.*`` series plus the derived
@@ -87,6 +94,7 @@ from mlcomp_tpu.telemetry.spans import (
     current_span_id, flush_spans, get_trace_context, new_trace_id,
     record_span, set_trace_context, span, trace_context_env,
 )
+from mlcomp_tpu.telemetry.slo import SloConfig, SloEngine, slo_status
 from mlcomp_tpu.telemetry.watchdog import Watchdog, WatchdogConfig
 
 __all__ = [
@@ -99,6 +107,7 @@ __all__ = [
     'record_device_stats',
     'TaskProfiler', 'request_trace', 'request_stop', 'trace_status',
     'Watchdog', 'WatchdogConfig',
+    'SloEngine', 'SloConfig', 'slo_status',
     'StepAttribution', 'PHASES',
     'CompileEventRecorder', 'HostSyncTripwire', 'COMPILE_EVENTS',
     'MemorySampler', 'memory_attribution',

@@ -52,6 +52,9 @@ REQUIRED_FAMILIES = (
     'mlcomp_supervisor_leader', 'mlcomp_supervisor_epoch',
     'mlcomp_supervisor_failovers', 'mlcomp_supervisor_fenced_writes',
     'mlcomp_db_listener_reconnects',
+    'mlcomp_usage_core_seconds', 'mlcomp_usage_tasks',
+    'mlcomp_queue_wait_seconds', 'mlcomp_queue_max_wait_seconds',
+    'mlcomp_slo_bad_fraction', 'mlcomp_slo_burn_rate',
     'mlcomp_scrape_errors', 'mlcomp_scrape_duration_seconds',
 )
 
@@ -668,6 +671,125 @@ def _collect_sweeps(session, cells, prunes, rungs):
         rungs.append(('', labels(sid), top_rung.get(sid, -1)))
 
 
+def _collect_usage(session, core_samples, task_samples):
+    """Usage-ledger tenant totals (migration v14): core-seconds and
+    folded attempts per (owner, project). The ledger is append-only
+    (one exactly-once row per terminal attempt), so both families hold
+    counter semantics scrape-over-scrape without any event window."""
+    for r in session.query(
+            'SELECT owner, project, COUNT(*) AS n, '
+            'SUM(core_seconds) AS cs FROM usage '
+            'GROUP BY owner, project ORDER BY owner, project'):
+        labels = {'owner': r['owner'] or 'default',
+                  'project': r['project'] or 'default'}
+        core_samples.append(('_total', labels, float(r['cs'] or 0.0)))
+        task_samples.append(('_total', labels, r['n']))
+
+
+def _collect_queue_wait(session, samples):
+    """Latest flushed bucket/count/mean rows per scheduling class →
+    one histogram family (``mlcomp_queue_wait_seconds{class=...}``).
+    The supervisor's queue-wait recorder uses cumulative buckets
+    (telemetry/metrics.py), so the latest snapshot is monotone — same
+    protocol as the serving-latency re-export."""
+    pattern = re.compile(
+        r'^queue\.wait_s\.(.+)\.(bucket|count|mean)$')
+    latest = {}      # (class, stat, le) -> (id, value)
+    for r in session.query(
+            "SELECT id, name, value, tags FROM metric "
+            "WHERE id > (SELECT COALESCE(MAX(id), 0) FROM metric) - ? "
+            "AND kind='histogram' AND ("
+            "name LIKE 'queue.wait_s.%.bucket' OR "
+            "name LIKE 'queue.wait_s.%.count' OR "
+            "name LIKE 'queue.wait_s.%.mean')",
+            (_SERVING_SCAN_WINDOW,)):
+        m = pattern.match(r['name'])
+        if m is None:
+            continue
+        cls, stat = m.group(1), m.group(2)
+        le = None
+        if stat == 'bucket':
+            try:
+                le = json.loads(r['tags'] or '{}').get('le')
+            except ValueError:
+                continue
+            if le is None:
+                continue
+        key = (cls, stat, str(le))
+        if key not in latest or r['id'] > latest[key][0]:
+            latest[key] = (r['id'], r['value'])
+    classes = sorted({cls for cls, _, _ in latest})
+    for cls in classes:
+        buckets = sorted(
+            ((le, v) for (c2, stat, le), (_, v) in latest.items()
+             if c2 == cls and stat == 'bucket'),
+            key=lambda kv: float('inf') if kv[0] == '+Inf'
+            else float(kv[0]))
+        for le, value in buckets:
+            samples.append(('_bucket', {'class': cls, 'le': le},
+                            value))
+        count = latest.get((cls, 'count', 'None'))
+        if count is not None:
+            samples.append(('_count', {'class': cls}, count[1]))
+            mean = latest.get((cls, 'mean', 'None'))
+            if mean is not None:
+                samples.append(('_sum', {'class': cls},
+                                mean[1] * count[1]))
+
+
+def _collect_queue_max_wait(session, samples):
+    """``mlcomp_queue_max_wait_seconds{class}`` — the supervisor's
+    per-tick starvation gauge over the LIVE pending queue: age of the
+    oldest unclaimed dispatch per scheduling class, 0 when the class
+    queue is empty. The acceptance metric for bounded-wait fairness
+    (docs/scheduling.md)."""
+    pattern = re.compile(r'^queue\.max_wait_s\.(.+)$')
+    latest = {}
+    for r in session.query(
+            "SELECT id, name, value FROM metric "
+            "WHERE id > (SELECT COALESCE(MAX(id), 0) FROM metric) - ? "
+            "AND name LIKE 'queue.max_wait_s.%'",
+            (_SERVING_SCAN_WINDOW,)):
+        m = pattern.match(r['name'])
+        if m is None:
+            continue
+        cls = m.group(1)
+        if cls not in latest or r['id'] > latest[cls][0]:
+            latest[cls] = (r['id'], r['value'])
+    for cls, (_, value) in sorted(latest.items()):
+        samples.append(('', {'class': cls}, value))
+
+
+def _collect_slo(session, bad_samples, burn_samples):
+    """SLO engine gauges (telemetry/slo.py): the latest instantaneous
+    bad-fraction SLI per objective plus the latest fast/slow burn
+    rates — the numbers the engine's alert verdicts are computed from,
+    re-exported so a Grafana burn-rate panel shows exactly what the
+    alerting path saw."""
+    stats = {'bad': None, 'burn_fast': 'fast', 'burn_slow': 'slow'}
+    latest = {}      # (key, stat) -> (id, value)
+    for r in session.query(
+            "SELECT id, name, value FROM metric "
+            "WHERE id > (SELECT COALESCE(MAX(id), 0) FROM metric) - ? "
+            "AND name LIKE 'slo.%'", (_SERVING_SCAN_WINDOW,)):
+        rest = r['name'][len('slo.'):]
+        if '.' not in rest:
+            continue
+        key, stat = rest.rsplit('.', 1)
+        if stat not in stats:
+            continue
+        mkey = (key, stat)
+        if mkey not in latest or r['id'] > latest[mkey][0]:
+            latest[mkey] = (r['id'], r['value'])
+    for (key, stat), (_, value) in sorted(latest.items()):
+        if stat == 'bad':
+            bad_samples.append(('', {'objective': key}, value))
+        else:
+            burn_samples.append(
+                ('', {'objective': key, 'window': stats[stat]},
+                 value))
+
+
 def _collect_supervisor_ha(session, leader, epoch, failovers, fenced):
     """Supervisor HA families (migration v12 + server/ha.py):
 
@@ -748,6 +870,8 @@ def collect_server_families(session):
     sweep_cells, sweep_prunes, sweep_rungs = [], [], []
     hbm, comm_bytes, comm_frac = [], [], []
     leader, epoch, failovers, fenced, reconnects = [], [], [], [], []
+    usage_cores, usage_tasks = [], []
+    qwait, qmax, slo_bad, slo_burn = [], [], [], []
     guarded('tasks', _collect_tasks, session, tasks)
     guarded('queue_depth', _collect_queue_depth, session, queues)
     guarded('worker_slots', _collect_worker_slots, session, slots)
@@ -771,6 +895,11 @@ def collect_server_families(session):
             epoch, failovers, fenced)
     guarded('listener_reconnects', _collect_listener_reconnects,
             session, reconnects)
+    guarded('usage', _collect_usage, session, usage_cores,
+            usage_tasks)
+    guarded('queue_wait', _collect_queue_wait, session, qwait)
+    guarded('queue_max_wait', _collect_queue_max_wait, session, qmax)
+    guarded('slo', _collect_slo, session, slo_bad, slo_burn)
     running = []
     errors.setdefault('running_tasks', 0)
     try:
@@ -880,6 +1009,27 @@ def collect_server_families(session):
         family('mlcomp_db_listener_reconnects', 'counter',
                'LISTEN/NOTIFY listener reconnect events (sum of '
                'flushed db.listener_reconnects deltas)', reconnects),
+        family('mlcomp_usage_core_seconds', 'counter',
+               'billed TPU core-seconds per tenant from the usage '
+               'ledger (append-only, exactly-once per terminal '
+               'attempt — migration v14)', usage_cores),
+        family('mlcomp_usage_tasks', 'counter',
+               'folded terminal task attempts per tenant (usage '
+               'ledger rows)', usage_tasks),
+        family('mlcomp_queue_wait_seconds', 'histogram',
+               'enqueue-to-claim wait per scheduling class '
+               '(cumulative buckets, latest supervisor flush)', qwait),
+        family('mlcomp_queue_max_wait_seconds', 'gauge',
+               'age of the oldest still-pending dispatch per '
+               'scheduling class (starvation gauge, 0 = empty queue)',
+               qmax),
+        family('mlcomp_slo_bad_fraction', 'gauge',
+               'latest instantaneous SLI bad-fraction per SLO '
+               'objective (telemetry/slo.py)', slo_bad),
+        family('mlcomp_slo_burn_rate', 'gauge',
+               'error-budget burn rate per SLO objective and window '
+               '(fast=5m, slow=6h; >= 14.4 fast pages, >= 6 slow '
+               'warns)', slo_burn),
         family('mlcomp_scrape_errors', 'gauge',
                'failures during this scrape, labeled by collector '
                '(the endpoint never 500s on a sick DB — the label '

@@ -102,6 +102,7 @@ class Histogram:
             'mean': self.total / self.count,
             'min': self.min, 'max': self.max,
             'p50': float(np.percentile(window, 50)),
+            'p95': float(np.percentile(window, 95)),
             'p99': float(np.percentile(window, 99)),
         }
 

@@ -120,6 +120,18 @@ FLOORS = {
     'memory_sampler_overhead_pct': ('max', 1.0,
                                     'per-step HBM memory sampler '
                                     'overhead vs step time %'),
+    # round-12 legs (ISSUE 18: cluster-economy observability). Both
+    # passes run inside the supervisor control loop (bench.py
+    # bench_economy), so their budget is the loop's own cadence: the
+    # steady-state usage fold per 1 s tick interval, one full SLO
+    # burn-rate evaluation per 10 s evaluation period. <1% = the
+    # economy layer is effectively free on the control plane.
+    'usage_fold_overhead_pct': ('max', 1.0,
+                                'steady-state usage-ledger fold vs '
+                                'the 1 s supervisor tick interval %'),
+    'slo_eval_overhead_pct': ('max', 1.0,
+                              'full SLO burn-rate evaluation vs its '
+                              '10 s evaluation period %'),
 }
 
 

@@ -77,6 +77,17 @@ simulated by rewinding the stored timestamps, never by sleeping):
    (no attempt consumed, no backoff scheduled); the prune counters
    (``mlcomp_sweep_prunes_total``/``mlcomp_sweep_cells``) are visible
    on /metrics
+11. SLO burn-rate alerting + usage-ledger failover (telemetry/slo.py
+   + db/providers/usage.py): dispatch latency is degraded past its
+   objective and the SLO engine is driven over a simulated hour of
+   evaluations — the fast-burn page (``slo-dispatch-p99``, critical)
+   opens on the FIRST evaluation window and stays deduped across all
+   subsequent ones; after the degradation clears and the burn windows
+   drain, the page AUTO-RESOLVES with a ``resolved`` finding; then a
+   terminal task is folded into the usage ledger by BOTH sides of a
+   leader failover (old leader's tick replayed by the new one) and
+   the bill comes out EXACTLY ONCE — one ledger row per (task,
+   attempt) across the whole scenario history
 """
 
 import datetime
@@ -1008,6 +1019,89 @@ def scenario_sweep_prune_failover(session):
           f'prunes={prunes_fam} cells={cells_fam}')
 
 
+def scenario_slo_burn_and_usage_fold(session):
+    """Degrade dispatch latency past its objective and drive the SLO
+    engine over a simulated hour: the fast-burn page must open within
+    one evaluation window, dedup across the rest, and auto-resolve
+    once the degradation clears and the windows drain. Then both
+    sides of a leader failover fold the same terminal task into the
+    usage ledger — the bill must come out exactly once."""
+    from mlcomp_tpu.db.providers import MetricProvider, UsageProvider
+    from mlcomp_tpu.telemetry.slo import SloEngine
+
+    mp = MetricProvider(session)
+    engine = SloEngine(session)
+    ap = AlertProvider(session)
+    t0 = now()
+
+    # the fault: dispatch p99 pinned at 9 s (objective: 5 s) across an
+    # hour of 60 s-cadence evaluations — every one measures bad=1.0.
+    # The clock is simulated via now_dt; nothing sleeps.
+    first = None
+    for age in range(3600, -1, -60):
+        t = t0 - datetime.timedelta(seconds=age)
+        mp.add_many([(None, 'supervisor.dispatch_latency_s.p99',
+                      'histogram', None, 9.0, t, 'supervisor', None)])
+        findings = engine.evaluate(now_dt=t)
+        if first is None:
+            first = [f for f in findings
+                     if f['rule'] == 'slo-dispatch-p99']
+    check('fast-burn page opened within one evaluation window',
+          first and first[0]['severity'] == 'critical'
+          and first[0]['burn'] >= 14.4, str(first))
+    open_slo = ap.get(status='open', rule='slo-dispatch-p99')
+    check('page deduped across 61 evaluations',
+          len(open_slo) == 1
+          and open_slo[0].severity == 'critical',
+          f'open={len(open_slo)}')
+
+    # the fault clears; 7 h later every burn window holds only healthy
+    # samples — the page must resolve on its own, no human in the loop
+    t1 = t0 + datetime.timedelta(hours=7)
+    resolved = []
+    for age in (120, 60, 0):
+        t = t1 - datetime.timedelta(seconds=age)
+        mp.add_many([(None, 'supervisor.dispatch_latency_s.p99',
+                      'histogram', None, 0.4, t, 'supervisor', None)])
+        resolved += [f for f in engine.evaluate(now_dt=t)
+                     if f['rule'] == 'slo-dispatch-p99']
+    check('page auto-resolved after the degradation cleared',
+          any(f['severity'] == 'resolved' for f in resolved)
+          and not ap.get(status='open', rule='slo-dispatch-p99'),
+          str(resolved))
+
+    # usage across a failover: the old leader folds the terminal
+    # attempt, dies, and the new leader's first tick replays the fold
+    # — the conditional insert (UNIQUE(task, attempt) backstop) must
+    # bill exactly once
+    finished = now()
+    task = Task(name='chaos_billed', executor='noop',
+                status=int(TaskStatus.Success), owner='chaos',
+                project='chaos_proj', cores_assigned='[0, 1]',
+                started=finished - datetime.timedelta(seconds=30),
+                finished=finished, last_activity=now())
+    TaskProvider(session).add(task)
+    old_leader = SupervisorBuilder(session=session)
+    new_leader = SupervisorBuilder(session=session)
+    old_leader.process_usage()
+    new_leader.process_usage()    # the replayed fold after promotion
+    n = session.query('SELECT COUNT(*) AS n FROM usage WHERE task=?',
+                      (task.id,))[0]['n']
+    billed = session.query(
+        'SELECT owner, project, core_seconds FROM usage WHERE task=?',
+        (task.id,))[0]
+    check('usage folded exactly once across the failover',
+          n == 1 and billed['owner'] == 'chaos'
+          and 58.0 <= billed['core_seconds'] <= 62.0,
+          f'rows={n} billed={dict(billed)}')
+    dup = session.query(
+        'SELECT task, attempt, COUNT(*) AS n FROM usage '
+        'GROUP BY task, attempt HAVING COUNT(*) > 1')
+    check('ledger holds one row per (task, attempt) across every '
+          'scenario', not dup,
+          str([(r['task'], r['n']) for r in dup]))
+
+
 def main():
     session = Session.create_session(key='chaos_smoke')
     migrate(session)
@@ -1020,6 +1114,7 @@ def main():
     scenario_oom_flight_recorder(session, sup)
     scenario_supervisor_failover(session)
     scenario_sweep_prune_failover(session)
+    scenario_slo_burn_and_usage_fold(session)
     if FAILURES:
         print(f'FAIL: {len(FAILURES)} scenario check(s): {FAILURES}')
         return 1

@@ -168,6 +168,36 @@ def seed(session):
     dp.record(sweep.id, cells[0].id, 0, 'promote', 0.9, 0.5, 3, 1)
     dp.record(sweep.id, cells[1].id, 0, 'prune', 0.2, 0.5, 3, 1)
     dp.record(sweep.id, cells[2].id, 1, 'promote', 0.95, 0.6, 2, 1)
+    # usage ledger (migration v14): one folded terminal attempt, so
+    # the per-owner aggregation collectors have a real row to bill
+    from mlcomp_tpu.db.providers import UsageProvider
+    billed = Task(name='smoke_billed', executor='jax_train',
+                  status=int(TaskStatus.Success), owner='smoke_owner',
+                  project='smoke_proj',
+                  cores_assigned=json.dumps([0, 1]),
+                  started=now() - datetime.timedelta(seconds=50),
+                  finished=now(), last_activity=now())
+    tp.add(billed)
+    assert UsageProvider(session).fold_task(billed)
+    # queue-wait histogram + starvation gauge rows (what a supervisor
+    # tick flushes) and an SLO evaluation's SLI/burn gauges
+    MetricProvider(session).add_many(
+        [(None, 'queue.wait_s.train.bucket', 'histogram', None, n, ts,
+          'supervisor', json.dumps({'of': 'queue.wait_s.train',
+                                    'le': le}))
+         for le, n in ((5.0, 1), (60.0, 3), ('+Inf', 3))]
+        + [(None, 'queue.wait_s.train.count', 'histogram', None, 3.0,
+            ts, 'supervisor', None),
+           (None, 'queue.wait_s.train.mean', 'histogram', None, 18.0,
+            ts, 'supervisor', None),
+           (None, 'queue.max_wait_s.train', 'gauge', None, 42.0, ts,
+            'supervisor', None),
+           (None, 'slo.dispatch-p99.bad', 'gauge', None, 0.0, ts,
+            'supervisor', None),
+           (None, 'slo.dispatch-p99.burn_fast', 'gauge', None, 0.0,
+            ts, 'supervisor', None),
+           (None, 'slo.dispatch-p99.burn_slow', 'gauge', None, 0.0,
+            ts, 'supervisor', None)])
     return task.id
 
 
@@ -282,6 +312,29 @@ def main():
         ('mlcomp_db_listener_reconnects', any(
             v == 2 for _, _, v in
             doc['mlcomp_db_listener_reconnects']['samples'])),
+        ('mlcomp_usage_core_seconds by owner/project', any(
+            l.get('owner') == 'smoke_owner'
+            and l.get('project') == 'smoke_proj' and 99.0 <= v <= 101.0
+            for _, l, v in
+            doc['mlcomp_usage_core_seconds']['samples'])),
+        ('mlcomp_usage_tasks', any(
+            l.get('owner') == 'smoke_owner' and v == 1
+            for _, l, v in doc['mlcomp_usage_tasks']['samples'])),
+        ('mlcomp_queue_wait_seconds buckets', any(
+            l.get('class') == 'train' and l.get('le') == '+Inf'
+            for l in sample_labels('mlcomp_queue_wait_seconds'))),
+        ('mlcomp_queue_max_wait_seconds', any(
+            l.get('class') == 'train' and v == 42.0
+            for _, l, v in
+            doc['mlcomp_queue_max_wait_seconds']['samples'])),
+        ('mlcomp_slo_bad_fraction', any(
+            l.get('objective') == 'dispatch-p99'
+            for l in sample_labels('mlcomp_slo_bad_fraction'))),
+        ('mlcomp_slo_burn_rate windows', all(
+            any(l.get('objective') == 'dispatch-p99'
+                and l.get('window') == w
+                for l in sample_labels('mlcomp_slo_burn_rate'))
+            for w in ('fast', 'slow'))),
         # scrape self-observability: one labeled sample per collector,
         # every one healthy, and the scrape timed itself
         ('mlcomp_scrape_errors labeled per collector',

@@ -443,6 +443,64 @@ class TestObservabilityCards:
         assert 'pruned rung 0 (0.34 vs 0.6)' in html
         assert 'promoted through rung 0' in html
 
+    def test_supervisor_tab_usage_card(self, browser, session):
+        """A folded ledger row renders in the usage card (real usage
+        fold -> real /api/usage -> real JS)."""
+        import datetime
+        from mlcomp_tpu.db.enums import TaskStatus
+        from mlcomp_tpu.db.models import Dag, Task
+        from mlcomp_tpu.db.providers import (
+            DagProvider, ProjectProvider, TaskProvider, UsageProvider,
+        )
+        from mlcomp_tpu.utils.misc import now
+        project = ProjectProvider(session).add_project('p_usage_js')
+        dag = Dag(name='usagedag', project=project.id, config='{}',
+                  created=now(), owner='alice')
+        DagProvider(session).add(dag)
+        finished = now()
+        task = Task(name='bill me', executor='train', dag=dag.id,
+                    status=int(TaskStatus.Success),
+                    started=finished - datetime.timedelta(seconds=50),
+                    finished=finished, cores_assigned='[0, 1]',
+                    owner='alice', project='p_usage_js',
+                    last_activity=now())
+        TaskProvider(session).add(task)
+        up = UsageProvider(session)
+        for t in up.unfolded_terminal_tasks():
+            up.fold_task(t)
+        browser.call('go', 'supervisor')
+        html = browser.html('#main')
+        assert 'usage (core-seconds by owner)' in html
+        assert 'alice' in html
+        assert '100.0' in html          # 2 cores x 50 s
+
+    def test_supervisor_tab_slo_card(self, browser, session):
+        """A burning objective renders in the SLO scoreboard with its
+        open alert (real SLI rows + alert -> /api/slos -> real JS)."""
+        from mlcomp_tpu.db.providers import (
+            AlertProvider, MetricProvider,
+        )
+        from mlcomp_tpu.utils.misc import now
+        now_dt = now()
+        MetricProvider(session).add_many([
+            (None, 'slo.dispatch-p99.bad', 'gauge', None, 1.0,
+             now_dt, 'supervisor', None),
+            (None, 'slo.dispatch-p99.burn_fast', 'gauge', None, 25.0,
+             now_dt, 'supervisor', None),
+            (None, 'slo.dispatch-p99.burn_slow', 'gauge', None, 2.0,
+             now_dt, 'supervisor', None),
+        ])
+        AlertProvider(session).raise_alert(
+            'slo-dispatch-p99', 'dispatch p99 burning fast',
+            severity='critical')
+        browser.call('go', 'supervisor')
+        html = browser.html('#main')
+        assert 'SLOs (burn rates)' in html
+        assert 'dispatch-p99' in html
+        assert 'critical' in html
+        assert 'burning fast' in html
+        assert '25' in html
+
 
 class TestJsrtRegressions:
     def test_return_multiline_template_no_asi(self):

@@ -587,10 +587,12 @@ class TestMigrationV13:
                         status=int(TaskStatus.Success),
                         last_activity=now())
             tp.add(task)
-            assert migrate(s) == 13
+            # later PRs extend the chain past 13; this test only
+            # cares that the upgrade runs the whole remainder
+            assert migrate(s) == len(MIGRATIONS)
             row = s.query_one('SELECT MAX(version) AS v '
                               'FROM migration_version')
-            assert row['v'] == 13
+            assert row['v'] == len(MIGRATIONS)
             # tables exist, legacy data intact, unique index enforced
             assert s.table_columns('sweep')
             assert s.table_columns('sweep_decision')

@@ -1,0 +1,460 @@
+"""Cluster-economy observability: the usage ledger (migration v14),
+per-class queue-wait/starvation instrumentation, and the SLO burn-rate
+engine (telemetry/slo.py).
+
+The economics of the cluster must be as crash-safe as its scheduling:
+the fold tests race two supervisors at the same terminal task and
+assert one ledger row; the burn-rate tests seed SLI series at chosen
+timestamps and assert the multi-window verdicts (fast pages, the long
+window vetoes blips, slow warns, recovery auto-resolves); the upgrade
+test migrates a live v13 file in place and expects the history
+backfilled, not a cold-start-empty ledger.
+"""
+
+import datetime
+import json
+import sqlite3
+import uuid
+
+import pytest
+
+from mlcomp_tpu.db.core import Session
+from mlcomp_tpu.db.enums import TaskStatus, TaskType
+from mlcomp_tpu.db.models import Dag, Task
+from mlcomp_tpu.db.providers import (
+    AlertProvider, DagProvider, MetricProvider, ProjectProvider,
+    QueueProvider, TaskProvider, UsageProvider,
+)
+from mlcomp_tpu.db.providers.usage import TASK_CLASSES, task_class_of
+from mlcomp_tpu.telemetry import SloConfig, SloEngine, slo_status
+from mlcomp_tpu.utils.misc import now
+
+
+def _seed_terminal_task(session, *, owner='alice', project='proj',
+                        seconds=50, cores='[0, 1]',
+                        status=TaskStatus.Success, executor='train',
+                        attempt=0, **extra):
+    finished = now()
+    task = Task(name='billed', executor=executor,
+                status=int(status),
+                started=finished - datetime.timedelta(seconds=seconds),
+                finished=finished, cores_assigned=cores,
+                owner=owner, project=project, attempt=attempt,
+                last_activity=now(), **extra)
+    TaskProvider(session).add(task)
+    return task
+
+
+# ------------------------------------------------------------- the fold
+class TestUsageFold:
+    def test_fold_bills_core_seconds(self, session):
+        task = _seed_terminal_task(session, seconds=50, cores='[0, 1]')
+        up = UsageProvider(session)
+        pending = up.unfolded_terminal_tasks()
+        assert [t.id for t in pending] == [task.id]
+        assert up.fold_task(pending[0]) is True
+        row = up.recent(limit=1)[0]
+        assert row.task == task.id
+        assert row.owner == 'alice' and row.project == 'proj'
+        assert row.cores == 2
+        assert row.core_seconds == pytest.approx(100.0, abs=1.0)
+        assert row.task_class == 'train'
+        assert row.status == int(TaskStatus.Success)
+        # the worklist is empty once folded — replayed ticks are cheap
+        assert up.unfolded_terminal_tasks() == []
+
+    def test_fold_is_exactly_once_under_raced_double_tick(self, session):
+        """Two supervisors (a failover window) fold the same terminal
+        attempt: one wins, the ledger has one row, and the unique
+        index backstops even a raw duplicate insert."""
+        task = _seed_terminal_task(session)
+        up_a, up_b = UsageProvider(session), UsageProvider(session)
+        t = up_a.unfolded_terminal_tasks()[0]
+        results = [up_a.fold_task(t), up_b.fold_task(t)]
+        assert sorted(results) == [False, True]
+        assert up_a.count() == 1
+        with pytest.raises(sqlite3.IntegrityError):
+            session.execute(
+                'INSERT INTO usage (task, attempt) VALUES (?, ?)',
+                (task.id, 0))
+
+    def test_new_attempt_is_billed_separately(self, session):
+        """A retried task's new attempt is a new ledger row — retries
+        burn real cores and the bill must say so."""
+        task = _seed_terminal_task(session, attempt=0)
+        up = UsageProvider(session)
+        up.fold_task(up.unfolded_terminal_tasks()[0])
+        task.attempt = 1
+        TaskProvider(session).update(task, ['attempt'])
+        pending = up.unfolded_terminal_tasks()
+        assert [t.id for t in pending] == [task.id]
+        assert up.fold_task(pending[0]) is True
+        assert up.count() == 2
+
+    def test_fold_records_queue_wait_from_message(self, session):
+        qp = QueueProvider(session)
+        msg_id = qp.enqueue('q_train', {'action': 'execute'})
+        # backdate the enqueue, then claim: wait is claim - created
+        session.execute(
+            'UPDATE queue_message SET created=? WHERE id=?',
+            (now() - datetime.timedelta(seconds=30), msg_id))
+        assert qp.claim(['q_train'], 'w1') is not None
+        task = _seed_terminal_task(session, queue_id=msg_id)
+        up = UsageProvider(session)
+        up.fold_task(up.unfolded_terminal_tasks()[0])
+        row = up.recent(limit=1)[0]
+        assert row.queue_wait_s == pytest.approx(30.0, abs=2.0)
+
+    def test_fold_records_peak_hbm(self, session):
+        task = _seed_terminal_task(session)
+        MetricProvider(session).add_many([
+            (task.id, 'device0.hbm_used', 'gauge', 1, 1.5e9, now(),
+             'train', None),
+            (task.id, 'device1.hbm_used', 'gauge', 1, 2.5e9, now(),
+             'train', None),
+        ])
+        up = UsageProvider(session)
+        up.fold_task(up.unfolded_terminal_tasks()[0])
+        assert up.recent(limit=1)[0].hbm_peak_bytes == \
+            pytest.approx(2.5e9)
+
+    def test_aggregate_groups_and_validates(self, session):
+        _seed_terminal_task(session, owner='alice', seconds=50)
+        _seed_terminal_task(session, owner='bob', seconds=200,
+                            cores='[0]')
+        up = UsageProvider(session)
+        for t in up.unfolded_terminal_tasks():
+            up.fold_task(t)
+        by_owner = {r['key']: r for r in up.aggregate('owner')}
+        assert by_owner['alice']['core_seconds'] == \
+            pytest.approx(100.0, abs=2.0)
+        assert by_owner['bob']['core_seconds'] == \
+            pytest.approx(200.0, abs=2.0)
+        # the biggest spender leads the table
+        assert up.aggregate('owner')[0]['key'] == 'bob'
+        with pytest.raises(ValueError):
+            up.aggregate('owner; DROP TABLE usage')
+
+    def test_task_class_priority(self):
+        assert task_class_of({'executor': 'train', 'type': 1,
+                              'additional_info': None}) == 'train'
+        assert task_class_of(
+            {'executor': 'train', 'type': 1,
+             'additional_info': "{'sweep': {'id': 1}}"}) == 'sweep'
+        assert task_class_of(
+            {'executor': 'serve_replica',
+             'type': int(TaskType.Service),
+             'additional_info': None}) == 'serve-replica'
+        assert task_class_of(
+            {'executor': 'svc', 'type': int(TaskType.Service),
+             'additional_info': None}) == 'service'
+
+
+# -------------------------------------------------- supervisor plumbing
+class TestSupervisorEconomy:
+    def _builder(self, session):
+        from mlcomp_tpu.server.supervisor import SupervisorBuilder
+        return SupervisorBuilder(session=session)
+
+    def test_tick_folds_terminal_tasks(self, session):
+        task = _seed_terminal_task(session)
+        b = self._builder(session)
+        b.build()
+        up = UsageProvider(session)
+        assert up.count() == 1
+        assert up.recent(limit=1)[0].task == task.id
+        assert b.aux.get('usage_folded') == 1
+        # second tick: nothing left to fold, no double billing
+        b.build()
+        assert up.count() == 1
+
+    def test_starvation_gauges_cover_every_class(self, session):
+        """A stuck pending queue surfaces as queue.max_wait_s.<class>;
+        classes with an empty queue gauge 0 every tick."""
+        qp = QueueProvider(session)
+        msg_id = qp.enqueue('q_host', {'action': 'execute'})
+        session.execute(
+            'UPDATE queue_message SET created=? WHERE id=?',
+            (now() - datetime.timedelta(seconds=120), msg_id))
+        task = Task(name='starved', executor='train',
+                    status=int(TaskStatus.Queued), queue_id=msg_id,
+                    last_activity=now())
+        TaskProvider(session).add(task)
+        b = self._builder(session)
+        b.build()
+        b.telemetry.flush(session)
+        gauges = {r['name']: r['value'] for r in session.query(
+            "SELECT name, value FROM metric "
+            "WHERE name LIKE 'queue.max_wait_s.%'")}
+        assert set(gauges) == {
+            f'queue.max_wait_s.{cls}' for cls in TASK_CLASSES}
+        assert gauges['queue.max_wait_s.train'] == \
+            pytest.approx(120.0, abs=5.0)
+        for cls in ('sweep', 'serve-replica', 'service'):
+            assert gauges[f'queue.max_wait_s.{cls}'] == 0.0
+
+    def test_claimed_messages_feed_per_class_wait_histogram(
+            self, session):
+        # the claim watermark starts at builder construction — build
+        # the supervisor FIRST so this tick's claim is inside the
+        # window it scans
+        b = self._builder(session)
+        qp = QueueProvider(session)
+        msg_id = qp.enqueue('q_host', {'action': 'execute'})
+        session.execute(
+            'UPDATE queue_message SET created=? WHERE id=?',
+            (now() - datetime.timedelta(seconds=45), msg_id))
+        assert qp.claim(['q_host'], 'w1') is not None
+        task = Task(name='served', executor='serve_replica',
+                    status=int(TaskStatus.InProgress),
+                    type=int(TaskType.Service), queue_id=msg_id,
+                    last_activity=now())
+        TaskProvider(session).add(task)
+        b.build()
+        b.telemetry.flush(session)
+        rows = session.query(
+            "SELECT name FROM metric "
+            "WHERE name LIKE 'queue.wait_s.serve-replica.%'")
+        stats = {r['name'].rsplit('.', 1)[-1] for r in rows}
+        assert 'count' in stats and 'p95' in stats
+
+
+# ------------------------------------------------------------ burn math
+def _seed_sli(session, key, points):
+    """Insert slo.<key>.bad rows: points = [(age_seconds, value)]."""
+    now_dt = now()
+    MetricProvider(session).add_many([
+        (None, f'slo.{key}.bad', 'gauge', None, float(value),
+         now_dt - datetime.timedelta(seconds=age), 'supervisor', None)
+        for age, value in points])
+    return now_dt
+
+
+class TestBurnRates:
+    KEY = 'dispatch-p99'
+    RULE = 'slo-dispatch-p99'
+
+    def test_fast_burn_fires_critical(self, session):
+        """bad=1.0 across both the 5m and 1h windows: burn 100x a 1%
+        budget on both -> page."""
+        now_dt = _seed_sli(session, self.KEY, [
+            (age, 1.0) for age in range(0, 3600, 60)])
+        engine = SloEngine(session)
+        findings = engine.evaluate(now_dt=now_dt)
+        crit = [f for f in findings if f['rule'] == self.RULE]
+        assert crit and crit[0]['severity'] == 'critical'
+        assert crit[0]['burn'] >= SloConfig.fast_burn
+        open_alerts = AlertProvider(session).get(status='open')
+        assert any(a.rule == self.RULE and a.severity == 'critical'
+                   for a in open_alerts)
+
+    def test_long_window_vetoes_a_blip(self, session):
+        """bad=1.0 only in the last 5m of an otherwise-clean 6h: the
+        1h confirmation window stays under threshold, so no page (the
+        blip veto the two-window recipe exists for), and the diluted
+        slow window stays under its warning line too."""
+        points = [(age, 1.0) for age in range(0, 300, 60)]
+        points += [(age, 0.0) for age in range(300, 21600, 60)]
+        now_dt = _seed_sli(session, self.KEY, points)
+        engine = SloEngine(session)
+        findings = engine.evaluate(now_dt=now_dt)
+        assert not [f for f in findings if f['rule'] == self.RULE]
+        assert not AlertProvider(session).get(status='open')
+
+    def test_slow_burn_warns(self, session):
+        """bad=0.1 steadily for 6h: fast burn 10x (under 14.4), slow
+        burn 10x (over 6) -> warning, not page."""
+        now_dt = _seed_sli(session, self.KEY, [
+            (age, 0.1) for age in range(0, 21600, 600)])
+        engine = SloEngine(session)
+        findings = engine.evaluate(now_dt=now_dt)
+        found = [f for f in findings if f['rule'] == self.RULE]
+        assert found and found[0]['severity'] == 'warning'
+
+    def test_recovery_auto_resolves(self, session):
+        """An open slo-* alert resolves once every populated window is
+        back under its threshold."""
+        now_dt = _seed_sli(session, self.KEY, [
+            (age, 1.0) for age in range(0, 3600, 60)])
+        engine = SloEngine(session)
+        engine.evaluate(now_dt=now_dt)
+        assert AlertProvider(session).get(status='open')
+        # 7h later the bad windows have aged out; fresh clean samples
+        later = now_dt + datetime.timedelta(hours=7)
+        MetricProvider(session).add_many([
+            (None, f'slo.{self.KEY}.bad', 'gauge', None, 0.0,
+             later - datetime.timedelta(seconds=age), 'supervisor',
+             None)
+            for age in range(0, 300, 60)])
+        findings = engine.evaluate(now_dt=later)
+        resolved = [f for f in findings if f['rule'] == self.RULE]
+        assert resolved and resolved[0]['severity'] == 'resolved'
+        assert not AlertProvider(session).get(status='open')
+
+    def test_burn_gauges_persisted_and_status_read(self, session):
+        now_dt = _seed_sli(session, self.KEY, [
+            (age, 1.0) for age in range(0, 3600, 60)])
+        SloEngine(session).evaluate(now_dt=now_dt)
+        names = {r['name'] for r in session.query(
+            "SELECT DISTINCT name FROM metric WHERE name LIKE 'slo.%'")}
+        assert f'slo.{self.KEY}.burn_fast' in names
+        assert f'slo.{self.KEY}.burn_slow' in names
+        status = slo_status(session)
+        entry = next(e for e in status if e['key'] == self.KEY)
+        assert entry['status'] == 'critical'
+        assert entry['burn_fast'] >= SloConfig.fast_burn
+        assert entry['alert'] is not None
+
+    def test_rate_limit_and_unknown_option(self, session):
+        engine = SloEngine(session, config=SloConfig(
+            evaluate_every_s=3600))
+        now_dt = now()
+        engine.maybe_evaluate(now_dt=now_dt)
+        # off-cadence call: no second evaluation
+        assert engine.maybe_evaluate(
+            now_dt=now_dt + datetime.timedelta(seconds=5)) == []
+        with pytest.raises(TypeError):
+            SloConfig(not_an_option=1)
+
+    def test_dispatch_objective_reads_flushed_p99(self, session):
+        """A fresh flushed p99 above the objective measures bad=1.0
+        and lands as an SLI row; a stale one measures nothing."""
+        MetricProvider(session).add_many([
+            (None, 'supervisor.dispatch_latency_s.p99', 'histogram',
+             None, 9.0, now(), 'supervisor', json.dumps(
+                 {'of': 'supervisor.dispatch_latency_s'})),
+        ])
+        engine = SloEngine(session)
+        engine.evaluate()
+        row = session.query_one(
+            "SELECT value FROM metric WHERE name='slo.dispatch-p99.bad' "
+            "ORDER BY id DESC LIMIT 1")
+        assert row is not None and row['value'] == 1.0
+
+
+# ----------------------------------------------------- tenant threading
+class TestOwnerThreading:
+    def test_config_owner_lands_on_dag_task_and_ledger(self, session):
+        from mlcomp_tpu.server.create_dags.standard import dag_standard
+        config = {'info': {'name': 'x', 'project': 'p_owner',
+                           'owner': 'alice'},
+                  'executors': {'v': {'type': 'valid_classify',
+                                      'y': '1'}}}
+        dag, tasks = dag_standard(session, config)
+        assert DagProvider(session).by_id(dag.id).owner == 'alice'
+        task_id = next(iter(tasks.values()))[0]
+        task = TaskProvider(session).by_id(task_id)
+        assert task.owner == 'alice'
+        assert task.project == 'p_owner'
+        # terminal -> fold carries the labels into the ledger
+        task.started = now() - datetime.timedelta(seconds=10)
+        task.finished = now()
+        task.status = int(TaskStatus.Success)
+        TaskProvider(session).update(
+            task, ['started', 'finished', 'status'])
+        up = UsageProvider(session)
+        up.fold_task(up.unfolded_terminal_tasks()[0])
+        row = up.recent(limit=1)[0]
+        assert row.owner == 'alice' and row.project == 'p_owner'
+
+    def test_default_owner_when_unset(self, session):
+        from mlcomp_tpu.server.create_dags.standard import dag_standard
+        config = {'info': {'name': 'x', 'project': 'p_noowner'},
+                  'executors': {'v': {'type': 'valid_classify',
+                                      'y': '1'}}}
+        dag, tasks = dag_standard(session, config)
+        assert DagProvider(session).by_id(dag.id).owner == 'default'
+        task_id = next(iter(tasks.values()))[0]
+        assert TaskProvider(session).by_id(task_id).owner == 'default'
+
+
+# -------------------------------------------------------- API surfaces
+class TestApi:
+    def test_api_usage_shape(self, session):
+        from mlcomp_tpu.server.api import api_usage
+        _seed_terminal_task(session)
+        up = UsageProvider(session)
+        up.fold_task(up.unfolded_terminal_tasks()[0])
+        out = api_usage({'group_by': 'owner'}, session)['data']
+        assert out['count'] == 1
+        assert out['totals'][0]['key'] == 'alice'
+        r = out['recent'][0]
+        assert r['owner'] == 'alice' and r['status'] == 'Success'
+        filtered = api_usage({'owner': 'nobody'}, session)['data']
+        assert filtered['recent'] == []
+
+    def test_api_slos_shape(self, session):
+        from mlcomp_tpu.server.api import api_slos
+        now_dt = _seed_sli(session, 'dispatch-p99', [
+            (age, 1.0) for age in range(0, 3600, 60)])
+        SloEngine(session).evaluate(now_dt=now_dt)
+        items = api_slos({}, session)['data']
+        entry = next(i for i in items if i['key'] == 'dispatch-p99')
+        assert entry['status'] == 'critical'
+        assert entry['alert']['rule'] == 'slo-dispatch-p99'
+
+    def test_metrics_export_declares_new_families(self, session):
+        from mlcomp_tpu.telemetry.export import (
+            REQUIRED_FAMILIES, parse_openmetrics,
+            render_server_metrics,
+        )
+        _seed_terminal_task(session)
+        up = UsageProvider(session)
+        up.fold_task(up.unfolded_terminal_tasks()[0])
+        parsed = parse_openmetrics(render_server_metrics(session))
+        for fam in ('mlcomp_usage_core_seconds', 'mlcomp_usage_tasks',
+                    'mlcomp_queue_wait_seconds',
+                    'mlcomp_queue_max_wait_seconds',
+                    'mlcomp_slo_bad_fraction', 'mlcomp_slo_burn_rate'):
+            assert fam in REQUIRED_FAMILIES
+            assert fam in parsed
+        samples = parsed['mlcomp_usage_core_seconds']['samples']
+        assert samples and samples[0][1]['owner'] == 'alice'
+        assert samples[0][2] == pytest.approx(100.0, abs=2.0)
+
+
+# ------------------------------------------------------------ migration
+class TestMigrationV14:
+    def test_v13_to_v14_upgrade_backfills_ledger(self, tmp_path):
+        from mlcomp_tpu.db.migration import MIGRATIONS, migrate
+        key = f'v14_{uuid.uuid4().hex[:8]}'
+        s = Session.create_session(
+            key=key, connection_string=f'sqlite:///{tmp_path}/up.db')
+        try:
+            s.execute('CREATE TABLE IF NOT EXISTS migration_version '
+                      '(version INTEGER)')
+            for i, fn in enumerate(MIGRATIONS[:13], start=1):
+                fn(s)
+                s.execute('INSERT INTO migration_version (version) '
+                          'VALUES (?)', (i,))
+            s.execute('DROP TABLE usage')
+            # a live v13 deployment: terminal history, no tenant labels
+            finished = now()
+            s.execute(
+                'INSERT INTO task ("name", "executor", "status", '
+                '"started", "finished", "cores_assigned", '
+                '"last_activity") VALUES (?, ?, ?, ?, ?, ?, ?)',
+                ('legacy', 'train', int(TaskStatus.Success),
+                 finished - datetime.timedelta(seconds=60), finished,
+                 '[0, 1, 2, 3]', now()))
+            assert migrate(s) == 14
+            row = s.query_one('SELECT MAX(version) AS v '
+                              'FROM migration_version')
+            assert row['v'] == 14
+            assert 'owner' in s.table_columns('dag')
+            assert {'owner', 'project'} <= s.table_columns('task')
+            # the history arrived folded, with defaulted labels
+            up = UsageProvider(s)
+            assert up.count() == 1
+            billed = up.recent(limit=1)[0]
+            assert billed.owner == 'default'
+            assert billed.core_seconds == pytest.approx(240.0, abs=4.0)
+            with pytest.raises(sqlite3.IntegrityError):
+                s.execute(
+                    'INSERT INTO usage (task, attempt) VALUES (?, ?)',
+                    (billed.task, 0))
+            # re-running migrate is a no-op (idempotent DDL + fold)
+            assert migrate(s) == 14
+            assert up.count() == 1
+        finally:
+            Session.cleanup(key)
