@@ -808,7 +808,49 @@ def bench_lm(peak_tflops: float) -> dict:
             step_ms = (time.perf_counter() - t0) * 1e3 / n_comm_steps
             probe_ms = measure_collective_ms(
                 fsdp_mesh, stats['total_bytes'])
+            # trace-measured cross-check (telemetry/trace_parse.py):
+            # capture a profiler window around the same compiled step
+            # and compare its per-device-line collective ms/step with
+            # the wire probe — two INDEPENDENT measurements of the
+            # same collectives (HLO-walk + microbenchmark vs sampled
+            # trace); bench_guard sanity-bounds the ratio
+            devtime_comm_ms = None
+            devtime_vs_probe = None
+            try:
+                import shutil
+                import tempfile
+
+                from mlcomp_tpu.telemetry.trace_parse import \
+                    parse_trace_dir
+                tdir = tempfile.mkdtemp(prefix='bench_devtime_')
+                jax.profiler.start_trace(tdir)
+                for _ in range(n_comm_steps):
+                    state, metrics = compiled(state, x, None)
+                float(metrics['loss'])
+                jax.profiler.stop_trace()
+                attr = parse_trace_dir(tdir)
+                shutil.rmtree(tdir, ignore_errors=True)
+                lines = max(1, attr['device_lines'])
+                devtime_comm_ms = (attr['buckets']['comm_ms']
+                                   / lines / n_comm_steps)
+                if probe_ms:
+                    devtime_vs_probe = \
+                        100.0 * devtime_comm_ms / probe_ms
+            except Exception:
+                pass
             result.update({
+                'devtime_comm_ms_per_step':
+                    round(devtime_comm_ms, 4)
+                    if devtime_comm_ms is not None else None,
+                'devtime_comm_vs_probe_pct':
+                    round(devtime_vs_probe, 1)
+                    if devtime_vs_probe is not None else None,
+                'devtime_comm_note':
+                    'trace-measured collective ms per device line per '
+                    'step (sampled jax.profiler window parsed by '
+                    'telemetry/trace_parse.py) as a percentage of the '
+                    'wire probe for the same compiled step — the two '
+                    'attributions cross-check each other',
                 'comm_bytes_per_step': stats['total_bytes'],
                 'comm_op_counts': {
                     op: entry['count']
@@ -1792,6 +1834,43 @@ def main():
         mem_sampler.sample(step=i)
     mem_sample_cost = (time.perf_counter() - t0) / n_mem
 
+    # ---- sampled device-time profiling overhead (telemetry/
+    # deviceprof.py, same <1% budget, bench_guard floor). Two legs:
+    # the hot path outside a capture window is ONE integer comparison
+    # per step (timed over many calls), and a window pays a real
+    # jax.profiler start/stop + trace dump on the loop thread (parse +
+    # DB write ride a background daemon thread and never block a
+    # step). Amortized per-step cost = hot path + window cost spread
+    # over the DEFAULT_EVERY cadence.
+    from mlcomp_tpu.telemetry.deviceprof import (
+        DEFAULT_EVERY as _DP_EVERY,
+    )
+    from mlcomp_tpu.telemetry.deviceprof import DeviceProfiler
+    _dp_idle = DeviceProfiler(None, task_id=0, every=10 ** 9)
+    n_dp = 20000
+    t0 = time.perf_counter()
+    for i in range(n_dp):
+        _dp_idle.on_step(i + 1)
+    dp_hot_cost = (time.perf_counter() - t0) / n_dp
+    dp_window_cost = 0.0
+    try:
+        # the FIRST start_trace of a process pays one-time profiler
+        # session init (seconds); every later window costs ~ms. A run
+        # long enough to sample pays the init once, so the amortized
+        # number uses the steady-state window: warm untimed, then time
+        _dp_warm = DeviceProfiler(None, task_id=0, every=1, window=3)
+        for i in range(1, 5):
+            _dp_warm.on_step(i)
+        _dp_warm.close()
+        _dp_real = DeviceProfiler(None, task_id=0, every=1, window=3)
+        t0 = time.perf_counter()
+        for i in range(1, 5):     # opens at step 1, closes at step 4
+            _dp_real.on_step(i)
+        dp_window_cost = time.perf_counter() - t0   # loop-thread cost
+        _dp_real.close()
+    except Exception:
+        pass
+
     # ---- trace propagation + watchdog overhead (same <1% budget,
     # measured the same isolated way). Propagation adds one dict read
     # per span exit (the process trace context); the watchdog runs
@@ -1948,6 +2027,18 @@ def main():
             f'{len(mem_sampler._devices)} reporting device(s) on '
             f'{mem_sampler.platform or "cpu"}) vs the measured '
             f'compute step; budget <1% (bench_guard floor)',
+        'devtime_overhead_pct':
+            round(100.0 * (dp_hot_cost + dp_window_cost / _DP_EVERY)
+                  / step_time, 4),
+        'devtime_overhead_note':
+            f'sampled device-time profiler (telemetry/deviceprof.py) '
+            f'loop-thread cost: {dp_hot_cost * 1e9:.1f} ns/step hot '
+            f'path + one steady-state jax.profiler capture window '
+            f'({dp_window_cost * 1e3:.1f} ms: start/stop + dump; '
+            f'parse/persist ride a daemon thread, one-time profiler '
+            f'init excluded as warmup) amortized over the '
+            f'{_DP_EVERY}-step cadence vs the measured compute step; '
+            f'budget <1% (bench_guard floor)',
         'attribution_overhead_pct':
             round(100.0 * attr_cost / step_time, 4),
         'attribution_overhead_note':

@@ -418,6 +418,45 @@ def api_task_postmortem(data, s):
     return bundle
 
 
+def api_task_devtime(data, s):
+    """Device-time attribution (telemetry/deviceprof.py): the sampled
+    ``devtime.*`` windows of a task — per-bucket series tails
+    (compute / comm / comm_exposed / io / idle ms, busy + exposed-comm
+    fractions) plus the newest window's summary (bucket split, top-op
+    table, host dispatch gaps). ``{'task': id, 'tail': N}`` bounds the
+    series tails (default 32 windows). 404 until a sampled or
+    on-demand capture has landed rows."""
+    from mlcomp_tpu.db.providers.telemetry import MetricProvider
+    task = _int_arg(data, 'task')
+    if task is None:
+        task = _int_arg(data, 'id', required=True)
+    if TaskProvider(s).by_id(task) is None:
+        raise ApiError('task not found', status=404)
+    tail = _int_arg(data, 'tail')
+    series = {
+        name: rows for name, rows in
+        MetricProvider(s).tail_series(
+            task, per_name=max(1, min(tail or 32, 512))).items()
+        if name.startswith('devtime.')}
+    if not series:
+        raise ApiError(
+            'no device-time attribution recorded for this task — '
+            'sampled profiling is off (telemetry profile_every) and '
+            'no on-demand trace has been parsed', status=404)
+    summary_rows = series.pop('devtime.summary', [])
+    newest = summary_rows[-1] if summary_rows else None
+    return {
+        'task': task,
+        'windows': len(summary_rows) or
+        max(len(r) for r in series.values()),
+        'series': series,
+        'summary': None if newest is None else dict(
+            (newest.get('tags') or {}),
+            window_ms=newest['value'], step=newest['step'],
+            time=newest['time']),
+    }
+
+
 def api_dag_stop(data, s):
     provider = DagProvider(s)
     dag_id = int(data['id'])
@@ -881,7 +920,10 @@ def api_alert_resolve(data, s):
 def api_telemetry_profile(data, s):
     """Toggle an on-demand ``jax.profiler`` trace on a RUNNING task:
     action start|stop|status (telemetry/profiler.py — the training
-    process polls at epoch boundaries)."""
+    process polls at epoch boundaries). Once the worker stops the
+    trace it parses the dump (parse-on-stop), so the ``done`` row
+    returned by stop/status carries the device-time ``attribution``
+    — buckets, exposed-comm, top ops — not just the trace dir."""
     from mlcomp_tpu.telemetry import (
         request_stop, request_trace, trace_status,
     )
@@ -1147,6 +1189,7 @@ _ROUTES = {
     # (no secrets: metric names + floats); the profile toggle mutates
     # state and needs the token
     '/api/telemetry/series': (api_telemetry_series, False),
+    '/api/task/devtime': (api_task_devtime, False),
     '/api/telemetry/spans': (api_telemetry_spans, False),
     '/api/telemetry/trace': (api_telemetry_trace, False),
     '/api/alerts': (api_alerts, False),
@@ -1179,6 +1222,7 @@ _READ_ONLY_ROUTES = frozenset({
     '/api/report', '/api/report/update_layout_start',
     '/api/telemetry/series', '/api/telemetry/spans',
     '/api/telemetry/trace', '/api/alerts', '/api/task/postmortem',
+    '/api/task/devtime',
 })
 
 
@@ -1375,7 +1419,8 @@ class ApiHandler(BaseHTTPRequestHandler):
         if parsed.path in ('/telemetry/series', '/telemetry/spans',
                            '/api/alerts', '/api/fleets', '/api/sweeps',
                            '/api/usage', '/api/slos',
-                           '/api/task/postmortem') \
+                           '/api/task/postmortem',
+                           '/api/task/devtime') \
                 or parsed.path.startswith('/telemetry/trace/'):
             # GET mirrors of the POST routes (curl-friendly:
             # /telemetry/series?task=7&name=loss,
@@ -1400,6 +1445,8 @@ class ApiHandler(BaseHTTPRequestHandler):
                 handler = api_slos
             elif parsed.path == '/api/task/postmortem':
                 handler = api_task_postmortem
+            elif parsed.path == '/api/task/devtime':
+                handler = api_task_devtime
             else:
                 data['id'] = parsed.path[len('/telemetry/trace/'):]
                 handler = api_telemetry_trace

@@ -1079,6 +1079,60 @@ function commCard(series) {
   return html + '</div>';
 }
 
+function devtimeCard(dt) {
+  // sampled device-time attribution (telemetry/deviceprof.py +
+  // POST /api/task/devtime): where the newest trace window's device
+  // time went — compute / exposed collectives / infeed-outfeed /
+  // idle — plus the exposed-comm trend across windows that the
+  // watchdog's exposed-comm-regression rule judges
+  if (!dt || dt.success === false || !dt.summary) return '';
+  const s = dt.summary, b = s.buckets || {};
+  const total = (b.compute_ms||0) + (b.comm_exposed_ms||0)
+    + (b.io_ms||0) + (b.idle_ms||0);
+  if (!total) return '';
+  let html = '<h3>device time</h3><div class="card">'
+    + '<div style="display:flex;gap:18px;margin-bottom:8px">'
+    + `<div><b>${((s.busy_frac||0)*100).toFixed(1)}%</b>
+       <span class="dim">device busy</span></div>`
+    + `<div><b>${((s.exposed_comm_frac||0)*100).toFixed(1)}%</b>
+       <span class="dim">exposed comm</span></div>`
+    + `<div><b>${(+s.window_ms||0).toFixed(2)} ms</b>
+       <span class="dim">window${s.step != null ? ' @ step '+s.step : ''}
+       &times; ${s.device_lines||1} device lines</span></div>`
+    + '</div>';
+  // stacked bucket bar: compute + exposed comm + io + idle sum to
+  // the window (comm hidden under compute rides inside the compute
+  // segment by construction — the parser's bucket invariant)
+  const segs = [['compute', b.compute_ms, '#41c07c'],
+                ['exposed comm', b.comm_exposed_ms, '#e05d5d'],
+                ['io', b.io_ms, '#5d9de0'],
+                ['idle', b.idle_ms, '#565d6b']];
+  html += '<div style="display:flex;height:10px;border-radius:4px;'
+    + 'overflow:hidden;margin:2px 0 4px;background:#2a2f3a">'
+    + segs.map(([n, v, c]) =>
+      `<div title="${n} ${(v||0).toFixed(2)} ms" style="width:${
+        (100*(v||0)/total).toFixed(1)}%;background:${c}"></div>`)
+      .join('')
+    + '</div><div class="dim" style="font-size:11px">'
+    + segs.map(([n, v, c]) => `<span style="color:${c}">&#9632;</span>
+        ${n} ${(100*(v||0)/total).toFixed(1)}%`).join(' &middot; ')
+    + '</div>';
+  const ops = s.ops || [];
+  if (ops.length)
+    html += '<div class="dim" style="font-size:11px;margin-top:6px">'
+      + ops.slice(0,6).map(o =>
+          esc(o.op) + ' ' + (+o.ms).toFixed(2) + ' ms'
+          + (o.count ? ' &times; ' + o.count : '')).join(' &middot; ')
+      + '</div>';
+  const trend = ((dt.series||{})['devtime.exposed_comm_frac']||[])
+    .filter(p => p.step != null);
+  if (trend.length >= 2)
+    html += '<div class="charts">' + lineChart(
+      'devtime.exposed_comm_frac', 'step',
+      trend.map(p => ({epoch: p.step, value: p.value}))) + '</div>';
+  return html + '</div>';
+}
+
 function postmortemCard(pm) {
   // the flight recorder's frozen bundle (telemetry/memory.py,
   // POST /api/task/postmortem): the at-death explanation of a failed
@@ -1252,6 +1306,14 @@ async function viewTaskDetail(el, id) {
   if (mem) el.appendChild(h('<div>' + mem + '</div>'));
   const comm = commCard(perfTel.series || {});
   if (comm) el.appendChild(h('<div>' + comm + '</div>'));
+  // device-time card: the sampled trace windows' attribution
+  // (telemetry/deviceprof.py — 404s quietly when the engine never
+  // sampled this task, e.g. CPU runs with the cadence defaulted off)
+  let dt = null;
+  try { dt = await api('task/devtime', {task: id, tail: 32}); }
+  catch (e) {}
+  const dtc = devtimeCard(dt);
+  if (dtc) el.appendChild(h('<div>' + dtc + '</div>'));
   // postmortem card for failed tasks: the flight recorder's frozen
   // at-death bundle (404s quietly when the task never failed with a
   // taxonomy reason)

@@ -16,7 +16,14 @@ package wired through every layer of this framework:
 - ``device``   — HBM occupancy + compiled-step FLOPs from inside the
   training process (MFU computed in the loop, not in bench.py).
 - ``profiler`` — on-demand ``jax.profiler`` traces toggled per task
-  through ``POST /api/telemetry/profile``.
+  through ``POST /api/telemetry/profile`` (parsed on stop into the
+  same device-time attribution the sampled engine emits).
+- ``deviceprof`` + ``trace_parse`` — continuous sampled device-time
+  profiling: short ``jax.profiler`` windows every ``profile_every``
+  steps, parsed jax-free into compute/collective/io/idle buckets with
+  measured exposed-comm (collective time NOT hidden under compute) —
+  persisted as ``devtime.*`` series, the ground truth ROADMAP item
+  2's overlap work is judged against.
 - ``watchdog`` — rule engine over the recorded signals, evaluated from
   the supervisor tick: stalled tasks, step-time regressions vs a
   per-task rolling baseline, straggler workers, HBM-pressure trends,
@@ -79,6 +86,10 @@ from mlcomp_tpu.telemetry.memory import (
     memory_attribution, persist_memory_attribution,
     persist_postmortem, persist_run_snapshot,
 )
+from mlcomp_tpu.telemetry.deviceprof import (
+    DeviceProfiler, close_live_profilers, persist_attribution,
+    prune_profile_dirs,
+)
 from mlcomp_tpu.telemetry.export import (
     OPENMETRICS_CONTENT_TYPE, parse_openmetrics, render_openmetrics,
     render_server_metrics,
@@ -95,6 +106,9 @@ from mlcomp_tpu.telemetry.spans import (
     record_span, set_trace_context, span, trace_context_env,
 )
 from mlcomp_tpu.telemetry.slo import SloConfig, SloEngine, slo_status
+from mlcomp_tpu.telemetry.trace_parse import (
+    parse_trace_dir, parse_trace_events, parse_trace_file,
+)
 from mlcomp_tpu.telemetry.watchdog import Watchdog, WatchdogConfig
 
 __all__ = [
@@ -115,6 +129,9 @@ __all__ = [
     'build_postmortem', 'persist_postmortem', 'load_postmortem',
     'COLLECTIVE_OPS', 'collective_stats', 'measure_collective_ms',
     'persist_collective_stats',
+    'DeviceProfiler', 'persist_attribution', 'prune_profile_dirs',
+    'close_live_profilers',
+    'parse_trace_dir', 'parse_trace_file', 'parse_trace_events',
     'render_openmetrics', 'parse_openmetrics', 'render_server_metrics',
     'OPENMETRICS_CONTENT_TYPE',
 ]

@@ -49,6 +49,7 @@ REQUIRED_FAMILIES = (
     'mlcomp_fleet_swaps',
     'mlcomp_sweep_cells', 'mlcomp_sweep_prunes', 'mlcomp_sweep_rung',
     'mlcomp_hbm_bytes', 'mlcomp_comm_bytes', 'mlcomp_comm_fraction',
+    'mlcomp_devtime_ms', 'mlcomp_devtime_exposed_comm_fraction',
     'mlcomp_supervisor_leader', 'mlcomp_supervisor_epoch',
     'mlcomp_supervisor_failovers', 'mlcomp_supervisor_fenced_writes',
     'mlcomp_db_listener_reconnects',
@@ -386,6 +387,36 @@ def _collect_comm(session, running, bytes_samples, frac_samples):
             continue        # counts/probe/totals ride the JSON surfaces
         bytes_samples.append(
             ('', {'task': r['task'], 'op': m.group(1)}, r['value']))
+
+
+#: devtime bucket series -> the ``bucket`` label value on
+#: mlcomp_devtime_ms (telemetry/deviceprof.py BUCKET_SERIES)
+_DEVTIME_NAME = re.compile(r'^devtime\.([a-z_]+)_ms$')
+
+
+def _collect_devtime(session, running, ms_samples, frac_samples):
+    """``mlcomp_devtime_ms{task,bucket}`` (newest sampled window's
+    compute/comm/comm_exposed/io/idle device time, summed across
+    device lines) + ``mlcomp_devtime_exposed_comm_fraction{task}``
+    (collective time NOT hidden under compute) —
+    telemetry/deviceprof.py sampled profiling. Latest row per
+    (task, name) like the comm family."""
+    if not running:
+        return
+    marks = ','.join('?' * len(running))
+    for r in session.query(
+            f'SELECT task, name, value, MAX(id) AS latest FROM metric '
+            f"WHERE task IN ({marks}) AND name LIKE 'devtime.%' "
+            f'GROUP BY task, name', tuple(running)):
+        if r['name'] == 'devtime.exposed_comm_frac':
+            frac_samples.append(('', {'task': r['task']}, r['value']))
+            continue
+        m = _DEVTIME_NAME.match(r['name'])
+        if m is None or m.group(1) in ('window', 'host_dispatch_gap'):
+            continue     # fractions/window/summary ride the JSON API
+        ms_samples.append(
+            ('', {'task': r['task'], 'bucket': m.group(1)},
+             r['value']))
 
 
 def _collect_compile_events(session, running, samples):
@@ -869,6 +900,7 @@ def collect_server_families(session):
     freplicas, fgens, fshed, frespawns, fswaps = [], [], [], [], []
     sweep_cells, sweep_prunes, sweep_rungs = [], [], []
     hbm, comm_bytes, comm_frac = [], [], []
+    devtime_ms, devtime_frac = [], []
     leader, epoch, failovers, fenced, reconnects = [], [], [], [], []
     usage_cores, usage_tasks = [], []
     qwait, qmax, slo_bad, slo_burn = [], [], [], []
@@ -913,6 +945,8 @@ def collect_server_families(session):
     guarded('hbm', _collect_hbm, session, running, hbm)
     guarded('comm', _collect_comm, session, running, comm_bytes,
             comm_frac)
+    guarded('devtime', _collect_devtime, session, running, devtime_ms,
+            devtime_frac)
     guarded('serving_latency', _collect_serving_latency, session,
             serving)
     error_samples = [('', {'collector': name}, n)
@@ -991,6 +1025,15 @@ def collect_server_families(session):
                'measured collective share of the step (wire probe / '
                f'step time; newest {_RUNNING_TASKS_CAP} running '
                'tasks)', comm_frac),
+        family('mlcomp_devtime_ms', 'gauge',
+               'newest sampled device-time window by bucket '
+               '(compute|comm|comm_exposed|io|idle, summed across '
+               'device lines; telemetry deviceprof, newest '
+               f'{_RUNNING_TASKS_CAP} running tasks)', devtime_ms),
+        family('mlcomp_devtime_exposed_comm_fraction', 'gauge',
+               'collective time NOT overlapped with compute in the '
+               'newest sampled window (trace-measured; newest '
+               f'{_RUNNING_TASKS_CAP} running tasks)', devtime_frac),
         family('mlcomp_supervisor_leader', 'gauge',
                '1 while a live supervisor lease names a leader '
                '(labels: computer, holder) — a missing sample means '

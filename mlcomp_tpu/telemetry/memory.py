@@ -50,12 +50,15 @@ import json
 POSTMORTEM_SERIES_PREFIXES = (
     'loss', 'step_time_ms', 'throughput', 'step.phase.',
     'step.pipeline_efficiency', 'device', 'compile.backend_ms',
-    'comm.', 'mfu', 'host_sync.suspect_ms',
+    'comm.', 'mfu', 'host_sync.suspect_ms', 'devtime.',
 )
 
 #: single-row context signals carried whole (latest row, tags decoded)
+#: — devtime.summary is the newest sampled device-time window
+#: (telemetry/deviceprof.py), so an OOM/stall postmortem shows what
+#: the device was actually doing
 POSTMORTEM_CONTEXT_NAMES = ('run.snapshot', 'memory.attribution',
-                            'comm.bytes_per_step')
+                            'comm.bytes_per_step', 'devtime.summary')
 
 
 class MemorySampler:

@@ -11,12 +11,23 @@ overhead between polls, no new transport.
 Row lifecycle under key ``telemetry:profile:<task>``:
 ``requested`` → (worker starts trace) → ``tracing`` → on a ``stop``
 request or ``max_epochs`` elapsed → ``done`` (with the trace dir).
+
+Parse-on-stop: the ``done`` row also carries the device-time
+``attribution`` (telemetry/trace_parse.py over the fresh dump, also
+persisted as ``devtime.*`` rows), so the API answers with what the
+device spent its time on, not just a path; the capture dir is pruned
+to the newest ``KEEP_CAPTURES`` dumps. A failed parse degrades to the
+old path-only ``done`` row — never an error.
 """
 
 import os
 import time
 
 AUX_PREFIX = 'telemetry:profile:'
+
+#: on-demand capture retention per task dir (the postmortem-retention
+#: pattern applied to trace dumps)
+KEEP_CAPTURES = 3
 
 
 def _provider(session):
@@ -124,8 +135,28 @@ class TaskProfiler:
         except Exception:
             pass
         self.tracing = False
-        self._write(dict(row, status='done', dir=self._dir,
-                         epochs=self._epochs_traced))
+        done = dict(row, status='done', dir=self._dir,
+                    epochs=self._epochs_traced)
+        # parse-on-stop: attach the device-time attribution and land
+        # it as devtime.* rows; any failure degrades to the path-only
+        # answer above (the dump may be absent, truncated, or in a
+        # format the parser has never seen)
+        try:
+            from mlcomp_tpu.telemetry.deviceprof import (
+                persist_attribution, prune_profile_dirs,
+            )
+            from mlcomp_tpu.telemetry.trace_parse import \
+                parse_trace_dir
+            attr = parse_trace_dir(self._dir)
+            done['attribution'] = attr
+            try:
+                persist_attribution(self.session, self.task_id, attr)
+            except Exception:
+                pass
+            prune_profile_dirs(self._dir, keep=KEEP_CAPTURES)
+        except Exception:
+            pass
+        self._write(done)
 
     def close(self):
         """Stop an open trace (exception paths) so a restarted executor
@@ -135,4 +166,4 @@ class TaskProfiler:
 
 
 __all__ = ['TaskProfiler', 'request_trace', 'request_stop',
-           'trace_status', 'AUX_PREFIX']
+           'trace_status', 'AUX_PREFIX', 'KEEP_CAPTURES']

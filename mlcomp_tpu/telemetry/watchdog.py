@@ -33,6 +33,15 @@ Rules (all thresholds tunable via WatchdogConfig):
   BEFORE the crash the flight recorder would otherwise only explain
   after the fact. A monotonic rise above ``hbm_trend_floor`` that
   projects past the horizon still warns.
+- **exposed-comm-regression** — a running task whose newest sampled
+  device-time window (telemetry/deviceprof.py,
+  ``devtime.exposed_comm_frac``) shows the exposed collective
+  fraction — collective time NOT hidden under compute — jumping more
+  than ``devtime_exposed_rise`` fraction points over the task's own
+  rolling baseline. Overlap regressions (a sharding change, a fusion
+  boundary moving) are invisible to wall-clock step time until they
+  dominate; the trace-measured fraction catches them at the first
+  sampled window.
 - **recompile-storm** — ``recompile_storm_count`` XLA compile events
   past ``recompile_warmup_steps`` within ``recompile_window_s``
   (telemetry/compile_events.py records them); time-windowed so the
@@ -104,6 +113,18 @@ class WatchdogConfig:
     recompile_storm_count = 3
     recompile_warmup_steps = 20
     recompile_window_s = 600.0
+    #: exposed-comm regression: sampled devtime windows needed for a
+    #: verdict (the newest window vs the median of the older ones in
+    #: the same fetch)
+    devtime_windows = 4
+    #: the newest window's exposed-comm fraction must exceed the
+    #: baseline median by this many fraction points (absolute — a
+    #: quarter of the window flipping from hidden to exposed is a real
+    #: regression at any model size) ...
+    devtime_exposed_rise = 0.25
+    #: ... and itself clear this noise floor (tiny fractions wobble
+    #: window to window without meaning anything)
+    devtime_exposed_floor = 0.05
     #: gang-stall: seconds of docker-heartbeat silence before a gang
     #: rank's host counts as preempted. Heartbeats tick every ~5 s, so
     #: this is dozens of missed beats — far past an agent restart or a
@@ -175,6 +196,8 @@ class Watchdog:
                 lambda: self._check_hbm(running, metrics, alerts),
                 lambda: self._check_recompiles(running, metrics,
                                                alerts, now_dt),
+                lambda: self._check_exposed_comm(running, metrics,
+                                                 alerts),
                 lambda: self._sweep_finished(running, alerts)):
             try:
                 findings += rule() or []
@@ -419,6 +442,43 @@ class Watchdog:
                              'last_step': storm[0][0]}))
             else:
                 alerts.resolve_for_task(task.id, rule='recompile-storm')
+        return out
+
+    def _check_exposed_comm(self, running, metrics, alerts):
+        """Exposed-comm regression: the newest sampled device-time
+        window's ``devtime.exposed_comm_frac`` vs the task's own
+        rolling baseline (median of the older windows in the same
+        fetch). Per-task baseline for the same reason step-regression
+        uses one — a comm-bound 70%-exposed model is not regressing,
+        a compute-bound model jumping 10%→40% is. Warning severity:
+        the run still makes progress, it just wastes the overlap the
+        roofline advisor budgets for (ROADMAP item 2)."""
+        need = int(self.config.devtime_windows)
+        out = []
+        for task in running:
+            values = metrics.recent_values(
+                task.id, 'devtime.exposed_comm_frac', limit=need)
+            if len(values) < need:
+                continue     # not enough sampled windows for a verdict
+            newest = values[0]                        # newest first
+            baseline = statistics.median(values[1:])
+            rise = newest - baseline
+            if newest > self.config.devtime_exposed_floor and \
+                    rise > self.config.devtime_exposed_rise:
+                out.append(self._raise(
+                    alerts, 'exposed-comm-regression',
+                    f'task {task.id} ({task.name}): exposed '
+                    f'collective time jumped to {newest:.0%} of the '
+                    f'sampled device-time window (rolling baseline '
+                    f'{baseline:.0%}) — compute/comm overlap '
+                    f'regressed; see the devtime series',
+                    task,
+                    details={'exposed_frac': round(newest, 4),
+                             'baseline_frac': round(baseline, 4),
+                             'rise': round(rise, 4)}))
+            else:
+                alerts.resolve_for_task(
+                    task.id, rule='exposed-comm-regression')
         return out
 
     @staticmethod

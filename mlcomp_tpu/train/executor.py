@@ -127,7 +127,8 @@ class JaxTrain(Executor):
         self.profile = dict(profile) if profile else None
         # telemetry: True (default) | False | {flush_every: N,
         # cost_analysis: bool, memory_analysis: bool,
-        # collectives: bool, memory_every: N, peak_tflops: float}.
+        # collectives: bool, memory_every: N, peak_tflops: float,
+        # profile_every: N, profile_steps: N}.
         # Per-step loss/throughput series + the per-step HBM timeline
         # (MemorySampler, memory_every cadence) + per-epoch device
         # stats land in the metric table (telemetry/); cost_analysis/
@@ -284,6 +285,7 @@ class JaxTrain(Executor):
         self._profile_open = False
         self._telemetry = None
         self._profiler = None
+        self._deviceprof = None
         self._attribution = None
         self._tripwire = None
         self._compile_events = None
@@ -330,6 +332,14 @@ class JaxTrain(Executor):
             if self._profiler is not None:
                 try:
                     self._profiler.close()
+                except Exception:
+                    pass
+            if self._deviceprof is not None:
+                # an open sampled window stops + parses here so its
+                # devtime.* rows land even on the failure path (the
+                # postmortem bundle tails them)
+                try:
+                    self._deviceprof.close()
                 except Exception:
                     pass
             if self._telemetry is not None:
@@ -449,6 +459,7 @@ class JaxTrain(Executor):
         self._memory = None
         self._comm_probe_ms = None
         self._introspected = False
+        self._deviceprof = None
         if self.telemetry_spec is not None and self.session is not None \
                 and self.task is not None and self._is_main:
             from mlcomp_tpu.telemetry import MetricRecorder, TaskProfiler
@@ -485,6 +496,26 @@ class JaxTrain(Executor):
             self._memory = MemorySampler(
                 self._telemetry,
                 every=int(self.telemetry_spec.get('memory_every', 1)))
+            # sampled device-time profiling (telemetry/deviceprof.py):
+            # like the introspection gates, default ON off-CPU only —
+            # `profile_every: <steps>` in the telemetry spec forces it
+            # either way (0 disables); `profile_steps` sets the window
+            # extent in dispatches
+            from mlcomp_tpu.telemetry import DeviceProfiler
+            from mlcomp_tpu.telemetry.deviceprof import (
+                DEFAULT_EVERY, DEFAULT_WINDOW,
+            )
+            prof_every = self.telemetry_spec.get('profile_every')
+            if prof_every is None:
+                prof_every = DEFAULT_EVERY \
+                    if jax.default_backend() != 'cpu' else 0
+            if int(prof_every) > 0:
+                self._deviceprof = DeviceProfiler(
+                    self.session, self.task.id,
+                    every=int(prof_every),
+                    window=int(self.telemetry_spec.get(
+                        'profile_steps', DEFAULT_WINDOW)),
+                    logger=self.info)
 
         def _want(key):
             """Per-feature introspection gate: 'cost_analysis' /
@@ -797,7 +828,8 @@ class JaxTrain(Executor):
                     attribution=self._attribution,
                     tripwire=self._tripwire,
                     compile_events=self._compile_events,
-                    memory=self._memory)
+                    memory=self._memory,
+                    deviceprof=self._deviceprof)
             eval_step = make_eval_step(
                 model, loss_fn, mesh=mesh,
                 self_supervised=self_supervised)

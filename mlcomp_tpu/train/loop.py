@@ -378,7 +378,7 @@ def make_eval_step(model, loss_fn: Callable,
 def instrumented_step(step_fn, recorder, batch_size: int = None,
                       metric_keys=('loss',), attribution=None,
                       tripwire=None, compile_events=None,
-                      memory=None):
+                      memory=None, deviceprof=None):
     """Wrap a jit'd train step with per-step telemetry recording
     (telemetry/metrics.py). Hot-path cost per step: a perf_counter
     read and 2-3 list appends — the device arrays in ``metrics`` are
@@ -407,7 +407,12 @@ def instrumented_step(step_fn, recorder, batch_size: int = None,
       per-step HBM timeline after the dispatch — one allocator-stats
       read per reporting device, no device sync, inert on platforms
       without memory stats (bench publishes
-      ``memory_sampler_overhead_pct``; budget <1%).
+      ``memory_sampler_overhead_pct``; budget <1%);
+    - ``deviceprof`` (telemetry/deviceprof.py DeviceProfiler) opens a
+      short ``jax.profiler`` window every ``profile_every`` steps and
+      closes it after its dispatch count — between windows this is
+      one integer comparison (bench publishes
+      ``devtime_overhead_pct``; budget <1%).
     """
     import time as _time
     last = [None]
@@ -441,6 +446,11 @@ def instrumented_step(step_fn, recorder, batch_size: int = None,
                 tripwire.observe(dt * 1e3, step=step)
         if memory is not None:
             memory.sample(step=step)
+        if deviceprof is not None:
+            # sampled device-time windows (telemetry/deviceprof.py):
+            # one integer comparison per step outside a window; open
+            # windows count this dispatch toward their extent
+            deviceprof.on_step(step)
         if attribution is not None:
             attribution.step_end(step=step)
         return out

@@ -56,10 +56,16 @@ def _install_crash_flush(session):
 
     def _drain():
         from mlcomp_tpu.telemetry import (
-            flush_live_recorders, flush_spans,
+            close_live_profilers, flush_live_recorders, flush_spans,
         )
         try:
             flush_spans(session)
+        except Exception:
+            pass
+        try:
+            # an open sampled trace window stops + parses so its
+            # devtime.* rows land before the recorder flush below
+            close_live_profilers()
         except Exception:
             pass
         try:

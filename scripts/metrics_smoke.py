@@ -77,6 +77,21 @@ def seed(session):
             'train', None),
            (task.id, 'comm.fraction', 'series', 0, 0.12, ts, 'train',
             None),
+           # sampled device-time window (telemetry/deviceprof.py):
+           # the bucket series export maps onto
+           # mlcomp_devtime_ms{bucket=...} + the exposed fraction
+           (task.id, 'devtime.compute_ms', 'series', 10, 5.2, ts,
+            'train', None),
+           (task.id, 'devtime.comm_ms', 'series', 10, 1.4, ts,
+            'train', None),
+           (task.id, 'devtime.comm_exposed_ms', 'series', 10, 0.6,
+            ts, 'train', None),
+           (task.id, 'devtime.io_ms', 'series', 10, 0.2, ts, 'train',
+            None),
+           (task.id, 'devtime.idle_ms', 'series', 10, 1.0, ts,
+            'train', None),
+           (task.id, 'devtime.exposed_comm_frac', 'series', 10,
+            0.43, ts, 'train', None),
            (None, 'supervisor.dispatch_latency_s.p50', 'histogram',
             None, 0.4, ts, 'supervisor', None),
            (None, 'supervisor.dispatch_latency_s.p99', 'histogram',
@@ -296,6 +311,16 @@ def main():
         ('mlcomp_comm_fraction', any(
             v == 0.12
             for _, l, v in doc['mlcomp_comm_fraction']['samples'])),
+        ('mlcomp_devtime_ms buckets', all(
+            any(l.get('bucket') == bucket
+                and str(l.get('task')) == str(task_id)
+                for l in sample_labels('mlcomp_devtime_ms'))
+            for bucket in ('compute', 'comm', 'comm_exposed', 'io',
+                           'idle'))),
+        ('mlcomp_devtime_exposed_comm_fraction', any(
+            v == 0.43 and str(l.get('task')) == str(task_id)
+            for _, l, v in
+            doc['mlcomp_devtime_exposed_comm_fraction']['samples'])),
         ('mlcomp_supervisor_leader', any(
             l.get('computer') == 'smoke'
             and l.get('holder') == 'smoke:2:bbb' and v == 1

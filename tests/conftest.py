@@ -22,6 +22,26 @@ jax.config.update('jax_platforms', 'cpu')
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _confine_sigterm_handler():
+    """In-process worker tests run ExecuteBuilder inside the pytest
+    process, which installs the worker's SIGTERM -> SystemExit(143)
+    crash-flush handler (worker/tasks._install_crash_flush) — and the
+    handler outlives the installing test. A CI time-budget SIGTERM
+    landing after that point then raises SystemExit inside whichever
+    unrelated test happens to be running, reported as a spurious
+    failure. Restore the handler after each test so a budget cut
+    kills the run cleanly instead."""
+    import signal as _signal
+    before = _signal.getsignal(_signal.SIGTERM)
+    yield
+    if _signal.getsignal(_signal.SIGTERM) is not before:
+        try:
+            _signal.signal(_signal.SIGTERM, before)
+        except (ValueError, OSError):
+            pass
+
+
 @pytest.fixture()
 def session():
     """Fresh migrated DB per test (parity: reference utils/tests.py:12-21)."""

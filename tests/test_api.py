@@ -374,7 +374,7 @@ class TestRobustness:
             headers={'Content-Type': 'application/json',
                      'Authorization': TOKEN})
         try:
-            urllib.request.urlopen(req, timeout=10)
+            urllib.request.urlopen(req, timeout=30)
             raise AssertionError('expected HTTP error')
         except urllib.error.HTTPError as e:
             assert e.code == 400

@@ -1,0 +1,343 @@
+"""Sampled device-time profiling engine (telemetry/deviceprof.py):
+cadence, window extent, background parse+persist of devtime.* rows,
+teardown flush, failure degradation, and the capture-dir pruning the
+on-demand profiler reuses."""
+
+import gzip
+import json
+import os
+import shutil
+import time
+
+import pytest
+
+from mlcomp_tpu.db.providers.telemetry import MetricProvider
+from mlcomp_tpu.telemetry.deviceprof import (
+    BUCKET_SERIES, DeviceProfiler, persist_attribution,
+    prune_profile_dirs,
+)
+
+from tests.test_telemetry import api  # noqa: F401  (live-server fixture)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), 'fixtures',
+                       'mini_device_trace.json.gz')
+
+
+def _fake_tracers(calls):
+    """start copies the fixture into the capture dir (the layout jax
+    dumps), stop just records — the engine under test never imports
+    jax."""
+    def start(out_dir):
+        calls.append(('start', out_dir))
+        dst = os.path.join(out_dir, 'plugins', 'profile', 'stamp')
+        os.makedirs(dst)
+        shutil.copy(FIXTURE, os.path.join(dst, 'h.trace.json.gz'))
+
+    def stop():
+        calls.append(('stop', None))
+    return start, stop
+
+
+def _wait_windows(prof, n, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline and prof.windows < n:
+        time.sleep(0.02)
+    assert prof.windows >= n, \
+        f'only {prof.windows} windows landed (failures=' \
+        f'{prof.failures})'
+
+
+class TestEngine:
+    def test_cadence_and_persisted_series(self, session):
+        calls = []
+        start, stop = _fake_tracers(calls)
+        prof = DeviceProfiler(session, task_id=1, every=10, window=2,
+                              tracer_start=start, tracer_stop=stop)
+        for step in range(15):
+            prof.on_step(step)
+        # a cadence hit while the previous window still parses is
+        # skipped, never queued — wait for the parse before step 20
+        _wait_windows(prof, 1)
+        prof._parse_thread.join(5)
+        for step in range(15, 25):
+            prof.on_step(step)
+        _wait_windows(prof, 2)
+        # windows opened at steps 10 and 20, each 2 dispatches long
+        assert [c[0] for c in calls] == ['start', 'stop'] * 2
+        series = MetricProvider(session).series(task_id=1)
+        for key in BUCKET_SERIES:
+            assert f'devtime.{key}' in series, series.keys()
+        comp = series['devtime.compute_ms']
+        assert len(comp) == 2
+        assert comp[0]['step'] == 10 and comp[1]['step'] == 20
+        assert comp[0]['value'] == pytest.approx(1.3)
+        exposed = series['devtime.exposed_comm_frac']
+        assert exposed[0]['value'] == pytest.approx(0.5 / 1.1,
+                                                    abs=1e-4)
+        summary = series['devtime.summary'][0]
+        assert summary['tags']['buckets']['comm_ms'] == \
+            pytest.approx(1.1)
+        assert summary['tags']['ops'][0]['ms'] > 0
+        # capture temp dirs are removed after parse
+        for _, d in calls:
+            if d:
+                assert not os.path.exists(d)
+
+    def test_close_flushes_open_window(self, session):
+        calls = []
+        start, stop = _fake_tracers(calls)
+        prof = DeviceProfiler(session, task_id=2, every=5, window=100,
+                              tracer_start=start, tracer_stop=stop)
+        for step in range(7):
+            prof.on_step(step)   # window opens at 5, never fills
+        assert prof._capturing
+        prof.close()
+        assert not prof._capturing
+        _wait_windows(prof, 1)
+        series = MetricProvider(session).series(task_id=2)
+        assert 'devtime.comm_exposed_ms' in series
+
+    def test_failed_parse_degrades_without_rows(self, session):
+        def start(out_dir):
+            os.makedirs(os.path.join(out_dir, 'empty'))
+
+        prof = DeviceProfiler(session, task_id=3, every=2, window=1,
+                              tracer_start=start,
+                              tracer_stop=lambda: None)
+        for step in range(5):
+            prof.on_step(step)
+        prof.close()
+        deadline = time.time() + 5
+        while time.time() < deadline and prof.failures < 1:
+            time.sleep(0.02)
+        assert prof.failures >= 1 and prof.windows == 0
+        assert MetricProvider(session).series(task_id=3) == {}
+
+    def test_failed_start_never_opens(self, session):
+        def start(out_dir):
+            raise RuntimeError('profiler busy')
+
+        prof = DeviceProfiler(session, task_id=4, every=2, window=1,
+                              tracer_start=start,
+                              tracer_stop=lambda: None)
+        for step in range(5):
+            prof.on_step(step)
+        assert not prof._capturing and prof.failures == 2
+
+    def test_disabled_cadence_is_inert(self, session):
+        prof = DeviceProfiler(session, task_id=5, every=0,
+                              tracer_start=None, tracer_stop=None)
+        for step in range(100):
+            prof.on_step(step)
+        assert not prof._capturing and prof.windows == 0
+
+
+class TestPersistAttribution:
+    def test_row_shape(self, session):
+        from mlcomp_tpu.telemetry.trace_parse import parse_trace_file
+        attr = parse_trace_file(FIXTURE)
+        n = persist_attribution(session, 7, attr, step=123)
+        series = MetricProvider(session).series(task_id=7)
+        assert n == len(series)
+        assert series['devtime.window_ms'][0]['value'] == \
+            pytest.approx(1.1)
+        assert series['devtime.host_dispatch_gap_ms'][0]['value'] == \
+            pytest.approx(0.9)
+        assert all(rows[0]['step'] == 123
+                   for rows in series.values())
+
+
+class TestPrune:
+    def test_keeps_newest_three(self, tmp_path):
+        root = tmp_path / 'trace'
+        for i in range(5):
+            d = root / 'plugins' / 'profile' / f'stamp{i}'
+            d.mkdir(parents=True)
+            (d / 'h.trace.json.gz').write_bytes(b'x')
+            os.utime(d, (i + 1, i + 1))
+        removed = prune_profile_dirs(str(root), keep=3)
+        assert removed == 2
+        left = sorted(os.listdir(root / 'plugins' / 'profile'))
+        assert left == ['stamp2', 'stamp3', 'stamp4']
+
+    def test_missing_root_is_noop(self, tmp_path):
+        assert prune_profile_dirs(str(tmp_path / 'nope')) == 0
+
+
+class TestDevtimeApiAndCli:
+    def _seed(self, session):
+        from mlcomp_tpu.telemetry.trace_parse import parse_trace_file
+        from tests.test_telemetry import make_task
+        task = make_task(session)
+        attr = parse_trace_file(FIXTURE)
+        for step in (10, 20):
+            persist_attribution(session, task.id, attr, step=step)
+        return task
+
+    def test_devtime_endpoint(self, api, session):
+        task = self._seed(session)
+        out = api('/api/task/devtime', {'task': task.id})
+        assert out['windows'] == 2
+        assert out['summary']['step'] == 20
+        assert out['summary']['buckets']['compute_ms'] == \
+            pytest.approx(1.3)
+        assert out['summary']['window_ms'] == pytest.approx(1.1)
+        series = out['series']
+        assert 'devtime.summary' not in series   # folded into summary
+        assert [p['step']
+                for p in series['devtime.exposed_comm_frac']] == \
+            [10, 20]
+        # GET mirror for curl/dashboards
+        got = api(f'/api/task/devtime?task={task.id}', method='GET')
+        assert got['windows'] == 2
+
+    def test_devtime_404s_without_rows_or_task(self, api, session):
+        import urllib.error
+
+        from tests.test_telemetry import make_task
+        task = make_task(session)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/api/task/devtime', {'task': task.id})
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            api('/api/task/devtime', {'task': 999999})
+        assert e.value.code == 404
+
+    def test_cli_devtime(self, session):
+        from click.testing import CliRunner
+
+        from mlcomp_tpu.__main__ import main as cli
+        task = self._seed(session)
+        runner = CliRunner()
+        out = runner.invoke(cli, ['devtime', str(task.id)])
+        assert out.exit_code == 0, out.output
+        assert '2 sampled device-time windows' in out.output
+        assert 'step 20' in out.output
+        assert 'exposed comm' in out.output
+        assert 'exposed-comm trend' in out.output
+        out = runner.invoke(cli, ['devtime', str(task.id), '--json'])
+        payload = json.loads(out.output)
+        assert payload['summary']['tags']['buckets']['comm_ms'] == \
+            pytest.approx(1.1)
+        out = runner.invoke(cli, ['devtime', '999999'])
+        assert out.exit_code == 1
+        assert 'no device-time attribution' in out.output
+
+
+@pytest.mark.slow
+class TestRealTrainRunAcceptance:
+    def test_jax_train_persists_devtime_windows(self, session,
+                                                tmp_path):
+        """The acceptance bar: a real CPU-mesh jax_train run with the
+        sampled cadence forced on persists devtime.* windows whose
+        buckets sum to the measured window device time."""
+        from mlcomp_tpu.db.enums import TaskStatus
+        from mlcomp_tpu.db.providers import TaskProvider
+        from mlcomp_tpu.server.create_dags.standard import dag_standard
+        from mlcomp_tpu.worker.tasks import execute_by_id
+        folder = tmp_path / 'exp'
+        folder.mkdir()
+        config = {
+            'info': {'name': 'devprof_dag', 'project': 'p_devprof'},
+            'executors': {
+                'train': {
+                    'type': 'jax_train',
+                    'model': {'name': 'mlp', 'num_classes': 4,
+                              'hidden': [16], 'dtype': 'float32'},
+                    'dataset': {'name': 'synthetic_images',
+                                'n_train': 128, 'n_valid': 64,
+                                'image_size': 8, 'channels': 1,
+                                'num_classes': 4},
+                    'batch_size': 32,
+                    'stages': [{'name': 's1', 'epochs': 2}],
+                    # force the CPU-defaulted-off cadence ON: window
+                    # at step 2, two dispatches long
+                    'telemetry': {'profile_every': 2,
+                                  'profile_steps': 2},
+                },
+            },
+        }
+        dag, tasks = dag_standard(session, config,
+                                  upload_folder=str(folder))
+        task_id = tasks['train'][0]
+        execute_by_id(task_id, exit=False, folder=str(folder),
+                      session=session)
+        task = TaskProvider(session).by_id(task_id)
+        assert task.status == int(TaskStatus.Success)
+        series = MetricProvider(session).series(task_id=task_id)
+        summaries = series.get('devtime.summary') or []
+        assert summaries, sorted(series)
+        for key in BUCKET_SERIES + ('busy_frac', 'exposed_comm_frac',
+                                    'window_ms'):
+            assert f'devtime.{key}' in series
+        for row in summaries:
+            tags = row['tags']
+            buckets = tags['buckets']
+            lines = tags['device_lines']
+            if not lines:
+                continue      # a window that caught no device ops
+            total = sum(buckets[k] for k in
+                        ('compute_ms', 'io_ms', 'comm_exposed_ms',
+                         'idle_ms'))
+            # the parser's bucket invariant, on a REAL jax dump:
+            # compute + io + exposed comm + idle == window x lines
+            assert total == pytest.approx(row['value'] * lines,
+                                          rel=0.02), tags
+
+
+class TestOnDemandParseOnStop:
+    def test_profiler_finish_attaches_attribution(self, session,
+                                                  tmp_path):
+        """telemetry/profiler.py parse-on-stop: the done row carries
+        the parsed attribution, devtime.* rows persist, and the
+        capture dir is pruned to the newest 3."""
+        from mlcomp_tpu.telemetry.profiler import (
+            TaskProfiler, request_trace, trace_status,
+        )
+        out = str(tmp_path / 'prof')
+
+        def fake_start(d):
+            stamp = os.path.join(d, 'plugins', 'profile',
+                                 f's{int(time.time() * 1e6)}')
+            os.makedirs(stamp)
+            shutil.copy(FIXTURE,
+                        os.path.join(stamp, 'h.trace.json.gz'))
+
+        prof = TaskProfiler(session, 11, str(tmp_path),
+                            tracer_start=fake_start,
+                            tracer_stop=lambda: None)
+        for round_no in range(4):
+            request_trace(session, 11, out_dir=out, max_epochs=1)
+            assert prof.poll()       # starts tracing
+            prof.poll()              # one epoch elapsed -> finish
+            row = trace_status(session, 11)
+            assert row['status'] == 'done'
+            assert row['attribution']['buckets']['comm_ms'] == \
+                pytest.approx(1.1)
+        # repeated captures pruned to the newest 3
+        stamps = os.listdir(os.path.join(out, 'plugins', 'profile'))
+        assert len(stamps) == 3
+        series = MetricProvider(session).series(task_id=11)
+        assert len(series['devtime.summary']) == 4
+
+    def test_profiler_finish_degrades_on_parse_failure(self, session,
+                                                       tmp_path):
+        from mlcomp_tpu.telemetry.profiler import (
+            TaskProfiler, request_trace, trace_status,
+        )
+        out = str(tmp_path / 'prof')
+
+        def fake_start(d):
+            os.makedirs(d, exist_ok=True)   # nothing dumped
+
+        prof = TaskProfiler(session, 12, str(tmp_path),
+                            tracer_start=fake_start,
+                            tracer_stop=lambda: None)
+        request_trace(session, 12, out_dir=out, max_epochs=1)
+        assert prof.poll()
+        prof.poll()
+        row = trace_status(session, 12)
+        # old path-only answer, not a failure
+        assert row['status'] == 'done'
+        assert row['dir'] == out
+        assert 'attribution' not in row

@@ -3,6 +3,7 @@ rejections, the live GET /metrics endpoint covering every required
 family, and the serving-bucket re-export path."""
 
 import json
+import os
 import urllib.request
 
 import pytest
@@ -152,6 +153,15 @@ def seed_everything(session):
             None, 4.0, ts, 'supervisor', None),
            (None, 'supervisor.dispatch_latency_s.mean', 'histogram',
             None, 0.5, ts, 'supervisor', None)])
+    # device-time attribution through the real path: the parsed
+    # fixture window persisted exactly as the sampled engine does
+    from mlcomp_tpu.telemetry.deviceprof import persist_attribution
+    from mlcomp_tpu.telemetry.trace_parse import parse_trace_file
+    persist_attribution(
+        session, task.id,
+        parse_trace_file(os.path.join(
+            os.path.dirname(__file__), 'fixtures',
+            'mini_device_trace.json.gz')), step=10)
     # serving buckets arrive through the REAL path: a bucketed
     # recorder flush, exactly what ModelServer's heartbeat does
     rec = MetricRecorder(session=session, component='serving',
@@ -207,6 +217,15 @@ class TestServerCollector:
         assert ('mlcomp_compile_events_total',
                 {'task': str(task.id)}, 1.0) \
             in by['mlcomp_compile_events']
+        devms = {l['bucket']: v for _, l, v in by['mlcomp_devtime_ms']
+                 if l['task'] == str(task.id)}
+        assert devms['compute'] == pytest.approx(1.3)
+        assert devms['comm_exposed'] == pytest.approx(0.5)
+        assert set(devms) == {'compute', 'comm', 'comm_exposed',
+                              'io', 'idle'}
+        (exp,) = by['mlcomp_devtime_exposed_comm_fraction']
+        assert exp[1] == {'task': str(task.id)}
+        assert exp[2] == pytest.approx(0.5 / 1.1, abs=1e-4)
         buckets = {l['le']: v for n, l, v in
                    by['mlcomp_serving_latency_ms']
                    if n.endswith('_bucket')}
@@ -221,6 +240,7 @@ class TestServerCollector:
         doc = parse_openmetrics(render_server_metrics(session))
         assert doc['mlcomp_step_phase_ms']['samples'] == []
         assert doc['mlcomp_pipeline_efficiency']['samples'] == []
+        assert doc['mlcomp_devtime_ms']['samples'] == []
 
 
 class TestMetricsEndpoint:

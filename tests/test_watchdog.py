@@ -495,3 +495,59 @@ class TestRecompileStormRule:
         findings = [f for f in wd.evaluate()
                     if f['rule'] == 'recompile-storm']
         assert len(findings) == 1
+
+
+class TestExposedCommRule:
+    """exposed-comm-regression: the trace-measured exposed collective
+    fraction (telemetry/deviceprof.py devtime series) jumping over the
+    task's own rolling baseline."""
+
+    def test_overlap_regression_flags_and_resolves(self, session):
+        task = make_task(session)
+        add_series(session, task.id, 'devtime.exposed_comm_frac',
+                   [0.10, 0.11, 0.09, 0.45])
+        wd = Watchdog(session, fast_config())
+        findings = [f for f in wd.evaluate()
+                    if f['rule'] == 'exposed-comm-regression']
+        assert len(findings) == 1
+        assert findings[0]['severity'] == 'warning'
+        assert findings[0]['details']['exposed_frac'] == \
+            pytest.approx(0.45)
+        assert findings[0]['details']['baseline_frac'] == \
+            pytest.approx(0.10)
+        # overlap restored — later windows back at baseline — and the
+        # open alert resolves on the next pass
+        add_series(session, task.id, 'devtime.exposed_comm_frac',
+                   [0.10, 0.11, 0.10, 0.09], start_step=4)
+        assert [f for f in wd.evaluate()
+                if f['rule'] == 'exposed-comm-regression'] == []
+        assert AlertProvider(session).get(
+            rule='exposed-comm-regression') == []
+
+    def test_comm_bound_baseline_is_not_a_regression(self, session):
+        # a model that is ALWAYS ~70% exposed is comm-bound, not
+        # regressing — the per-task baseline absorbs it
+        task = make_task(session)
+        add_series(session, task.id, 'devtime.exposed_comm_frac',
+                   [0.70, 0.72, 0.69, 0.71])
+        wd = Watchdog(session, fast_config())
+        assert [f for f in wd.evaluate()
+                if f['rule'] == 'exposed-comm-regression'] == []
+
+    def test_shallow_window_withholds_verdict(self, session):
+        task = make_task(session)
+        add_series(session, task.id, 'devtime.exposed_comm_frac',
+                   [0.05, 0.60])     # only 2 sampled windows
+        wd = Watchdog(session, fast_config())
+        assert [f for f in wd.evaluate()
+                if f['rule'] == 'exposed-comm-regression'] == []
+
+    def test_sub_floor_wobble_is_quiet(self, session):
+        # tiny fractions wobble window to window without meaning:
+        # a 0.00 -> 0.04 "jump" never clears the noise floor
+        task = make_task(session)
+        add_series(session, task.id, 'devtime.exposed_comm_frac',
+                   [0.0, 0.001, 0.0, 0.04],)
+        wd = Watchdog(session, fast_config(devtime_exposed_rise=0.01))
+        assert [f for f in wd.evaluate()
+                if f['rule'] == 'exposed-comm-regression'] == []

@@ -331,8 +331,11 @@ class TestChaos:
             # machine dies: SIGKILL the worker's whole process group
             # (worker + its run-task child share it)
             os.killpg(os.getpgid(worker.pid), signal.SIGKILL)
-            worker.wait(timeout=10)
-            deadline = time.time() + 10
+            worker.wait(timeout=30)
+            # the SIGKILLed run-task child reparents to init and only
+            # stops pid_exists()-ing once reaped — give a loaded CI
+            # box real time, the kill itself is instant
+            deadline = time.time() + 30
             from mlcomp_tpu import native
             while time.time() < deadline and native.pid_exists(task.pid):
                 time.sleep(0.2)
