@@ -93,7 +93,7 @@ def _preflight(config_path: str, params=()):
 
 
 def _dag(config_path: str, params=(), debug: bool = False,
-         owner: str = None):
+         owner: str = None, priority: str = None):
     from mlcomp_tpu.analysis import format_report, split_findings
     from mlcomp_tpu.server.create_dags import dag_pipe, dag_standard
 
@@ -113,6 +113,9 @@ def _dag(config_path: str, params=(), debug: bool = False,
         # --owner beats info.owner: the submitting human outranks a
         # config checked in by someone else (usage-ledger tenant label)
         config.setdefault('info', {})['owner'] = owner
+    if priority:
+        # same precedence for the v15 scheduling class
+        config.setdefault('info', {})['priority'] = priority
     logger = create_logger(session)
     if 'pipes' in config:
         # pipe registration (reference __main__.py:49-52): nothing runs
@@ -135,9 +138,16 @@ def _dag(config_path: str, params=(), debug: bool = False,
 @click.option('--owner', default=None,
               help='tenant label for the usage ledger '
                    '(overrides info.owner; default "default")')
-def dag(config, params, owner):
+@click.option('--priority', default=None,
+              type=click.Choice(['critical', 'high', 'normal',
+                                 'preemptible']),
+              help='scheduling class for every task of the dag '
+                   '(overrides info.priority; per-executor '
+                   'spec.priority overrides both)')
+def dag(config, params, owner, priority):
     """Submit a DAG (or register a pipe) to the scheduler."""
-    _, dag_row, tasks, _ = _dag(config, params, owner=owner)
+    _, dag_row, tasks, _ = _dag(config, params, owner=owner,
+                                priority=priority)
     total = sum(len(v) for v in tasks.values())
     click.echo(f'dag {dag_row.id} created with {total} tasks')
 
@@ -833,6 +843,88 @@ def usage(as_json, group_by, owner, project, limit):
                     f"{r['core_seconds'] or 0:.1f} core-s")
             if r['queue_wait_s'] is not None:
                 line += f", waited {r['queue_wait_s']:.1f}s"
+            click.echo(line)
+
+
+@main.command()
+@click.option('--json', 'as_json', is_flag=True,
+              help='machine-readable output')
+@click.option('--set', 'set_spec', default=None, metavar='SPEC',
+              help='upsert a ceiling: scope:tenant:resource=limit, '
+                   'e.g. owner:alice:cores=16 or '
+                   'project:nlp:core_seconds=86400')
+@click.option('--window', type=float, default=None,
+              help='ledger window seconds for a core_seconds quota '
+                   '(with --set; default 86400)')
+@click.option('--delete', 'delete_spec', default=None,
+              metavar='SCOPE:TENANT:RESOURCE',
+              help='remove a ceiling (the tenant becomes unlimited)')
+@click.option('--limit', default=20,
+              help='recent preemptions to show')
+def quotas(as_json, set_spec, window, delete_spec, limit):
+    """Multi-tenant scheduling (migration v15): fair-share quota
+    ceilings with live usage, the class roster, and the newest
+    checkpoint-preemptions. Absent quota row = unlimited; an explicit
+    0 locks the tenant out."""
+    from mlcomp_tpu.db.providers.quota import QuotaProvider
+    from mlcomp_tpu.server.api import api_quotas
+    session = Session.create_session()
+    migrate(session)
+    if set_spec and delete_spec:
+        raise click.ClickException('--set and --delete are exclusive')
+    if set_spec:
+        try:
+            key, limit_str = set_spec.split('=', 1)
+            scope, tenant, resource = key.split(':', 2)
+            q = QuotaProvider(session).set_quota(
+                scope, tenant, resource, float(limit_str),
+                window_s=window)
+        except ValueError as e:
+            raise click.ClickException(
+                f'bad --set spec {set_spec!r}: {e}')
+        click.echo(f'quota {q.scope}:{q.tenant}:{q.resource} = '
+                   f'{q.limit_value:g}'
+                   + (f' over {q.window_s:g}s'
+                      if q.resource == 'core_seconds' else ''))
+        return
+    if delete_spec:
+        try:
+            scope, tenant, resource = delete_spec.split(':', 2)
+        except ValueError:
+            raise click.ClickException(
+                f'bad --delete spec {delete_spec!r}')
+        if not QuotaProvider(session).delete(scope, tenant, resource):
+            raise click.ClickException('quota not found')
+        click.echo(f'quota {delete_spec} removed (tenant unlimited)')
+        return
+    data = api_quotas({'limit': limit}, session)['data']
+    if as_json:
+        click.echo(json.dumps(data))
+        return
+    if data['quotas']:
+        click.echo('quotas:')
+        for q in data['quotas']:
+            unit = 'cores' if q['resource'] == 'cores' else 'core-s'
+            line = (f"  {q['scope']}:{q['tenant']}:{q['resource']} "
+                    f"{q['used']:g}/{q['limit']:g} {unit}")
+            if q['resource'] == 'core_seconds':
+                line += f" over {q['window_s']:g}s"
+            click.echo(line)
+    else:
+        click.echo('no quotas configured (every tenant unlimited)')
+    click.echo('classes:')
+    for cls, counts in data['classes'].items():
+        click.echo(f"  {cls}: {counts['pending']} pending, "
+                   f"{counts['running']} running")
+    if data['preemptions']:
+        click.echo('recent preemptions:')
+        for p in data['preemptions']:
+            line = (f"  task {p['task']} ({p['task_name']}, "
+                    f"{p['victim_class']}) attempt {p['attempt']} "
+                    f"← task {p['initiator']} "
+                    f"({p['initiator_class']}): {p['reason']}")
+            if not p['applied']:
+                line += ' [pending apply]'
             click.echo(line)
 
 

@@ -54,10 +54,12 @@ from mlcomp_tpu.db.core import insert_sql, update_sql
 #: control-state tables whose supervisor-issued mutations are fenced.
 #: sweep/sweep_decision belong here: a zombie ex-leader recording a
 #: prune verdict — or acting on one — would kill a cell the live
-#: leader may have judged differently
+#: leader may have judged differently. preemption likewise: a zombie
+#: recording an eviction decision — or applying one — would kill a
+#: victim the live leader never chose (double-preemption)
 FENCED_TABLES = frozenset(
     {'task', 'queue_message', 'serve_fleet', 'serve_replica',
-     'sweep', 'sweep_decision'})
+     'sweep', 'sweep_decision', 'preemption'})
 
 #: the store-side fence predicate (one indexed read of a 1-row table)
 FENCE_PREDICATE = '(SELECT epoch FROM supervisor_lease WHERE id=1)=?'

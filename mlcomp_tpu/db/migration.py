@@ -422,10 +422,39 @@ def _v14_usage(session: Session):
                 provider.fold_task(task)
 
 
+def _v15_scheduling(session: Session):
+    """Multi-tenant scheduling (server/scheduler.py): priority-class
+    columns on dag/task/serve_fleet, the ``quota`` fair-share table
+    and the ``preemption`` eviction audit trail (db/models/quota.py).
+    The ALTERs are guarded by live pragma checks like every column
+    migration; NULL priority deliberately stays NULL so legacy rows
+    read their class-based default (sweep cells 'preemptible', serve
+    replicas 'high', the rest 'normal') instead of freezing today's
+    default into history. The UNIQUE index is the store-level backstop
+    of the preemption engine's exactly-once conditional insert — a
+    raced double tick or a failover replay can never evict the same
+    attempt twice (the sweep_decision pattern, v13)."""
+    from mlcomp_tpu.db.models import Preemption, Quota
+    for table in ('dag', 'task', 'serve_fleet'):
+        have = session.table_columns(table)
+        if have and 'priority' not in have:
+            session.execute(
+                f'ALTER TABLE {table} ADD COLUMN "priority" TEXT')
+    for model in (Quota, Preemption):
+        for stmt in model.create_table_ddl(_dialect(session)):
+            session.execute(stmt)           # IF NOT EXISTS — safe
+    session.execute(
+        'CREATE UNIQUE INDEX IF NOT EXISTS idx_preemption_once '
+        'ON preemption("task", "attempt")')
+    session.execute(
+        'CREATE UNIQUE INDEX IF NOT EXISTS idx_quota_key '
+        'ON quota("scope", "tenant", "resource")')
+
+
 MIGRATIONS = [_v1_init, _v2_data, _v3_auth, _v4_telemetry, _v5_preflight,
               _v6_tracing_alerts, _v7_recovery, _v8_gang, _v9_fleet,
               _v10_postmortem, _v11_dispatch_indexes, _v12_supervisor_ha,
-              _v13_sweep, _v14_usage]
+              _v13_sweep, _v14_usage, _v15_scheduling]
 
 
 def migrate(session: Session = None):

@@ -24,6 +24,7 @@ from mlcomp_tpu.db.models.supervisor import (
 )
 from mlcomp_tpu.db.models.sweep import Sweep, SweepDecision
 from mlcomp_tpu.db.models.usage import Usage
+from mlcomp_tpu.db.models.quota import Preemption, Quota
 
 ALL_MODELS = [
     Project, Report, ReportLayout, Dag, Task, TaskDependence, TaskSynced,
@@ -35,6 +36,7 @@ ALL_MODELS = [
     SupervisorLease, SupervisorInstance,
     Sweep, SweepDecision,
     Usage,
+    Quota, Preemption,
 ]
 
 __all__ = [m.__name__ for m in ALL_MODELS] + ['ALL_MODELS']

@@ -21,6 +21,9 @@ class Dag(DBModel):
     # usage ledger and queue accounting group by it; defaults to
     # 'default' when the config/CLI did not say.
     owner = Column('TEXT')
+    # scheduling class (migration v15) stamped at submission; tasks
+    # inherit it unless their executor spec overrides per-task
+    priority = Column('TEXT')
 
 
 class DagPreflight(DBModel):

@@ -51,6 +51,10 @@ class ServeFleet(DBModel):
     cores = Column('INTEGER', default=1)
     batch_size = Column('INTEGER', default=64)
     quantize = Column('TEXT')
+    # scheduling class (migration v15) stamped onto every replica task
+    # this fleet spawns; serving defaults to 'high' so scale-ups can
+    # preempt preemptible batch work (server/scheduler.py)
+    priority = Column('TEXT')
     created = Column('TEXT', dtype='datetime')
     updated = Column('TEXT', dtype='datetime')
 

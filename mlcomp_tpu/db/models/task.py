@@ -65,6 +65,10 @@ class Task(DBModel):
     # group without a dag/project join on the tick hot path.
     owner = Column('TEXT')
     project = Column('TEXT')
+    # scheduling class (migration v15): critical|high|normal|
+    # preemptible. NULL reads as the class-based default
+    # (server/scheduler.py) so legacy rows keep their old ordering.
+    priority = Column('TEXT')
 
 
 class TaskDependence(DBModel):

@@ -32,10 +32,14 @@ from mlcomp_tpu.db.providers.sweep import (
     SweepDecisionProvider, SweepProvider,
 )
 from mlcomp_tpu.db.providers.usage import UsageProvider
+from mlcomp_tpu.db.providers.quota import (
+    PreemptionProvider, QuotaProvider,
+)
 
 __all__ = [
     'FleetProvider', 'ReplicaProvider', 'SupervisorLeaseProvider',
     'SweepProvider', 'SweepDecisionProvider', 'UsageProvider',
+    'QuotaProvider', 'PreemptionProvider',
     'WorkerTokenProvider', 'DbAuditProvider', 'AlertProvider',
     'MetricProvider', 'TelemetrySpanProvider', 'PostmortemProvider',
     'DagPreflightProvider',

@@ -195,8 +195,13 @@ def gateway(host, port, refresh, hedge_ratio, flush_every):
 @click.option('--batch-size', type=int, default=64)
 @click.option('--quantize', default=None)
 @click.option('--max-pending', type=int, default=256)
+@click.option('--priority', default=None,
+              type=click.Choice(['critical', 'high', 'normal',
+                                 'preemptible']),
+              help='scheduling class for the replicas '
+                   '(default: serve-replica class default, high)')
 def fleet_create(name, model, project, replicas, slo_p99_ms, cores,
-                 batch_size, quantize, max_pending):
+                 batch_size, quantize, max_pending, priority):
     """Register a serving fleet: NAME replicas of export MODEL. The
     supervisor's reconciler brings them up on its next tick."""
     from mlcomp_tpu.db.migration import migrate
@@ -206,7 +211,8 @@ def fleet_create(name, model, project, replicas, slo_p99_ms, cores,
     fleet = create_fleet(session, name, model, project=project,
                          desired=replicas, slo_p99_ms=slo_p99_ms,
                          cores=cores, batch_size=batch_size,
-                         quantize=quantize, max_pending=max_pending)
+                         quantize=quantize, max_pending=max_pending,
+                         priority=priority)
     print(f'fleet {name} (id {fleet.id}): {replicas} replica(s) of '
           f'{model}, p99 SLO {slo_p99_ms}ms')
 

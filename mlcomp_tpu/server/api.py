@@ -734,6 +734,109 @@ def api_slos(data, s):
     return {'data': slo_status(s)}
 
 
+def api_quotas(data, s):
+    """Multi-tenant scheduling read (migration v15): the quota table
+    with live/windowed usage next to each ceiling, the class roster
+    (live tasks per effective scheduling class), and the newest
+    preemptions with victim lineage. Same no-auth introspection tier
+    as /api/usage; the dashboard's scheduling card and the
+    `mlcomp_tpu quotas` CLI read this."""
+    from mlcomp_tpu.db.providers.quota import (
+        PreemptionProvider, QuotaProvider,
+    )
+    from mlcomp_tpu.server.scheduler import (
+        PRIORITY_CLASSES, task_priority_of,
+    )
+    qp = QuotaProvider(s)
+    usage_cache = {}
+    quotas = []
+    for q in qp.all():
+        if q.resource == 'cores':
+            key = ('live', q.scope)
+            if key not in usage_cache:
+                usage_cache[key] = qp.live_cores(q.scope)
+            used = usage_cache[key].get(q.tenant, 0)
+        else:
+            window = float(q.window_s or 86400.0)
+            key = ('window', q.scope, window)
+            if key not in usage_cache:
+                usage_cache[key] = qp.window_core_seconds(q.scope,
+                                                          window)
+            used = usage_cache[key].get(q.tenant, 0.0)
+        quotas.append({
+            'scope': q.scope, 'tenant': q.tenant,
+            'resource': q.resource,
+            'limit': float(q.limit_value or 0.0),
+            'window_s': float(q.window_s or 86400.0),
+            'used': float(used)})
+    # class roster: live tasks per EFFECTIVE class (explicit column or
+    # class-based default) — retryable units only, like the victim scan
+    roster = {cls: {'pending': 0, 'running': 0}
+              for cls in PRIORITY_CLASSES}
+    for r in s.query(
+            'SELECT status, priority, executor, type, additional_info '
+            'FROM task WHERE status IN (?, ?, ?) AND parent IS NULL',
+            (int(TaskStatus.NotRan), int(TaskStatus.Queued),
+             int(TaskStatus.InProgress))):
+        cls = task_priority_of(dict(r))
+        bucket = 'pending' if r['status'] == int(TaskStatus.NotRan) \
+            else 'running'
+        roster[cls][bucket] += 1
+    limit = _int_arg(data, 'limit') if data.get('limit') else 20
+    names = {}
+    preemptions = []
+    for p in PreemptionProvider(s).recent(limit=limit):
+        for tid in (p.task, p.initiator):
+            if tid is not None and tid not in names:
+                row = s.query_one('SELECT name FROM task WHERE id=?',
+                                  (tid,))
+                names[tid] = row['name'] if row else None
+        preemptions.append({
+            'task': p.task, 'task_name': names.get(p.task),
+            'attempt': p.attempt, 'victim_class': p.victim_class,
+            'gang_id': p.gang_id, 'initiator': p.initiator,
+            'initiator_name': names.get(p.initiator),
+            'initiator_class': p.initiator_class,
+            'reason': p.reason, 'computer': p.computer,
+            'cores_freed': p.cores_freed,
+            'applied': bool(p.applied), 'time': str(p.time or '')})
+    return {'data': {'quotas': quotas, 'classes': roster,
+                     'preemptions': preemptions}}
+
+
+def api_quota_set(data, s):
+    """Upsert one (scope, tenant, resource) ceiling. Token-gated —
+    quota writes change what the scheduler admits."""
+    from mlcomp_tpu.db.providers.quota import QuotaProvider
+    for field in ('scope', 'tenant', 'resource'):
+        if not data.get(field):
+            raise ApiError(f'{field} required')
+    if data.get('limit') is None:
+        raise ApiError('limit required')
+    try:
+        limit = float(data['limit'])
+        window = float(data['window_s']) \
+            if data.get('window_s') is not None else None
+        q = QuotaProvider(s).set_quota(
+            data['scope'], data['tenant'], data['resource'],
+            limit, window_s=window)
+    except ValueError as e:
+        raise ApiError(str(e))
+    return {'success': True, 'quota': q.id}
+
+
+def api_quota_delete(data, s):
+    from mlcomp_tpu.db.providers.quota import QuotaProvider
+    for field in ('scope', 'tenant', 'resource'):
+        if not data.get(field):
+            raise ApiError(f'{field} required')
+    removed = QuotaProvider(s).delete(
+        data['scope'], data['tenant'], data['resource'])
+    if not removed:
+        raise ApiError('quota not found', status=404)
+    return {'success': True}
+
+
 def _fleet_or_404(data, s):
     from mlcomp_tpu.db.providers import FleetProvider
     fleet = None
@@ -754,7 +857,7 @@ def api_fleet_create(data, s):
         raise ApiError('name and model required')
     kwargs = {}
     for key in ('project', 'desired', 'slo_p99_ms', 'cores',
-                'batch_size', 'quantize', 'max_pending'):
+                'batch_size', 'quantize', 'max_pending', 'priority'):
         if data.get(key) is not None:
             kwargs[key] = data[key]
     try:
@@ -1181,6 +1284,11 @@ _ROUTES = {
     # aggregates + objective verdicts, no secrets — introspection tier
     '/api/usage': (api_usage, False),
     '/api/slos': (api_slos, False),
+    # multi-tenant scheduling (migration v15): the roster read is
+    # introspection; quota writes change what the scheduler admits
+    '/api/quotas': (api_quotas, False),
+    '/api/quota/set': (api_quota_set, True),
+    '/api/quota/delete': (api_quota_delete, True),
     '/api/fleet/create': (api_fleet_create, True),
     '/api/fleet/scale': (api_fleet_scale, True),
     '/api/fleet/swap': (api_fleet_swap, True),
@@ -1218,6 +1326,7 @@ _READ_ONLY_ROUTES = frozenset({
     '/api/dags', '/api/code', '/api/tasks', '/api/task/info',
     '/api/task/steps', '/api/dag/preflight', '/api/auxiliary',
     '/api/fleets', '/api/sweeps', '/api/usage', '/api/slos',
+    '/api/quotas',
     '/api/logs', '/api/reports',
     '/api/report', '/api/report/update_layout_start',
     '/api/telemetry/series', '/api/telemetry/spans',

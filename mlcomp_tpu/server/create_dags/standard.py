@@ -16,6 +16,7 @@ from mlcomp_tpu.db.providers import (
     DagProvider, ProjectProvider, ReportLayoutProvider, ReportProvider,
     ReportTasksProvider, TaskProvider
 )
+from mlcomp_tpu.server.scheduler import normalize_priority
 from mlcomp_tpu.utils.io import yaml_dump
 from mlcomp_tpu.utils.misc import now
 from mlcomp_tpu.worker.executors import Executor
@@ -119,6 +120,11 @@ class DagStandardBuilder:
             # task inherits it below so the supervisor's fold never
             # joins back to the dag row
             owner=str(self.info.get('owner') or 'default'),
+            # scheduling class (migration v15): info.priority from the
+            # config or --priority on submit, validated here so a typo
+            # rejects the submission instead of silently reading as
+            # the class default at dispatch
+            priority=normalize_priority(self.info.get('priority')),
         )
         self.dag_provider.add(dag)
         self.dag = dag
@@ -293,6 +299,11 @@ class DagStandardBuilder:
             last_activity=now(),
             owner=str(self.info.get('owner') or 'default'),
             project=self.project.name,
+            # per-executor spec overrides the dag-wide class; NULL
+            # falls through to the class-based default at dispatch
+            priority=normalize_priority(
+                spec.get('priority'),
+                default=normalize_priority(self.info.get('priority'))),
         )
         self.task_provider.add(task)
 

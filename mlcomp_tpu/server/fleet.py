@@ -103,10 +103,14 @@ def http_probe(url: str, timeout_s: float = 2.0) -> bool:
 def create_fleet(session, name: str, model: str, project: str = None,
                  desired: int = 2, slo_p99_ms: float = 250.0,
                  cores: int = 1, batch_size: int = 64,
-                 quantize: str = None, max_pending: int = 256):
+                 quantize: str = None, max_pending: int = 256,
+                 priority: str = None):
     """Register a fleet (idempotent on name). The reconciler brings the
-    replicas up on the next supervisor tick."""
+    replicas up on the next supervisor tick. ``priority`` is the v15
+    scheduling class its replicas dispatch under (validated; NULL
+    reads as the serve-replica default, ``high``)."""
     from mlcomp_tpu.db.models import ServeFleet
+    from mlcomp_tpu.server.scheduler import normalize_priority
     provider = FleetProvider(session)
     fleet = provider.by_name(name)
     if fleet is not None:
@@ -115,7 +119,9 @@ def create_fleet(session, name: str, model: str, project: str = None,
         name=name, project=project, model=model, desired=int(desired),
         generation=1, status='active', slo_p99_ms=float(slo_p99_ms),
         cores=int(cores), batch_size=int(batch_size), quantize=quantize,
-        max_pending=int(max_pending), created=now(), updated=now())
+        max_pending=int(max_pending),
+        priority=normalize_priority(priority),
+        created=now(), updated=now())
     provider.add(fleet)
     return fleet
 
@@ -409,6 +415,10 @@ class FleetReconciler:
             dag=self._ensure_dag(fleet),
             type=int(TaskType.Service), single_node=1,
             additional_info=yaml_dump(info),
+            # replicas dispatch under the fleet's scheduling class;
+            # NULL keeps the serve-replica default ('high')
+            priority=fleet.priority,
+            project=fleet.project,
             last_activity=now())
         self.tasks.add(task)
         replica.task = task.id

@@ -788,6 +788,61 @@ async function viewSupervisor(el) {
               ?(t.hbm_peak_bytes/1073741824).toFixed(2)+' GiB':''}</td>
         </tr>`).join('') + '</table></div>'));
   }
+  // scheduling card (migration v15): class roster + fair-share quota
+  // bars + the newest checkpoint-preemptions with victim lineage
+  let sched = {data: {quotas: [], classes: {}, preemptions: []}};
+  try { sched = await api('quotas', {}); } catch (e) {}
+  if (sched && sched.success === false)
+    sched = {data: {quotas: [], classes: {}, preemptions: []}};
+  const sd = sched.data || {};
+  el.appendChild(h('<h3>scheduling (priority / quota / preemption)'
+    + '</h3>'));
+  let schedHtml = '<div class="cards"><div class="card"><h3>classes'
+    + '</h3><table><tr><th>class</th><th>pending</th><th>running</th>'
+    + '</tr>'
+    + Object.entries(sd.classes || {}).map(([cls, n]) =>
+      `<tr><td>${esc(cls)}</td><td>${n.pending}</td>
+       <td>${n.running}</td></tr>`).join('')
+    + '</table></div>';
+  schedHtml += '<div class="card"><h3>quotas '
+    + '<button class="btn" onclick="quotaSetDialog()">set</button>'
+    + '</h3><table>'
+    + '<tr><th>tenant</th><th>usage</th><th></th><th></th></tr>'
+    + (sd.quotas || []).map(q => {
+        const frac = q.limit > 0
+          ? Math.min(1, q.used / q.limit) : (q.used > 0 ? 1 : 0);
+        const color = frac >= 1 ? 'var(--bad,#e66)'
+          : frac >= 0.8 ? 'var(--warn,#ea3)' : 'var(--ok,#4a4)';
+        return `<tr>
+          <td>${esc(q.scope)}:${esc(q.tenant)}:${esc(q.resource)}</td>
+          <td>${q.used.toFixed(0)}/${q.limit.toFixed(0)}</td>
+          <td><div style="width:120px;background:#0003;
+              border-radius:3px"><div style="width:${
+                (frac*100).toFixed(0)}%;background:${color};
+              height:8px;border-radius:3px"></div></div></td>
+          <td><button class="btn" onclick="quotaDelete(
+            '${esc(q.scope)}','${esc(q.tenant)}','${esc(q.resource)}'
+            )">remove</button></td>
+          </tr>`;
+      }).join('') + '</table></div>';
+  if ((sd.preemptions || []).length) {
+    schedHtml += '<div class="card"><h3>recent preemptions</h3>'
+      + '<table><tr><th>victim</th><th>class</th><th>by</th>'
+      + '<th>reason</th><th>applied</th></tr>'
+      + sd.preemptions.map(p => `<tr>
+          <td>${p.task} ${esc(p.task_name||'')}
+            ${p.gang_id ? '<span class="dim">gang '
+              + esc(p.gang_id) + '</span>' : ''}</td>
+          <td>${esc(p.victim_class||'')}</td>
+          <td>${p.initiator==null?'':p.initiator + ' '
+            + esc(p.initiator_name||'') + ' ('
+            + esc(p.initiator_class||'') + ')'}</td>
+          <td>${esc(p.reason||'')}</td>
+          <td>${p.applied ? 'yes'
+            : '<span style="color:var(--warn,#ea3)">pending</span>'}
+          </td></tr>`).join('') + '</table></div>';
+  }
+  el.appendChild(h(schedHtml + '</div>'));
   const np = sup.not_placed || {};
   if (Object.keys(np).length)
     el.appendChild(h('<h3>not placed (reasons)</h3><table>'
@@ -805,6 +860,36 @@ async function viewSupervisor(el) {
       <td>${esc(a.op)}</td>
       <td><pre style="margin:0;max-height:80px">${esc(a.sql)}</pre></td>
       </tr>`).join('') + '</table>'));
+}
+
+function quotaSetDialog() {
+  // create/update a fair-share ceiling (scope owner|project,
+  // resource cores|core_seconds; window only meters core_seconds)
+  dialog('set quota', `
+    <div class="formrow"><label>scope</label>
+      <select id="qscope"><option>owner</option>
+        <option>project</option></select></div>
+    <div class="formrow"><label>tenant</label>
+      <input id="qtenant" placeholder="default"></div>
+    <div class="formrow"><label>resource</label>
+      <select id="qres"><option>cores</option>
+        <option>core_seconds</option></select></div>
+    <div class="formrow"><label>limit</label>
+      <input id="qlimit" placeholder="e.g. 16"></div>
+    <div class="formrow"><label>window s</label>
+      <input id="qwin" placeholder="3600 (core_seconds only)"></div>`,
+    async d => {
+      const body = {scope: fval(d,'qscope'), tenant: fval(d,'qtenant'),
+                    resource: fval(d,'qres'),
+                    limit: parseFloat(fval(d,'qlimit'))};
+      const win = fval(d,'qwin');
+      if (win) body.window_s = parseFloat(win);
+      await api('quota/set', body);
+    });
+}
+async function quotaDelete(scope, tenant, resource) {
+  if (!confirm(`remove quota ${scope}:${tenant}:${resource}?`)) return;
+  await api('quota/delete', {scope, tenant, resource}); render();
 }
 
 async function toggleReportDialog(kind, id) {

@@ -1,0 +1,294 @@
+"""Multi-tenant scheduling policy — pure functions, no DB, no jax.
+
+This is the POLICY half of ISSUE 20 / ROADMAP item 3; the supervisor
+(server/supervisor.py) is the mechanism half that feeds it snapshots
+and applies its verdicts. Keeping the policy pure keeps it testable at
+function granularity and keeps the tick hot path free of surprises —
+every function here is O(tasks) arithmetic over plain dicts.
+
+Four pieces:
+
+- **priority classes** — ``critical > high > normal > preemptible``,
+  stamped on dags/tasks/fleets (migration v15). A row with NULL
+  priority reads its class-based default: sweep cells are
+  ``preemptible`` (they checkpoint at every rung boundary, so eviction
+  costs one rung at most), serve replicas are ``high`` (latency SLOs
+  outrank batch), everything else ``normal``.
+- **aging / anti-starvation** — waiting escalates effective priority
+  one class per :data:`AGING_STEP_S`, so a ``preemptible`` task's max
+  wait is bounded at ``3 * AGING_STEP_S`` before it sorts with
+  ``critical`` work. Asserted against the ``queue.max_wait_s.*``
+  starvation gauges.
+- **fair-share** — among equals, the tenant who consumed the least of
+  its quota window goes first (usage from the v14 ledger, ceiling
+  from the quota table; quota-less tenants compare by raw usage).
+- **victim selection** — when a higher class cannot fit, evict
+  strictly-lower-class work, cheapest first (class, then
+  cores x runtime cost, then youngest), greedily until the blocked
+  ask fits. Multi-host gangs get a defragmentation flavor of the same
+  pass: hosts are ranked by reclaimable capacity so the gang's grain
+  lands on the fewest hosts.
+"""
+
+#: scheduling classes, strongest first
+PRIORITY_CLASSES = ('critical', 'high', 'normal', 'preemptible')
+
+#: rank: higher = scheduled earlier, preempts lower
+PRIORITY_RANK = {'critical': 3, 'high': 2, 'normal': 1,
+                 'preemptible': 0}
+
+#: class-based defaults for rows whose priority column is NULL —
+#: keyed by the usage ledger's task_class_of() buckets
+DEFAULT_PRIORITY_BY_CLASS = {
+    'sweep': 'preemptible',
+    'serve-replica': 'high',
+    'service': 'normal',
+    'train': 'normal',
+}
+
+#: seconds of queue wait that escalate effective priority one class.
+#: Bounds starvation: rank distance from preemptible to critical is 3,
+#: so max wait before a task sorts with critical work is 3 * this.
+AGING_STEP_S = 300.0
+
+#: evictions one tick may apply — preemption happens in small steps so
+#: a burst of high-priority asks cannot flash-evict a whole pool
+#: before any of it re-places
+MAX_PREEMPTIONS_PER_TICK = 8
+
+
+def normalize_priority(value, default: str = None):
+    """Validated class name or ``default`` (None passes through for
+    "no explicit class, use the class-based default")."""
+    if value is None or value == '':
+        return default
+    name = str(value).strip().lower()
+    if name not in PRIORITY_RANK:
+        raise ValueError(
+            f'unknown priority class {value!r} — expected one of '
+            f'{", ".join(PRIORITY_CLASSES)}')
+    return name
+
+
+def task_priority_of(task) -> str:
+    """Effective class of a task row: the explicit v15 column when
+    set, else the class-based default. Works on Task models and raw
+    dict rows (export collectors scan dicts)."""
+    from mlcomp_tpu.db.providers.usage import task_class_of
+    get = task.get if isinstance(task, dict) else \
+        lambda k, d=None: getattr(task, k, d)
+    explicit = get('priority')
+    if explicit in PRIORITY_RANK:
+        return explicit
+    return DEFAULT_PRIORITY_BY_CLASS.get(task_class_of(task), 'normal')
+
+
+def effective_rank(priority: str, wait_s: float,
+                   aging_step_s: float = AGING_STEP_S) -> int:
+    """Class rank plus the aging boost, capped at critical."""
+    base = PRIORITY_RANK.get(priority, PRIORITY_RANK['normal'])
+    if wait_s and wait_s > 0 and aging_step_s > 0:
+        base += int(wait_s // aging_step_s)
+    return min(base, PRIORITY_RANK['critical'])
+
+
+def dispatch_order_key(task, now_dt, usage_share=None,
+                       aging_step_s: float = AGING_STEP_S):
+    """Sort key for the per-tick dispatch list: strongest effective
+    class first, then least fair-share consumption, then age (oldest
+    row first). ``usage_share`` is the tenant's consumed fraction of
+    its quota window (see :func:`fair_share_of`); None sorts as 0."""
+    waited = wait_seconds(task, now_dt)
+    rank = effective_rank(task_priority_of(task), waited, aging_step_s)
+    share = 0.0 if usage_share is None else float(usage_share)
+    return (-rank, share, int(task.id))
+
+
+def wait_seconds(task, now_dt) -> float:
+    """How long a pending task has been waiting for placement —
+    last_activity is stamped at creation and at every requeue, so it
+    is the row's entry into the current scheduling wait."""
+    anchor = getattr(task, 'last_activity', None)
+    if anchor is None:
+        return 0.0
+    return max(0.0, (now_dt - anchor).total_seconds())
+
+
+def tenant_share(owner: str, limits: dict, windowed: dict) -> float:
+    """An owner's fair-share sort weight from the supervisor's tick
+    snapshot: consumed fraction of the core-seconds window when a
+    ceiling exists, raw (scaled) usage otherwise."""
+    key = ('owner', owner or 'default')
+    entry = limits.get((key[0], key[1], 'core_seconds'))
+    limit = float(entry[0]) if entry else None
+    return fair_share_of(windowed.get(key, 0.0), limit)
+
+
+def fair_share_of(tenant_usage: float, limit) -> float:
+    """The fair-share sort weight: fraction of the quota window
+    consumed when a ceiling exists, else raw usage scaled down so
+    quota-less tenants still order among themselves but never
+    outrank a tenant measured against a real ceiling."""
+    used = float(tenant_usage or 0.0)
+    if limit is not None and limit > 0:
+        return used / float(limit)
+    return used / 1e9
+
+
+# ------------------------------------------------------------ admission
+def quota_block(priority: str, cores_wanted: int, owner: str,
+                project: str, limits: dict, live: dict,
+                windowed: dict):
+    """Why quota admission refuses this placement, or None to admit.
+
+    ``limits`` maps ``(scope, tenant, resource) -> (limit, window_s)``
+    (the quota table snapshot); ``live`` maps ``(scope, tenant) ->
+    cores`` currently held; ``windowed`` maps ``(scope, tenant) ->
+    core_seconds`` settled in the ledger window. Absent limit =
+    unlimited; an explicit 0 locks the tenant out. ``critical`` work
+    is exempt — quota shapes batch fairness, it must never be the
+    reason pager-class work waits.
+    """
+    if priority == 'critical':
+        return None
+    for scope, tenant in (('owner', owner or 'default'),
+                          ('project', project or 'default')):
+        entry = limits.get((scope, tenant, 'cores'))
+        if entry is not None:
+            limit = float(entry[0] or 0.0)
+            held = float(live.get((scope, tenant), 0))
+            if held + cores_wanted > limit:
+                return (f'quota: {scope} {tenant} holds '
+                        f'{held:g}/{limit:g} cores, '
+                        f'+{cores_wanted} would exceed')
+        entry = limits.get((scope, tenant, 'core_seconds'))
+        if entry is not None:
+            limit = float(entry[0] or 0.0)
+            used = float(windowed.get((scope, tenant), 0.0))
+            if used >= limit:
+                return (f'quota: {scope} {tenant} used '
+                        f'{used:g}/{limit:g} core-seconds in window')
+    return None
+
+
+# ------------------------------------------------------- victim choice
+def victim_cost(victim: dict) -> float:
+    """What evicting this victim throws away: held cores x seconds of
+    progress since the attempt started. Checkpointed work (sweep
+    cells, gang trainers) restarts from its last checkpoint, but the
+    cost still orders candidates sensibly — prefer the victim with
+    the least sunk compute."""
+    return float(victim.get('cores') or 0) * \
+        max(0.0, float(victim.get('run_s') or 0.0))
+
+
+def victim_order(victims):
+    """Cheapest-first eviction order: weakest class, then least sunk
+    cost, then youngest row."""
+    return sorted(victims, key=lambda v: (
+        PRIORITY_RANK.get(v.get('priority'), 1),
+        victim_cost(v),
+        -int(v.get('task_id') or 0)))
+
+
+def eligible_victims(victims, blocked_rank: int):
+    """Only strictly-lower CLASS rank may be evicted — the aging boost
+    deliberately does not count here: an aged preemptible task earns
+    earlier DISPATCH, not the power to evict running work."""
+    return [v for v in victims
+            if PRIORITY_RANK.get(v.get('priority'), 1) < blocked_rank]
+
+
+def plan_single_node(need: int, free: int, victims,
+                     blocked_rank: int):
+    """Victims to evict on ONE computer so a single-node ask fits:
+    cheapest-first until ``free + freed >= need``; [] when already
+    fitting, None when even evicting everything eligible cannot fit."""
+    if free >= need:
+        return []
+    chosen, freed = [], 0
+    for v in victim_order(eligible_victims(victims, blocked_rank)):
+        chosen.append(v)
+        freed += int(v.get('cores') or 0)
+        if free + freed >= need:
+            return chosen
+    return None
+
+
+def plan_gang(need: int, grain: int, hosts, blocked_rank: int):
+    """Defragmentation pass for a blocked multi-host gang: pick hosts
+    by total reclaimable capacity (free + evictable), descending —
+    consolidating the gang's ``grain``-sized slices onto the FEWEST
+    hosts — then evict per host only what that host's slice needs.
+
+    ``hosts`` is ``[{name, free, victims}]``; returns ``(plan, used)``
+    where plan maps host name -> victims to evict there (possibly
+    empty for hosts already holding a free slice), or (None, []) when
+    the pool cannot fit the gang even after full eviction.
+    """
+    if grain <= 0:
+        grain = need
+    ranked = []
+    for h in hosts:
+        evictable = eligible_victims(h.get('victims') or [],
+                                     blocked_rank)
+        reclaimable = int(h.get('free') or 0) + \
+            sum(int(v.get('cores') or 0) for v in evictable)
+        slices = min(reclaimable, grain)
+        if slices > 0:
+            ranked.append((reclaimable, h, evictable))
+    ranked.sort(key=lambda t: (-t[0], t[1].get('name') or ''))
+    plan, used, remaining = {}, [], need
+    for reclaimable, h, evictable in ranked:
+        if remaining <= 0:
+            break
+        take = min(grain, remaining, reclaimable)
+        if take <= 0:
+            continue
+        shortfall = take - int(h.get('free') or 0)
+        evictions = []
+        if shortfall > 0:
+            freed = 0
+            for v in victim_order(evictable):
+                evictions.append(v)
+                freed += int(v.get('cores') or 0)
+                if freed >= shortfall:
+                    break
+            if freed < shortfall:
+                continue    # host cannot cover its slice; skip it
+        plan[h.get('name')] = evictions
+        used.append((h.get('name'), take))
+        remaining -= take
+    if remaining > 0:
+        return None, []
+    return plan, used
+
+
+# ---------------------------------------------------------- bin packing
+def pack_candidates(fits, want: int, multi_host: bool,
+                    spread: bool = False):
+    """Bin-packing order for placement candidates (each a tuple of
+    ``(computer_model, free_core_count)``): single-node asks best-fit
+    into the TIGHTEST computer that still satisfies the FULL elastic
+    ask (``want`` = cores_max), leaving the big contiguous blocks for
+    multi-host gangs; hosts too small for the full ask sort last,
+    largest partial grant first, so elasticity is only traded when no
+    host fits. Gangs keep the historical most-free-first order (their
+    fan-out wants the largest slices), and ``spread`` forces it for
+    single-node work whose replicas want failure-domain anti-affinity
+    (serve replicas): best-fit would stack a fleet onto one host."""
+    if multi_host or spread:
+        return sorted(fits, key=lambda cf: -cf[1])
+    return sorted(fits, key=lambda cf: (
+        cf[1] < want, cf[1] if cf[1] >= want else -cf[1]))
+
+
+__all__ = [
+    'PRIORITY_CLASSES', 'PRIORITY_RANK', 'DEFAULT_PRIORITY_BY_CLASS',
+    'AGING_STEP_S', 'MAX_PREEMPTIONS_PER_TICK', 'normalize_priority',
+    'task_priority_of', 'effective_rank', 'dispatch_order_key',
+    'wait_seconds', 'fair_share_of', 'tenant_share', 'quota_block',
+    'victim_cost',
+    'victim_order', 'eligible_victims', 'plan_single_node',
+    'plan_gang', 'pack_candidates',
+]

@@ -124,6 +124,23 @@ class SupervisorBuilder:
         self.usage_provider = UsageProvider(self.session)
         from mlcomp_tpu.telemetry import SloEngine
         self.slo_engine = SloEngine(self.session, logger=logger)
+        # multi-tenant scheduling plane (migration v15, policy in
+        # server/scheduler.py): fair-share quotas enforced at
+        # admission, priority-ordered dispatch, and the checkpoint-
+        # preemption engine with its exactly-once audit trail — all
+        # riding the fenced session so a zombie ex-leader can neither
+        # double-preempt nor mint phantom quota denials
+        from mlcomp_tpu.db.providers.quota import (
+            PreemptionProvider, QuotaProvider,
+        )
+        self.quota_provider = QuotaProvider(self.session)
+        self.preemption_provider = PreemptionProvider(self.session)
+        # per-tick scheduling snapshot: (quota limits, live cores,
+        # windowed core-seconds); None = not computed this tick
+        self._sched_snapshot = None
+        # tasks this tick's placement could not fit for CAPACITY
+        # reasons — the preemption engine's worklist
+        self._capacity_blocked = []
         # per-tick cache for the sweep cells' preemption-aware
         # placement: computer -> transient-failure count (recovery
         # taxonomy history); None = not computed this tick
@@ -170,6 +187,11 @@ class SupervisorBuilder:
         # retry-prone history is tick-scoped like the pending index:
         # recomputed lazily on the first sweep-cell placement
         self._retry_prone = None
+        # scheduling snapshot + capacity-blocked worklist are tick-
+        # scoped too: quotas admitted against a stale snapshot would
+        # leak across the ceiling as dispatches accumulate
+        self._sched_snapshot = None
+        self._capacity_blocked = []
 
     # -------------------------------------------------------- parent tasks
     def process_parent_tasks(self):
@@ -273,13 +295,80 @@ class SupervisorBuilder:
     # -------------------------------------------------------------- loading
     def load_tasks(self):
         """NotRan tasks + dependency status sets
-        (reference supervisor.py:54-73)."""
+        (reference supervisor.py:54-73), ordered for multi-tenant
+        dispatch (server/scheduler.py): strongest effective class
+        first — aging escalates a waiting task one class per
+        AGING_STEP_S, the anti-starvation bound the queue.max_wait_s
+        gauges assert — then least fair-share consumption (the tenant
+        who used the least of its ledger window goes first among
+        equals), then row age."""
+        from mlcomp_tpu.server.scheduler import (
+            dispatch_order_key, tenant_share,
+        )
         self.tasks = [
             t for t in self.provider.by_status(TaskStatus.NotRan)
             if not t.debug]
+        limits, _live, windowed = self._scheduling_snapshot()
+        now_dt = now()
+        self.tasks.sort(key=lambda t: dispatch_order_key(
+            t, now_dt,
+            usage_share=tenant_share(t.owner, limits, windowed)))
         self.dep_status = self.provider.dependency_status(
             [t.id for t in self.tasks])
         self.aux['tasks_to_process'] = [t.id for t in self.tasks]
+
+    def _scheduling_snapshot(self):
+        """(limits, live, windowed) — the quota table plus live-core
+        and ledger-window usage, read ONCE per tick. ``limits`` maps
+        (scope, tenant, resource) -> (limit, window_s); ``live`` and
+        ``windowed`` map (scope, tenant) -> cores / core-seconds.
+        Dispatches made later in the SAME tick bill into ``live``
+        in-place (_bill_live) so a burst cannot leak past the ceiling
+        between snapshot and admission. Degrades to empty (= every
+        tenant unlimited) on any read failure — quota must never be a
+        new single point of failure for scheduling."""
+        if self._sched_snapshot is not None:
+            return self._sched_snapshot
+        limits, live, windowed = {}, {}, {}
+        try:
+            quotas = self.quota_provider.all()
+            for q in quotas:
+                limits[(q.scope, q.tenant, q.resource)] = (
+                    float(q.limit_value or 0.0),
+                    float(q.window_s or 86400.0))
+            if limits:
+                scopes = {q.scope for q in quotas}
+                for scope in scopes:
+                    for tenant, cores in \
+                            self.quota_provider.live_cores(scope).items():
+                        live[(scope, tenant)] = cores
+                    window = max(
+                        [w for (s, _t, r), (_l, w) in limits.items()
+                         if s == scope and r == 'core_seconds'],
+                        default=86400.0)
+                    for tenant, cs in self.quota_provider \
+                            .window_core_seconds(scope, window).items():
+                        windowed[(scope, tenant)] = cs
+        except Exception:
+            limits, live, windowed = {}, {}, {}
+            if self.logger:
+                self.logger.error(
+                    f'quota snapshot failed (admitting unlimited):\n'
+                    f'{traceback.format_exc()}',
+                    ComponentType.Supervisor)
+        self._sched_snapshot = (limits, live, windowed)
+        return self._sched_snapshot
+
+    def _bill_live(self, task: Task, cores_n: int):
+        """Count a dispatch against the live-core side of the quota
+        snapshot so later admissions in the same tick see it."""
+        if self._sched_snapshot is None or not cores_n:
+            return
+        _limits, live, _windowed = self._sched_snapshot
+        for scope, tenant in (('owner', task.owner or 'default'),
+                              ('project', task.project or 'default')):
+            live[(scope, tenant)] = \
+                live.get((scope, tenant), 0) + int(cores_n)
 
     def load_computers(self):
         """Free-resource model per computer
@@ -374,9 +463,21 @@ class SupervisorBuilder:
                     if c['name'] in exclude:
                         reasons[c['name']] = 'excluded after failure'
                 fits = kept
-        # most-free-cores first (single-node packing,
-        # reference supervisor.py:200-226)
-        fits.sort(key=lambda c: -len(self._free_cores(c)))
+        # bin-packing order (server/scheduler.py): single-node asks
+        # best-fit into the TIGHTEST computer that still fits, keeping
+        # the big contiguous blocks free for multi-host gangs (the
+        # defragmentation half of ROADMAP item 3); gangs keep the
+        # historical most-free-first order (reference
+        # supervisor.py:200-226) — their fan-out wants the largest
+        # slices
+        from mlcomp_tpu.server.scheduler import pack_candidates
+        multi_host = (task.cores_max or 0) > 1 \
+            and not task.single_node
+        want = task.cores_max or task.cores or 0
+        fits = [c for c, _free in pack_candidates(
+            [(c, len(self._free_cores(c))) for c in fits],
+            int(want), multi_host,
+            spread=bool((info or {}).get('serve')))]
         # preemption-aware placement for SWEEP cells (server/sweep.py,
         # ROADMAP item 5's second half): a pruned/retried cell is
         # cheap, disposable work — steer it off hosts whose recovery
@@ -644,16 +745,58 @@ class SupervisorBuilder:
             single_node=task.single_node,
             gang_id=gang.get('id'),
             gang_generation=gang.get('generation') or 0,
+            owner=task.owner, project=task.project,
+            priority=task.priority,
         )
         self.provider.add(service)
         return service
 
     def process_task(self, task: Task):
         """Placement + dispatch for one runnable task
-        (reference supervisor.py:228-317)."""
+        (reference supervisor.py:228-317), behind quota admission
+        (server/scheduler.py): a tenant at its cores ceiling — or past
+        its core-seconds window — is refused placement this tick
+        instead of silently crowding everyone else out. critical-class
+        work is exempt by policy."""
+        from mlcomp_tpu.server.scheduler import (
+            quota_block, task_priority_of,
+        )
+        limits, live, windowed = self._scheduling_snapshot()
+        if limits:
+            need_cores = int(task.cores or task.cores_max or 0)
+            block = quota_block(
+                task_priority_of(task), need_cores, task.owner,
+                task.project, limits, live, windowed)
+            if block:
+                self.aux.setdefault('not_placed', {})[task.id] = {
+                    'quota': block}
+                self.telemetry.count('scheduler.quota_denied')
+                return
         fits, reasons = self._candidate_computers(task)
         if not fits:
             self.aux.setdefault('not_placed', {})[task.id] = reasons
+            # a COMPLETELY full pool rejects every computer with the
+            # capacity verdict and fits comes back empty — still a
+            # preemption candidate (the commonest contention shape),
+            # not just the partial-fit path below
+            if any(str(r).startswith('no free cores')
+                   for r in reasons.values()):
+                info = yaml_load(task.additional_info) \
+                    if task.additional_info else {}
+                multi = (task.cores_max or 0) > 1 \
+                    and not task.single_node \
+                    and bool((info or {}).get(
+                        'distr', task.cores_max > 1))
+                from mlcomp_tpu.parallel.meshspec import (
+                    host_grant_granularity,
+                )
+                mesh = (info or {}).get('mesh') \
+                    if isinstance((info or {}).get('mesh'), dict) \
+                    else None
+                self._capacity_blocked.append(
+                    {'task': task, 'need': int(task.cores or 0),
+                     'grain': int(host_grant_granularity(mesh))
+                     if multi else 0, 'multi': multi})
             return
         info = yaml_load(task.additional_info) \
             if task.additional_info else {}
@@ -701,8 +844,14 @@ class SupervisorBuilder:
                                   + (f' (mesh {mesh_spec})'
                                      if mesh_spec else '')
                                   + f', free {len(free)}'}
+                # capacity shortfall — a preemption candidate: the
+                # engine may evict lower-class work for it this tick
+                self._capacity_blocked.append(
+                    {'task': task, 'need': int(need), 'grain': 0,
+                     'multi': False})
                 return
             queue = self.dispatch(task, comp, cores)
+            self._bill_live(task, len(cores))
             self.aux.setdefault('dispatched', []).append(
                 {'task': task.id, 'queue': queue, 'cores': cores})
             return
@@ -768,6 +917,12 @@ class SupervisorBuilder:
                                   f'(mesh {mesh_spec})'
                                   if mesh_spec and grain > 1 else '')
                                + f', found {total_cores}'}
+            # gang capacity shortfall — the preemption engine's
+            # defragmentation pass consolidates grain-sized slices
+            # onto the fewest hosts by evicting lower-class work
+            self._capacity_blocked.append(
+                {'task': task, 'need': int(need), 'grain': int(grain),
+                 'multi': True})
             return
         master_comp = placements[0][0]
         port = self.find_port(master_comp)
@@ -804,6 +959,7 @@ class SupervisorBuilder:
                 {'task': service.id, 'parent': task.id, 'queue': queue,
                  'cores': cores, 'rank': rank, 'gang': gang_id,
                  'generation': generation})
+        self._bill_live(task, total_cores)
         self.provider.change_status(task, TaskStatus.Queued)
 
     # ------------------------------------------------------------- recovery
@@ -1253,6 +1409,253 @@ class SupervisorBuilder:
                         f'{traceback.format_exc()}',
                         ComponentType.Supervisor)
 
+    # ---------------------------------------------------------- preemption
+    def process_preemptions(self):
+        """Checkpoint-preemption (server/scheduler.py, ROADMAP item
+        3): when a higher-class placement could not fit this tick,
+        evict strictly-lower-class work to make room — decision row
+        FIRST (exactly-once per victim attempt, epoch-fenced), kill
+        second, so a leader SIGKILLed between the two leaves a
+        recorded-but-unapplied row the standby's repair pass finishes
+        instead of a lost victim or a double eviction. Victims fail
+        with the transient ``preempted`` reason, so the normal
+        recovery path requeues them exactly once with backoff and
+        resume-from-checkpoint; their cores re-place next tick, where
+        the blocked task sorts first by class. Crashes here never take
+        the scheduling tick down."""
+        t0 = time.monotonic()
+        try:
+            self._repair_preemptions()
+            self._preempt_for_blocked()
+        except FenceLostError:
+            raise       # zombie leader: stop the tick, demote
+        except Exception:
+            if self.logger:
+                self.logger.error(
+                    f'preemption pass failed:\n'
+                    f'{traceback.format_exc()}',
+                    ComponentType.Supervisor)
+        self.telemetry.gauge(
+            'supervisor.preempt_ms',
+            round((time.monotonic() - t0) * 1e3, 3))
+
+    def _repair_preemptions(self):
+        """Finish decisions a dead leader recorded but never applied.
+        A decision whose victim is gone, already terminal, or on a
+        NEWER attempt is closed without action — the victim moved on,
+        and re-killing it would be the double-preemption this audit
+        trail exists to prevent."""
+        live = {int(TaskStatus.NotRan), int(TaskStatus.Queued),
+                int(TaskStatus.InProgress)}
+        for dec in self.preemption_provider.unapplied():
+            row = self.session.query_one(
+                'SELECT * FROM task WHERE id=?', (dec.task,))
+            victim = Task.from_row(row) if row else None
+            if victim is None or int(victim.status) not in live \
+                    or int(victim.attempt or 0) != int(dec.attempt or 0):
+                self.preemption_provider.mark_applied(
+                    dec.task, dec.attempt or 0)
+                continue
+            self._apply_preemption(victim, dec.reason or 'capacity',
+                                   repair=True)
+
+    def _victim_candidates(self) -> dict:
+        """``{computer: [victim dicts]}`` over the busy task rows. The
+        unit of eviction is the RETRYABLE row — a gang rank's parent
+        (service children are never retried directly), a standalone
+        task otherwise — but the cores counted are the LOCAL slice, so
+        a gang parent appearing on several hosts frees each host's
+        slice with one preemption."""
+        from mlcomp_tpu.server.scheduler import task_priority_of
+        now_dt = now()
+        parents = {}
+        out = {}
+        for t in self.provider.by_status(
+                TaskStatus.Queued, TaskStatus.InProgress):
+            if not t.computer_assigned or not t.cores_assigned:
+                continue
+            try:
+                local = len(json.loads(t.cores_assigned))
+            except (TypeError, ValueError):
+                local = int(t.cores or 0)
+            if not local:
+                continue
+            unit = t
+            if t.parent is not None:
+                if t.parent not in parents:
+                    row = self.session.query_one(
+                        'SELECT * FROM task WHERE id=?', (t.parent,))
+                    parents[t.parent] = Task.from_row(row) \
+                        if row else None
+                unit = parents[t.parent]
+                if unit is None:
+                    continue
+            started = t.started or t.last_activity
+            run_s = max(0.0, (now_dt - started).total_seconds()) \
+                if started else 0.0
+            out.setdefault(t.computer_assigned, []).append({
+                'task_id': int(unit.id), 'unit': unit,
+                'priority': task_priority_of(unit),
+                'cores': local, 'run_s': run_s,
+                'gang': bool(unit.gang_id)})
+        return out
+
+    def _plan_for(self, blocked: dict, rank: int, victims_by_comp,
+                  chosen_ids):
+        """The victim list that lets one blocked ask fit, or []. For a
+        single-node ask: the cheapest viable per-computer plan. For a
+        gang: plan_gang's defragmentation pass over every eligible
+        host. Victims already chosen for an earlier (stronger) blocked
+        task this tick are off the table."""
+        from mlcomp_tpu.server.scheduler import (
+            plan_gang, plan_single_node, victim_cost,
+        )
+        task = blocked['task']
+        eligible = []
+        for comp in self.computers:
+            reason = self._valid_computer(task, comp)
+            # a FULL host is exactly where preemption applies — only
+            # the capacity verdict is ignorable here
+            if reason and not reason.startswith('no free cores'):
+                continue
+            victims = [v for v in victims_by_comp.get(comp['name'], [])
+                       if v['task_id'] not in chosen_ids]
+            eligible.append((comp, victims))
+        if blocked['multi']:
+            hosts = [{'name': comp['name'],
+                      'free': len(self._free_cores(comp)),
+                      'victims': victims}
+                     for comp, victims in eligible]
+            plan, _used = plan_gang(blocked['need'], blocked['grain'],
+                                    hosts, rank)
+            if not plan:
+                return []
+            return [v for evs in plan.values() for v in evs]
+        best = None
+        for comp, victims in eligible:
+            plan = plan_single_node(
+                blocked['need'], len(self._free_cores(comp)),
+                victims, rank)
+            if not plan:        # fits free (not capacity) or no plan
+                continue
+            key = (len(plan), sum(victim_cost(v) for v in plan))
+            if best is None or key < best[0]:
+                best = (key, plan)
+        return best[1] if best else []
+
+    def _preempt_for_blocked(self):
+        """Evict for this tick's capacity-blocked tasks, strongest
+        CLASS first — the aging boost earns earlier dispatch, never
+        the power to evict running work, so an aged ``preemptible``
+        task still cannot preempt. At most MAX_PREEMPTIONS_PER_TICK
+        victims per tick: a burst of high-class asks drains the pool
+        in steps, each step's frees re-placing before the next."""
+        if not self._capacity_blocked:
+            return
+        from mlcomp_tpu.server.scheduler import (
+            MAX_PREEMPTIONS_PER_TICK, PRIORITY_RANK, task_priority_of,
+        )
+        victims_by_comp = self._victim_candidates()
+        if not victims_by_comp:
+            return
+        budget = MAX_PREEMPTIONS_PER_TICK
+        chosen = set()
+        blocked = sorted(
+            self._capacity_blocked,
+            key=lambda b: (-PRIORITY_RANK.get(
+                task_priority_of(b['task']), 1), int(b['task'].id)))
+        for b in blocked:
+            if budget <= 0:
+                break
+            rank = PRIORITY_RANK.get(task_priority_of(b['task']), 1)
+            if rank <= PRIORITY_RANK['preemptible']:
+                continue        # lowest class never evicts anyone
+            plan = self._plan_for(b, rank, victims_by_comp, chosen)
+            for v in plan:
+                if budget <= 0:
+                    break
+                if v['task_id'] in chosen:
+                    continue    # same gang parent on another host:
+                    # one preemption already frees that slice too
+                reason = 'defrag' if b['multi'] else 'capacity'
+                if self._preempt_victim(v['unit'], b['task'], reason,
+                                        v['cores']):
+                    chosen.add(v['task_id'])
+                    budget -= 1
+
+    def _preempt_victim(self, victim: Task, initiator: Task,
+                        reason: str, cores_freed: int) -> bool:
+        """Decision row first, kill second. The conditional insert
+        (unique per victim attempt, epoch-fenced) is the linearization
+        point: whoever records it owns the eviction; everyone else —
+        a raced standby, a zombie ex-leader — records nothing and
+        kills nothing."""
+        from mlcomp_tpu.server.scheduler import task_priority_of
+        epoch = getattr(self.session, 'fence_epoch', None)
+        recorded = self.preemption_provider.record(
+            victim, initiator, reason, cores_freed, epoch,
+            victim_class=task_priority_of(victim),
+            initiator_class=task_priority_of(initiator))
+        if not recorded:
+            return False
+        # crash seam between decision and apply (tests/chaos): a
+        # leader dying HERE leaves the unapplied row repair finishes
+        fault_point('supervisor.preempt', task=victim.id,
+                    initiator=initiator.id)
+        self._apply_preemption(victim, reason)
+        if self.logger:
+            self.logger.warning(
+                f'preempted task {victim.id} ({victim.name}, class '
+                f'{task_priority_of(victim)}) for task {initiator.id} '
+                f'({initiator.name}, class '
+                f'{task_priority_of(initiator)}): {reason}',
+                ComponentType.Supervisor, None, victim.id)
+        return True
+
+    def _apply_preemption(self, victim: Task, reason: str,
+                          repair: bool = False):
+        """Checkpoint-stop one victim: gang-atomic abort for a gang
+        parent (ranks fail as collateral), Failed-with-reason
+        ``preempted`` (transient — the recovery pass requeues with
+        backoff + resume), process tree killed, then the decision row
+        flipped to applied. Every step is idempotent, so a repair
+        re-run after a crash mid-apply converges."""
+        from mlcomp_tpu.server.scheduler import task_priority_of
+        from mlcomp_tpu.worker.tasks import kill_task
+        if victim.gang_id and victim.parent is None:
+            self.gang_abort(victim.id)
+        if int(victim.status) != int(TaskStatus.Failed):
+            self.provider.fail_with_reason(victim, 'preempted')
+        try:
+            kill_task(victim.id, session=self.session)
+        except FenceLostError:
+            raise
+        except Exception:
+            if self.logger:
+                self.logger.error(
+                    f'kill of preempted task {victim.id} failed:\n'
+                    f'{traceback.format_exc()}',
+                    ComponentType.Supervisor)
+        self.preemption_provider.mark_applied(
+            victim.id, victim.attempt or 0)
+        # immediate metric row (not buffered): the exporter's windowed
+        # scan and the dashboard must see the eviction now
+        from mlcomp_tpu.db.providers import MetricProvider
+        try:
+            MetricProvider(self.session).add_many([(
+                victim.id, 'scheduler.preemption', 'counter',
+                victim.attempt or 0, 1.0, now(), 'supervisor',
+                json.dumps({'class': task_priority_of(victim),
+                            'reason': reason,
+                            'repair': int(bool(repair))}))])
+        except Exception:
+            pass            # observability must not block the eviction
+        self.telemetry.count('supervisor.preemptions')
+        self.aux.setdefault('preempted', []).append(
+            {'task': victim.id, 'attempt': victim.attempt or 0,
+             'class': task_priority_of(victim), 'reason': reason,
+             'repair': bool(repair)})
+
     # ---------------------------------------------------------------- aux
     def write_auxiliary(self):
         """Persist the full decision trace
@@ -1314,12 +1717,14 @@ class SupervisorBuilder:
             # messages whose task is gone degrade to class 'train'
             rows = self.session.query(
                 'SELECT qm.created, qm.claimed_at, t.executor, '
-                't.type, t.additional_info FROM queue_message qm '
+                't.type, t.additional_info, t.priority '
+                'FROM queue_message qm '
                 'LEFT JOIN task t ON t.queue_id = qm.id '
                 'WHERE qm.claimed_at IS NOT NULL AND qm.claimed_at > ?',
                 (self._last_claim_ts,))
         except Exception:
             rows = []
+        from mlcomp_tpu.server.scheduler import task_priority_of
         latest = None
         for r in rows:
             created = parse_datetime(r['created'])
@@ -1327,12 +1732,16 @@ class SupervisorBuilder:
             if created and claimed:
                 wait = (claimed - created).total_seconds()
                 tel.observe('supervisor.dispatch_latency_s', wait)
-                cls = task_class_of({'executor': r['executor'],
-                                     'type': r['type'],
-                                     'additional_info':
-                                         r['additional_info']})
-                tel.observe(f'queue.wait_s.{cls}', wait,
-                            buckets=QUEUE_WAIT_BUCKETS_S)
+                row = {'executor': r['executor'], 'type': r['type'],
+                       'additional_info': r['additional_info'],
+                       'priority': r['priority']}
+                cls = task_class_of(row)
+                # class + scheduling-class labels (migration v15): the
+                # exporter splits the trailing segment back into the
+                # priority label on mlcomp_queue_wait_seconds
+                tel.observe(
+                    f'queue.wait_s.{cls}.{task_priority_of(row)}',
+                    wait, buckets=QUEUE_WAIT_BUCKETS_S)
             if claimed and (latest is None or claimed > latest):
                 latest = claimed
         if latest is not None:
@@ -1546,6 +1955,11 @@ class SupervisorBuilder:
             self.load_tasks()
             self.load_computers()
             self.process_tasks()
+            # preemption AFTER placement: it works off the tasks
+            # placement could not fit this tick for capacity reasons;
+            # its frees re-place next tick, where the blocked task
+            # sorts first by class
+            self.process_preemptions()
             # usage AFTER task processing so attempts that went
             # terminal this tick are folded in the same tick
             self.process_usage()

@@ -55,6 +55,7 @@ REQUIRED_FAMILIES = (
     'mlcomp_db_listener_reconnects',
     'mlcomp_usage_core_seconds', 'mlcomp_usage_tasks',
     'mlcomp_queue_wait_seconds', 'mlcomp_queue_max_wait_seconds',
+    'mlcomp_preemptions', 'mlcomp_quota_usage',
     'mlcomp_slo_bad_fraction', 'mlcomp_slo_burn_rate',
     'mlcomp_scrape_errors', 'mlcomp_scrape_duration_seconds',
 )
@@ -719,13 +720,17 @@ def _collect_usage(session, core_samples, task_samples):
 
 def _collect_queue_wait(session, samples):
     """Latest flushed bucket/count/mean rows per scheduling class →
-    one histogram family (``mlcomp_queue_wait_seconds{class=...}``).
-    The supervisor's queue-wait recorder uses cumulative buckets
+    one histogram family (``mlcomp_queue_wait_seconds{class,
+    priority}``). Series names are ``queue.wait_s.<class>.<priority>``
+    since migration v15; a legacy class-only series (no priority
+    segment) exports with priority='normal'. The supervisor's
+    queue-wait recorder uses cumulative buckets
     (telemetry/metrics.py), so the latest snapshot is monotone — same
     protocol as the serving-latency re-export."""
+    from mlcomp_tpu.server.scheduler import PRIORITY_RANK
     pattern = re.compile(
         r'^queue\.wait_s\.(.+)\.(bucket|count|mean)$')
-    latest = {}      # (class, stat, le) -> (id, value)
+    latest = {}      # ((class, priority), stat, le) -> (id, value)
     for r in session.query(
             "SELECT id, name, value, tags FROM metric "
             "WHERE id > (SELECT COALESCE(MAX(id), 0) FROM metric) - ? "
@@ -737,7 +742,12 @@ def _collect_queue_wait(session, samples):
         m = pattern.match(r['name'])
         if m is None:
             continue
-        cls, stat = m.group(1), m.group(2)
+        series, stat = m.group(1), m.group(2)
+        head, _, tail = series.rpartition('.')
+        if head and tail in PRIORITY_RANK:
+            cls, prio = head, tail
+        else:
+            cls, prio = series, 'normal'
         le = None
         if stat == 'bucket':
             try:
@@ -746,26 +756,73 @@ def _collect_queue_wait(session, samples):
                 continue
             if le is None:
                 continue
-        key = (cls, stat, str(le))
+        key = ((cls, prio), stat, str(le))
         if key not in latest or r['id'] > latest[key][0]:
             latest[key] = (r['id'], r['value'])
-    classes = sorted({cls for cls, _, _ in latest})
-    for cls in classes:
+    pairs = sorted({pair for pair, _, _ in latest})
+    for pair in pairs:
+        cls, prio = pair
+        labels = {'class': cls, 'priority': prio}
         buckets = sorted(
-            ((le, v) for (c2, stat, le), (_, v) in latest.items()
-             if c2 == cls and stat == 'bucket'),
+            ((le, v) for (p2, stat, le), (_, v) in latest.items()
+             if p2 == pair and stat == 'bucket'),
             key=lambda kv: float('inf') if kv[0] == '+Inf'
             else float(kv[0]))
         for le, value in buckets:
-            samples.append(('_bucket', {'class': cls, 'le': le},
-                            value))
-        count = latest.get((cls, 'count', 'None'))
+            samples.append(('_bucket', {**labels, 'le': le}, value))
+        count = latest.get((pair, 'count', 'None'))
         if count is not None:
-            samples.append(('_count', {'class': cls}, count[1]))
-            mean = latest.get((cls, 'mean', 'None'))
+            samples.append(('_count', labels, count[1]))
+            mean = latest.get((pair, 'mean', 'None'))
             if mean is not None:
-                samples.append(('_sum', {'class': cls},
-                                mean[1] * count[1]))
+                samples.append(('_sum', labels, mean[1] * count[1]))
+
+
+def _collect_preemptions(session, samples):
+    """``mlcomp_preemptions_total{class,reason}`` from the v15
+    preemption audit table — durable counter semantics (one row per
+    eviction decision, exactly-once per victim attempt), like the
+    sweep-prune family. ``class`` is the VICTIM's scheduling class."""
+    if not session.table_columns('preemption'):
+        return
+    for r in session.query(
+            'SELECT victim_class, reason, COUNT(*) AS n '
+            'FROM preemption GROUP BY victim_class, reason '
+            'ORDER BY victim_class, reason'):
+        samples.append((
+            '_total',
+            {'class': r['victim_class'] or 'unknown',
+             'reason': r['reason'] or 'unknown'}, r['n']))
+
+
+def _collect_quota(session, samples):
+    """``mlcomp_quota_usage{scope,tenant,resource,kind}`` — every
+    configured quota ceiling (kind=limit) next to the usage admission
+    measures it against (kind=used): live held cores, or core-seconds
+    settled in the tenant's ledger window. Tenants without a quota row
+    are absent by design — unlimited has no ceiling to burn."""
+    if not session.table_columns('quota'):
+        return
+    from mlcomp_tpu.db.providers.quota import QuotaProvider
+    qp = QuotaProvider(session)
+    cache = {}
+    for q in qp.all():
+        labels = {'scope': q.scope, 'tenant': q.tenant,
+                  'resource': q.resource}
+        samples.append(('', {**labels, 'kind': 'limit'},
+                        float(q.limit_value or 0.0)))
+        if q.resource == 'cores':
+            key = ('live', q.scope)
+            if key not in cache:
+                cache[key] = qp.live_cores(q.scope)
+            used = cache[key].get(q.tenant, 0)
+        else:
+            window = float(q.window_s or 86400.0)
+            key = ('window', q.scope, window)
+            if key not in cache:
+                cache[key] = qp.window_core_seconds(q.scope, window)
+            used = cache[key].get(q.tenant, 0.0)
+        samples.append(('', {**labels, 'kind': 'used'}, float(used)))
 
 
 def _collect_queue_max_wait(session, samples):
@@ -904,6 +961,7 @@ def collect_server_families(session):
     leader, epoch, failovers, fenced, reconnects = [], [], [], [], []
     usage_cores, usage_tasks = [], []
     qwait, qmax, slo_bad, slo_burn = [], [], [], []
+    preemptions, quota = [], []
     guarded('tasks', _collect_tasks, session, tasks)
     guarded('queue_depth', _collect_queue_depth, session, queues)
     guarded('worker_slots', _collect_worker_slots, session, slots)
@@ -931,6 +989,8 @@ def collect_server_families(session):
             usage_tasks)
     guarded('queue_wait', _collect_queue_wait, session, qwait)
     guarded('queue_max_wait', _collect_queue_max_wait, session, qmax)
+    guarded('preemptions', _collect_preemptions, session, preemptions)
+    guarded('quota', _collect_quota, session, quota)
     guarded('slo', _collect_slo, session, slo_bad, slo_burn)
     running = []
     errors.setdefault('running_tasks', 0)
@@ -1066,6 +1126,16 @@ def collect_server_families(session):
                'age of the oldest still-pending dispatch per '
                'scheduling class (starvation gauge, 0 = empty queue)',
                qmax),
+        family('mlcomp_preemptions', 'counter',
+               'checkpoint-preemption evictions by victim class and '
+               'reason (preemption audit table — durable counter, '
+               'exactly-once per victim attempt; migration v15)',
+               preemptions),
+        family('mlcomp_quota_usage', 'gauge',
+               'fair-share quota ceilings (kind=limit) and the usage '
+               'admission measures against them (kind=used) per '
+               'scope/tenant/resource — absent tenant = unlimited',
+               quota),
         family('mlcomp_slo_bad_fraction', 'gauge',
                'latest instantaneous SLI bad-fraction per SLO '
                'objective (telemetry/slo.py)', slo_bad),

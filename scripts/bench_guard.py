@@ -112,6 +112,25 @@ FLOORS = {
     'dag_grid_asha_audit_ok': ('min', 1.0,
                                'every prune audited exactly once, no '
                                'pruned cell retried (1 = holds)'),
+    # round-15 legs (ISSUE 20: multi-tenant scheduling). The jax-free
+    # preempt leg (bench.py bench_preempt) seeds a full 8-core host of
+    # preemptible cells, then times a high-class arrival through
+    # decision-row + checkpoint-kill + replacement dispatch across two
+    # in-process supervisor ticks — milliseconds on a dev box; the
+    # floor leaves room for a loaded CI runner. The steady-state
+    # passes (drained preemption scan; priority + fair-share dispatch
+    # ordering over a 200-deep queue) are per-tick control-loop costs
+    # held to the same budget discipline as the economy passes.
+    'preempt_to_dispatch_ms': ('max', 1000.0,
+                               'full-host eviction + replacement '
+                               'dispatch, two in-process ticks'),
+    'preempt_drained_overhead_pct': ('max', 1.0,
+                                     'drained preemption pass vs the '
+                                     '1 s supervisor tick %'),
+    'sched_order_overhead_pct': ('max', 5.0,
+                                 'priority/fair-share dispatch '
+                                 'ordering, 200-deep queue, vs the '
+                                 '1 s tick %'),
     # round-8 leg (ISSUE 12: deep-step observability). The per-step
     # HBM timeline must stay effectively free — the sampler is one
     # allocator-stats read per reporting device (telemetry/memory.py),

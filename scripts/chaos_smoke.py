@@ -88,6 +88,18 @@ simulated by rewinding the stored timestamps, never by sleeping):
    leader failover (old leader's tick replayed by the new one) and
    the bill comes out EXACTLY ONCE — one ledger row per (task,
    attempt) across the whole scenario history
+12. mixed-workload preemption (multi-tenant scheduling, migration v15
+   + server/scheduler.py): a high-class gang trainer and a
+   preemptible ASHA sweep fill a 2-host pool to the last core, then a
+   high-class serving fleet arrives needing room NOW — the preemption
+   engine evicts EXACTLY the checkpointable sweep cells (decision row
+   recorded first, exactly once per victim attempt, then the kill),
+   never the equal-class gang; the replicas place on the freed cores
+   the next tick; the victims requeue EXACTLY ONCE with
+   resume-from-checkpoint info through the normal transient-retry
+   path; and ``mlcomp_preemptions_total`` plus bounded per-class
+   ``mlcomp_queue_max_wait_seconds`` starvation gauges are visible on
+   /metrics
 """
 
 import datetime
@@ -1102,6 +1114,171 @@ def scenario_slo_burn_and_usage_fold(session):
           str([(r['task'], r['n']) for r in dup]))
 
 
+def scenario_mixed_workload_preemption(session):
+    """Mixed workload on one 2-host pool: a high-class gang trainer
+    (12 of 16 cores) plus a preemptible 4-cell ASHA sweep fill it
+    completely; a high-class serving fleet then needs 4 cores NOW.
+    The engine must evict exactly the 4 sweep cells — cheapest first,
+    decision row before the kill, one row per victim attempt — leave
+    the equal-class gang alone, place the replicas on the freed cores
+    next tick, and requeue the victims exactly once with resume info
+    through the normal transient-retry path."""
+    from mlcomp_tpu.db.models import Dag, Sweep
+    from mlcomp_tpu.db.providers import (
+        DagProvider, ProjectProvider, ReplicaProvider, SweepProvider,
+    )
+    from mlcomp_tpu.server.fleet import FleetConfig, create_fleet
+
+    # retire earlier scenarios' hosts, fleets and sweeps: this
+    # scenario's eviction arithmetic is about ITS OWN 16-core pool
+    session.execute('UPDATE computer SET can_process_tasks=0')
+    session.execute(
+        "UPDATE serve_fleet SET status='stopped', desired=0")
+    session.execute("UPDATE sweep SET status='stopped'")
+    add_computer(session, 'mix_a')
+    add_computer(session, 'mix_b')
+    tp = TaskProvider(session)
+    qp = QueueProvider(session)
+    cfg = RecoveryConfig(lease_seconds=3600, backoff_base_s=0,
+                         max_retries=3)
+    sup = SupervisorBuilder(
+        session=session, recovery_config=cfg,
+        fleet_config=FleetConfig(probe_interval_s=3600.0))
+
+    # the gang trainer: explicitly high-class — it holds most of the
+    # pool and must NOT be what an equal-class replica evicts
+    gang = Task(name='mix_gang', executor='noop', cores=12,
+                cores_max=12, single_node=False, priority='high',
+                additional_info='distr: true\n',
+                status=int(TaskStatus.NotRan), last_activity=now())
+    tp.add(gang)
+    sup.build()
+    ranks = tp.children(gang.id)
+    check('gang trainer fanned out across both hosts (12 cores)',
+          len(ranks) == 2
+          and {r.computer_assigned for r in ranks} ==
+          {'mix_a', 'mix_b'},
+          str(sup.aux.get('not_placed')))
+    for r in ranks:
+        qp.claim([f'{r.computer_assigned}_default'],
+                 f'{r.computer_assigned}:0')
+        tp.change_status(r, TaskStatus.InProgress)
+
+    # the ASHA sweep: 4 preemptible cells soak up the last 4 cores
+    project = ProjectProvider(session).add_project('chaos_mixed')
+    # config empty (not a dict): submit-gate preflight is out of
+    # scope here — these cells arrive pre-built, like scenario 10's
+    dag = Dag(name='chaos_mixed', project=project.id, config='',
+              created=now())
+    DagProvider(session).add(dag)
+    sweep = Sweep(dag=dag.id, executor='mix_cells',
+                  name='chaos_mixed/cells', metric='score', mode='max',
+                  eta=2.0, rung_base=1, unit='epochs',
+                  min_cells_per_rung=2, cells=4, status='active',
+                  created=now())
+    SweepProvider(session).add(sweep)
+    cells = []
+    for i in range(4):
+        cell = Task(name=f'mix_cell_{i}', executor='mix_cells',
+                    dag=dag.id, cores=1, cores_max=1,
+                    additional_info=f'sweep: {sweep.id}\n',
+                    status=int(TaskStatus.NotRan), last_activity=now())
+        tp.add(cell)
+        cells.append(cell)
+    sup.build()
+    cells = [tp.by_id(c.id) for c in cells]
+    check('sweep cells filled the pool to the last core',
+          all(c.status == int(TaskStatus.Queued) for c in cells),
+          str([(c.id, TaskStatus(c.status).name,
+                c.computer_assigned) for c in cells]))
+    for c in cells:
+        qp.claim([f'{c.computer_assigned}_default'],
+                 f'{c.computer_assigned}:0')
+        tp.change_status(c, TaskStatus.InProgress)
+
+    # the serving fleet arrives on the FULL pool: 2 high-class
+    # replicas x 2 cores; its spawn tick is the contention tick
+    fleet = create_fleet(session, 'mix_fleet', 'stub_model',
+                         desired=2, cores=2)
+    sup.build()
+    decisions = session.query('SELECT * FROM preemption ORDER BY id')
+    cell_ids = sorted(c.id for c in cells)
+    check('exactly one applied decision row per evicted cell',
+          sorted(d['task'] for d in decisions) == cell_ids
+          and all(d['applied'] == 1 and d['attempt'] == 0
+                  and d['victim_class'] == 'preemptible'
+                  and d['reason'] == 'capacity' for d in decisions),
+          str([(d['task'], d['attempt'], d['applied'],
+                d['victim_class'], d['reason']) for d in decisions]))
+    cells = [tp.by_id(c.id) for c in cells]
+    check('victims failed with the transient preempted reason',
+          all(c.status == int(TaskStatus.Failed)
+              and c.failure_reason == 'preempted' for c in cells),
+          str([(c.id, c.failure_reason) for c in cells]))
+    gang_rows = [tp.by_id(gang.id)] + \
+        [tp.by_id(r.id) for r in ranks]
+    check('equal-class gang trainer untouched by the eviction',
+          all(g.status != int(TaskStatus.Failed)
+              and g.failure_reason is None for g in gang_rows),
+          str([(g.id, g.status, g.failure_reason)
+               for g in gang_rows]))
+
+    # next tick: the freed cores place both replicas
+    sup.build()
+    replicas = ReplicaProvider(session).of_fleet(fleet.id)
+    rtasks = [tp.by_id(r.task) for r in replicas]
+    check('replicas placed on the freed cores within one tick',
+          len(rtasks) == 2
+          and all(t.status == int(TaskStatus.Queued)
+                  and t.computer_assigned == 'mix_b' for t in rtasks),
+          str([(t.id, t.status, t.computer_assigned)
+               for t in rtasks]))
+
+    # the victims ride the normal retry path: backoff scheduled, then
+    # (deadline rewound — never slept on) requeued with resume info,
+    # EXACTLY once — attempt 1, one decision row per cell, forever
+    for c in cells:
+        session.execute(
+            'UPDATE task SET next_retry_at=? WHERE id=?',
+            (now() - datetime.timedelta(seconds=1), c.id))
+    sup.build()
+    cells = [tp.by_id(c.id) for c in cells]
+    check('preempted cells requeued exactly once with resume info',
+          all((c.attempt or 0) == 1
+              and (yaml_load(c.additional_info) or {}).get(
+                  'resume', {}).get('load_last') is True
+              for c in cells),
+          str([(c.id, c.attempt, c.additional_info) for c in cells]))
+    sup.build()      # an extra tick must not double-preempt/requeue
+    n_rows = session.query(
+        'SELECT COUNT(*) AS n FROM preemption')[0]['n']
+    cells = [tp.by_id(c.id) for c in cells]
+    check('no double preemption or double requeue on later ticks',
+          n_rows == 4 and all((c.attempt or 0) == 1 for c in cells),
+          f'rows={n_rows} '
+          f'attempts={[(c.id, c.attempt) for c in cells]}')
+
+    sup.telemetry.flush()
+    from mlcomp_tpu.server.scheduler import AGING_STEP_S
+    from mlcomp_tpu.telemetry.export import (
+        parse_openmetrics, render_server_metrics,
+    )
+    doc = parse_openmetrics(render_server_metrics(session))
+    pre = doc.get('mlcomp_preemptions', {}).get('samples', [])
+    check('mlcomp_preemptions_total on /metrics', any(
+        labels.get('class') == 'preemptible'
+        and labels.get('reason') == 'capacity' and v == 4
+        for _, labels, v in pre), str(pre))
+    waits = doc.get('mlcomp_queue_max_wait_seconds', {}) \
+        .get('samples', [])
+    bound = 3 * AGING_STEP_S        # the aging anti-starvation bound
+    check('per-class max wait bounded below the aging ceiling',
+          waits and any(labels.get('class') == 'sweep'
+                        for _, labels, _ in waits)
+          and all(v < bound for _, _, v in waits),
+          str(waits))
+
+
 def main():
     session = Session.create_session(key='chaos_smoke')
     migrate(session)
@@ -1115,6 +1292,7 @@ def main():
     scenario_supervisor_failover(session)
     scenario_sweep_prune_failover(session)
     scenario_slo_burn_and_usage_fold(session)
+    scenario_mixed_workload_preemption(session)
     if FAILURES:
         print(f'FAIL: {len(FAILURES)} scenario check(s): {FAILURES}')
         return 1

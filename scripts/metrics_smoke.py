@@ -194,14 +194,35 @@ def seed(session):
                   finished=now(), last_activity=now())
     tp.add(billed)
     assert UsageProvider(session).fold_task(billed)
+    # multi-tenant scheduling (migration v15): a fair-share ceiling,
+    # and one applied checkpoint-preemption decision for the audit
+    # counter family
+    from mlcomp_tpu.db.providers import PreemptionProvider, QuotaProvider
+    QuotaProvider(session).set_quota('owner', 'smoke_owner', 'cores', 8)
+    pp = PreemptionProvider(session)
+    assert pp.record(task, None, 'capacity', 2, epoch=2,
+                     victim_class='preemptible',
+                     initiator_class='high')
+    assert pp.mark_applied(task.id, task.attempt or 0)
     # queue-wait histogram + starvation gauge rows (what a supervisor
-    # tick flushes) and an SLO evaluation's SLI/burn gauges
+    # tick flushes) and an SLO evaluation's SLI/burn gauges; the
+    # class.priority series is what a v15 supervisor writes, the bare
+    # class series checks the legacy fallback (priority='normal')
     MetricProvider(session).add_many(
         [(None, 'queue.wait_s.train.bucket', 'histogram', None, n, ts,
           'supervisor', json.dumps({'of': 'queue.wait_s.train',
                                     'le': le}))
          for le, n in ((5.0, 1), (60.0, 3), ('+Inf', 3))]
-        + [(None, 'queue.wait_s.train.count', 'histogram', None, 3.0,
+        + [(None, 'queue.wait_s.sweep.preemptible.bucket', 'histogram',
+            None, n, ts, 'supervisor',
+            json.dumps({'of': 'queue.wait_s.sweep.preemptible',
+                        'le': le}))
+           for le, n in ((5.0, 2), ('+Inf', 4))]
+        + [(None, 'queue.wait_s.sweep.preemptible.count', 'histogram',
+            None, 4.0, ts, 'supervisor', None),
+           (None, 'queue.wait_s.sweep.preemptible.mean', 'histogram',
+            None, 30.0, ts, 'supervisor', None),
+           (None, 'queue.wait_s.train.count', 'histogram', None, 3.0,
             ts, 'supervisor', None),
            (None, 'queue.wait_s.train.mean', 'histogram', None, 18.0,
             ts, 'supervisor', None),
@@ -345,9 +366,27 @@ def main():
         ('mlcomp_usage_tasks', any(
             l.get('owner') == 'smoke_owner' and v == 1
             for _, l, v in doc['mlcomp_usage_tasks']['samples'])),
-        ('mlcomp_queue_wait_seconds buckets', any(
-            l.get('class') == 'train' and l.get('le') == '+Inf'
-            for l in sample_labels('mlcomp_queue_wait_seconds'))),
+        ('mlcomp_queue_wait_seconds legacy series -> priority=normal',
+         any(l.get('class') == 'train' and l.get('le') == '+Inf'
+             and l.get('priority') == 'normal'
+             for l in sample_labels('mlcomp_queue_wait_seconds'))),
+        ('mlcomp_queue_wait_seconds priority-labeled buckets', any(
+            l.get('class') == 'sweep' and l.get('le') == '+Inf'
+            and l.get('priority') == 'preemptible' and v == 4
+            for _, l, v in
+            doc['mlcomp_queue_wait_seconds']['samples'])),
+        ('mlcomp_preemptions_total class/reason', any(
+            l.get('class') == 'preemptible'
+            and l.get('reason') == 'capacity' and v == 1
+            for _, l, v in doc['mlcomp_preemptions']['samples'])),
+        ('mlcomp_quota_usage limit sample', any(
+            l.get('scope') == 'owner' and l.get('tenant') == 'smoke_owner'
+            and l.get('resource') == 'cores' and l.get('kind') == 'limit'
+            and v == 8
+            for _, l, v in doc['mlcomp_quota_usage']['samples'])),
+        ('mlcomp_quota_usage used sample', any(
+            l.get('tenant') == 'smoke_owner' and l.get('kind') == 'used'
+            for l in sample_labels('mlcomp_quota_usage'))),
         ('mlcomp_queue_max_wait_seconds', any(
             l.get('class') == 'train' and v == 42.0
             for _, l, v in

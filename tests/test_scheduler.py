@@ -1,0 +1,481 @@
+"""Multi-tenant scheduling tests (migration v15): the pure policy
+module (server/scheduler.py), the quota/preemption providers, the
+v14→v15 upgrade-in-place, priority-ordered dispatch, quota admission,
+and the preemption engine's exactly-once + crash-repair guarantees."""
+
+import datetime
+import json
+import sqlite3
+import uuid
+
+import pytest
+
+from mlcomp_tpu.db.core import Session
+from mlcomp_tpu.db.enums import TaskStatus
+from mlcomp_tpu.db.models import Computer, Task
+from mlcomp_tpu.db.providers import (
+    ComputerProvider, DockerProvider, TaskProvider,
+)
+from mlcomp_tpu.db.providers.quota import (
+    PreemptionProvider, QuotaProvider,
+)
+from mlcomp_tpu.server.scheduler import (
+    AGING_STEP_S, PRIORITY_RANK, dispatch_order_key, effective_rank,
+    eligible_victims, normalize_priority, pack_candidates, plan_gang,
+    plan_single_node, quota_block, task_priority_of,
+)
+from mlcomp_tpu.server.supervisor import SupervisorBuilder
+from mlcomp_tpu.utils.misc import now
+
+
+def add_computer(session, name='host1', cores=8):
+    ComputerProvider(session).create_or_update(
+        Computer(name=name, cores=cores, cpu=16, memory=64,
+                 ip='127.0.0.1', can_process_tasks=True), 'name')
+    DockerProvider(session).heartbeat(name, 'default')
+
+
+def add_task(session, name='t', cores=1, status=TaskStatus.NotRan,
+             priority=None, owner=None, computer_assigned=None,
+             cores_assigned=None, additional_info=None, **kw):
+    task = Task(name=name, executor='noop', cores=cores,
+                cores_max=cores, status=int(status), priority=priority,
+                owner=owner, computer_assigned=computer_assigned,
+                cores_assigned=cores_assigned,
+                additional_info=additional_info,
+                last_activity=now(), **kw)
+    TaskProvider(session).add(task)
+    return task
+
+
+def occupy(session, name, computer, cores_list, priority=None,
+           additional_info=None, owner=None):
+    """An InProgress task holding specific cores on a computer."""
+    return add_task(
+        session, name=name, cores=len(cores_list),
+        status=TaskStatus.InProgress, priority=priority, owner=owner,
+        computer_assigned=computer,
+        cores_assigned=json.dumps(cores_list),
+        additional_info=additional_info, started=now())
+
+
+# -------------------------------------------------------------- policy
+class TestPolicy:
+    def test_normalize_priority(self):
+        assert normalize_priority('High') == 'high'
+        assert normalize_priority(None) is None
+        assert normalize_priority('', default='normal') == 'normal'
+        with pytest.raises(ValueError):
+            normalize_priority('urgent')
+
+    def test_class_defaults_and_explicit_override(self):
+        sweep_cell = {'executor': 'cells', 'additional_info': 'sweep: 3'}
+        assert task_priority_of(sweep_cell) == 'preemptible'
+        assert task_priority_of({'executor': 'serve_replica'}) == 'high'
+        assert task_priority_of({'executor': 'train'}) == 'normal'
+        # the explicit v15 column beats the class default
+        assert task_priority_of(
+            {'executor': 'serve_replica',
+             'priority': 'preemptible'}) == 'preemptible'
+
+    def test_aging_escalates_and_caps(self):
+        assert effective_rank('preemptible', 0.0) == 0
+        assert effective_rank('preemptible', AGING_STEP_S) == 1
+        # bounded: never past critical no matter the wait
+        assert effective_rank('preemptible', 100 * AGING_STEP_S) == \
+            PRIORITY_RANK['critical']
+
+    def test_dispatch_order_class_share_then_age(self):
+        now_dt = now()
+        crit = Task(id=9, priority='critical', last_activity=now_dt)
+        norm = Task(id=1, priority='normal', last_activity=now_dt)
+        norm2 = Task(id=2, priority='normal', last_activity=now_dt)
+        order = sorted([norm2, crit, norm],
+                       key=lambda t: dispatch_order_key(t, now_dt))
+        assert [t.id for t in order] == [9, 1, 2]
+        # among equals the lighter fair-share consumer goes first
+        assert dispatch_order_key(norm2, now_dt, usage_share=0.1) < \
+            dispatch_order_key(norm, now_dt, usage_share=0.9)
+
+    def test_quota_block_edges(self):
+        limits = {('owner', 'alice', 'cores'): (2.0, 86400.0),
+                  ('owner', 'mallory', 'cores'): (0.0, 86400.0),
+                  ('project', 'p', 'core_seconds'): (100.0, 3600.0)}
+        # unknown tenant: no row, unlimited
+        assert quota_block('normal', 8, 'bob', None, limits, {}, {}) \
+            is None
+        # at the ceiling: refused
+        assert 'quota' in quota_block(
+            'normal', 1, 'alice', None, limits,
+            {('owner', 'alice'): 2}, {})
+        # explicit zero locks out entirely
+        assert 'quota' in quota_block(
+            'normal', 1, 'mallory', None, limits, {}, {})
+        # spent core-seconds window blocks the project scope
+        assert 'core-seconds' in quota_block(
+            'normal', 1, 'bob', 'p', limits, {},
+            {('project', 'p'): 150.0})
+        # critical work is exempt from every ceiling
+        assert quota_block('critical', 9, 'mallory', 'p', limits,
+                           {('owner', 'mallory'): 99},
+                           {('project', 'p'): 999.0}) is None
+
+    def test_eligible_victims_strict_class_only(self):
+        victims = [{'task_id': 1, 'priority': 'preemptible'},
+                   {'task_id': 2, 'priority': 'normal'},
+                   {'task_id': 3, 'priority': 'high'}]
+        got = eligible_victims(victims, PRIORITY_RANK['high'])
+        assert [v['task_id'] for v in got] == [1, 2]
+        # preemptible-rank blockers evict nobody, aged or not
+        assert eligible_victims(victims,
+                                PRIORITY_RANK['preemptible']) == []
+
+    def test_plan_single_node(self):
+        victims = [
+            {'task_id': 1, 'priority': 'preemptible', 'cores': 1,
+             'run_s': 10.0},
+            {'task_id': 2, 'priority': 'preemptible', 'cores': 4,
+             'run_s': 500.0},
+            {'task_id': 3, 'priority': 'normal', 'cores': 2,
+             'run_s': 5.0},
+        ]
+        assert plan_single_node(2, 4, victims, 2) == []   # already fits
+        # cheapest eligible victim alone covers the gap — stop there
+        plan = plan_single_node(2, 1, victims, 2)
+        assert [v['task_id'] for v in plan] == [1]
+        # lowest class first, then cost — NOT the cheap normal one
+        plan = plan_single_node(4, 1, victims, 2)
+        assert [v['task_id'] for v in plan] == [1, 2]
+        assert plan_single_node(99, 0, victims, 2) is None
+
+    def test_plan_gang_consolidates_fewest_hosts(self):
+        hosts = [
+            {'name': 'a', 'free': 0, 'victims': [
+                {'task_id': 1, 'priority': 'preemptible', 'cores': 4,
+                 'run_s': 1.0}]},
+            {'name': 'b', 'free': 4, 'victims': []},
+            {'name': 'c', 'free': 1, 'victims': []},
+        ]
+        plan, used = plan_gang(8, 4, hosts, PRIORITY_RANK['normal'])
+        assert set(plan) == {'a', 'b'}      # c's 1 core never needed
+        assert [v['task_id'] for v in plan['a']] == [1]
+        assert plan['b'] == []
+        assert plan_gang(99, 4, hosts, PRIORITY_RANK['normal']) == \
+            (None, [])
+
+    def test_pack_candidates(self):
+        fits = [('big', 8), ('tight', 2), ('small', 1)]
+        # single-node best-fit: tightest FULL fit first, undersized last
+        assert [c for c, _ in pack_candidates(fits, 2, False)] == \
+            ['tight', 'big', 'small']
+        # gangs and spread replicas want the most-free order
+        assert [c for c, _ in pack_candidates(fits, 2, True)] == \
+            ['big', 'tight', 'small']
+        assert [c for c, _ in pack_candidates(
+            fits, 2, False, spread=True)] == ['big', 'tight', 'small']
+
+
+# ----------------------------------------------------------- providers
+class TestQuotaProvider:
+    def test_set_get_delete_and_edges(self, session):
+        qp = QuotaProvider(session)
+        assert qp.limit_for('owner', 'nobody', 'cores') is None
+        q = qp.set_quota('owner', 'alice', 'cores', 4)
+        assert q.limit_value == 4.0
+        qp.set_quota('owner', 'alice', 'cores', 8, window_s=60.0)
+        assert qp.limit_for('owner', 'alice', 'cores') == 8.0
+        # explicit zero is a lockout, not "unlimited"
+        qp.set_quota('owner', 'mallory', 'cores', 0)
+        assert qp.limit_for('owner', 'mallory', 'cores') == 0.0
+        with pytest.raises(ValueError):
+            qp.set_quota('team', 'x', 'cores', 1)
+        with pytest.raises(ValueError):
+            qp.set_quota('owner', 'x', 'gpus', 1)
+        assert qp.delete('owner', 'alice', 'cores') is True
+        assert qp.delete('owner', 'alice', 'cores') is False
+
+    def test_live_cores_skips_fanned_out_parents(self, session):
+        qp = QuotaProvider(session)
+        occupy(session, 'solo', 'h1', [0, 1], owner='alice')
+        parent = add_task(session, 'gang', cores=4, owner='bob',
+                          status=TaskStatus.Queued)
+        child = occupy(session, 'rank0', 'h1', [2, 3, 4, 5],
+                       owner='bob')
+        child.parent = parent.id
+        TaskProvider(session).update(child, ['parent'])
+        live = qp.live_cores('owner')
+        # the parent's ask is not double-billed over its live ranks
+        assert live == {'alice': 2, 'bob': 4}
+
+    def test_window_core_seconds_honors_window(self, session):
+        qp = QuotaProvider(session)
+        old = now() - datetime.timedelta(seconds=7200)
+        for tid, owner, cs, finished in ((1, 'alice', 100.0, now()),
+                                         (2, 'alice', 900.0, old),
+                                         (3, 'bob', 50.0, now())):
+            session.execute(
+                'INSERT INTO usage (task, attempt, owner, '
+                'core_seconds, finished, created) '
+                'VALUES (?, 0, ?, ?, ?, ?)',
+                (tid, owner, cs, finished, finished))
+        got = qp.window_core_seconds('owner', window_s=3600.0)
+        assert got == {'alice': 100.0, 'bob': 50.0}
+
+    def test_preemption_record_exactly_once(self, session):
+        pp = PreemptionProvider(session)
+        victim = add_task(session, 'v', status=TaskStatus.InProgress)
+        boss = add_task(session, 'b', priority='high')
+        assert pp.record(victim, boss, 'capacity', 2, epoch=1,
+                         victim_class='preemptible',
+                         initiator_class='high') is True
+        # second record for the same attempt: zero rows, no error
+        assert pp.record(victim, boss, 'capacity', 2, epoch=1) is False
+        # the unique index backstops even a raw racing insert
+        with pytest.raises(sqlite3.IntegrityError):
+            session.execute(
+                'INSERT INTO preemption (task, attempt, applied, '
+                'time) VALUES (?, ?, 0, ?)',
+                (victim.id, victim.attempt or 0, now()))
+        assert pp.mark_applied(victim.id, 0) is True
+        assert pp.mark_applied(victim.id, 0) is False
+        assert pp.unapplied() == []
+        # a NEW attempt is a new eviction decision
+        victim.attempt = 1
+        assert pp.record(victim, boss, 'capacity', 2, epoch=1) is True
+
+
+# ----------------------------------------------------------- migration
+class TestMigrationV15:
+    def test_v14_to_v15_upgrade_in_place(self, tmp_path):
+        from mlcomp_tpu.db.migration import MIGRATIONS, migrate
+        key = f'v15_{uuid.uuid4().hex[:8]}'
+        s = Session.create_session(
+            key=key, connection_string=f'sqlite:///{tmp_path}/up.db')
+        try:
+            s.execute('CREATE TABLE IF NOT EXISTS migration_version '
+                      '(version INTEGER)')
+            for i, fn in enumerate(MIGRATIONS[:14], start=1):
+                fn(s)
+                s.execute('INSERT INTO migration_version (version) '
+                          'VALUES (?)', (i,))
+            # a live v14 deployment: dags, tasks and a fleet, none of
+            # them knowing about priority classes
+            s.execute('INSERT INTO dag ("name", "config", "created") '
+                      'VALUES (?, ?, ?)', ('legacy_dag', '', now()))
+            s.execute(
+                'INSERT INTO task ("name", "executor", "status", '
+                '"additional_info", "last_activity") '
+                'VALUES (?, ?, ?, ?, ?)',
+                ('legacy_cell', 'cells', int(TaskStatus.InProgress),
+                 'sweep: 1\n', now()))
+            s.execute(
+                'INSERT INTO serve_fleet ("name", "model", "desired", '
+                '"created") VALUES (?, ?, 1, ?)',
+                ('legacy_fleet', 'm', now()))
+            assert migrate(s) == len(MIGRATIONS)
+            row = s.query_one('SELECT MAX(version) AS v '
+                              'FROM migration_version')
+            assert row['v'] == len(MIGRATIONS)
+            for table in ('dag', 'task', 'serve_fleet'):
+                assert 'priority' in s.table_columns(table)
+            assert s.table_columns('quota')
+            assert s.table_columns('preemption')
+            # legacy rows keep NULL priority and read the CLASS
+            # default — today's policy, not a frozen backfill
+            legacy = s.query_one(
+                'SELECT * FROM task WHERE name=?', ('legacy_cell',))
+            assert legacy['priority'] is None
+            assert task_priority_of(dict(legacy)) == 'preemptible'
+            # the exactly-once backstop arrived with the table
+            s.execute('INSERT INTO preemption (task, attempt, '
+                      'applied, time) VALUES (1, 0, 0, ?)', (now(),))
+            with pytest.raises(sqlite3.IntegrityError):
+                s.execute('INSERT INTO preemption (task, attempt, '
+                          'applied, time) VALUES (1, 0, 0, ?)',
+                          (now(),))
+            # idempotent re-run
+            assert migrate(s) == len(MIGRATIONS)
+        finally:
+            Session.cleanup(key)
+
+
+# ------------------------------------------------------------ dispatch
+class TestPriorityDispatch:
+    def test_strongest_class_dispatches_first(self, session):
+        add_computer(session, cores=2)
+        weak = add_task(session, 'weak', cores=2,
+                        priority='preemptible')
+        mid = add_task(session, 'mid', cores=2)
+        strong = add_task(session, 'strong', cores=2,
+                          priority='critical')
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        tp = TaskProvider(session)
+        assert tp.by_id(strong.id).status == int(TaskStatus.Queued)
+        assert tp.by_id(mid.id).status == int(TaskStatus.NotRan)
+        assert tp.by_id(weak.id).status == int(TaskStatus.NotRan)
+
+    def test_quota_admission_refuses_at_ceiling(self, session):
+        add_computer(session, cores=8)
+        QuotaProvider(session).set_quota('owner', 'alice', 'cores', 2)
+        occupy(session, 'held', 'host1', [0, 1], owner='alice')
+        blocked = add_task(session, 'over', cores=2, owner='alice')
+        other = add_task(session, 'fine', cores=2, owner='bob')
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        tp = TaskProvider(session)
+        assert tp.by_id(blocked.id).status == int(TaskStatus.NotRan)
+        assert 'quota' in sup.aux['not_placed'][blocked.id]
+        # the ceiling shapes ONE tenant, not the pool
+        assert tp.by_id(other.id).status == int(TaskStatus.Queued)
+
+    def test_same_tick_burst_cannot_leak_past_ceiling(self, session):
+        add_computer(session, cores=8)
+        QuotaProvider(session).set_quota('owner', 'alice', 'cores', 2)
+        first = add_task(session, 'a1', cores=2, owner='alice')
+        second = add_task(session, 'a2', cores=2, owner='alice')
+        SupervisorBuilder(session=session).build()
+        tp = TaskProvider(session)
+        statuses = sorted([tp.by_id(first.id).status,
+                           tp.by_id(second.id).status])
+        assert statuses == [int(TaskStatus.NotRan),
+                            int(TaskStatus.Queued)]
+
+
+# ---------------------------------------------------------- preemption
+class TestPreemption:
+    def test_full_pool_preempts_lower_class(self, session):
+        add_computer(session, cores=2)
+        victim = occupy(session, 'cell', 'host1', [0, 1],
+                        additional_info='sweep: 1\n')
+        boss = add_task(session, 'replica', cores=2, priority='high')
+        sup = SupervisorBuilder(session=session)
+        sup.build()
+        tp = TaskProvider(session)
+        victim = tp.by_id(victim.id)
+        assert victim.status == int(TaskStatus.Failed)
+        assert victim.failure_reason == 'preempted'
+        rows = session.query('SELECT * FROM preemption')
+        assert len(rows) == 1
+        assert rows[0]['task'] == victim.id
+        assert rows[0]['initiator'] == boss.id
+        assert rows[0]['applied'] == 1
+        assert rows[0]['victim_class'] == 'preemptible'
+        # the freed cores place the initiator next tick
+        sup.build()
+        assert tp.by_id(boss.id).status == int(TaskStatus.Queued)
+
+    def test_equal_class_never_evicted(self, session):
+        add_computer(session, cores=2)
+        occupy(session, 'peer', 'host1', [0, 1], priority='high')
+        add_task(session, 'replica', cores=2, priority='high')
+        SupervisorBuilder(session=session).build()
+        assert session.query('SELECT * FROM preemption') == []
+
+    def test_aged_preemptible_blocker_evicts_nobody(self, session):
+        add_computer(session, cores=2)
+        occupy(session, 'running', 'host1', [0, 1],
+               additional_info='sweep: 1\n')
+        stale = add_task(session, 'starved', cores=2,
+                         additional_info='sweep: 2\n')
+        # waited past every aging step: dispatch order escalates, the
+        # power to evict running work must not
+        session.execute(
+            'UPDATE task SET last_activity=? WHERE id=?',
+            (now() - datetime.timedelta(seconds=50 * AGING_STEP_S),
+             stale.id))
+        SupervisorBuilder(session=session).build()
+        assert session.query('SELECT * FROM preemption') == []
+
+    def test_budget_bounds_evictions_per_tick(self, session):
+        from mlcomp_tpu.server.scheduler import (
+            MAX_PREEMPTIONS_PER_TICK,
+        )
+        n = MAX_PREEMPTIONS_PER_TICK + 4
+        add_computer(session, cores=n)
+        for i in range(n):
+            occupy(session, f'cell{i}', 'host1', [i],
+                   additional_info='sweep: 1\n')
+        add_task(session, 'big', cores=n, priority='critical')
+        SupervisorBuilder(session=session).build()
+        rows = session.query('SELECT COUNT(*) AS n FROM preemption')
+        assert rows[0]['n'] == MAX_PREEMPTIONS_PER_TICK
+
+    def test_leader_killed_mid_preempt_repaired_exactly_once(
+            self, session):
+        """The acceptance shape: a leader dies BETWEEN recording the
+        decision and applying the kill (the ``supervisor.preempt``
+        seam sits exactly there). The standby's repair pass must
+        finish the eviction — never double-preempt, never lose the
+        victim."""
+        from mlcomp_tpu.testing.faults import (
+            clear_faults, configure_faults,
+        )
+        add_computer(session, cores=2)
+        victim = occupy(session, 'cell', 'host1', [0, 1],
+                        additional_info='sweep: 1\n')
+        boss = add_task(session, 'replica', cores=2, priority='high')
+        configure_faults({'supervisor.preempt': {
+            'action': 'raise', 'after': 1, 'times': 1}})
+        try:
+            SupervisorBuilder(session=session).build()
+        finally:
+            clear_faults()
+        tp = TaskProvider(session)
+        rows = session.query('SELECT * FROM preemption')
+        assert len(rows) == 1 and rows[0]['applied'] == 0
+        assert tp.by_id(victim.id).status == \
+            int(TaskStatus.InProgress)   # decision yes, kill no
+
+        # the standby's tick: repair finishes the recorded eviction,
+        # and its own preempt pass records nothing new
+        standby = SupervisorBuilder(session=session)
+        standby.build()
+        victim = tp.by_id(victim.id)
+        assert victim.status == int(TaskStatus.Failed)
+        assert victim.failure_reason == 'preempted'
+        rows = session.query('SELECT * FROM preemption')
+        assert len(rows) == 1 and rows[0]['applied'] == 1
+        standby.build()     # extra ticks stay idempotent
+        assert session.query(
+            'SELECT COUNT(*) AS n FROM preemption')[0]['n'] == 1
+        assert tp.by_id(boss.id).status == int(TaskStatus.Queued)
+
+    def test_repair_closes_stale_decision_without_rekill(self,
+                                                         session):
+        """A recorded decision whose victim already moved on (newer
+        attempt) is closed without action — re-killing it would be
+        the double preemption the audit trail exists to prevent."""
+        add_computer(session, cores=4)
+        victim = occupy(session, 'cell', 'host1', [0],
+                        additional_info='sweep: 1\n')
+        boss = add_task(session, 'replica', cores=1, priority='high')
+        pp = PreemptionProvider(session)
+        assert pp.record(victim, boss, 'capacity', 1, epoch=1)
+        # the victim retried meanwhile: attempt bumped
+        session.execute('UPDATE task SET attempt=1 WHERE id=?',
+                        (victim.id,))
+        SupervisorBuilder(session=session).build()
+        row = session.query_one(
+            'SELECT * FROM preemption WHERE task=?', (victim.id,))
+        assert row['applied'] == 1
+        fresh = TaskProvider(session).by_id(victim.id)
+        assert fresh.status == int(TaskStatus.InProgress)
+        assert fresh.failure_reason is None
+
+    def test_zombie_leader_preemption_fenced(self, session):
+        """A demoted ex-leader replaying its eviction at a stale
+        epoch: the store-side fence kills the decision insert, so
+        nothing is recorded and nobody dies."""
+        from mlcomp_tpu.db.fencing import FencedSession, FenceLostError
+        from mlcomp_tpu.server.ha import StaticLease
+        session.execute(
+            'UPDATE supervisor_lease SET epoch=5, holder=? WHERE id=1',
+            ('live:leader:xyz',))
+        victim = add_task(session, 'v', status=TaskStatus.InProgress)
+        boss = add_task(session, 'b', priority='high')
+        zombie = PreemptionProvider(
+            FencedSession(session, StaticLease(3)))
+        with pytest.raises(FenceLostError):
+            zombie.record(victim, boss, 'capacity', 1, epoch=3)
+        assert session.query('SELECT * FROM preemption') == []

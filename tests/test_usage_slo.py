@@ -437,10 +437,10 @@ class TestMigrationV14:
                 ('legacy', 'train', int(TaskStatus.Success),
                  finished - datetime.timedelta(seconds=60), finished,
                  '[0, 1, 2, 3]', now()))
-            assert migrate(s) == 14
+            assert migrate(s) == len(MIGRATIONS)
             row = s.query_one('SELECT MAX(version) AS v '
                               'FROM migration_version')
-            assert row['v'] == 14
+            assert row['v'] == len(MIGRATIONS)
             assert 'owner' in s.table_columns('dag')
             assert {'owner', 'project'} <= s.table_columns('task')
             # the history arrived folded, with defaulted labels
@@ -454,7 +454,7 @@ class TestMigrationV14:
                     'INSERT INTO usage (task, attempt) VALUES (?, ?)',
                     (billed.task, 0))
             # re-running migrate is a no-op (idempotent DDL + fold)
-            assert migrate(s) == 14
+            assert migrate(s) == len(MIGRATIONS)
             assert up.count() == 1
         finally:
             Session.cleanup(key)
